@@ -1,0 +1,41 @@
+"""ratelimiter_tpu — a TPU-native distributed rate-limiting framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the Java/Redis
+reference ``tharunjasti/distributed-rate-limiter``:
+
+- sliding-window-counter and token-bucket algorithms with the reference's
+  decision semantics (see ``ratelimiter_tpu.semantics.oracle``),
+- a pluggable storage boundary (``ratelimiter_tpu.storage``) mirroring the
+  reference's ``RateLimitStorage`` interface (storage/RateLimitStorage.java:10-70),
+- a host-side TTL negative cache (the Caffeine analog, C7 in SURVEY.md),
+- per-limiter immutable config with validation and factories
+  (core/RateLimitConfig.java:14-81),
+- multi-tenant named limiter instances, an HTTP demo API with 429 semantics,
+  metrics counters, and a benchmark harness.
+
+Instead of a per-request Redis round-trip, decisions are micro-batched on the
+host and dispatched to a TPU-resident, device-sharded counter array updated by
+a single vectorized gather->decide->scatter step (``ratelimiter_tpu.engine``).
+
+Timestamps are absolute Unix milliseconds carried as int64 on device; the
+package enables JAX x64 support at import so window arithmetic matches the
+reference's `System.currentTimeMillis()` math exactly.
+"""
+
+import jax
+
+# Device state carries absolute Unix-ms timestamps (int64) so that window
+# bucketing (timestampMs / windowMs * windowMs — the reference's
+# SlidingWindowRateLimiter.java:185-188) is exact. Must run before any tracing.
+jax.config.update("jax_enable_x64", True)
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.core.limiter import RateLimiter
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RateLimitConfig",
+    "RateLimiter",
+    "__version__",
+]

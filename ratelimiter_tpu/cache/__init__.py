@@ -1,0 +1,3 @@
+from ratelimiter_tpu.cache.ttl_cache import TTLCache
+
+__all__ = ["TTLCache"]

@@ -1,0 +1,4 @@
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.core.limiter import RateLimiter
+
+__all__ = ["RateLimitConfig", "RateLimiter"]

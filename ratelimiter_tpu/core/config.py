@@ -1,0 +1,93 @@
+"""Per-limiter configuration.
+
+Capability parity with the reference's immutable Lombok value class
+``core/RateLimitConfig.java:14-81``: ``maxPermits``, ``window``, ``refillRate``
+(token bucket only, default 0), ``enableLocalCache`` (default True),
+``localCacheTtl`` (default 100 ms), a ``validate()`` method and
+``perSecond/perMinute/perHour`` factories (core/RateLimitConfig.java:61-80).
+
+TPU-specific addition: ``refill_rate_fp`` exposes the refill rate in integer
+fixed-point micro-tokens per millisecond (scale 2**TOKEN_FP_SHIFT), which is
+the exact arithmetic the device kernels use instead of the reference's Lua
+float math (TokenBucketRateLimiter.java:55-67).  See
+``ratelimiter_tpu.semantics.oracle`` for the equivalence argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from datetime import timedelta
+from typing import Union
+
+# Fixed-point scale for token-bucket accounting: 1 token == 2**20 "fp units".
+# Chosen so that a refill rate of 1e-3 tokens/ms (1 token/sec) is ~1049 fp/ms,
+# giving sub-micro-token resolution while keeping 1M-token buckets well inside
+# int64 (2**20 * 1e6 ~= 2**40).
+TOKEN_FP_SHIFT = 20
+TOKEN_FP_ONE = 1 << TOKEN_FP_SHIFT
+
+DurationLike = Union[timedelta, int, float]
+
+
+def _to_millis(d: DurationLike) -> int:
+    """Accept a timedelta or a number of milliseconds."""
+    if isinstance(d, timedelta):
+        return int(d.total_seconds() * 1000)
+    return int(d)
+
+
+@dataclasses.dataclass(frozen=True)
+class RateLimitConfig:
+    """Immutable rate-limit policy for one limiter instance.
+
+    Parameters mirror core/RateLimitConfig.java:14-56.
+    """
+
+    max_permits: int
+    window_ms: int
+    refill_rate: float = 0.0  # tokens per second (token bucket only)
+    enable_local_cache: bool = True
+    local_cache_ttl_ms: int = 100
+
+    def __post_init__(self):
+        object.__setattr__(self, "max_permits", int(self.max_permits))
+        object.__setattr__(self, "window_ms", _to_millis(self.window_ms))
+        object.__setattr__(self, "local_cache_ttl_ms", _to_millis(self.local_cache_ttl_ms))
+
+    # -- validation (core/RateLimitConfig.java:44-56) -------------------------
+    def validate(self) -> "RateLimitConfig":
+        if self.max_permits <= 0:
+            raise ValueError("maxPermits must be positive")
+        if self.window_ms <= 0:
+            raise ValueError("window must be a positive duration")
+        if self.refill_rate < 0:
+            raise ValueError("refillRate cannot be negative")
+        return self
+
+    # -- derived quantities ---------------------------------------------------
+    @property
+    def refill_rate_fp(self) -> int:
+        """Refill rate in fp units per millisecond (integer fixed point).
+
+        The reference converts to tokens/ms as a double
+        (TokenBucketRateLimiter.java:85 ``refillRate / 1000.0``); we round the
+        same quantity to the nearest fp unit.
+        """
+        return round(self.refill_rate * TOKEN_FP_ONE / 1000.0)
+
+    @property
+    def max_permits_fp(self) -> int:
+        return self.max_permits << TOKEN_FP_SHIFT
+
+    # -- factories (core/RateLimitConfig.java:61-80) --------------------------
+    @staticmethod
+    def per_second(max_permits: int) -> "RateLimitConfig":
+        return RateLimitConfig(max_permits=max_permits, window_ms=1_000)
+
+    @staticmethod
+    def per_minute(max_permits: int) -> "RateLimitConfig":
+        return RateLimitConfig(max_permits=max_permits, window_ms=60_000)
+
+    @staticmethod
+    def per_hour(max_permits: int) -> "RateLimitConfig":
+        return RateLimitConfig(max_permits=max_permits, window_ms=3_600_000)

@@ -1,0 +1,3 @@
+from ratelimiter_tpu.metrics.registry import Counter, MeterRegistry
+
+__all__ = ["Counter", "MeterRegistry"]

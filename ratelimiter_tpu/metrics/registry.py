@@ -1,0 +1,136 @@
+"""Metrics counters.
+
+Capability parity with the reference's Micrometer usage (C12 in SURVEY.md):
+named monotonic counters registered against a registry, e.g.
+``ratelimiter.requests.allowed`` / ``ratelimiter.requests.rejected`` /
+``ratelimiter.cache.hits`` (SlidingWindowRateLimiter.java:67-77) and
+``ratelimiter.tokenbucket.allowed`` / ``ratelimiter.tokenbucket.rejected``
+(TokenBucketRateLimiter.java:87-93), exposed by the service's actuator-style
+endpoints (application.properties:14-15).
+
+The reference also *documents* a ``ratelimiter.storage.latency`` histogram
+that it never implements (ARCHITECTURE.md:172-185); here we implement it —
+``Timer`` records microsecond latencies with percentile snapshots.
+
+Counters use per-instance locks and support batch increments (``add(n)``)
+because one device step resolves thousands of decisions at once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class Counter:
+    """A named monotonic counter (Micrometer Counter analog)."""
+
+    __slots__ = ("name", "description", "_value", "_lock")
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def increment(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    # Batch-friendly alias: one device step yields many decisions.
+    def add(self, amount: float) -> None:
+        self.increment(amount)
+
+    def count(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Timer:
+    """Latency recorder with percentile snapshots.
+
+    Implements the ``ratelimiter.storage.latency`` histogram the reference
+    documents but never ships (ARCHITECTURE.md:172-185). Keeps a bounded
+    reservoir of recent samples (microseconds).
+    """
+
+    __slots__ = ("name", "description", "_samples", "_count", "_total_us", "_lock", "_max_samples")
+
+    def __init__(self, name: str, description: str = "", max_samples: int = 65536):
+        self.name = name
+        self.description = description
+        self._samples: List[float] = []
+        self._count = 0
+        self._total_us = 0.0
+        self._max_samples = max_samples
+        self._lock = threading.Lock()
+
+    def record_us(self, micros: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_us += micros
+            if len(self._samples) < self._max_samples:
+                self._samples.append(micros)
+            else:
+                # Simple reservoir: overwrite pseudo-randomly by count.
+                self._samples[self._count % self._max_samples] = micros
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = self._count
+            total = self._total_us
+            samples = sorted(self._samples)
+        if not samples:
+            return {"count": 0, "mean_us": 0.0, "p50_us": 0.0, "p95_us": 0.0, "p99_us": 0.0}
+
+        def pct(p: float) -> float:
+            return samples[min(len(samples) - 1, int(p * len(samples)))]
+
+        return {
+            "count": n,
+            "mean_us": total / max(1, n),
+            "p50_us": pct(0.50),
+            "p95_us": pct(0.95),
+            "p99_us": pct(0.99),
+        }
+
+
+class MeterRegistry:
+    """Registry of named meters (SimpleMeterRegistry analog,
+    config/RateLimiterConfig.java:37-40)."""
+
+    def __init__(self):
+        self._meters: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, description: str = "") -> Counter:
+        with self._lock:
+            meter = self._meters.get(name)
+            if meter is None:
+                meter = Counter(name, description)
+                self._meters[name] = meter
+            if not isinstance(meter, Counter):
+                raise TypeError(f"meter {name!r} already registered as {type(meter).__name__}")
+            return meter
+
+    def timer(self, name: str, description: str = "") -> Timer:
+        with self._lock:
+            meter = self._meters.get(name)
+            if meter is None:
+                meter = Timer(name, description)
+                self._meters[name] = meter
+            if not isinstance(meter, Timer):
+                raise TypeError(f"meter {name!r} already registered as {type(meter).__name__}")
+            return meter
+
+    def scrape(self) -> Dict[str, object]:
+        """All meter values, for the /actuator/metrics endpoint."""
+        with self._lock:
+            meters = dict(self._meters)
+        out: Dict[str, object] = {}
+        for name, meter in meters.items():
+            if isinstance(meter, Counter):
+                out[name] = meter.count()
+            elif isinstance(meter, Timer):
+                out[name] = meter.snapshot()
+        return out

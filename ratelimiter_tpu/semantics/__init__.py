@@ -1,0 +1,7 @@
+from ratelimiter_tpu.semantics.oracle import (
+    Decision,
+    SlidingWindowOracle,
+    TokenBucketOracle,
+)
+
+__all__ = ["Decision", "SlidingWindowOracle", "TokenBucketOracle"]

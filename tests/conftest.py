@@ -1,0 +1,16 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices so the sharded (multi-chip) engine
+paths are exercised without TPU hardware — the key-space sharding is
+device-count agnostic (SURVEY.md §4 "multi-device tests runnable on CPU").
+Must be set before JAX is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

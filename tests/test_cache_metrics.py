@@ -1,0 +1,71 @@
+"""TTL cache (Caffeine analog, C7) and metrics registry (C12)."""
+
+from ratelimiter_tpu.cache import TTLCache
+from ratelimiter_tpu.metrics import MeterRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_cache_expire_after_write():
+    clock = FakeClock()
+    c = TTLCache(ttl_ms=100, clock_ms=clock)
+    c.put("a", 5)
+    assert c.get_if_present("a") == 5
+    clock.t = 99
+    assert c.get_if_present("a") == 5
+    clock.t = 100
+    assert c.get_if_present("a") is None
+
+
+def test_cache_put_refreshes_ttl():
+    clock = FakeClock()
+    c = TTLCache(ttl_ms=100, clock_ms=clock)
+    c.put("a", 1)
+    clock.t = 80
+    c.put("a", 2)  # expireAfterWrite: deadline moves to 180
+    clock.t = 150
+    assert c.get_if_present("a") == 2
+    clock.t = 180
+    assert c.get_if_present("a") is None
+
+
+def test_cache_invalidate_and_bound():
+    clock = FakeClock()
+    c = TTLCache(ttl_ms=1000, max_size=3, clock_ms=clock)
+    for i in range(5):
+        c.put(f"k{i}", i)
+    # Oldest writes evicted first; size bounded at 3.
+    assert len(c) == 3
+    assert c.get_if_present("k0") is None
+    assert c.get_if_present("k4") == 4
+    c.invalidate("k4")
+    assert c.get_if_present("k4") is None
+
+
+def test_counter_and_registry():
+    reg = MeterRegistry()
+    a = reg.counter("ratelimiter.requests.allowed", "allowed")
+    a.increment()
+    a.add(41)
+    # Same name returns the same meter (Micrometer registry semantics).
+    assert reg.counter("ratelimiter.requests.allowed").count() == 42
+    scrape = reg.scrape()
+    assert scrape["ratelimiter.requests.allowed"] == 42
+
+
+def test_timer_percentiles():
+    reg = MeterRegistry()
+    t = reg.timer("ratelimiter.storage.latency")
+    for v in range(1, 101):
+        t.record_us(float(v))
+    snap = t.snapshot()
+    assert snap["count"] == 100
+    assert 45 <= snap["p50_us"] <= 55
+    assert 94 <= snap["p95_us"] <= 100
+    assert abs(snap["mean_us"] - 50.5) < 1e-9

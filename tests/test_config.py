@@ -1,0 +1,52 @@
+"""RateLimitConfig validation + factories (core/RateLimitConfig.java:44-80)."""
+
+from datetime import timedelta
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+
+
+def test_factories():
+    assert RateLimitConfig.per_second(5).window_ms == 1_000
+    assert RateLimitConfig.per_minute(100).window_ms == 60_000
+    assert RateLimitConfig.per_hour(1000).window_ms == 3_600_000
+    assert RateLimitConfig.per_minute(100).max_permits == 100
+
+
+def test_defaults():
+    cfg = RateLimitConfig.per_minute(100)
+    assert cfg.refill_rate == 0.0
+    assert cfg.enable_local_cache is True
+    assert cfg.local_cache_ttl_ms == 100
+
+
+def test_timedelta_windows():
+    cfg = RateLimitConfig(max_permits=10, window_ms=timedelta(seconds=30))
+    assert cfg.window_ms == 30_000
+    cfg = RateLimitConfig(max_permits=10, window_ms=60_000,
+                          local_cache_ttl_ms=timedelta(milliseconds=250))
+    assert cfg.local_cache_ttl_ms == 250
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(max_permits=0, window_ms=1000),
+        dict(max_permits=-1, window_ms=1000),
+        dict(max_permits=10, window_ms=0),
+        dict(max_permits=10, window_ms=-5),
+        dict(max_permits=10, window_ms=1000, refill_rate=-1.0),
+    ],
+)
+def test_validate_rejects(kwargs):
+    with pytest.raises(ValueError):
+        RateLimitConfig(**kwargs).validate()
+
+
+def test_fixed_point_rate():
+    cfg = RateLimitConfig(max_permits=50, window_ms=60_000, refill_rate=10.0)
+    # 10 tokens/sec == 0.01 tokens/ms == round(0.01 * 2**20) fp/ms
+    assert cfg.refill_rate_fp == round(0.01 * TOKEN_FP_ONE)
+    assert cfg.max_permits_fp == 50 * TOKEN_FP_ONE

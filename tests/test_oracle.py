@@ -1,0 +1,316 @@
+"""Oracle semantics tests.
+
+Ports the scenarios of the reference's (disabled) unit test class
+``SlidingWindowRateLimiterTest.java:27-199`` against the pure-Python oracle,
+plus: quirk Q1/Q2 behaviors, PEXPIRE-accurate previous-window expiry, token
+bucket refill/burst/TTL, and float-emulation differential property tests
+backing the integer-arithmetic claims in ``semantics/oracle.py``.
+"""
+
+import random
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.core.config import TOKEN_FP_SHIFT
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+
+T0 = 1_753_000_000_000  # fixed epoch for determinism (aligned tests offset it)
+
+
+def sw(max_permits=10, window_ms=60_000):
+    return SlidingWindowOracle(
+        RateLimitConfig(max_permits=max_permits, window_ms=window_ms,
+                        enable_local_cache=False))
+
+
+def tb(max_permits=50, window_ms=60_000, refill_rate=10.0):
+    return TokenBucketOracle(
+        RateLimitConfig(max_permits=max_permits, window_ms=window_ms,
+                        refill_rate=refill_rate))
+
+
+# ---------------------------------------------------------------------------
+# Sliding window: reference test scenarios
+# ---------------------------------------------------------------------------
+
+def test_allows_requests_under_limit():
+    # SlidingWindowRateLimiterTest.java:50-64
+    o = sw(max_permits=10)
+    now = (T0 // 60_000) * 60_000  # window-aligned: no prev-window bleed
+    for i in range(10):
+        assert o.try_acquire("user1", 1, now + i).allowed, f"request {i}"
+
+
+def test_rejects_when_limit_reached_without_increment():
+    # SlidingWindowRateLimiterTest.java:67-78 — at the limit, the request is
+    # rejected pre-increment (no storage mutation).
+    o = sw(max_permits=10)
+    now = (T0 // 60_000) * 60_000
+    for i in range(10):
+        o.try_acquire("user1", 1, now + i)
+    d = o.try_acquire("user1", 1, now + 50)
+    assert not d.allowed and not d.mutated
+    assert d.observed == 10
+
+
+def test_multi_permit_acquire():
+    # SlidingWindowRateLimiterTest.java:81-100
+    o = sw(max_permits=10)
+    now = (T0 // 60_000) * 60_000
+    d = o.try_acquire("user1", 5, now)
+    assert d.allowed
+    # Quirk Q1: the counter rose by 1, not 5 — estimate is now 1.
+    assert o.current_count("user1", now) == 1
+    # permits=10 still passes the pre-check (1 + 10 > 10 -> reject).
+    assert not o.try_acquire("user1", 10, now + 1).allowed
+
+
+def test_available_permits():
+    # SlidingWindowRateLimiterTest.java:103-111
+    o = sw(max_permits=10)
+    now = (T0 // 60_000) * 60_000
+    assert o.get_available_permits("user1", now) == 10
+    for i in range(3):
+        o.try_acquire("user1", 1, now + i)
+    assert o.get_available_permits("user1", now + 3) == 7
+
+
+def test_reset_clears_both_windows():
+    # SlidingWindowRateLimiterTest.java:114-122
+    o = sw(max_permits=10, window_ms=1000)
+    now = (T0 // 1000) * 1000 + 500
+    # Populate previous window and current window.
+    for i in range(4):
+        o.try_acquire("user1", 1, now - 1000 + i)
+    for i in range(4):
+        o.try_acquire("user1", 1, now + i)
+    assert o.current_count("user1", now + 10) > 0
+    o.reset("user1", now + 10)
+    assert o.current_count("user1", now + 10) == 0
+    assert o.get_available_permits("user1", now + 10) == 10
+
+
+def test_invalid_permits_raise():
+    # SlidingWindowRateLimiterTest.java:125-132
+    o = sw()
+    with pytest.raises(ValueError):
+        o.try_acquire("user1", 0, T0)
+    with pytest.raises(ValueError):
+        o.try_acquire("user1", -1, T0)
+
+
+# ---------------------------------------------------------------------------
+# Sliding window: weighting, rollover, expiry
+# ---------------------------------------------------------------------------
+
+def test_weighted_estimate_mid_window():
+    # 100 req in window W; at 30s into W+1 the prev weight is 0.5.
+    o = sw(max_permits=1000, window_ms=60_000)
+    w0 = (T0 // 60_000) * 60_000
+    # Increment late in the window so the bucket's TTL (last incr + window)
+    # survives the reads below (PEXPIRE semantics).
+    for i in range(100):
+        assert o.try_acquire("u", 1, w0 + 59_000 + i).allowed
+    mid = w0 + 60_000 + 30_000
+    assert o.current_count("u", mid) == 50  # 100 * 0.5
+    q3 = w0 + 60_000 + 45_000
+    assert o.current_count("u", q3) == 25  # 100 * 0.25
+
+
+def test_quirk_q2_count_then_reject():
+    # Q2: the post-increment check uses the RAW current-bucket counter; a
+    # request passing the pre-check can be counted then rejected when the raw
+    # bucket alone exceeds max.  Construct: prev bleed keeps estimate low is
+    # impossible (prev only adds); instead use multi-permits pre-check slack:
+    # raw bucket == max via increments, then estimate < raw impossible...
+    # The real Q2 trigger is concurrent interleaving in the reference; in
+    # sequential semantics it triggers when est < raw count cannot happen, so
+    # verify the guard equivalence instead: after max increments, the
+    # pre-check always fires first.
+    o = sw(max_permits=3, window_ms=60_000)
+    w0 = (T0 // 60_000) * 60_000
+    for i in range(3):
+        assert o.try_acquire("u", 1, w0 + i).allowed
+    d = o.try_acquire("u", 1, w0 + 10)
+    assert not d.allowed and not d.mutated
+
+
+def test_prev_window_pexpire_semantics():
+    # The previous bucket vanishes `window` ms after its LAST increment —
+    # not at the 2x-window boundary (RedisRateLimitStorage.java:38-49).
+    o = sw(max_permits=1000, window_ms=1000)
+    w0 = (T0 // 1000) * 1000
+    # Last increment at w0+100 -> bucket expires at w0+1100.
+    for i in range(10):
+        o.try_acquire("u", 1, w0 + 91 + i)
+    # At w0+1050 (in next window), prev bucket still alive: weight=0.95
+    assert o.current_count("u", w0 + 1050) == int(10 * 0.95)
+    # At w0+1100 the prev bucket is expired even though window math would
+    # still weight it until w0+2000.
+    assert o.current_count("u", w0 + 1100) == 0
+
+
+def test_rollover_two_windows_clears_all():
+    o = sw(max_permits=1000, window_ms=1000)
+    w0 = (T0 // 1000) * 1000
+    for i in range(5):
+        o.try_acquire("u", 1, w0 + i)
+    assert o.current_count("u", w0 + 2000) == 0
+
+
+# ---------------------------------------------------------------------------
+# Token bucket
+# ---------------------------------------------------------------------------
+
+def test_tb_initial_burst_and_deny():
+    o = tb(max_permits=50, refill_rate=10.0)
+    d = o.try_acquire("u", 50, T0)  # full burst allowed from a fresh bucket
+    assert d.allowed and d.remaining_hint == 0
+    assert not o.try_acquire("u", 1, T0).allowed  # drained
+
+
+def test_tb_refill_rate():
+    o = tb(max_permits=50, refill_rate=10.0)
+    o.try_acquire("u", 50, T0)
+    # 10 tokens/sec -> after 500 ms, 5 tokens.
+    assert o.get_available_permits("u", T0 + 500) == 5
+    assert o.try_acquire("u", 5, T0 + 500).allowed
+    assert not o.try_acquire("u", 1, T0 + 500).allowed
+
+
+def test_tb_cap_clipping():
+    o = tb(max_permits=50, refill_rate=10.0)
+    o.try_acquire("u", 10, T0)
+    # After a long idle, tokens cap at capacity.
+    assert o.get_available_permits("u", T0 + 3_600_000) == 50
+
+
+def test_tb_permits_above_capacity_rejected_without_storage():
+    o = tb(max_permits=50, refill_rate=10.0)
+    d = o.try_acquire("u", 51, T0)
+    assert not d.allowed and not d.mutated
+    # Bucket untouched: still full.
+    assert o.try_acquire("u", 50, T0 + 1).allowed
+
+
+def test_tb_deny_does_not_refresh_ttl():
+    # TTL (2x window) is refreshed only by the allow branch; a denied request
+    # leaves the old deadline, after which the bucket re-inits to capacity.
+    o = tb(max_permits=10, window_ms=1000, refill_rate=1.0)
+    o.try_acquire("u", 10, T0)  # allow: deadline = T0 + 2000
+    d = o.try_acquire("u", 5, T0 + 1000)  # deny (only 1 token): no refresh
+    assert not d.allowed
+    # At T0+2000 the bucket expired -> fresh full bucket.
+    assert o.try_acquire("u", 10, T0 + 2000).allowed
+
+
+def test_tb_deny_leaves_refill_idempotent():
+    # Denies don't write back, but refill recomputation is observationally
+    # identical (associativity in exact fp arithmetic).
+    o1 = tb(max_permits=50, refill_rate=7.3)
+    o2 = tb(max_permits=50, refill_rate=7.3)
+    o1.try_acquire("u", 50, T0)
+    o2.try_acquire("u", 50, T0)
+    # o1 issues intermediate denied probes; o2 doesn't.
+    for dt in (100, 250, 333):
+        o1.try_acquire("u", 50, T0 + dt)
+    for dt in (1000, 2000, 5000):
+        a1 = o1.try_acquire("u", 9, T0 + dt)
+        a2 = o2.try_acquire("u", 9, T0 + dt)
+        assert (a1.allowed, a1.remaining_hint) == (a2.allowed, a2.remaining_hint)
+
+
+def test_tb_reset():
+    o = tb(max_permits=50, refill_rate=10.0)
+    o.try_acquire("u", 50, T0)
+    o.reset("u", T0)
+    assert o.try_acquire("u", 50, T0 + 1).allowed
+
+
+def test_tb_invalid_permits():
+    o = tb()
+    with pytest.raises(ValueError):
+        o.try_acquire("u", 0, T0)
+
+
+def test_tb_requires_refill_rate():
+    with pytest.raises(ValueError):
+        TokenBucketOracle(RateLimitConfig(max_permits=10, window_ms=1000))
+
+
+# ---------------------------------------------------------------------------
+# Float-emulation differential property tests
+# ---------------------------------------------------------------------------
+
+def _java_estimate(prev: int, curr: int, now: int, win: int) -> int:
+    """(long)(prev * (1.0 - (now % win)/win) + curr) — the Java double math
+    (SlidingWindowRateLimiter.java:170-174)."""
+    pct = float(now % win) / float(win)
+    return int(prev * (1.0 - pct) + curr)
+
+
+def test_sw_integer_estimate_matches_java_double_math():
+    rng = random.Random(42)
+    mismatch = 0
+    for _ in range(200_000):
+        win = rng.choice([1000, 60_000, 3_600_000])
+        prev = rng.randrange(0, 100_000)
+        curr = rng.randrange(0, 100_000)
+        now = T0 + rng.randrange(0, 10 * win)
+        rem = now % win
+        ours = curr + (prev * (win - rem)) // win
+        theirs = _java_estimate(prev, curr, now, win)
+        if ours != theirs:
+            mismatch += 1
+            # Every divergence must be the documented boundary: the exact
+            # weighted product is an integer and the double rounds just
+            # below it, so Java truncates one lower than the exact floor.
+            assert (prev * (win - rem)) % win == 0, (prev, rem, win)
+            assert ours == theirs + 1, (ours, theirs)
+    assert mismatch / 200_000 < 1e-4
+
+
+class _LuaTokenBucket:
+    """Double-arithmetic emulation of the Lua script
+    (TokenBucketRateLimiter.java:38-68)."""
+
+    def __init__(self, capacity: float, refill_per_sec: float, window_ms: int):
+        self.capacity = float(capacity)
+        self.rate_ms = refill_per_sec / 1000.0
+        self.window_ms = window_ms
+        self.state = None  # (tokens: float, last_refill: int, deadline: int)
+
+    def try_acquire(self, permits: int, now: int) -> bool:
+        if permits > self.capacity:
+            return False
+        if self.state is None or now >= self.state[2]:
+            tokens, last = self.capacity, now
+        else:
+            tokens, last, _ = self.state
+        tokens = min(self.capacity, tokens + (now - last) * self.rate_ms)
+        if tokens >= permits:
+            tokens -= permits
+            self.state = (tokens, now, now + 2 * self.window_ms)
+            return True
+        return False
+
+
+def test_tb_fixed_point_matches_lua_double_math():
+    rng = random.Random(7)
+    total = agree = 0
+    for trial in range(300):
+        cap = rng.choice([10, 50, 1000])
+        rate = rng.choice([1.0, 10.0, 97.5, 1000.0])
+        win = 60_000
+        ours = tb(max_permits=cap, window_ms=win, refill_rate=rate)
+        lua = _LuaTokenBucket(cap, rate, win)
+        now = T0
+        for _ in range(300):
+            now += rng.randrange(0, 500)
+            p = rng.randrange(1, cap + 1)
+            total += 1
+            agree += ours.try_acquire("k", p, now).allowed == lua.try_acquire(p, now)
+    # Fixed-point rounding can flip knife-edge decisions only; demand
+    # essentially full agreement.
+    assert agree / total > 0.9995, f"{agree}/{total}"
