@@ -19,12 +19,17 @@ import dataclasses
 from datetime import timedelta
 from typing import Union
 
-# Fixed-point scale for token-bucket accounting: 1 token == 2**20 "fp units".
-# Chosen so that a refill rate of 1e-3 tokens/ms (1 token/sec) is ~1049 fp/ms,
-# giving sub-micro-token resolution while keeping 1M-token buckets well inside
-# int64 (2**20 * 1e6 ~= 2**40).
+# Fixed-point scale for token-bucket accounting: 1 token == 1000*2**20 "fp
+# units".  The factor 1000 makes the tokens/sec -> tokens/ms conversion exact
+# in integers: the refill rate becomes round(refill_rate * 2**20) fp-units per
+# millisecond — an integer with NO rounding for any rate of the form k/2**20
+# (all integral and most practical fractional rates) — and a refill is then a
+# pure multiply with no division, so fixed-point token values coincide exactly
+# with the mathematical rational semantics.  Billion-token buckets still fit
+# int64 (1000*2**20*1e9 ~= 2**60); the refill clamps elapsed time (see
+# semantics/oracle.py) so device int64 arithmetic cannot overflow.
 TOKEN_FP_SHIFT = 20
-TOKEN_FP_ONE = 1 << TOKEN_FP_SHIFT
+TOKEN_FP_ONE = 1000 << TOKEN_FP_SHIFT  # fp units per whole token
 
 DurationLike = Union[timedelta, int, float]
 
@@ -67,17 +72,20 @@ class RateLimitConfig:
     # -- derived quantities ---------------------------------------------------
     @property
     def refill_rate_fp(self) -> int:
-        """Refill rate in fp units per millisecond (integer fixed point).
+        """Refill rate in fp units per MILLISECOND (integer fixed point).
 
-        The reference converts to tokens/ms as a double
-        (TokenBucketRateLimiter.java:85 ``refillRate / 1000.0``); we round the
-        same quantity to the nearest fp unit.
+        Equals round(refill_rate * 2**TOKEN_FP_SHIFT): exact (no rounding)
+        whenever refill_rate is k/2**TOKEN_FP_SHIFT — in particular for every
+        integral rate — because TOKEN_FP_ONE carries the factor 1000.  The
+        reference converts tokens/sec to tokens/ms as a double
+        (TokenBucketRateLimiter.java:85); this is the same quantity with the
+        rounding done once at config time instead of every refill.
         """
-        return round(self.refill_rate * TOKEN_FP_ONE / 1000.0)
+        return round(self.refill_rate * (1 << TOKEN_FP_SHIFT))
 
     @property
     def max_permits_fp(self) -> int:
-        return self.max_permits << TOKEN_FP_SHIFT
+        return self.max_permits * TOKEN_FP_ONE
 
     # -- factories (core/RateLimitConfig.java:61-80) --------------------------
     @staticmethod

@@ -58,7 +58,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Tuple
 
-from ratelimiter_tpu.core.config import RateLimitConfig, TOKEN_FP_SHIFT
+from ratelimiter_tpu.core.config import RateLimitConfig, TOKEN_FP_ONE
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,10 +182,16 @@ class TokenBucketOracle:
         return tokens_fp, last_refill
 
     def _refilled(self, key: str, now_ms: int) -> int:
+        """Refill = min(cap, tokens + elapsed_ms * rate_fp) — a pure integer
+        multiply (rate_fp is fp-units/ms), exact w.r.t. the rational
+        semantics.  Elapsed is clamped once the refill is guaranteed to cap
+        the bucket, bounding the product within int64 on device."""
         tokens_fp, last_refill = self._load(key, now_ms)
         elapsed = now_ms - last_refill
         cap_fp = self.config.max_permits_fp
-        return min(cap_fp, tokens_fp + elapsed * self.config.refill_rate_fp)
+        rate_fp = self.config.refill_rate_fp
+        elapsed = min(elapsed, cap_fp // max(rate_fp, 1) + 1)
+        return min(cap_fp, tokens_fp + elapsed * rate_fp)
 
     def try_acquire(self, key: str, permits: int, now_ms: int) -> Decision:
         if permits <= 0:
@@ -194,28 +200,28 @@ class TokenBucketOracle:
         if permits > cfg.max_permits:
             # Can never be fulfilled (TokenBucketRateLimiter.java:110-116);
             # rejected client-side without touching storage.
+            whole = self._refilled(key, now_ms) // TOKEN_FP_ONE
             return Decision(allowed=False, mutated=False,
-                            observed=self._refilled(key, now_ms) >> TOKEN_FP_SHIFT,
-                            remaining_hint=self._refilled(key, now_ms) >> TOKEN_FP_SHIFT)
+                            observed=whole, remaining_hint=whole)
 
         tokens_fp = self._refilled(key, now_ms)
-        observed = tokens_fp >> TOKEN_FP_SHIFT
-        requested_fp = permits << TOKEN_FP_SHIFT
+        observed = tokens_fp // TOKEN_FP_ONE
+        requested_fp = permits * TOKEN_FP_ONE
 
         if tokens_fp >= requested_fp:
             tokens_fp -= requested_fp
             # HMSET + PEXPIRE(2*window) — only on the allow branch.
             self._buckets[key] = (tokens_fp, now_ms, now_ms + 2 * cfg.window_ms)
             return Decision(allowed=True, mutated=True, observed=observed,
-                            remaining_hint=tokens_fp >> TOKEN_FP_SHIFT)
+                            remaining_hint=tokens_fp // TOKEN_FP_ONE)
         # Deny: no write-back (state, including TTL, untouched).
         return Decision(allowed=False, mutated=False, observed=observed,
-                        remaining_hint=tokens_fp >> TOKEN_FP_SHIFT)
+                        remaining_hint=tokens_fp // TOKEN_FP_ONE)
 
     def get_available_permits(self, key: str, now_ms: int) -> int:
         """Refill-then-floor, replacing the reference's broken string-GET of a
         hash (quirk Q3)."""
-        return self._refilled(key, now_ms) >> TOKEN_FP_SHIFT
+        return self._refilled(key, now_ms) // TOKEN_FP_ONE
 
     def reset(self, key: str, now_ms: int) -> None:
         self._buckets.pop(key, None)

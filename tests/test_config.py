@@ -5,7 +5,7 @@ from datetime import timedelta
 import pytest
 
 from ratelimiter_tpu import RateLimitConfig
-from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE, TOKEN_FP_SHIFT
 
 
 def test_factories():
@@ -47,6 +47,9 @@ def test_validate_rejects(kwargs):
 
 def test_fixed_point_rate():
     cfg = RateLimitConfig(max_permits=50, window_ms=60_000, refill_rate=10.0)
-    # 10 tokens/sec == 0.01 tokens/ms == round(0.01 * 2**20) fp/ms
-    assert cfg.refill_rate_fp == round(0.01 * TOKEN_FP_ONE)
+    # Rate in fp units per ms: exact for integral rates since TOKEN_FP_ONE
+    # carries the ms factor 1000.
+    assert cfg.refill_rate_fp == 10 << TOKEN_FP_SHIFT
     assert cfg.max_permits_fp == 50 * TOKEN_FP_ONE
+    # Consistency: refilling for exactly 1 second yields exactly the rate.
+    assert 1000 * cfg.refill_rate_fp == 10 * TOKEN_FP_ONE
