@@ -1,0 +1,127 @@
+"""Sliding-window-counter limiter over the storage plugin boundary.
+
+Behavioral parity with ``algorithms/SlidingWindowRateLimiter.java:34-189``:
+two fixed window buckets with a weighted estimate, a local negative cache
+that short-circuits repeat rejections (lines 93-100), pre-check then
+increment-by-one (quirks Q1/Q2), and the same metric names (lines 67-77).
+The estimate uses this framework's exact integer arithmetic — see
+``semantics/oracle.py`` for the spec and its equivalence to the reference's
+double math.
+
+This is the "compat" per-call path: every decision performs storage
+operations one at a time, exactly like the reference does against Redis.  The
+TPU-batched fast path lives behind ``TpuBatchedStorage`` (storage/tpu.py) and
+the batch entry points of ``RateLimiter``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ratelimiter_tpu.cache import TTLCache
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.core.limiter import RateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage.base import RateLimitStorage
+
+
+def _wall_clock_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class SlidingWindowRateLimiter(RateLimiter):
+    def __init__(
+        self,
+        storage: RateLimitStorage,
+        config: RateLimitConfig,
+        meter_registry: MeterRegistry,
+        clock_ms: Callable[[], int] = _wall_clock_ms,
+    ):
+        config.validate()
+        self._storage = storage
+        self._config = config
+        self._clock_ms = clock_ms
+
+        # Local cache to reduce storage round trips; short TTL balances
+        # performance vs accuracy (SlidingWindowRateLimiter.java:55-64).
+        if config.enable_local_cache:
+            self._local_cache = TTLCache(
+                ttl_ms=config.local_cache_ttl_ms, max_size=10_000, clock_ms=clock_ms
+            )
+        else:
+            self._local_cache = None
+
+        self._allowed = meter_registry.counter(
+            "ratelimiter.requests.allowed", "Number of allowed requests")
+        self._rejected = meter_registry.counter(
+            "ratelimiter.requests.rejected", "Number of rejected requests")
+        self._cache_hits = meter_registry.counter(
+            "ratelimiter.cache.hits", "Number of local cache hits")
+
+    # -- RateLimiter ----------------------------------------------------------
+    def try_acquire(self, key: str, permits: int = 1) -> bool:
+        if permits <= 0:
+            raise ValueError("permits must be positive")
+
+        # Fast path: recently-seen count at/over the limit -> reject without
+        # touching storage (SlidingWindowRateLimiter.java:93-100).
+        if self._local_cache is not None:
+            cached = self._local_cache.get_if_present(key)
+            if cached is not None and cached >= self._config.max_permits:
+                self._cache_hits.increment()
+                self._rejected.increment()
+                return False
+
+        now = self._clock_ms()
+        current = self._current_count(key, now)
+
+        if current + permits > self._config.max_permits:
+            # Cache the rejection to avoid hammering storage
+            # (SlidingWindowRateLimiter.java:104-111).
+            if self._local_cache is not None:
+                self._local_cache.put(key, current)
+            self._rejected.increment()
+            return False
+
+        # Increment the current bucket atomically (quirk Q1: by 1, not by
+        # `permits`) and re-check on the raw counter (quirk Q2).
+        win = self._config.window_ms
+        new_count = self._storage.increment_and_expire(
+            self._window_key(key, now, win), win)
+
+        if self._local_cache is not None:
+            self._local_cache.put(key, new_count)
+
+        allowed = new_count <= self._config.max_permits
+        (self._allowed if allowed else self._rejected).increment()
+        return allowed
+
+    def get_available_permits(self, key: str) -> int:
+        current = self._current_count(key, self._clock_ms())
+        return max(0, self._config.max_permits - current)
+
+    def reset(self, key: str) -> None:
+        now = self._clock_ms()
+        win = self._config.window_ms
+        # Clear current and previous windows
+        # (SlidingWindowRateLimiter.java:140-153).
+        self._storage.delete(self._window_key(key, now, win))
+        self._storage.delete(self._window_key(key, now - win, win))
+        if self._local_cache is not None:
+            self._local_cache.invalidate(key)
+
+    # -- internals ------------------------------------------------------------
+    def _current_count(self, key: str, now: int) -> int:
+        """Weighted two-window estimate, exact integer form
+        (SlidingWindowRateLimiter.java:158-180)."""
+        win = self._config.window_ms
+        curr = self._storage.get(self._window_key(key, now, win))
+        prev = self._storage.get(self._window_key(key, now - win, win))
+        rem = now % win
+        return curr + (prev * (win - rem)) // win
+
+    @staticmethod
+    def _window_key(key: str, timestamp_ms: int, window_ms: int) -> str:
+        window_start = (timestamp_ms // window_ms) * window_ms
+        return f"rl:{key}:{window_start}"

@@ -1,0 +1,91 @@
+"""Token-bucket limiter over the storage plugin boundary.
+
+Behavioral parity with ``algorithms/TokenBucketRateLimiter.java:28-159``:
+burst-friendly, atomic refill-then-consume executed *inside the storage
+backend* (the reference ships a Lua script to Redis, lines 38-68; we invoke
+the backend's named ``token_bucket`` script — a device kernel on the TPU
+backend), TTL = 2x window refreshed only on allow, permits > capacity
+rejected client-side (lines 110-116), and the same metric names (lines
+87-93).
+
+Deliberate fix over the reference: ``get_available_permits`` performs a
+read-only refill via the ``token_bucket_peek`` script instead of string-
+GETting the bucket hash, which in the reference always throws (quirk Q3,
+TokenBucketRateLimiter.java:146-151).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ratelimiter_tpu.core.config import RateLimitConfig, TOKEN_FP_ONE
+from ratelimiter_tpu.core.limiter import RateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage.base import RateLimitStorage
+
+
+def _wall_clock_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class TokenBucketRateLimiter(RateLimiter):
+    def __init__(
+        self,
+        storage: RateLimitStorage,
+        config: RateLimitConfig,
+        meter_registry: MeterRegistry,
+        clock_ms: Callable[[], int] = _wall_clock_ms,
+    ):
+        config.validate()
+        if config.refill_rate <= 0:
+            raise ValueError(
+                "Token bucket requires positive refillRate. "
+                "Use RateLimitConfig(refill_rate=...)")
+        self._storage = storage
+        self._config = config
+        self._clock_ms = clock_ms
+
+        self._allowed = meter_registry.counter(
+            "ratelimiter.tokenbucket.allowed", "Allowed requests (token bucket)")
+        self._rejected = meter_registry.counter(
+            "ratelimiter.tokenbucket.rejected", "Rejected requests (token bucket)")
+
+    # -- RateLimiter ----------------------------------------------------------
+    def try_acquire(self, key: str, permits: int = 1) -> bool:
+        if permits <= 0:
+            raise ValueError("permits must be positive")
+        cfg = self._config
+        if permits > cfg.max_permits:
+            # Can never fulfill this request
+            # (TokenBucketRateLimiter.java:110-116).
+            self._rejected.increment()
+            return False
+
+        now = self._clock_ms()
+        allowed_flag, _tokens_fp = self._storage.eval_script(
+            "token_bucket",
+            keys=[f"tb:{key}"],
+            args=[
+                cfg.max_permits_fp,
+                cfg.refill_rate_fp,
+                permits * TOKEN_FP_ONE,
+                now,
+                cfg.window_ms * 2,  # TTL: 2x window for safety
+            ],
+        )
+        allowed = allowed_flag == 1
+        (self._allowed if allowed else self._rejected).increment()
+        return allowed
+
+    def get_available_permits(self, key: str) -> int:
+        cfg = self._config
+        (tokens_fp,) = self._storage.eval_script(
+            "token_bucket_peek",
+            keys=[f"tb:{key}"],
+            args=[cfg.max_permits_fp, cfg.refill_rate_fp, self._clock_ms()],
+        )
+        return tokens_fp // TOKEN_FP_ONE
+
+    def reset(self, key: str) -> None:
+        self._storage.delete(f"tb:{key}")

@@ -1,0 +1,5 @@
+from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
+from ratelimiter_tpu.storage.memory import InMemoryStorage
+
+__all__ = ["RateLimitStorage", "InMemoryStorage", "RetryPolicy", "StorageException"]

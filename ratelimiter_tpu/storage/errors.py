@@ -1,0 +1,40 @@
+"""Storage failure type and retry policy.
+
+Mirrors ``storage/StorageException.java:6-15`` (unchecked failure after
+retries are exhausted) and the retry wrapper
+``RedisRateLimitStorage.java:155-178`` (3 attempts, linear 10/20/30 ms
+backoff).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class StorageException(RuntimeError):
+    """Raised when a storage operation fails after all retries."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Linear-backoff retry (RedisRateLimitStorage.java:19-20,155-178)."""
+
+    max_retries: int = 3
+    retry_delay_ms: float = 10.0
+
+    def execute(self, operation: Callable[[], T], sleep=time.sleep) -> T:
+        last_exc: Exception | None = None
+        for attempt in range(self.max_retries):
+            try:
+                return operation()
+            except Exception as exc:  # noqa: BLE001 — parity: catches everything
+                last_exc = exc
+                if attempt < self.max_retries - 1:
+                    sleep(self.retry_delay_ms * (attempt + 1) / 1000.0)
+        raise StorageException(
+            f"Operation failed after {self.max_retries} retries"
+        ) from last_exc

@@ -1,0 +1,163 @@
+"""Compat-path algorithms (storage plugin) vs the oracle.
+
+The InMemoryStorage-backed algorithm classes must reproduce the oracle's
+decisions exactly — this is the differential test SURVEY.md §4 prescribes as
+the replacement for the reference's disabled Mockito tests.
+"""
+
+import random
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter, TokenBucketRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.storage import InMemoryStorage
+
+T0 = 1_753_000_000_000
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_sw(config):
+    clock = FakeClock()
+    storage = InMemoryStorage(clock_ms=clock)
+    limiter = SlidingWindowRateLimiter(storage, config, MeterRegistry(), clock_ms=clock)
+    return limiter, clock
+
+
+def make_tb(config):
+    clock = FakeClock()
+    storage = InMemoryStorage(clock_ms=clock)
+    limiter = TokenBucketRateLimiter(storage, config, MeterRegistry(), clock_ms=clock)
+    return limiter, clock
+
+
+# ---------------------------------------------------------------------------
+# Differential: random streams, decisions must match the oracle exactly
+# ---------------------------------------------------------------------------
+
+def test_sw_differential_vs_oracle():
+    cfg = RateLimitConfig(max_permits=25, window_ms=1000, enable_local_cache=False)
+    limiter, clock = make_sw(cfg)
+    oracle = SlidingWindowOracle(cfg)
+    rng = random.Random(1)
+    keys = [f"u{i}" for i in range(5)]
+    for step in range(5000):
+        clock.t += rng.randrange(0, 120)
+        key = rng.choice(keys)
+        permits = rng.randrange(1, 4)
+        if rng.random() < 0.01:
+            limiter.reset(key)
+            oracle.reset(key, clock.t)
+            continue
+        got = limiter.try_acquire(key, permits)
+        want = oracle.try_acquire(key, permits, clock.t).allowed
+        assert got == want, f"step {step}: {key} p={permits} t={clock.t - T0}"
+        assert limiter.get_available_permits(key) == oracle.get_available_permits(key, clock.t)
+
+
+def test_tb_differential_vs_oracle():
+    cfg = RateLimitConfig(max_permits=30, window_ms=2000, refill_rate=13.0)
+    limiter, clock = make_tb(cfg)
+    oracle = TokenBucketOracle(cfg)
+    rng = random.Random(2)
+    keys = [f"u{i}" for i in range(5)]
+    for step in range(5000):
+        clock.t += rng.randrange(0, 300)
+        key = rng.choice(keys)
+        permits = rng.randrange(1, 35)  # sometimes above capacity
+        if rng.random() < 0.01:
+            limiter.reset(key)
+            oracle.reset(key, clock.t)
+            continue
+        got = limiter.try_acquire(key, permits)
+        want = oracle.try_acquire(key, permits, clock.t).allowed
+        assert got == want, f"step {step}: {key} p={permits} t={clock.t - T0}"
+        assert limiter.get_available_permits(key) == oracle.get_available_permits(key, clock.t)
+
+
+# ---------------------------------------------------------------------------
+# Local negative cache (C7)
+# ---------------------------------------------------------------------------
+
+def test_cache_short_circuits_rejections():
+    cfg = RateLimitConfig(max_permits=3, window_ms=60_000,
+                          enable_local_cache=True, local_cache_ttl_ms=100)
+    clock = FakeClock((T0 // 60_000) * 60_000)
+    storage = InMemoryStorage(clock_ms=clock)
+    registry = MeterRegistry()
+    limiter = SlidingWindowRateLimiter(storage, cfg, registry, clock_ms=clock)
+
+    for _ in range(3):
+        assert limiter.try_acquire("u")
+        clock.t += 1
+    assert not limiter.try_acquire("u")  # storage-backed rejection, caches count
+    hits_before = registry.counter("ratelimiter.cache.hits").count()
+    assert not limiter.try_acquire("u")  # served from the negative cache
+    assert registry.counter("ratelimiter.cache.hits").count() == hits_before + 1
+
+    # After the TTL the cache entry lapses and storage is consulted again.
+    clock.t += 100
+    hits = registry.counter("ratelimiter.cache.hits").count()
+    assert not limiter.try_acquire("u")
+    assert registry.counter("ratelimiter.cache.hits").count() == hits
+
+
+def test_reset_invalidates_cache():
+    cfg = RateLimitConfig(max_permits=2, window_ms=60_000,
+                          enable_local_cache=True, local_cache_ttl_ms=10_000)
+    clock = FakeClock((T0 // 60_000) * 60_000)
+    storage = InMemoryStorage(clock_ms=clock)
+    limiter = SlidingWindowRateLimiter(storage, cfg, MeterRegistry(), clock_ms=clock)
+    assert limiter.try_acquire("u")
+    assert limiter.try_acquire("u")
+    assert not limiter.try_acquire("u")
+    limiter.reset("u")
+    assert limiter.try_acquire("u")  # cache invalidated with storage
+
+
+# ---------------------------------------------------------------------------
+# Metrics (C12)
+# ---------------------------------------------------------------------------
+
+def test_metric_names_and_counts():
+    cfg = RateLimitConfig(max_permits=2, window_ms=60_000, enable_local_cache=False)
+    clock = FakeClock((T0 // 60_000) * 60_000)
+    registry = MeterRegistry()
+    limiter = SlidingWindowRateLimiter(
+        InMemoryStorage(clock_ms=clock), cfg, registry, clock_ms=clock)
+    limiter.try_acquire("u")
+    limiter.try_acquire("u")
+    limiter.try_acquire("u")
+    scrape = registry.scrape()
+    assert scrape["ratelimiter.requests.allowed"] == 2
+    assert scrape["ratelimiter.requests.rejected"] == 1
+
+    tb_registry = MeterRegistry()
+    tb = TokenBucketRateLimiter(
+        InMemoryStorage(clock_ms=clock),
+        RateLimitConfig(max_permits=2, window_ms=60_000, refill_rate=1.0),
+        tb_registry, clock_ms=clock)
+    tb.try_acquire("u", 2)
+    tb.try_acquire("u", 1)
+    scrape = tb_registry.scrape()
+    assert scrape["ratelimiter.tokenbucket.allowed"] == 1
+    assert scrape["ratelimiter.tokenbucket.rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Batch entry points (default loop implementation)
+# ---------------------------------------------------------------------------
+
+def test_try_acquire_many_default_path():
+    cfg = RateLimitConfig(max_permits=3, window_ms=60_000, enable_local_cache=False)
+    limiter, clock = make_sw(cfg)
+    clock.t = (T0 // 60_000) * 60_000
+    out = limiter.try_acquire_many(["a", "a", "a", "a", "b"])
+    assert list(out) == [True, True, True, False, True]

@@ -1,0 +1,97 @@
+"""InMemoryStorage contract tests (the real test double, SURVEY.md §4)."""
+
+import pytest
+
+from ratelimiter_tpu.storage import InMemoryStorage, RetryPolicy, StorageException
+
+
+class FakeClock:
+    def __init__(self, t=1_753_000_000_000):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_increment_and_expire():
+    clock = FakeClock()
+    s = InMemoryStorage(clock_ms=clock)
+    assert s.increment_and_expire("k", 1000) == 1
+    assert s.increment_and_expire("k", 1000) == 2
+    assert s.get("k") == 2
+    clock.t += 999
+    assert s.get("k") == 2  # TTL refreshed by the second increment
+    clock.t += 1
+    assert s.get("k") == 0  # expired exactly at the deadline
+    assert s.increment_and_expire("k", 1000) == 1  # fresh counter
+
+
+def test_set_get_delete():
+    s = InMemoryStorage(clock_ms=FakeClock())
+    s.set("k", 42, 1000)
+    assert s.get("k") == 42
+    s.delete("k")
+    assert s.get("k") == 0
+
+
+def test_compare_and_set():
+    s = InMemoryStorage(clock_ms=FakeClock())
+    s.set("k", 5, 10_000)
+    assert s.compare_and_set("k", 5, 9)
+    assert s.get("k") == 9
+    assert not s.compare_and_set("k", 5, 7)
+    assert s.get("k") == 9
+    # CAS against an absent key treats it as 0 (RedisRateLimitStorage.java:78).
+    assert s.compare_and_set("absent", 0, 1)
+    assert s.get("absent") == 1
+
+
+def test_zset_ops():
+    s = InMemoryStorage(clock_ms=FakeClock())
+    s.z_add("z", 1.0, "a")
+    s.z_add("z", 2.0, "b")
+    s.z_add("z", 3.0, "c")
+    assert s.z_count("z", 1.5, 3.5) == 2
+    assert s.z_remove_range_by_score("z", 0.0, 2.0) == 2
+    assert s.z_count("z", 0.0, 10.0) == 1
+
+
+def test_unknown_script_raises():
+    s = InMemoryStorage(clock_ms=FakeClock())
+    with pytest.raises(StorageException):
+        s.eval_script("no_such_script", ["k"], [])
+
+
+def test_health_and_fault_injection():
+    s = InMemoryStorage(clock_ms=FakeClock())
+    assert s.is_available()
+    s.set_available(False)
+    assert not s.is_available()
+
+
+def test_retry_policy_retries_then_raises():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("boom")
+
+    slept = []
+    with pytest.raises(StorageException):
+        RetryPolicy().execute(flaky, sleep=slept.append)
+    assert len(calls) == 3
+    # Linear backoff 10/20 ms between the 3 attempts
+    # (RedisRateLimitStorage.java:155-178).
+    assert slept == [0.01, 0.02]
+
+
+def test_retry_policy_recovers():
+    calls = []
+
+    def flaky_then_ok():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    assert RetryPolicy().execute(flaky_then_ok, sleep=lambda *_: None) == "ok"
