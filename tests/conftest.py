@@ -3,14 +3,23 @@
 Tests run on CPU with 8 virtual devices so the sharded (multi-chip) engine
 paths are exercised without TPU hardware — the key-space sharding is
 device-count agnostic (SURVEY.md §4 "multi-device tests runnable on CPU").
-Must be set before JAX is imported anywhere.
+
+jax may already be imported by the time this conftest runs (pytest's import
+graph pulls it in), so the platform override must go through jax.config —
+the JAX_PLATFORMS env var is latched at import.  XLA_FLAGS is read at first
+backend initialization, which has not happened yet, so the env var works for
+the virtual device count.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
