@@ -1,0 +1,144 @@
+"""Single-device decision engine.
+
+Owns the device-resident slot state for both algorithms, the jitted step
+functions (donated state buffers — updates happen in place in HBM), and the
+batch padding discipline (power-of-two buckets so XLA compiles a handful of
+shapes, then every flush hits the cache).
+
+This is the device half of ``TpuBatchedStorage``; the host half (key->slot
+index + micro-batcher) lives in engine/slots.py and engine/batcher.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ratelimiter_tpu.engine.state import (
+    LimiterTable,
+    SWState,
+    TBState,
+    make_sw_state,
+    make_tb_state,
+)
+from ratelimiter_tpu.ops.sliding_window import sw_peek, sw_reset, sw_step
+from ratelimiter_tpu.ops.token_bucket import tb_peek, tb_reset, tb_step
+
+_MIN_BATCH = 256
+
+
+def _bucket_size(n: int) -> int:
+    size = _MIN_BATCH
+    while size < n:
+        size *= 2
+    return size
+
+
+def _pad_i32(x: np.ndarray, size: int, fill: int) -> jnp.ndarray:
+    out = np.full(size, fill, dtype=np.int32)
+    out[: len(x)] = x
+    return jnp.asarray(out)
+
+
+def _pad_i64(x: np.ndarray, size: int, fill: int) -> jnp.ndarray:
+    out = np.full(size, fill, dtype=np.int64)
+    out[: len(x)] = x
+    return jnp.asarray(out)
+
+
+class DeviceEngine:
+    """Batched decision engine over TPU-resident counter arrays."""
+
+    def __init__(self, num_slots: int, table: LimiterTable):
+        self.num_slots = int(num_slots)
+        self.table = table
+        self.sw_state: SWState = make_sw_state(self.num_slots)
+        self.tb_state: TBState = make_tb_state(self.num_slots)
+        self._sw_step = jax.jit(sw_step, donate_argnums=0)
+        self._tb_step = jax.jit(tb_step, donate_argnums=0)
+        self._sw_peek = jax.jit(sw_peek)
+        self._tb_peek = jax.jit(tb_peek)
+        self._sw_reset = jax.jit(sw_reset, donate_argnums=0)
+        self._tb_reset = jax.jit(tb_reset, donate_argnums=0)
+
+    # -- acquire --------------------------------------------------------------
+    def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        """Batched sliding-window tryAcquire. Returns dict of numpy arrays
+        (allowed, mutated, observed, cache_value), trimmed to the input size."""
+        n = len(slots)
+        size = _bucket_size(n)
+        new_state, out = self._sw_step(
+            self.sw_state,
+            self.table.device_arrays,
+            _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
+            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+            _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
+            jnp.int64(now_ms),
+        )
+        self.sw_state = new_state
+        return {
+            "allowed": np.asarray(out.allowed)[:n],
+            "mutated": np.asarray(out.mutated)[:n],
+            "observed": np.asarray(out.observed)[:n],
+            "cache_value": np.asarray(out.cache_value)[:n],
+        }
+
+    def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        n = len(slots)
+        size = _bucket_size(n)
+        new_state, out = self._tb_step(
+            self.tb_state,
+            self.table.device_arrays,
+            _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
+            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+            _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
+            jnp.int64(now_ms),
+        )
+        self.tb_state = new_state
+        return {
+            "allowed": np.asarray(out.allowed)[:n],
+            "observed": np.asarray(out.observed)[:n],
+            "remaining": np.asarray(out.remaining)[:n],
+        }
+
+    # -- read-only ------------------------------------------------------------
+    def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
+        n = len(slots)
+        size = _bucket_size(n)
+        out = self._sw_peek(
+            self.sw_state,
+            self.table.device_arrays,
+            _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
+            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+            jnp.int64(now_ms),
+        )
+        return np.asarray(out)[:n]
+
+    def tb_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
+        n = len(slots)
+        size = _bucket_size(n)
+        out = self._tb_peek(
+            self.tb_state,
+            self.table.device_arrays,
+            _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
+            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+            jnp.int64(now_ms),
+        )
+        return np.asarray(out)[:n]
+
+    # -- reset ----------------------------------------------------------------
+    def sw_clear(self, slots: Sequence[int]) -> None:
+        size = _bucket_size(max(len(slots), 1))
+        self.sw_state = self._sw_reset(
+            self.sw_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+
+    def tb_clear(self, slots: Sequence[int]) -> None:
+        size = _bucket_size(max(len(slots), 1))
+        self.tb_state = self._tb_reset(
+            self.tb_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready((self.sw_state, self.tb_state))

@@ -1,0 +1,143 @@
+"""Device-resident state: slot arrays + the multi-tenant limiter table.
+
+The Redis keyspace of the reference (one string counter per window bucket,
+one hash per token bucket — ARCHITECTURE.md memory model) becomes
+struct-of-arrays state in HBM, indexed by *slot id*.  The host-side
+``SlotIndex`` (engine/slots.py) owns the key -> slot assignment; device code
+never sees string keys.
+
+A slot whose state is all zeros behaves exactly like an absent Redis key:
+the sliding-window rollover clears buckets whose window has passed, and a
+zero token-bucket deadline reads as expired (lazy init to full capacity).
+This makes slot allocation free — freshly allocated and reset slots are
+simply zeroed.
+
+``LimiterTable`` holds per-tenant policy (one row per named limiter config,
+the analog of the three Spring beans in config/RateLimiterConfig.java:46-95,
+scaled to 100K+ tenants): decisions gather their policy row by limiter id,
+so one device batch can mix tenants freely.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+
+
+class SWState(NamedTuple):
+    """Sliding-window per-slot state (two window buckets + PEXPIRE deadlines).
+
+    win_start — window-start timestamp the curr bucket belongs to
+    curr      — current-window bucket counter
+    curr_dl   — curr bucket's expiry deadline (last increment + window)
+    prev      — previous-window bucket counter
+    prev_dl   — prev bucket's expiry deadline
+    """
+
+    win_start: jax.Array  # i64[S]
+    curr: jax.Array       # i64[S]
+    curr_dl: jax.Array    # i64[S]
+    prev: jax.Array       # i64[S]
+    prev_dl: jax.Array    # i64[S]
+
+
+class TBState(NamedTuple):
+    """Token-bucket per-slot state (the Redis hash {tokens, last_refill} plus
+    its PEXPIRE deadline)."""
+
+    tokens_fp: jax.Array    # i64[S]
+    last_refill: jax.Array  # i64[S]
+    deadline: jax.Array     # i64[S]
+
+
+class TableArrays(NamedTuple):
+    """Per-limiter policy rows (gathered by limiter id on device)."""
+
+    max_permits: jax.Array  # i64[T]
+    window_ms: jax.Array    # i64[T]
+    cap_fp: jax.Array       # i64[T] (token bucket)
+    rate_fp: jax.Array      # i64[T] (token bucket)
+    ttl2_ms: jax.Array      # i64[T] (2 * window — token bucket TTL)
+
+
+def _zeros(num_slots: int) -> jax.Array:
+    return jnp.zeros((num_slots,), dtype=jnp.int64)
+
+
+def make_sw_state(num_slots: int) -> SWState:
+    # Distinct buffers per field: the step donates the whole pytree, and
+    # aliased buffers cannot be donated twice.
+    return SWState(*(_zeros(num_slots) for _ in range(5)))
+
+
+def make_tb_state(num_slots: int) -> TBState:
+    return TBState(*(_zeros(num_slots) for _ in range(3)))
+
+
+class LimiterTable:
+    """Host-side registry of limiter configs with a device mirror.
+
+    Row 0 is a sentinel (window 1 ms, zero permits) so padded/clamped lookups
+    are always in-range and never divide by zero.
+    """
+
+    SENTINEL_ROWS = 1
+
+    def __init__(self, capacity: int = 64):
+        self._lock = threading.Lock()
+        self._capacity = max(int(capacity), 2)
+        self._n = self.SENTINEL_ROWS
+        self._max_permits = np.zeros(self._capacity, dtype=np.int64)
+        self._window_ms = np.ones(self._capacity, dtype=np.int64)
+        self._cap_fp = np.zeros(self._capacity, dtype=np.int64)
+        self._rate_fp = np.zeros(self._capacity, dtype=np.int64)
+        self._ttl2_ms = np.ones(self._capacity, dtype=np.int64)
+        self._device: TableArrays | None = None
+
+    def register(self, config: RateLimitConfig) -> int:
+        """Add a policy row; returns its limiter id."""
+        config.validate()
+        with self._lock:
+            if self._n == self._capacity:
+                self._grow()
+            lid = self._n
+            self._n += 1
+            self._max_permits[lid] = config.max_permits
+            self._window_ms[lid] = config.window_ms
+            self._cap_fp[lid] = config.max_permits_fp
+            self._rate_fp[lid] = config.refill_rate_fp
+            self._ttl2_ms[lid] = 2 * config.window_ms
+            self._device = None  # re-upload lazily
+            return lid
+
+    def _grow(self) -> None:
+        new_cap = self._capacity * 2
+        for name in ("_max_permits", "_window_ms", "_cap_fp", "_rate_fp", "_ttl2_ms"):
+            old = getattr(self, name)
+            fresh = np.ones(new_cap, dtype=np.int64) if name in ("_window_ms", "_ttl2_ms") \
+                else np.zeros(new_cap, dtype=np.int64)
+            fresh[: self._capacity] = old
+            setattr(self, name, fresh)
+        self._capacity = new_cap
+
+    @property
+    def device_arrays(self) -> TableArrays:
+        with self._lock:
+            if self._device is None:
+                self._device = TableArrays(
+                    max_permits=jnp.asarray(self._max_permits),
+                    window_ms=jnp.asarray(self._window_ms),
+                    cap_fp=jnp.asarray(self._cap_fp),
+                    rate_fp=jnp.asarray(self._rate_fp),
+                    ttl2_ms=jnp.asarray(self._ttl2_ms),
+                )
+            return self._device
+
+    def __len__(self) -> int:
+        return self._n

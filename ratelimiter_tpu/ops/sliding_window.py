@@ -1,0 +1,165 @@
+"""Batched sliding-window decision step (device side).
+
+One invocation decides a whole micro-batch against the slot-array state:
+
+    gather slot rows -> roll windows forward to `now` -> weighted estimate ->
+    segmented sequential-semantics solve -> scatter updated rows
+
+This replaces the reference's per-request chain of 2 Redis GETs + pipelined
+INCR/PEXPIRE (SlidingWindowRateLimiter.java:158-180, 114-116;
+RedisRateLimitStorage.java:38-49) with one device dispatch for thousands of
+decisions.  Decision math is the exact integer semantics specified in
+``semantics/oracle.py`` — differential tests drive both on identical streams.
+
+All requests in a batch share one timestamp ``now`` (captured at flush time
+by the micro-batcher).  The reference stamps each call individually inside a
+<1 ms window; with the batcher's sub-millisecond flush deadline the shared
+stamp is the same fidelity at the algorithms' ms granularity, and it is what
+makes duplicate-slot segments closed under the threshold recurrence.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.engine.state import SWState, TableArrays
+from ratelimiter_tpu.ops.segments import (
+    first_occurrence,
+    last_occurrence,
+    segment_totals,
+    segmented_cumsum_exclusive,
+    solve_threshold_recurrence,
+)
+from ratelimiter_tpu.ops.sorting import sort_batch, unsort
+
+
+class SWOut(NamedTuple):
+    allowed: jnp.ndarray     # bool[B]
+    mutated: jnp.ndarray     # bool[B] — whether this request incremented
+    observed: jnp.ndarray    # i64[B] — weighted estimate seen by the request
+    cache_value: jnp.ndarray # i64[B] — value the host cache should store
+                             # (raw counter on increment, estimate on reject —
+                             #  mirroring SlidingWindowRateLimiter.java:106-121)
+
+
+def _rolled(state_rows, win, now):
+    """Advance gathered rows to `now`'s window, applying PEXPIRE deadlines."""
+    ws0, curr, cdl, prev, pdl = state_rows
+    curr_ws = now - now % win
+    same = ws0 == curr_ws
+    next1 = ws0 == curr_ws - win
+    curr_e = jnp.where(same, curr, 0)
+    prev_alive = now < pdl
+    curr_alive = now < cdl
+    prev_e = jnp.where(
+        same,
+        jnp.where(prev_alive, prev, 0),
+        jnp.where(next1 & curr_alive, curr, 0),
+    )
+    prev_dl_e = jnp.where(same, pdl, jnp.where(next1, cdl, 0))
+    return curr_ws, curr_e, prev_e, prev_dl_e
+
+
+def sw_step(
+    state: SWState,
+    table: TableArrays,
+    slots: jnp.ndarray,       # i32[B]; < 0 = padding
+    limiter_ids: jnp.ndarray, # i32[B]
+    permits: jnp.ndarray,     # i64[B]
+    now: jnp.ndarray,         # i64 scalar
+):
+    """Returns (new_state, SWOut) — jit with donate_argnums=0."""
+    order, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, state.win_start.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.max_permits.shape[0] - 1)
+
+    maxp = table.max_permits[lidc]
+    win = table.window_ms[lidc]
+
+    rows = (state.win_start[sc], state.curr[sc], state.curr_dl[sc],
+            state.prev[sc], state.prev_dl[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+
+    # Weighted estimate base: exact integer floor of prev * (1 - rem/win)
+    # (spec: semantics/oracle.py:current_count).
+    rem = now % win
+    base = (prev_e * (win - rem)) // win
+
+    # inc[j] = [ base + curr_e + S[j] + p[j] <= maxp ],  S = prior increments.
+    u = jnp.where(valid, maxp - base - curr_e - p, -1)
+    first = first_occurrence(s)
+    inc = solve_threshold_recurrence(u, jnp.ones_like(u), first)
+    S = segmented_cumsum_exclusive(inc, first)
+
+    c_j = curr_e + S                     # raw curr counter seen by request j
+    observed = base + c_j                # weighted estimate at request j
+    allowed = (inc == 1) & (c_j + 1 <= maxp)
+    # Host-cache value parity: raw new counter when incremented, estimate on
+    # pre-check rejection (SlidingWindowRateLimiter.java:106-108, 119-121).
+    cache_value = jnp.where(inc == 1, c_j + 1, observed)
+
+    # One state write per segment, at its last element.
+    lastm = last_occurrence(s) & valid
+    tot = segment_totals(inc, first)
+    any_inc = tot > 0
+    curr_new = curr_e + tot
+    ws0 = rows[0]
+    samew = ws0 == curr_ws
+    cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
+
+    n_slots = state.win_start.shape[0]
+    widx = jnp.where(lastm, sc, n_slots)  # out-of-range -> dropped
+    new_state = SWState(
+        win_start=state.win_start.at[widx].set(curr_ws, mode="drop"),
+        curr=state.curr.at[widx].set(curr_new, mode="drop"),
+        curr_dl=state.curr_dl.at[widx].set(cdl_new, mode="drop"),
+        prev=state.prev.at[widx].set(prev_e, mode="drop"),
+        prev_dl=state.prev_dl.at[widx].set(prev_dl_e, mode="drop"),
+    )
+
+    out = SWOut(
+        allowed=unsort(allowed & valid, order),
+        mutated=unsort((inc == 1) & valid, order),
+        observed=unsort(observed, order),
+        cache_value=unsort(cache_value, order),
+    )
+    return new_state, out
+
+
+def sw_peek(
+    state: SWState,
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    now: jnp.ndarray,
+) -> jnp.ndarray:
+    """Read-only availablePermits: max(0, maxPermits - estimate)
+    (SlidingWindowRateLimiter.java:134-137). No sort needed — no mutation."""
+    sc = jnp.clip(slots, 0, state.win_start.shape[0] - 1)
+    lidc = jnp.clip(limiter_ids, 0, table.max_permits.shape[0] - 1)
+    maxp = table.max_permits[lidc]
+    win = table.window_ms[lidc]
+    rows = (state.win_start[sc], state.curr[sc], state.curr_dl[sc],
+            state.prev[sc], state.prev_dl[sc])
+    _, curr_e, prev_e, _ = _rolled(rows, win, now)
+    rem = now % win
+    est = curr_e + (prev_e * (win - rem)) // win
+    return jnp.maximum(0, maxp - est)
+
+
+def sw_reset(state: SWState, slots: jnp.ndarray) -> SWState:
+    """Zero the given slots (delete curr+prev buckets,
+    SlidingWindowRateLimiter.java:140-153). Negative slots are dropped."""
+    n = state.win_start.shape[0]
+    widx = jnp.where(slots >= 0, slots, n)
+    z = jnp.zeros_like(slots, dtype=jnp.int64)
+    return SWState(
+        win_start=state.win_start.at[widx].set(z, mode="drop"),
+        curr=state.curr.at[widx].set(z, mode="drop"),
+        curr_dl=state.curr_dl.at[widx].set(z, mode="drop"),
+        prev=state.prev.at[widx].set(z, mode="drop"),
+        prev_dl=state.prev_dl.at[widx].set(z, mode="drop"),
+    )

@@ -1,0 +1,24 @@
+"""Batch sort/unsort helpers.
+
+Stable argsort by slot id groups duplicate keys into contiguous segments
+while preserving arrival order within each segment — the order the
+sequential semantics are defined over.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def sort_batch(slots: jnp.ndarray, *others: jnp.ndarray):
+    """Stable-sort the batch by slot id.
+
+    Returns (order, sorted_slots, tuple_of_sorted_others).
+    """
+    order = jnp.argsort(slots, stable=True)
+    return order, slots[order], tuple(o[order] for o in others)
+
+
+def unsort(x: jnp.ndarray, order: jnp.ndarray) -> jnp.ndarray:
+    """Invert the sort permutation (scatter back to arrival order)."""
+    return jnp.zeros_like(x).at[order].set(x)

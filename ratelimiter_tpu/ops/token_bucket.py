@@ -1,0 +1,139 @@
+"""Batched token-bucket decision step (device side).
+
+One invocation is the vectorized equivalent of N executions of the
+reference's atomic Lua script (TokenBucketRateLimiter.java:38-68): lazy init
+on absent/expired buckets, exact fixed-point refill, sequential-semantics
+consume within duplicate-slot segments, and write-back (tokens, last_refill,
+TTL=2x window) only for slots where at least one request was allowed — a
+fully-denied slot keeps its prior state bit-for-bit, like the Lua deny
+branch that performs no writes.
+
+Decision math is the exact fixed-point model of
+``semantics/oracle.py:TokenBucketOracle``; requests above bucket capacity
+are rejected without touching state (TokenBucketRateLimiter.java:110-116).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+from ratelimiter_tpu.engine.state import TBState, TableArrays
+from ratelimiter_tpu.ops.segments import (
+    first_occurrence,
+    last_occurrence,
+    segment_totals,
+    segmented_cumsum_exclusive,
+    solve_threshold_recurrence,
+)
+from ratelimiter_tpu.ops.sorting import sort_batch, unsort
+
+
+class TBOut(NamedTuple):
+    allowed: jnp.ndarray    # bool[B]
+    observed: jnp.ndarray   # i64[B] — whole tokens available pre-consume
+    remaining: jnp.ndarray  # i64[B] — whole tokens after the operation
+
+
+def _refilled(state_rows, cap, rate, now):
+    """Lazy-init + exact fixed-point refill (oracle: _refilled)."""
+    tokens, last, dl = state_rows
+    expired = now >= dl  # zero state reads as expired -> fresh full bucket
+    v0 = jnp.where(expired, cap, tokens)
+    last_e = jnp.where(expired, now, last)
+    elapsed = jnp.clip(now - last_e, 0, cap // jnp.maximum(rate, 1) + 1)
+    return jnp.minimum(cap, v0 + elapsed * rate)
+
+
+def tb_step(
+    state: TBState,
+    table: TableArrays,
+    slots: jnp.ndarray,        # i32[B]; < 0 = padding
+    limiter_ids: jnp.ndarray,  # i32[B]
+    permits: jnp.ndarray,      # i64[B]
+    now: jnp.ndarray,          # i64 scalar
+):
+    """Returns (new_state, TBOut) — jit with donate_argnums=0."""
+    order, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, state.tokens_fp.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.cap_fp.shape[0] - 1)
+
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    maxp = table.max_permits[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+
+    rows = (state.tokens_fp[sc], state.last_refill[sc], state.deadline[sc])
+    v1 = _refilled(rows, cap, rate, now)
+
+    req = p * TOKEN_FP_ONE
+    # Client-side reject above capacity; padding never passes.
+    pre_ok = valid & (p <= maxp)
+    # inc[j] = [ W[j] + req[j] <= v1 ],  W = fp tokens consumed by prior
+    # requests in the segment (all share `now`, so no intra-batch refill —
+    # matching the oracle at equal timestamps).
+    u = jnp.where(pre_ok, v1 - req, -1)
+    first = first_occurrence(s)
+    inc = solve_threshold_recurrence(u, req, first)
+    W = segmented_cumsum_exclusive(req * inc, first)
+
+    v_j = v1 - W                         # fp tokens seen by request j
+    allowed = inc == 1
+    after = v_j - req * inc              # Lua returns tokens post-op either way
+
+    # Per-segment write-back only where something was allowed.
+    lastm = last_occurrence(s) & valid
+    tot_w = segment_totals(req * inc, first)
+    tot_inc = segment_totals(inc, first)
+    any_inc = tot_inc > 0
+    tokens_new = jnp.where(any_inc, v1 - tot_w, rows[0])
+    last_new = jnp.where(any_inc, now, rows[1])
+    dl_new = jnp.where(any_inc, now + ttl2, rows[2])
+
+    n_slots = state.tokens_fp.shape[0]
+    widx = jnp.where(lastm, sc, n_slots)
+    new_state = TBState(
+        tokens_fp=state.tokens_fp.at[widx].set(tokens_new, mode="drop"),
+        last_refill=state.last_refill.at[widx].set(last_new, mode="drop"),
+        deadline=state.deadline.at[widx].set(dl_new, mode="drop"),
+    )
+
+    out = TBOut(
+        allowed=unsort(allowed & valid, order),
+        observed=unsort(v_j // TOKEN_FP_ONE, order),
+        remaining=unsort(after // TOKEN_FP_ONE, order),
+    )
+    return new_state, out
+
+
+def tb_peek(
+    state: TBState,
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    now: jnp.ndarray,
+) -> jnp.ndarray:
+    """Read-only refilled whole-token count (the fixed availablePermits —
+    quirk Q3 in the reference always crashed here)."""
+    sc = jnp.clip(slots, 0, state.tokens_fp.shape[0] - 1)
+    lidc = jnp.clip(limiter_ids, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    rows = (state.tokens_fp[sc], state.last_refill[sc], state.deadline[sc])
+    v1 = _refilled(rows, cap, rate, now)
+    return v1 // TOKEN_FP_ONE
+
+
+def tb_reset(state: TBState, slots: jnp.ndarray) -> TBState:
+    """Zero the given slots (delete bucket, TokenBucketRateLimiter.java:154-158)."""
+    n = state.tokens_fp.shape[0]
+    widx = jnp.where(slots >= 0, slots, n)
+    z = jnp.zeros_like(slots, dtype=jnp.int64)
+    return TBState(
+        tokens_fp=state.tokens_fp.at[widx].set(z, mode="drop"),
+        last_refill=state.last_refill.at[widx].set(z, mode="drop"),
+        deadline=state.deadline.at[widx].set(z, mode="drop"),
+    )
