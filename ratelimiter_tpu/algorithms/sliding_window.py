@@ -59,6 +59,14 @@ class SlidingWindowRateLimiter(RateLimiter):
         self._cache_hits = meter_registry.counter(
             "ratelimiter.cache.hits", "Number of local cache hits")
 
+        # TPU-batched backend: whole decisions execute as device kernels
+        # behind the same storage boundary; per-op storage calls otherwise.
+        self._lid = (
+            storage.register_limiter("sw", config)
+            if getattr(storage, "supports_device_batching", False)
+            else None
+        )
+
     # -- RateLimiter ----------------------------------------------------------
     def try_acquire(self, key: str, permits: int = 1) -> bool:
         if permits <= 0:
@@ -72,6 +80,14 @@ class SlidingWindowRateLimiter(RateLimiter):
                 self._cache_hits.increment()
                 self._rejected.increment()
                 return False
+
+        if self._lid is not None:
+            out = self._storage.acquire("sw", self._lid, key, permits)
+            if self._local_cache is not None:
+                self._local_cache.put(key, int(out["cache_value"]))
+            allowed = bool(out["allowed"])
+            (self._allowed if allowed else self._rejected).increment()
+            return allowed
 
         now = self._clock_ms()
         current = self._current_count(key, now)
@@ -97,11 +113,40 @@ class SlidingWindowRateLimiter(RateLimiter):
         (self._allowed if allowed else self._rejected).increment()
         return allowed
 
+    def try_acquire_many(self, keys, permits=None):
+        """Vectorized tryAcquire — one device batch for the whole call on the
+        TPU backend (falls back to the scalar loop otherwise)."""
+        if self._lid is None:
+            return super().try_acquire_many(keys, permits)
+        import numpy as np
+
+        n = len(keys)
+        permits = [1] * n if permits is None else [int(p) for p in permits]
+        if any(p <= 0 for p in permits):
+            raise ValueError("permits must be positive")
+        out = self._storage.acquire_many(
+            "sw", [self._lid] * n, list(keys), permits)
+        allowed = np.asarray(out["allowed"], dtype=bool)
+        if self._local_cache is not None:
+            for k, v in zip(keys, out["cache_value"]):
+                self._local_cache.put(k, int(v))
+        n_allowed = int(allowed.sum())
+        self._allowed.add(n_allowed)
+        self._rejected.add(n - n_allowed)
+        return allowed
+
     def get_available_permits(self, key: str) -> int:
+        if self._lid is not None:
+            return int(self._storage.available_many("sw", self._lid, [key])[0])
         current = self._current_count(key, self._clock_ms())
         return max(0, self._config.max_permits - current)
 
     def reset(self, key: str) -> None:
+        if self._lid is not None:
+            self._storage.reset_key("sw", self._lid, key)
+            if self._local_cache is not None:
+                self._local_cache.invalidate(key)
+            return
         now = self._clock_ms()
         win = self._config.window_ms
         # Clear current and previous windows

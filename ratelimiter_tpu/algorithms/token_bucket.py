@@ -51,6 +51,12 @@ class TokenBucketRateLimiter(RateLimiter):
         self._rejected = meter_registry.counter(
             "ratelimiter.tokenbucket.rejected", "Rejected requests (token bucket)")
 
+        self._lid = (
+            storage.register_limiter("tb", config)
+            if getattr(storage, "supports_device_batching", False)
+            else None
+        )
+
     # -- RateLimiter ----------------------------------------------------------
     def try_acquire(self, key: str, permits: int = 1) -> bool:
         if permits <= 0:
@@ -61,6 +67,12 @@ class TokenBucketRateLimiter(RateLimiter):
             # (TokenBucketRateLimiter.java:110-116).
             self._rejected.increment()
             return False
+
+        if self._lid is not None:
+            out = self._storage.acquire("tb", self._lid, key, permits)
+            allowed = bool(out["allowed"])
+            (self._allowed if allowed else self._rejected).increment()
+            return allowed
 
         now = self._clock_ms()
         allowed_flag, _tokens_fp = self._storage.eval_script(
@@ -78,7 +90,28 @@ class TokenBucketRateLimiter(RateLimiter):
         (self._allowed if allowed else self._rejected).increment()
         return allowed
 
+    def try_acquire_many(self, keys, permits=None):
+        """Vectorized tryAcquire — one device batch on the TPU backend."""
+        if self._lid is None:
+            return super().try_acquire_many(keys, permits)
+        import numpy as np
+
+        n = len(keys)
+        permits = [1] * n if permits is None else [int(p) for p in permits]
+        if any(p <= 0 for p in permits):
+            raise ValueError("permits must be positive")
+        # The device kernel itself rejects permits > capacity pre-consume.
+        out = self._storage.acquire_many(
+            "tb", [self._lid] * n, list(keys), permits)
+        allowed = np.asarray(out["allowed"], dtype=bool)
+        n_allowed = int(allowed.sum())
+        self._allowed.add(n_allowed)
+        self._rejected.add(n - n_allowed)
+        return allowed
+
     def get_available_permits(self, key: str) -> int:
+        if self._lid is not None:
+            return int(self._storage.available_many("tb", self._lid, [key])[0])
         cfg = self._config
         (tokens_fp,) = self._storage.eval_script(
             "token_bucket_peek",
@@ -88,4 +121,7 @@ class TokenBucketRateLimiter(RateLimiter):
         return tokens_fp // TOKEN_FP_ONE
 
     def reset(self, key: str) -> None:
+        if self._lid is not None:
+            self._storage.reset_key("tb", self._lid, key)
+            return
         self._storage.delete(f"tb:{key}")

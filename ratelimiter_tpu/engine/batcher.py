@@ -1,0 +1,170 @@
+"""Micro-batcher: coalesces concurrent tryAcquire calls into device batches.
+
+The reference's unit of concurrency is a servlet thread blocking on a Redis
+round-trip (~800 us, ARCHITECTURE.md latency model); ours is a Future that
+resolves when the next device batch lands.  Threads submit requests; a
+dedicated flusher thread dispatches a batch when either
+
+- the pending batch reaches ``max_batch``, or
+- the oldest pending request has waited ``max_delay_ms`` (adaptive flush:
+  size OR deadline — SURVEY.md §7 "Batching latency vs p99"),
+
+whichever comes first.  The queue lock is released during device execution
+so new requests accumulate while the previous batch runs (host/device
+pipelining); dispatches are serialized, preserving batch order, which is
+what makes eviction-clears safe (cleared slots are zeroed in the same
+dispatch stream before the batch that reuses them).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Set
+
+
+class _Pending:
+    __slots__ = ("slots", "lids", "permits", "futures", "clears", "born")
+
+    def __init__(self):
+        self.slots: List[int] = []
+        self.lids: List[int] = []
+        self.permits: List[int] = []
+        self.futures: List[Future] = []
+        self.clears: List[int] = []
+        self.born: float | None = None  # monotonic time of oldest request
+
+
+class MicroBatcher:
+    """One batching queue per algorithm kind ('sw' | 'tb')."""
+
+    def __init__(
+        self,
+        dispatch: Dict[str, Callable],      # algo -> fn(slots, lids, permits) -> dict
+        clear: Dict[str, Callable],         # algo -> fn(slots) -> None
+        max_batch: int = 8192,
+        max_delay_ms: float = 0.5,
+    ):
+        self._dispatch = dispatch
+        self._clear = clear
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self._cv = threading.Condition()
+        self._pending: Dict[str, _Pending] = {a: _Pending() for a in dispatch}
+        self._dispatch_lock = threading.Lock()  # serializes device batches
+        self._closed = False
+        self._flusher = threading.Thread(
+            target=self._run, name="ratelimiter-flusher", daemon=True)
+        self._flusher.start()
+
+    # -- submission -----------------------------------------------------------
+    def submit(self, algo: str, slot: int, lid: int, permits: int) -> Future:
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            pend = self._pending[algo]
+            if pend.born is None:
+                pend.born = time.monotonic()
+            pend.slots.append(slot)
+            pend.lids.append(lid)
+            pend.permits.append(permits)
+            pend.futures.append(fut)
+            self._cv.notify()
+        return fut
+
+    def add_clear(self, algo: str, slot: int) -> None:
+        """Schedule a slot zeroing ahead of the next batch (eviction)."""
+        with self._cv:
+            pend = self._pending[algo]
+            if pend.born is None:
+                pend.born = time.monotonic()
+            pend.clears.append(slot)
+            self._cv.notify()
+
+    def pending_slots(self, algo: str) -> Set[int]:
+        """Slots referenced by queued requests (pin set for eviction)."""
+        with self._cv:
+            return set(self._pending[algo].slots)
+
+    # -- flushing -------------------------------------------------------------
+    def _take(self, algo: str) -> _Pending | None:
+        pend = self._pending[algo]
+        if not pend.slots and not pend.clears:
+            return None
+        self._pending[algo] = _Pending()
+        return pend
+
+    def flush(self) -> None:
+        """Synchronously dispatch everything pending (admin/reset/shutdown)."""
+        with self._cv:
+            taken = {a: self._take(a) for a in self._pending}
+        self._execute(taken)
+
+    def _execute(self, taken) -> None:
+        with self._dispatch_lock:
+            self._execute_locked(taken)
+
+    def _execute_locked(self, taken) -> None:
+        for algo, pend in taken.items():
+            if pend is None:
+                continue
+            try:
+                if pend.clears:
+                    self._clear[algo](pend.clears)
+                if pend.slots:
+                    out = self._dispatch[algo](pend.slots, pend.lids, pend.permits)
+                    for i, fut in enumerate(pend.futures):
+                        fut.set_result({k: v[i] for k, v in out.items()})
+            except Exception as exc:  # noqa: BLE001 — fail every waiter
+                for fut in pend.futures:
+                    if not fut.done():
+                        fut.set_exception(exc)
+
+    def dispatch_direct(self, algo: str, slots, lids, permits, clears=None):
+        """Synchronous whole-batch dispatch (the vectorized/bench path).
+
+        Flushes everything pending first, then runs this batch under the same
+        dispatch lock — so direct batches serialize with queued traffic and
+        see a consistent state stream.
+        """
+        with self._cv:
+            taken = {a: self._take(a) for a in self._pending}
+        with self._dispatch_lock:
+            self._execute_locked(taken)
+            if clears:
+                self._clear[algo](clears)
+            return self._dispatch[algo](slots, lids, permits)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._closed:
+                    now = time.monotonic()
+                    ready, wait = [], None
+                    for algo, pend in self._pending.items():
+                        if pend.born is None:
+                            continue
+                        age = now - pend.born
+                        if len(pend.slots) >= self.max_batch or age >= self.max_delay_s:
+                            ready.append(algo)
+                        else:
+                            remaining = self.max_delay_s - age
+                            wait = remaining if wait is None else min(wait, remaining)
+                    if ready:
+                        break
+                    self._cv.wait(timeout=wait)
+                if self._closed and not any(
+                    p.born is not None for p in self._pending.values()
+                ):
+                    return
+                taken = {a: self._take(a) for a in self._pending}
+            self._execute(taken)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._flusher.join(timeout=5)
+        self.flush()

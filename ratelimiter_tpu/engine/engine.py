@@ -11,6 +11,7 @@ index + micro-batcher) lives in engine/slots.py and engine/batcher.py.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import jax
@@ -55,6 +56,11 @@ class DeviceEngine:
     def __init__(self, num_slots: int, table: LimiterTable):
         self.num_slots = int(num_slots)
         self.table = table
+        # The step functions donate the state buffers (in-place HBM updates),
+        # so every access — including read-only peeks, which must not grab a
+        # reference that a concurrent step is about to invalidate — is
+        # serialized through this lock.
+        self._lock = threading.RLock()
         self.sw_state: SWState = make_sw_state(self.num_slots)
         self.tb_state: TBState = make_tb_state(self.num_slots)
         self._sw_step = jax.jit(sw_step, donate_argnums=0)
@@ -70,6 +76,10 @@ class DeviceEngine:
         (allowed, mutated, observed, cache_value), trimmed to the input size."""
         n = len(slots)
         size = _bucket_size(n)
+        with self._lock:
+            return self._sw_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
+
+    def _sw_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
         new_state, out = self._sw_step(
             self.sw_state,
             self.table.device_arrays,
@@ -89,6 +99,10 @@ class DeviceEngine:
     def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
         n = len(slots)
         size = _bucket_size(n)
+        with self._lock:
+            return self._tb_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
+
+    def _tb_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
         new_state, out = self._tb_step(
             self.tb_state,
             self.table.device_arrays,
@@ -108,37 +122,42 @@ class DeviceEngine:
     def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
         n = len(slots)
         size = _bucket_size(n)
-        out = self._sw_peek(
-            self.sw_state,
-            self.table.device_arrays,
-            _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
-            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-            jnp.int64(now_ms),
-        )
+        with self._lock:
+            out = self._sw_peek(
+                self.sw_state,
+                self.table.device_arrays,
+                _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
+                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+                jnp.int64(now_ms),
+            )
         return np.asarray(out)[:n]
 
     def tb_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
         n = len(slots)
         size = _bucket_size(n)
-        out = self._tb_peek(
-            self.tb_state,
-            self.table.device_arrays,
-            _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
-            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-            jnp.int64(now_ms),
-        )
+        with self._lock:
+            out = self._tb_peek(
+                self.tb_state,
+                self.table.device_arrays,
+                _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
+                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+                jnp.int64(now_ms),
+            )
         return np.asarray(out)[:n]
 
     # -- reset ----------------------------------------------------------------
     def sw_clear(self, slots: Sequence[int]) -> None:
         size = _bucket_size(max(len(slots), 1))
-        self.sw_state = self._sw_reset(
-            self.sw_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+        with self._lock:
+            self.sw_state = self._sw_reset(
+                self.sw_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
         size = _bucket_size(max(len(slots), 1))
-        self.tb_state = self._tb_reset(
-            self.tb_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+        with self._lock:
+            self.tb_state = self._tb_reset(
+                self.tb_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
     def block_until_ready(self) -> None:
-        jax.block_until_ready((self.sw_state, self.tb_state))
+        with self._lock:
+            jax.block_until_ready((self.sw_state, self.tb_state))

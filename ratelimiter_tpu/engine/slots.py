@@ -1,0 +1,88 @@
+"""Host-side key -> slot index.
+
+The device state is a fixed-capacity slot array (engine/state.py); this
+index owns the mapping from (limiter_id, key) strings to slot ids.  It is
+the TPU build's analog of two reference mechanisms at once:
+
+- Redis's keyspace + TTL eviction (keys hash into Redis; expired keys are
+  collected lazily) — here: LRU-ordered assignment with eviction of the
+  least-recently-touched key when the slot array is full;
+- the Caffeine cache's role as the host-side key bookkeeping
+  (BASELINE.json north star: "the Caffeine local cache is repurposed as the
+  host-side key->slot index").
+
+Eviction contract: an evicted slot's device state MUST be cleared before the
+slot is reused (a zeroed slot behaves as an absent key).  ``assign`` returns
+the slot to clear, and callers (the micro-batcher) schedule the clear ahead
+of the reusing batch.  Slots referenced by the currently-pending batch can
+be pinned so eviction never pulls state out from under queued requests.
+
+A faster C++ implementation with the same interface lives in
+``native/slot_index.cpp`` (see engine/native_index.py); this pure-Python
+version is the portable fallback and the semantic reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Hashable, Optional, Set, Tuple
+
+
+class SlotIndex:
+    """LRU slot assignment over a fixed slot capacity."""
+
+    def __init__(self, num_slots: int):
+        if num_slots <= 0:
+            raise ValueError("num_slots must be positive")
+        self.num_slots = int(num_slots)
+        self._lock = threading.Lock()
+        self._map: "OrderedDict[Hashable, int]" = OrderedDict()  # key -> slot, LRU order
+        self._free = list(range(self.num_slots - 1, -1, -1))
+
+    def get(self, key: Hashable) -> Optional[int]:
+        """Slot for key, or None; refreshes recency."""
+        with self._lock:
+            slot = self._map.get(key)
+            if slot is not None:
+                self._map.move_to_end(key)
+            return slot
+
+    def assign(
+        self, key: Hashable, pinned: Optional[Set[int]] = None
+    ) -> Tuple[int, Optional[int]]:
+        """Slot for key, allocating (and possibly evicting) if absent.
+
+        Returns (slot, evicted_slot): ``evicted_slot`` is not None when an
+        LRU victim was displaced — its device state must be cleared before
+        this slot's next use.  Raises RuntimeError if every slot is pinned.
+        """
+        with self._lock:
+            slot = self._map.get(key)
+            if slot is not None:
+                self._map.move_to_end(key)
+                return slot, None
+            if self._free:
+                slot = self._free.pop()
+                self._map[key] = slot
+                return slot, None
+            # Evict the least-recently-used non-pinned key.
+            for victim_key, victim_slot in self._map.items():
+                if pinned and victim_slot in pinned:
+                    continue
+                del self._map[victim_key]
+                self._map[key] = victim_slot
+                return victim_slot, victim_slot
+            raise RuntimeError("all slots pinned; increase num_slots or flush")
+
+    def remove(self, key: Hashable) -> Optional[int]:
+        """Drop a key (admin reset); returns its slot (caller clears it)."""
+        with self._lock:
+            slot = self._map.pop(key, None)
+            if slot is not None:
+                self._free.append(slot)
+            return slot
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)

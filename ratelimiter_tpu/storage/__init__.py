@@ -1,5 +1,12 @@
 from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
 from ratelimiter_tpu.storage.memory import InMemoryStorage
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
-__all__ = ["RateLimitStorage", "InMemoryStorage", "RetryPolicy", "StorageException"]
+__all__ = [
+    "RateLimitStorage",
+    "InMemoryStorage",
+    "TpuBatchedStorage",
+    "RetryPolicy",
+    "StorageException",
+]
