@@ -161,3 +161,8 @@ class DeviceEngine:
     def block_until_ready(self) -> None:
         with self._lock:
             jax.block_until_ready((self.sw_state, self.tb_state))
+
+    def make_slot_index(self):
+        from ratelimiter_tpu.engine.slots import SlotIndex
+
+        return SlotIndex(self.num_slots)

@@ -1,0 +1,10 @@
+from ratelimiter_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from ratelimiter_tpu.parallel.sharded import ShardedDeviceEngine, ShardedSlotIndex, shard_of_key
+
+__all__ = [
+    "SHARD_AXIS",
+    "make_mesh",
+    "ShardedDeviceEngine",
+    "ShardedSlotIndex",
+    "shard_of_key",
+]

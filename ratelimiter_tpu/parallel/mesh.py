@@ -1,0 +1,35 @@
+"""Device mesh construction for key-space sharding.
+
+The reference scales horizontally by running N app instances against one
+Redis (README "Horizontal scaling"), with Redis Cluster sharding the
+keyspace when one server is not enough (ARCHITECTURE.md scaling section).
+The TPU-native equivalent is a 1-D ``jax.sharding.Mesh`` over the available
+chips: the slot array is sharded over the ``shard`` axis, every key hashes
+to exactly one shard, and the hot path needs **no cross-device traffic** —
+decisions are embarrassingly parallel across the key space, exactly like
+Redis Cluster hash slots.  Only aggregate metrics ride a ``psum`` over ICI.
+
+Multi-host deployments stack the same design over DCN: each host process
+owns the shards of its local chips, and the service tier routes keys to
+hosts by the same hash — the hot path never crosses DCN (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices: Optional[Sequence] = None, n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over ``devices`` (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

@@ -1,0 +1,308 @@
+"""Multi-chip decision engine: slot state sharded over a device mesh.
+
+``shard_map`` over a 1-D mesh runs the *same* single-device step
+(ops/sliding_window.py, ops/token_bucket.py) independently on every shard's
+partition of the slot array.  Keys are pinned to shards by hash, so a
+request batch is routed host-side into per-shard sub-batches of identical
+shape ``(n_shards, B)`` — SPMD with zero cross-shard traffic on the hot
+path (the Redis-Cluster-hash-slots analog; SURVEY.md §2 "Parallelism
+strategies").  The only collective is a ``psum`` over the mesh that
+aggregates per-step allow/deny totals for metrics.
+
+The global state lives as ``(n_shards, S_local)`` arrays with
+``NamedSharding(P('shard', None))`` — on a real TPU slice each row is
+resident in one chip's HBM and updates happen entirely chip-locally over
+ICI-free code; the same program runs unchanged on the CPU test mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import zlib
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ratelimiter_tpu.engine.slots import SlotIndex
+from ratelimiter_tpu.engine.state import (
+    LimiterTable,
+    SWState,
+    TBState,
+)
+from ratelimiter_tpu.ops.sliding_window import SWOut, sw_peek, sw_reset, sw_step
+from ratelimiter_tpu.ops.token_bucket import TBOut, tb_peek, tb_reset, tb_step
+from ratelimiter_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+_MIN_BATCH = 256
+
+
+def _bucket(n: int) -> int:
+    size = _MIN_BATCH
+    while size < n:
+        size *= 2
+    return size
+
+
+def shard_of_key(key, n_shards: int) -> int:
+    """Deterministic, process-independent key -> shard hash (crc32), so a
+    multi-host router and this engine always agree."""
+    return zlib.crc32(repr(key).encode()) % n_shards
+
+
+class ShardedSlotIndex:
+    """Key -> global slot with per-shard LRU sub-indexes.
+
+    Global slot id = shard * slots_per_shard + local slot; eviction is
+    shard-local (a key's state never migrates between shards).
+    """
+
+    def __init__(self, slots_per_shard: int, n_shards: int):
+        self.slots_per_shard = int(slots_per_shard)
+        self.n_shards = int(n_shards)
+        self.num_slots = self.slots_per_shard * self.n_shards
+        self._sub = [SlotIndex(self.slots_per_shard) for _ in range(self.n_shards)]
+
+    def _split(self, global_slot: int):
+        return divmod(global_slot, self.slots_per_shard)
+
+    def get(self, key):
+        shard = shard_of_key(key, self.n_shards)
+        local = self._sub[shard].get(key)
+        return None if local is None else shard * self.slots_per_shard + local
+
+    def assign(self, key, pinned=None):
+        shard = shard_of_key(key, self.n_shards)
+        local_pinned = None
+        if pinned:
+            local_pinned = {
+                s % self.slots_per_shard
+                for s in pinned
+                if s // self.slots_per_shard == shard
+            }
+        local, evicted = self._sub[shard].assign(key, pinned=local_pinned)
+        base = shard * self.slots_per_shard
+        return base + local, None if evicted is None else base + evicted
+
+    def remove(self, key):
+        shard = shard_of_key(key, self.n_shards)
+        local = self._sub[shard].remove(key)
+        return None if local is None else shard * self.slots_per_shard + local
+
+    def __len__(self):
+        return sum(len(s) for s in self._sub)
+
+
+# ---------------------------------------------------------------------------
+# Sharded step construction
+# ---------------------------------------------------------------------------
+
+def _squeeze(state):
+    return type(state)(*(f[0] for f in state))
+
+
+def _expand(state):
+    return type(state)(*(f[None] for f in state))
+
+
+def build_sharded_sw_step(mesh):
+    """shard_map'd sliding-window step over (n_shards, S_local) state and
+    (n_shards, B) batches; returns (state, out, global allow/deny totals)."""
+
+    def local_step(state, table, slots, lids, permits, now):
+        new_state, out = sw_step(_squeeze(state), table, slots[0], lids[0],
+                                 permits[0], now)
+        n_allowed = jnp.sum(out.allowed.astype(jnp.int64))
+        n_total = jnp.sum((slots[0] >= 0).astype(jnp.int64))
+        totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
+        return _expand(new_state), SWOut(*(f[None] for f in out)), totals
+
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+    )
+
+
+def build_sharded_tb_step(mesh):
+    def local_step(state, table, slots, lids, permits, now):
+        new_state, out = tb_step(_squeeze(state), table, slots[0], lids[0],
+                                 permits[0], now)
+        n_allowed = jnp.sum(out.allowed.astype(jnp.int64))
+        n_total = jnp.sum((slots[0] >= 0).astype(jnp.int64))
+        totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
+        return _expand(new_state), TBOut(*(f[None] for f in out)), totals
+
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+    )
+
+
+def build_sharded_peek(mesh, peek_fn):
+    def local_peek(state, table, slots, lids, now):
+        out = peek_fn(_squeeze(state), table, slots[0], lids[0], now)
+        return out[None]
+
+    return jax.shard_map(
+        local_peek,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=P(SHARD_AXIS),
+    )
+
+
+def build_sharded_reset(mesh, reset_fn):
+    def local_reset(state, slots):
+        return _expand(reset_fn(_squeeze(state), slots[0]))
+
+    return jax.shard_map(
+        local_reset,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(SHARD_AXIS),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class ShardedDeviceEngine:
+    """Drop-in DeviceEngine with state sharded over a mesh.
+
+    Public surface is identical (global slot ids in, numpy decisions out);
+    host-side routing scatters each request to its shard's row and unscatters
+    the results.  Exposes ``last_step_totals`` = (allowed, total) aggregated
+    across all shards by the on-device psum.
+    """
+
+    def __init__(self, slots_per_shard: int, table: LimiterTable, mesh=None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_shards = self.mesh.devices.size
+        self.slots_per_shard = int(slots_per_shard)
+        self.num_slots = self.n_shards * self.slots_per_shard
+        self.table = table
+        self._lock = threading.RLock()
+        self.last_step_totals = (0, 0)
+
+        shape = (self.n_shards, self.slots_per_shard)
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+
+        def zeros():
+            return jax.device_put(jnp.zeros(shape, dtype=jnp.int64), sharding)
+
+        self.sw_state = SWState(*(zeros() for _ in range(5)))
+        self.tb_state = TBState(*(zeros() for _ in range(3)))
+
+        self._sw_step = jax.jit(build_sharded_sw_step(self.mesh), donate_argnums=0)
+        self._tb_step = jax.jit(build_sharded_tb_step(self.mesh), donate_argnums=0)
+        self._sw_peek = jax.jit(build_sharded_peek(self.mesh, sw_peek))
+        self._tb_peek = jax.jit(build_sharded_peek(self.mesh, tb_peek))
+        self._sw_reset = jax.jit(build_sharded_reset(self.mesh, sw_reset), donate_argnums=0)
+        self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset), donate_argnums=0)
+
+    def make_slot_index(self) -> ShardedSlotIndex:
+        return ShardedSlotIndex(self.slots_per_shard, self.n_shards)
+
+    # -- routing --------------------------------------------------------------
+    def _route(self, slots, fill_extra=None):
+        """Scatter global-slot requests into (n_shards, B) rows.
+
+        Returns (mat_local_slots, row_of_req, col_of_req, B).
+        """
+        slots = np.asarray(slots, dtype=np.int64)
+        shard = slots // self.slots_per_shard
+        local = slots % self.slots_per_shard
+        counts = np.bincount(shard, minlength=self.n_shards)
+        B = _bucket(max(int(counts.max(initial=0)), 1))
+        order = np.argsort(shard, kind="stable")
+        offsets = np.zeros(self.n_shards + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        cols = np.empty(len(slots), dtype=np.int64)
+        cols[order] = np.arange(len(slots)) - offsets[shard[order]]
+        mat = np.full((self.n_shards, B), -1, dtype=np.int32)
+        mat[shard, cols] = local
+        return mat, shard, cols, B
+
+    def _route_batch(self, slots, limiter_ids, permits):
+        mat, shard, cols, B = self._route(slots)
+        lids = np.zeros((self.n_shards, B), dtype=np.int32)
+        perms = np.ones((self.n_shards, B), dtype=np.int64)
+        lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
+        perms[shard, cols] = np.asarray(permits, dtype=np.int64)
+        return mat, lids, perms, shard, cols
+
+    # -- public API (mirrors DeviceEngine) ------------------------------------
+    def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
+        with self._lock:
+            new_state, out, totals = self._sw_step(
+                self.sw_state, self.table.device_arrays,
+                jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
+                jnp.int64(now_ms))
+            self.sw_state = new_state
+            totals = np.asarray(totals)
+            self.last_step_totals = (int(totals[0]), int(totals[1]))
+            return {
+                "allowed": np.asarray(out.allowed)[shard, cols],
+                "mutated": np.asarray(out.mutated)[shard, cols],
+                "observed": np.asarray(out.observed)[shard, cols],
+                "cache_value": np.asarray(out.cache_value)[shard, cols],
+            }
+
+    def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
+        with self._lock:
+            new_state, out, totals = self._tb_step(
+                self.tb_state, self.table.device_arrays,
+                jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
+                jnp.int64(now_ms))
+            self.tb_state = new_state
+            totals = np.asarray(totals)
+            self.last_step_totals = (int(totals[0]), int(totals[1]))
+            return {
+                "allowed": np.asarray(out.allowed)[shard, cols],
+                "observed": np.asarray(out.observed)[shard, cols],
+                "remaining": np.asarray(out.remaining)[shard, cols],
+            }
+
+    def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
+        mat, shard, cols, B = self._route(slots)
+        lids = np.zeros((self.n_shards, B), dtype=np.int32)
+        lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
+        mat = np.maximum(mat, 0)  # peek clamps; padding read is discarded
+        with self._lock:
+            out = self._sw_peek(self.sw_state, self.table.device_arrays,
+                                jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
+        return np.asarray(out)[shard, cols]
+
+    def tb_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
+        mat, shard, cols, B = self._route(slots)
+        lids = np.zeros((self.n_shards, B), dtype=np.int32)
+        lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
+        mat = np.maximum(mat, 0)
+        with self._lock:
+            out = self._tb_peek(self.tb_state, self.table.device_arrays,
+                                jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
+        return np.asarray(out)[shard, cols]
+
+    def sw_clear(self, slots: Sequence[int]) -> None:
+        mat, _, _, _ = self._route(slots)
+        with self._lock:
+            self.sw_state = self._sw_reset(self.sw_state, jnp.asarray(mat))
+
+    def tb_clear(self, slots: Sequence[int]) -> None:
+        mat, _, _, _ = self._route(slots)
+        with self._lock:
+            self.tb_state = self._tb_reset(self.tb_state, jnp.asarray(mat))
+
+    def block_until_ready(self) -> None:
+        with self._lock:
+            jax.block_until_ready((self.sw_state, self.tb_state))

@@ -35,7 +35,6 @@ import numpy as np
 from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.engine.batcher import MicroBatcher
 from ratelimiter_tpu.engine.engine import DeviceEngine
-from ratelimiter_tpu.engine.slots import SlotIndex
 from ratelimiter_tpu.engine.state import LimiterTable
 from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.memory import InMemoryStorage
@@ -58,10 +57,17 @@ class TpuBatchedStorage(RateLimitStorage):
         table: LimiterTable | None = None,
     ):
         self._clock_ms = clock_ms
+        if engine is not None and table is None:
+            table = engine.table
         self.table = table if table is not None else LimiterTable()
         self.engine = engine if engine is not None else DeviceEngine(num_slots, self.table)
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
-        self._index = {"sw": SlotIndex(num_slots), "tb": SlotIndex(num_slots)}
+        # The engine decides the index shape: flat LRU for single device,
+        # per-shard LRU (key pinned to shard by hash) for a sharded engine.
+        self._index = {
+            "sw": self.engine.make_slot_index(),
+            "tb": self.engine.make_slot_index(),
+        }
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
         self._batcher = MicroBatcher(
             dispatch={
