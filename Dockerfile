@@ -1,0 +1,26 @@
+# ratelimiter_tpu service image (C16 parity: the reference ships a two-stage
+# JVM build; a Python/JAX service needs no build stage — the "compile" happens
+# at first jit, cached via a warmed persistent compilation cache layer).
+#
+# For TPU hosts, swap the base image for one with libtpu and run with
+# --privileged (or the TPU device plugin under Kubernetes).
+FROM python:3.12-slim
+
+RUN useradd --create-home ratelimiter
+WORKDIR /app
+
+# jax[cpu] serves the CPU fallback; on TPU VMs the host-provided jax/libtpu
+# is mounted instead.
+RUN pip install --no-cache-dir "jax[cpu]" numpy
+
+COPY ratelimiter_tpu/ ratelimiter_tpu/
+COPY application.properties .
+
+USER ratelimiter
+EXPOSE 8080
+
+HEALTHCHECK --interval=10s --timeout=3s --retries=3 \
+  CMD python -c "import urllib.request,sys; \
+    sys.exit(0 if b'UP' in urllib.request.urlopen('http://localhost:8080/api/health', timeout=2).read() else 1)"
+
+CMD ["python", "-m", "ratelimiter_tpu.service.app", "application.properties"]

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# End-to-end demo driver for ratelimiter_tpu (C17 parity: the reference ships
+# a 6-scenario curl walkthrough; this is the same idea against our service).
+#
+# Usage: ./demo.sh [BASE_URL]     (default http://localhost:8080)
+# Start the server first:  python -m ratelimiter_tpu.service.app
+
+set -euo pipefail
+BASE="${1:-http://localhost:8080}"
+
+say()  { printf '\n\033[1;36m== %s ==\033[0m\n' "$*"; }
+call() { curl -s -w '\n  -> HTTP %{http_code}\n' "$@"; }
+
+say "0. Health"
+call "$BASE/api/health"
+
+say "1. Standard API traffic (sliding window, 100/min) as user demo-1"
+for i in 1 2 3; do
+  call -H 'X-User-ID: demo-1' "$BASE/api/data"
+done
+
+say "2. Anonymous traffic shares one key"
+call "$BASE/api/data"
+
+say "3. Brute-force protection (auth, 10/min): 11th login attempt is 429"
+for i in $(seq 1 11); do
+  code=$(curl -s -o /dev/null -w '%{http_code}' -X POST \
+    -H 'Content-Type: application/json' \
+    -d '{"username":"attacker"}' "$BASE/api/login")
+  printf '  attempt %2d -> %s\n' "$i" "$code"
+done
+
+say "4. Burst batch (token bucket, cap 50, 10/sec refill)"
+call -X POST -H 'X-User-ID: batch-user' -H 'Content-Type: application/json' \
+  -d '{"size":40}' "$BASE/api/batch"
+echo "  ...second burst of 40 should be rejected (only ~10 tokens left):"
+call -X POST -H 'X-User-ID: batch-user' -H 'Content-Type: application/json' \
+  -d '{"size":40}' "$BASE/api/batch"
+
+say "5. Admin reset clears all limiters for a user"
+call -X DELETE "$BASE/api/admin/reset/attacker"
+echo "  ...attacker can log in again:"
+call -X POST -H 'Content-Type: application/json' \
+  -d '{"username":"attacker"}' "$BASE/api/login"
+
+say "6. Observability"
+call "$BASE/actuator/health"
+call "$BASE/actuator/metrics"
+
+say "demo complete"

@@ -1,0 +1,5 @@
+from ratelimiter_tpu.service.app import make_server, serve_forever
+from ratelimiter_tpu.service.props import AppProperties
+from ratelimiter_tpu.service.wiring import AppContext, build_app
+
+__all__ = ["make_server", "serve_forever", "AppProperties", "AppContext", "build_app"]

@@ -1,0 +1,218 @@
+"""HTTP demo API (C2 parity).
+
+The five endpoints of the reference's controller (DemoController.java:39-140)
+with the same request/response shapes and 429 semantics:
+
+- ``GET  /api/data``               — api limiter, key = X-User-ID or "anonymous"
+- ``POST /api/login``              — auth limiter, key = body username
+- ``POST /api/batch``              — burst limiter, permits = body size,
+                                     key = required X-User-ID
+- ``GET  /api/health``             — not rate limited
+- ``DELETE /api/admin/reset/{id}`` — resets all three limiters for the user
+  (note: the reference's README documents this as /admin/reset, but the
+  controller actually mounts it under /api — quirk Q4; we implement BOTH
+  paths so either set of docs works)
+
+Plus actuator-style observability (application.properties:14-15):
+``GET /actuator/health`` and ``GET /actuator/metrics``.
+
+Improvements over the reference, both of which its own docs promise:
+
+- **Fail-open** on storage failure (ARCHITECTURE notes prescribe it; the
+  reference actually 500s — SURVEY.md §5.3): configurable, on by default.
+- **X-RateLimit-Limit / X-RateLimit-Remaining headers** (described in
+  API_EXAMPLES but never sent by the reference).
+
+Implementation is a stdlib ThreadingHTTPServer: the service tier is a thin
+shim — concurrency and throughput live in the micro-batched device engine,
+not in the web framework, so no external dependency is warranted.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ratelimiter_tpu.service.wiring import AppContext, build_app
+from ratelimiter_tpu.storage.errors import StorageException
+
+_RESET_RE = re.compile(r"^/(?:api/)?admin/reset/([^/]+)$")
+
+
+def _now_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class RateLimiterHandler(BaseHTTPRequestHandler):
+    ctx: AppContext  # injected by make_server
+
+    # -- plumbing -------------------------------------------------------------
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json(self, status: int, payload: dict, headers: dict | None = None):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return {}
+
+    def _rate_limit_exceeded(self, limiter, key: str, limit: int):
+        # 429 with the same error body shape (DemoController.java:129-140).
+        remaining = self._safe_available(limiter, key)
+        self._json(429, {
+            "error": "Rate limit exceeded",
+            "message": "Too many requests. Please try again later.",
+            "remaining": remaining,
+        }, headers={"X-RateLimit-Limit": limit, "X-RateLimit-Remaining": remaining})
+
+    def _safe_available(self, limiter, key: str) -> int:
+        try:
+            return int(limiter.get_available_permits(key))
+        except StorageException:
+            return -1  # "unable to determine" (core/RateLimiter.java:31-37)
+
+    def _try_acquire(self, limiter, key: str, permits: int = 1) -> bool:
+        """Apply the fail-open policy: on storage failure, allow (and count)
+        rather than erroring the request — the availability-over-strictness
+        trade the reference documents."""
+        try:
+            return limiter.try_acquire(key, permits)
+        except StorageException:
+            if self.ctx.fail_open:
+                self.ctx.registry.counter(
+                    "ratelimiter.failopen.allowed",
+                    "Requests allowed due to fail-open on storage errors",
+                ).increment()
+                return True
+            raise
+
+    # -- routes ---------------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/api/data":
+            return self._get_data()
+        if self.path == "/api/health":
+            return self._json(200, {"status": "UP", "timestamp": str(_now_ms())})
+        if self.path == "/actuator/health":
+            up = self.ctx.storage.is_available()
+            return self._json(200 if up else 503,
+                              {"status": "UP" if up else "DOWN"})
+        if self.path == "/actuator/metrics":
+            return self._json(200, {"meters": self.ctx.registry.scrape()})
+        self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path == "/api/login":
+            return self._login()
+        if self.path == "/api/batch":
+            return self._batch()
+        self._json(404, {"error": "not found"})
+
+    def do_DELETE(self):
+        m = _RESET_RE.match(self.path)
+        if m:
+            return self._reset(m.group(1))
+        self._json(404, {"error": "not found"})
+
+    # -- endpoint bodies ------------------------------------------------------
+    def _get_data(self):
+        limiter = self.ctx.limiters["api"]
+        key = self.headers.get("X-User-ID") or "anonymous"
+        try:
+            if not self._try_acquire(limiter, key):
+                return self._rate_limit_exceeded(limiter, key, 100)
+        except StorageException:
+            return self._json(503, {"error": "storage unavailable"})
+        remaining = self._safe_available(limiter, key)
+        self._json(200, {
+            "message": "Success!",
+            "remaining": remaining,
+            "data": {"timestamp": _now_ms()},
+        }, headers={"X-RateLimit-Limit": 100, "X-RateLimit-Remaining": remaining})
+
+    def _login(self):
+        limiter = self.ctx.limiters["auth"]
+        username = self._body().get("username", "unknown")
+        try:
+            if not self._try_acquire(limiter, username):
+                return self._rate_limit_exceeded(limiter, username, 10)
+        except StorageException:
+            return self._json(503, {"error": "storage unavailable"})
+        self._json(200, {
+            "message": "Login successful",
+            "remaining_attempts": self._safe_available(limiter, username),
+        })
+
+    def _batch(self):
+        limiter = self.ctx.limiters["burst"]
+        user_id = self.headers.get("X-User-ID")
+        if not user_id:
+            return self._json(400, {"error": "X-User-ID header required"})
+        size = int(self._body().get("size", 1))
+        if size <= 0:
+            return self._json(400, {"error": "size must be positive"})
+        try:
+            if not self._try_acquire(limiter, user_id, size):
+                return self._rate_limit_exceeded(limiter, user_id, 50)
+        except StorageException:
+            return self._json(503, {"error": "storage unavailable"})
+        self._json(200, {
+            "message": "Batch processed",
+            "items_processed": size,
+            "tokens_remaining": self._safe_available(limiter, user_id),
+        })
+
+    def _reset(self, user_id: str):
+        for limiter in self.ctx.limiters.values():
+            limiter.reset(user_id)
+        self._json(200, {"message": f"Rate limits reset for user: {user_id}"})
+
+
+def make_server(ctx: AppContext | None = None, port: int | None = None) -> ThreadingHTTPServer:
+    ctx = ctx or build_app()
+    if port is None:
+        port = ctx.props.get_int("server.port", 8080)
+    handler = type("BoundHandler", (RateLimiterHandler,), {"ctx": ctx})
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    server.ctx = ctx  # type: ignore[attr-defined]
+    return server
+
+
+def serve_forever(ctx: AppContext | None = None, port: int | None = None) -> None:
+    server = make_server(ctx, port)
+    try:
+        server.serve_forever()
+    finally:
+        server.ctx.close()  # type: ignore[attr-defined]
+
+
+def main() -> None:  # python -m ratelimiter_tpu.service.app
+    import sys
+
+    from ratelimiter_tpu.service.props import AppProperties
+
+    path = sys.argv[1] if len(sys.argv) > 1 else "application.properties"
+    ctx = build_app(AppProperties.load(path))
+    port = ctx.props.get_int("server.port", 8080)
+    print(f"ratelimiter_tpu serving on :{port} "
+          f"(backend={ctx.props.get('storage.backend')})")
+    serve_forever(ctx, port)
+
+
+if __name__ == "__main__":
+    main()

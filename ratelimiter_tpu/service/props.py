@@ -1,0 +1,76 @@
+"""Application properties (C13 parity).
+
+The reference configures itself via Spring ``application.properties``
+(redis.host/redis.port/server.port, application.properties:1-15) with env
+overrides from docker-compose.  Here: the same ``key=value`` file format,
+env-var overrides (``RATELIMITER_<KEY with . -> _ uppercased>``), and typed
+accessors with defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+DEFAULTS = {
+    "server.port": "8080",
+    # "tpu" (device-batched) or "memory" (host dict) — the storage plugin.
+    "storage.backend": "tpu",
+    "storage.num_slots": str(1 << 20),
+    "batcher.max_batch": "8192",
+    "batcher.max_delay_ms": "0.5",
+    # Fail-open on storage failure: documented in the reference's
+    # architecture notes but never implemented there (SURVEY.md §5.3);
+    # implemented here and ON by default as documented.
+    "ratelimiter.fail_open": "true",
+    # Shard the slot array over all visible devices when > 1.
+    "parallel.shard": "auto",
+}
+
+
+def _env_key(key: str) -> str:
+    return "RATELIMITER_" + key.replace(".", "_").replace("-", "_").upper()
+
+
+class AppProperties:
+    def __init__(self, values: Optional[Dict[str, str]] = None):
+        self._values = dict(DEFAULTS)
+        if values:
+            self._values.update(values)
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "AppProperties":
+        values: Dict[str, str] = {}
+        if path and os.path.exists(path):
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line or line.startswith(("#", "!")):
+                        continue
+                    if "=" in line:
+                        k, v = line.split("=", 1)
+                        values[k.strip()] = v.strip()
+        props = cls(values)
+        for key in list(props._values):
+            env = os.environ.get(_env_key(key))
+            if env is not None:
+                props._values[key] = env
+        return props
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._values.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        value = self._values.get(key)
+        return int(value) if value is not None else default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        value = self._values.get(key)
+        return float(value) if value is not None else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        value = self._values.get(key)
+        if value is None:
+            return default
+        return value.strip().lower() in ("1", "true", "yes", "on")

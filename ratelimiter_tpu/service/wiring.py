@@ -1,0 +1,106 @@
+"""Application wiring (C3 parity).
+
+The reference's Spring ``@Configuration`` builds one storage bean, a meter
+registry, and three named limiters (config/RateLimiterConfig.java:31-95):
+
+- ``apiRateLimiter``   — sliding window, 100/min, local cache on (100 ms TTL)
+- ``authRateLimiter``  — sliding window, 10/min, cache OFF (strictness)
+- ``burstRateLimiter`` — token bucket, capacity 50, refill 10/sec
+
+This module builds the identical trio over this framework's storage
+backends, selected by ``storage.backend`` (tpu | memory), plus the shared
+registry and the fail-open policy object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter, TokenBucketRateLimiter
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.core.limiter import RateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.service.props import AppProperties
+from ratelimiter_tpu.storage import InMemoryStorage, RateLimitStorage, TpuBatchedStorage
+
+
+@dataclasses.dataclass
+class AppContext:
+    props: AppProperties
+    storage: RateLimitStorage
+    registry: MeterRegistry
+    limiters: Dict[str, RateLimiter]
+    fail_open: bool
+
+    def close(self) -> None:
+        self.storage.close()
+
+
+def build_storage(props: AppProperties) -> RateLimitStorage:
+    backend = (props.get("storage.backend") or "tpu").lower()
+    if backend == "memory":
+        return InMemoryStorage()
+    if backend == "tpu":
+        num_slots = props.get_int("storage.num_slots", 1 << 20)
+        shard = (props.get("parallel.shard") or "auto").lower()
+        engine = None
+        if shard in ("auto", "true", "on"):
+            import jax
+
+            devices = jax.devices()
+            if len(devices) > 1 and shard != "off":
+                from ratelimiter_tpu.engine.state import LimiterTable
+                from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+
+                mesh = make_mesh(devices)
+                engine = ShardedDeviceEngine(
+                    slots_per_shard=max(num_slots // len(devices), 1),
+                    table=LimiterTable(),
+                    mesh=mesh,
+                )
+        return TpuBatchedStorage(
+            num_slots=num_slots,
+            max_batch=props.get_int("batcher.max_batch", 8192),
+            max_delay_ms=props.get_float("batcher.max_delay_ms", 0.5),
+            engine=engine,
+        )
+    raise ValueError(f"unknown storage.backend: {backend!r}")
+
+
+def build_app(props: AppProperties | None = None,
+              storage: RateLimitStorage | None = None) -> AppContext:
+    props = props or AppProperties.load()
+    storage = storage or build_storage(props)
+    registry = MeterRegistry()
+
+    limiters: Dict[str, RateLimiter] = {
+        # Default API limiter: 100 req/min sliding window with local cache
+        # (config/RateLimiterConfig.java:46-59).
+        "api": SlidingWindowRateLimiter(
+            storage,
+            RateLimitConfig(max_permits=100, window_ms=60_000,
+                            enable_local_cache=True, local_cache_ttl_ms=100),
+            registry,
+        ),
+        # Strict auth limiter: 10/min, no cache (:65-77).
+        "auth": SlidingWindowRateLimiter(
+            storage,
+            RateLimitConfig(max_permits=10, window_ms=60_000,
+                            enable_local_cache=False),
+            registry,
+        ),
+        # Burst-friendly token bucket: 50 capacity, 10/sec refill (:83-95).
+        "burst": TokenBucketRateLimiter(
+            storage,
+            RateLimitConfig(max_permits=50, window_ms=60_000, refill_rate=10.0),
+            registry,
+        ),
+    }
+    return AppContext(
+        props=props,
+        storage=storage,
+        registry=registry,
+        limiters=limiters,
+        fail_open=props.get_bool("ratelimiter.fail_open", True),
+    )

@@ -1,0 +1,143 @@
+"""HTTP service endpoint tests (C2/C3 parity) over a live ThreadingHTTPServer."""
+
+import json
+import threading
+import http.client
+
+import pytest
+
+from ratelimiter_tpu.service.app import make_server
+from ratelimiter_tpu.service.props import AppProperties
+from ratelimiter_tpu.service.wiring import build_app
+from ratelimiter_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture()
+def server():
+    # memory backend: fast, hermetic; the TPU backend is covered by
+    # test_tpu_storage/test_sharded and the bench harness.
+    props = AppProperties({"storage.backend": "memory", "server.port": "0"})
+    storage = InMemoryStorage()
+    ctx = build_app(props, storage=storage)
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    thread.join(timeout=5)
+    ctx.close()
+
+
+def req(srv, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.server_address[1], timeout=10)
+    payload = json.dumps(body) if body is not None else None
+    conn.request(method, path, body=payload, headers=headers or {})
+    resp = conn.getresponse()
+    data = json.loads(resp.read() or b"{}")
+    out_headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, out_headers
+
+
+def test_data_endpoint_and_headers(server):
+    status, data, headers = req(server, "GET", "/api/data",
+                                headers={"X-User-ID": "alice"})
+    assert status == 200
+    assert data["message"] == "Success!"
+    assert data["remaining"] == 99
+    assert "timestamp" in data["data"]
+    assert headers["X-RateLimit-Limit"] == "100"
+    assert headers["X-RateLimit-Remaining"] == "99"
+
+
+def test_data_anonymous_key(server):
+    status, data, _ = req(server, "GET", "/api/data")
+    assert status == 200
+    assert data["remaining"] == 99
+
+
+def test_login_and_429(server):
+    for i in range(10):
+        status, data, _ = req(server, "POST", "/api/login",
+                              body={"username": "bob"})
+        assert status == 200
+        assert data["message"] == "Login successful"
+    status, data, _ = req(server, "POST", "/api/login", body={"username": "bob"})
+    assert status == 429
+    assert data["error"] == "Rate limit exceeded"
+    assert data["remaining"] == 0
+    # Different user unaffected.
+    status, _, _ = req(server, "POST", "/api/login", body={"username": "carol"})
+    assert status == 200
+
+
+def test_batch_endpoint(server):
+    status, data, _ = req(server, "POST", "/api/batch", body={"size": 30},
+                          headers={"X-User-ID": "dave"})
+    assert status == 200
+    assert data["items_processed"] == 30
+    assert data["tokens_remaining"] == 20
+    # 30 more exceeds the remaining 20 tokens -> 429.
+    status, data, _ = req(server, "POST", "/api/batch", body={"size": 30},
+                          headers={"X-User-ID": "dave"})
+    assert status == 429
+    # Missing header -> 400 (the reference's required header).
+    status, _, _ = req(server, "POST", "/api/batch", body={"size": 1})
+    assert status == 400
+
+
+def test_health_and_actuator(server):
+    status, data, _ = req(server, "GET", "/api/health")
+    assert status == 200 and data["status"] == "UP"
+    status, data, _ = req(server, "GET", "/actuator/health")
+    assert status == 200 and data["status"] == "UP"
+    req(server, "GET", "/api/data", headers={"X-User-ID": "m"})
+    status, data, _ = req(server, "GET", "/actuator/metrics")
+    assert status == 200
+    assert data["meters"]["ratelimiter.requests.allowed"] >= 1
+
+
+def test_admin_reset_both_paths(server):
+    for _ in range(10):
+        req(server, "POST", "/api/login", body={"username": "eve"})
+    status, _, _ = req(server, "POST", "/api/login", body={"username": "eve"})
+    assert status == 429
+    # Actual mount point (/api/admin, DemoController.java:118) ...
+    status, data, _ = req(server, "DELETE", "/api/admin/reset/eve")
+    assert status == 200 and "eve" in data["message"]
+    status, _, _ = req(server, "POST", "/api/login", body={"username": "eve"})
+    assert status == 200
+    # ... and the README-documented path (quirk Q4) also works.
+    status, _, _ = req(server, "DELETE", "/admin/reset/eve")
+    assert status == 200
+
+
+def test_unknown_route_404(server):
+    status, _, _ = req(server, "GET", "/nope")
+    assert status == 404
+
+
+def test_fail_open_allows_on_storage_outage():
+    props = AppProperties({"storage.backend": "memory", "ratelimiter.fail_open": "true"})
+    storage = InMemoryStorage()
+    ctx = build_app(props, storage=storage)
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        # Sabotage the storage: every op fails post-retries (StorageException,
+        # what RetryPolicy raises) -> fail-open must allow.
+        from ratelimiter_tpu.storage import StorageException
+
+        def boom(*a, **k):
+            raise StorageException("storage down")
+
+        storage.increment_and_expire = boom  # type: ignore[assignment]
+        storage.get = boom  # type: ignore[assignment]
+        status, data, _ = req(srv, "GET", "/api/data", headers={"X-User-ID": "z"})
+        assert status == 200
+        assert data["remaining"] == -1  # "unable to determine"
+        assert ctx.registry.scrape()["ratelimiter.failopen.allowed"] >= 1
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)

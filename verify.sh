@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Repo-structure verifier (C17 parity: required/forbidden file lint).
+set -uo pipefail
+cd "$(dirname "$0")"
+
+required=(
+  ratelimiter_tpu/__init__.py
+  ratelimiter_tpu/core/config.py
+  ratelimiter_tpu/core/limiter.py
+  ratelimiter_tpu/semantics/oracle.py
+  ratelimiter_tpu/ops/segments.py
+  ratelimiter_tpu/ops/sliding_window.py
+  ratelimiter_tpu/ops/token_bucket.py
+  ratelimiter_tpu/engine/state.py
+  ratelimiter_tpu/engine/engine.py
+  ratelimiter_tpu/engine/slots.py
+  ratelimiter_tpu/engine/batcher.py
+  ratelimiter_tpu/parallel/sharded.py
+  ratelimiter_tpu/storage/base.py
+  ratelimiter_tpu/storage/memory.py
+  ratelimiter_tpu/storage/tpu.py
+  ratelimiter_tpu/algorithms/sliding_window.py
+  ratelimiter_tpu/algorithms/token_bucket.py
+  ratelimiter_tpu/cache/ttl_cache.py
+  ratelimiter_tpu/metrics/registry.py
+  ratelimiter_tpu/service/app.py
+  ratelimiter_tpu/service/wiring.py
+  ratelimiter_tpu/service/props.py
+  tests/conftest.py
+  bench.py
+  __graft_entry__.py
+  demo.sh
+  Dockerfile
+  docker-compose.yml
+  SURVEY.md
+  README.md
+)
+
+forbidden=(
+  "*.pyc.orig"
+  "*.java"
+  ".ipynb_checkpoints"
+)
+
+fail=0
+echo "checking required files..."
+for f in "${required[@]}"; do
+  if [[ -e "$f" ]]; then
+    echo "  ok  $f"
+  else
+    echo "  MISSING  $f"
+    fail=1
+  fi
+done
+
+echo "checking forbidden patterns..."
+for pat in "${forbidden[@]}"; do
+  hits=$(find . -path ./.git -prune -o -name "$pat" -print | head -5)
+  if [[ -n "$hits" ]]; then
+    echo "  FORBIDDEN  $pat:"
+    echo "$hits" | sed 's/^/    /'
+    fail=1
+  else
+    echo "  ok  no $pat"
+  fi
+done
+
+if [[ $fail -eq 0 ]]; then
+  echo "structure OK"
+else
+  echo "structure FAILED"
+fi
+exit $fail
