@@ -1,0 +1,185 @@
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Headline: end-to-end rate-limit decisions/sec on a 1M-key token-bucket
+Zipf(1.1) stream (BASELINE.json config #2) — string keys in, allow/deny out,
+through the slot index + batched device engine on one chip.
+vs_baseline compares against the reference's published 80,192 req/s
+(README single-key sliding-window, local cache on, M1 + Redis —
+BASELINE.md).
+
+Detailed results for all scenarios land in BENCH_DETAIL.json:
+  1. single-key sliding window, 10 threads, through the micro-batcher
+     (latency percentiles — the reference's headline scenario)
+  2. 1M-key token bucket, Zipf(1.1)      [headline]
+  3. 10M-key sliding window, uniform     (engine-level; 10M host index
+     warmup is excluded by design)
+  4. 100K-tenant multi-config mix
+  5. burst batch-acquire tryAcquire(key, n in [1,100]) over 1M keys
+
+Scale knobs: BENCH_SCALE=small|full (default full on TPU, small elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    scale = os.environ.get("BENCH_SCALE") or ("full" if platform == "tpu" else "small")
+    small = scale == "small"
+    log(f"bench: platform={platform} scale={scale}")
+
+    from ratelimiter_tpu import RateLimitConfig
+    from ratelimiter_tpu.algorithms import (
+        SlidingWindowRateLimiter,
+        TokenBucketRateLimiter,
+    )
+    from ratelimiter_tpu.bench.harness import (
+        bench_end_to_end,
+        bench_engine,
+        bench_threaded,
+        make_engine,
+        uniform_stream,
+        zipf_stream,
+    )
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    rng = np.random.default_rng(42)
+    detail = {"platform": platform, "scale": scale}
+    t_start = time.time()
+
+    # -- scenario 2 (headline): 1M-key token bucket, Zipf(1.1) ---------------
+    num_keys = 20_000 if small else 1_000_000
+    n_requests = 200_000 if small else 8_000_000
+    batch = 4096 if small else 65_536
+    log(f"scenario 2: TB Zipf over {num_keys} keys, {n_requests} requests...")
+
+    tb_cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
+    storage = TpuBatchedStorage(num_slots=max(num_keys * 2, 1 << 16))
+    tb_limiter = TokenBucketRateLimiter(storage, tb_cfg, MeterRegistry())
+    lid_tb = tb_limiter._lid
+
+    key_ids = zipf_stream(rng, num_keys, n_requests)
+    keys = [f"k{i}" for i in key_ids]
+    permits = np.ones(n_requests, dtype=np.int64)
+    res = bench_end_to_end(tb_limiter, keys, permits, batch)
+    detail["tb_1m_zipf_end_to_end"] = res
+    headline = res["decisions_per_sec"]
+    log(f"  end-to-end: {headline:,.0f} decisions/s")
+
+    # Engine-level on the same stream (device decision throughput).
+    slot_stream = np.asarray(
+        [storage._index["tb"].get((lid_tb, k)) or 0 for k in keys[:n_requests]],
+        dtype=np.int64)
+    res = bench_engine(storage.engine, "tb", lid_tb, slot_stream, permits, batch)
+    detail["tb_1m_zipf_engine"] = res
+    log(f"  engine:     {res['decisions_per_sec']:,.0f} decisions/s")
+    storage.close()
+
+    # -- scenario 1: single-key SW, 10 threads through the batcher -----------
+    log("scenario 1: single-key sliding window, 10 threads...")
+    sw_cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                             enable_local_cache=True, local_cache_ttl_ms=100)
+    storage = TpuBatchedStorage(num_slots=1 << 12, max_delay_ms=0.3)
+    sw_limiter = SlidingWindowRateLimiter(storage, sw_cfg, MeterRegistry())
+    res = bench_threaded(
+        sw_limiter,
+        keys_per_thread=lambda t: ["hot-key"],
+        n_threads=10,
+        requests_per_thread=200 if small else 2000,
+    )
+    detail["sw_single_key_threaded"] = res
+    log(f"  {res['decisions_per_sec']:,.0f} req/s; "
+        f"p99 {res['request_latency']['p99_us']:.0f} us")
+    storage.close()
+
+    # -- scenario 3: 10M-key sliding window, uniform (engine-level) ----------
+    num_keys3 = 50_000 if small else 10_000_000
+    n3 = 200_000 if small else 4_000_000
+    log(f"scenario 3: SW uniform over {num_keys3} slots (engine)...")
+    engine, (lid_sw,) = make_engine(
+        num_slots=num_keys3,
+        configs=[RateLimitConfig(max_permits=100, window_ms=60_000,
+                                 enable_local_cache=False)])
+    slots3 = uniform_stream(rng, num_keys3, n3)
+    res = bench_engine(engine, "sw", lid_sw, slots3, np.ones(n3, dtype=np.int64), batch)
+    detail["sw_10m_uniform_engine"] = res
+    log(f"  engine: {res['decisions_per_sec']:,.0f} decisions/s")
+
+    # -- scenario 4: 100K-tenant multi-config mix (engine-level) -------------
+    n_tenants = 1000 if small else 100_000
+    n4 = 200_000 if small else 2_000_000
+    log(f"scenario 4: {n_tenants}-tenant mix...")
+    table = LimiterTable(capacity=n_tenants + 2)
+    lids = np.asarray(
+        [table.register(RateLimitConfig(
+            max_permits=50 + (i % 100), window_ms=60_000,
+            refill_rate=float(5 + i % 20)))
+         for i in range(n_tenants)], dtype=np.int32)
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+
+    engine4 = DeviceEngine(num_slots=max(n_tenants * 8, 1 << 16), table=table)
+    tenant_of_req = rng.integers(0, n_tenants, size=n4)
+    slots4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
+    # Mixed-tenant TB batches: every request carries its own tenant's policy.
+    fn_lids = lids[tenant_of_req]
+    n4b = (n4 // batch) * batch
+    # Warm the jit cache (compile excluded from timing).
+    engine4.tb_acquire(slots4[:batch], fn_lids[:batch],
+                       np.ones(batch, dtype=np.int64), 1_752_999_999_000)
+    engine4.block_until_ready()
+    t0_all = time.perf_counter()
+    for i in range(0, n4b, batch):
+        engine4.tb_acquire(slots4[i:i + batch], fn_lids[i:i + batch],
+                           np.ones(batch, dtype=np.int64), 1_753_000_000_000 + i)
+    wall = time.perf_counter() - t0_all
+    detail["multi_tenant_100k_engine"] = {
+        "mode": "engine", "decisions": n4b, "wall_s": wall,
+        "decisions_per_sec": n4b / wall, "tenants": n_tenants,
+    }
+    log(f"  engine: {n4b / wall:,.0f} decisions/s")
+
+    # -- scenario 5: burst batch-acquire over 1M keys ------------------------
+    num_keys5 = 20_000 if small else 1_000_000
+    n5 = 200_000 if small else 2_000_000
+    log(f"scenario 5: burst batch-acquire over {num_keys5} keys...")
+    engine5, (lid5,) = make_engine(
+        num_slots=num_keys5,
+        configs=[RateLimitConfig(max_permits=100, window_ms=60_000,
+                                 refill_rate=100.0)])
+    slots5 = uniform_stream(rng, num_keys5, n5)
+    perms5 = rng.integers(1, 101, size=n5).astype(np.int64)
+    res = bench_engine(engine5, "tb", lid5, slots5, perms5, batch)
+    detail["tb_burst_batch_engine"] = res
+    log(f"  engine: {res['decisions_per_sec']:,.0f} decisions/s")
+
+    detail["total_bench_seconds"] = time.time() - t_start
+
+    with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"), "w") as fh:
+        json.dump(detail, fh, indent=2)
+
+    baseline = 80_192.0  # reference README throughput (BASELINE.md)
+    print(json.dumps({
+        "metric": "tb_1m_keys_zipf_end_to_end_decisions_per_sec",
+        "value": round(headline, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(headline / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

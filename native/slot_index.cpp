@@ -1,0 +1,326 @@
+// Native slot index: key -> slot assignment with LRU eviction.
+//
+// The host-side hot path of the TPU rate limiter: every decision needs a
+// key -> slot lookup before it can join a device batch.  The pure-Python
+// index (ratelimiter_tpu/engine/slots.py — the semantic reference for this
+// file) tops out around 1-2M ops/s; this open-addressing table with an
+// intrusive LRU list sustains tens of millions, keeping the host from
+// starving the device.
+//
+// Design:
+//  - 128-bit key fingerprints (two independent FNV-1a streams) instead of
+//    stored keys: collision odds ~n^2/2^129 (~1e-25 at 10M keys).  Both
+//    string keys and int64 ids are supported; a per-limiter `lid` seed is
+//    mixed in so tenants are isolated.
+//  - Open addressing, linear probing, power-of-two capacity, tombstone-free
+//    deletion (backward-shift), load factor <= 0.5.
+//  - Intrusive doubly-linked LRU over the entries; eviction returns the
+//    victim's slot so the caller can zero its device state before reuse.
+//  - Pinning: (a) an explicit pin refcount per slot for queued async
+//    requests, (b) a generation stamp so entries touched by the current
+//    batch call are never evicted by later keys of the same batch.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  uint64_t h1 = 0, h2 = 0;  // 128-bit fingerprint; h1==0 && h2==0 => empty
+  int32_t slot = -1;
+  int32_t lru_prev = -1, lru_next = -1;
+  uint64_t gen = 0;
+};
+
+struct Index {
+  int64_t num_slots;
+  uint64_t mask;              // table size - 1
+  std::vector<Entry> table;
+  std::vector<int32_t> entry_of_slot;  // slot -> table position (-1 if free)
+  std::vector<int32_t> free_slots;
+  std::vector<uint32_t> pins;          // slot -> pin refcount
+  int64_t size = 0;
+  int32_t lru_head = -1, lru_tail = -1;  // head = most recent
+  uint64_t gen = 0;
+};
+
+inline void fnv_mix(uint64_t& h, uint64_t x) {
+  h ^= x;
+  h *= 0x100000001b3ULL;
+}
+
+inline void hash_bytes(const uint8_t* p, int64_t n, uint64_t seed,
+                       uint64_t& h1, uint64_t& h2) {
+  h1 = 0xcbf29ce484222325ULL ^ seed;
+  h2 = 0x84222325cbf29ce4ULL ^ (seed * 0x9e3779b97f4a7c15ULL);
+  for (int64_t i = 0; i < n; i++) {
+    fnv_mix(h1, p[i]);
+    h2 = (h2 ^ (p[i] + 0x9e3779b97f4a7c15ULL + (h2 << 6) + (h2 >> 2)));
+  }
+  h2 = h2 * 0xff51afd7ed558ccdULL + n;
+  if (h1 == 0 && h2 == 0) h2 = 1;  // reserve (0,0) for "empty"
+}
+
+inline void hash_int(int64_t key, uint64_t seed, uint64_t& h1, uint64_t& h2) {
+  uint64_t x = static_cast<uint64_t>(key) + seed * 0x9e3779b97f4a7c15ULL;
+  // splitmix64 twice for two independent streams
+  uint64_t z = (x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  h1 = z ^ (z >> 31);
+  z = (x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  h2 = z ^ (z >> 31);
+  if (h1 == 0 && h2 == 0) h2 = 1;
+}
+
+// -- LRU helpers -------------------------------------------------------------
+
+inline void lru_unlink(Index* ix, int32_t pos) {
+  Entry& e = ix->table[pos];
+  if (e.lru_prev >= 0) ix->table[e.lru_prev].lru_next = e.lru_next;
+  else ix->lru_head = e.lru_next;
+  if (e.lru_next >= 0) ix->table[e.lru_next].lru_prev = e.lru_prev;
+  else ix->lru_tail = e.lru_prev;
+  e.lru_prev = e.lru_next = -1;
+}
+
+inline void lru_push_front(Index* ix, int32_t pos) {
+  Entry& e = ix->table[pos];
+  e.lru_prev = -1;
+  e.lru_next = ix->lru_head;
+  if (ix->lru_head >= 0) ix->table[ix->lru_head].lru_prev = pos;
+  ix->lru_head = pos;
+  if (ix->lru_tail < 0) ix->lru_tail = pos;
+}
+
+inline void lru_touch(Index* ix, int32_t pos) {
+  if (ix->lru_head == pos) return;
+  lru_unlink(ix, pos);
+  lru_push_front(ix, pos);
+}
+
+// -- table ops ---------------------------------------------------------------
+
+inline int32_t find(Index* ix, uint64_t h1, uint64_t h2) {
+  uint64_t pos = h1 & ix->mask;
+  while (true) {
+    Entry& e = ix->table[pos];
+    if (e.h1 == 0 && e.h2 == 0) return -1;
+    if (e.h1 == h1 && e.h2 == h2) return static_cast<int32_t>(pos);
+    pos = (pos + 1) & ix->mask;
+  }
+}
+
+// Backward-shift deletion keeps probe chains intact without tombstones.
+inline void erase_at(Index* ix, uint64_t pos) {
+  uint64_t hole = pos;
+  uint64_t next = (hole + 1) & ix->mask;
+  while (true) {
+    Entry& e = ix->table[next];
+    if (e.h1 == 0 && e.h2 == 0) break;
+    uint64_t home = e.h1 & ix->mask;
+    // Can e move into the hole? Yes iff hole lies within [home, next).
+    bool movable = ((next - home) & ix->mask) >= ((next - hole) & ix->mask);
+    if (movable) {
+      // Fix LRU links & slot back-pointer to the new position.
+      int32_t np = static_cast<int32_t>(next), hp = static_cast<int32_t>(hole);
+      if (e.lru_prev >= 0) ix->table[e.lru_prev].lru_next = hp;
+      else ix->lru_head = hp;
+      if (e.lru_next >= 0) ix->table[e.lru_next].lru_prev = hp;
+      else ix->lru_tail = hp;
+      ix->entry_of_slot[e.slot] = hp;
+      ix->table[hole] = e;
+      e = Entry{};
+      hole = next;
+      (void)np;
+    }
+    next = (next + 1) & ix->mask;
+  }
+  ix->table[hole] = Entry{};
+}
+
+inline int32_t insert(Index* ix, uint64_t h1, uint64_t h2, int32_t slot) {
+  uint64_t pos = h1 & ix->mask;
+  while (true) {
+    Entry& e = ix->table[pos];
+    if (e.h1 == 0 && e.h2 == 0) {
+      e.h1 = h1; e.h2 = h2; e.slot = slot;
+      e.gen = ix->gen;
+      ix->entry_of_slot[slot] = static_cast<int32_t>(pos);
+      lru_push_front(ix, static_cast<int32_t>(pos));
+      ix->size++;
+      return static_cast<int32_t>(pos);
+    }
+    pos = (pos + 1) & ix->mask;
+  }
+}
+
+// Returns evicted slot (>= 0) or -1 if a free slot was available, -2 if
+// eviction failed (everything pinned).
+inline int64_t take_slot(Index* ix, int32_t* out_slot) {
+  if (!ix->free_slots.empty()) {
+    *out_slot = ix->free_slots.back();
+    ix->free_slots.pop_back();
+    return -1;
+  }
+  // Evict from LRU tail, skipping pinned and current-generation entries.
+  int32_t pos = ix->lru_tail;
+  while (pos >= 0) {
+    Entry& e = ix->table[pos];
+    if (ix->pins[e.slot] == 0 && e.gen != ix->gen) {
+      int32_t victim_slot = e.slot;
+      lru_unlink(ix, pos);
+      ix->entry_of_slot[victim_slot] = -1;
+      erase_at(ix, static_cast<uint64_t>(pos));
+      ix->size--;
+      *out_slot = victim_slot;
+      return victim_slot;
+    }
+    pos = e.lru_prev;
+  }
+  return -2;
+}
+
+inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
+                             int32_t* out_slot) {
+  int32_t pos = find(ix, h1, h2);
+  if (pos >= 0) {
+    ix->table[pos].gen = ix->gen;
+    lru_touch(ix, pos);
+    *out_slot = ix->table[pos].slot;
+    return -1;
+  }
+  int32_t slot;
+  int64_t evicted = take_slot(ix, &slot);
+  if (evicted == -2) { *out_slot = -1; return -2; }
+  pos = insert(ix, h1, h2, slot);
+  *out_slot = slot;
+  return evicted;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rl_index_new(int64_t num_slots) {
+  Index* ix = new Index();
+  ix->num_slots = num_slots;
+  uint64_t cap = 16;
+  while (cap < static_cast<uint64_t>(num_slots) * 2) cap <<= 1;
+  ix->mask = cap - 1;
+  ix->table.assign(cap, Entry{});
+  ix->entry_of_slot.assign(num_slots, -1);
+  ix->pins.assign(num_slots, 0);
+  ix->free_slots.reserve(num_slots);
+  for (int64_t s = num_slots - 1; s >= 0; s--)
+    ix->free_slots.push_back(static_cast<int32_t>(s));
+  return ix;
+}
+
+void rl_index_free(void* h) { delete static_cast<Index*>(h); }
+
+int64_t rl_index_len(void* h) { return static_cast<Index*>(h)->size; }
+
+// Batch assign for int64 keys. out_evicted[i] = slot to clear before reuse
+// (-1 none, -2 assignment failed: all pinned).
+void rl_index_assign_ints(void* h, const int64_t* keys, int64_t n,
+                          uint64_t lid_seed, int32_t* out_slots,
+                          int32_t* out_evicted) {
+  Index* ix = static_cast<Index*>(h);
+  ix->gen++;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    hash_int(keys[i], lid_seed, h1, h2);
+    int64_t ev = assign_hashed(ix, h1, h2, &out_slots[i]);
+    out_evicted[i] = static_cast<int32_t>(ev);
+  }
+}
+
+// Batch assign for string keys packed as bytes + offsets (offsets[n] entries
+// of start positions, key i = data[offsets[i]..offsets[i+1])).
+void rl_index_assign_bytes(void* h, const uint8_t* data, const int64_t* offsets,
+                           int64_t n, uint64_t lid_seed, int32_t* out_slots,
+                           int32_t* out_evicted) {
+  Index* ix = static_cast<Index*>(h);
+  ix->gen++;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    hash_bytes(data + offsets[i], offsets[i + 1] - offsets[i], lid_seed, h1, h2);
+    int64_t ev = assign_hashed(ix, h1, h2, &out_slots[i]);
+    out_evicted[i] = static_cast<int32_t>(ev);
+  }
+}
+
+// Scalar lookups (no assignment). Return slot or -1.
+int32_t rl_index_get_int(void* h, int64_t key, uint64_t lid_seed) {
+  Index* ix = static_cast<Index*>(h);
+  uint64_t h1, h2;
+  hash_int(key, lid_seed, h1, h2);
+  int32_t pos = find(ix, h1, h2);
+  if (pos < 0) return -1;
+  lru_touch(ix, pos);
+  return ix->table[pos].slot;
+}
+
+int32_t rl_index_get_bytes(void* h, const uint8_t* data, int64_t len,
+                           uint64_t lid_seed) {
+  Index* ix = static_cast<Index*>(h);
+  uint64_t h1, h2;
+  hash_bytes(data, len, lid_seed, h1, h2);
+  int32_t pos = find(ix, h1, h2);
+  if (pos < 0) return -1;
+  lru_touch(ix, pos);
+  return ix->table[pos].slot;
+}
+
+// Remove a key; returns its slot (caller must clear device state BEFORE the
+// slot can be reused) or -1.  The slot returns to the free list immediately,
+// matching the Python index contract.
+int32_t rl_index_remove_bytes(void* h, const uint8_t* data, int64_t len,
+                              uint64_t lid_seed) {
+  Index* ix = static_cast<Index*>(h);
+  uint64_t h1, h2;
+  hash_bytes(data, len, lid_seed, h1, h2);
+  int32_t pos = find(ix, h1, h2);
+  if (pos < 0) return -1;
+  int32_t slot = ix->table[pos].slot;
+  lru_unlink(ix, pos);
+  ix->entry_of_slot[slot] = -1;
+  erase_at(ix, static_cast<uint64_t>(pos));
+  ix->size--;
+  ix->free_slots.push_back(slot);
+  return slot;
+}
+
+int32_t rl_index_remove_int(void* h, int64_t key, uint64_t lid_seed) {
+  Index* ix = static_cast<Index*>(h);
+  uint64_t h1, h2;
+  hash_int(key, lid_seed, h1, h2);
+  int32_t pos = find(ix, h1, h2);
+  if (pos < 0) return -1;
+  int32_t slot = ix->table[pos].slot;
+  lru_unlink(ix, pos);
+  ix->entry_of_slot[slot] = -1;
+  erase_at(ix, static_cast<uint64_t>(pos));
+  ix->size--;
+  ix->free_slots.push_back(slot);
+  return slot;
+}
+
+void rl_index_pin(void* h, int32_t slot) {
+  Index* ix = static_cast<Index*>(h);
+  if (slot >= 0 && slot < ix->num_slots) ix->pins[slot]++;
+}
+
+void rl_index_unpin(void* h, int32_t slot) {
+  Index* ix = static_cast<Index*>(h);
+  if (slot >= 0 && slot < ix->num_slots && ix->pins[slot] > 0) ix->pins[slot]--;
+}
+
+}  // extern "C"

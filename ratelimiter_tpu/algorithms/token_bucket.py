@@ -109,6 +109,24 @@ class TokenBucketRateLimiter(RateLimiter):
         self._rejected.add(n - n_allowed)
         return allowed
 
+    def try_acquire_ids(self, key_ids, permits=None):
+        """Integer-key vectorized tryAcquire (hyperscale path, TPU backend
+        only)."""
+        if self._lid is None:
+            raise NotImplementedError("try_acquire_ids requires the TPU backend")
+        import numpy as np
+
+        key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        permits = (np.ones(n, dtype=np.int64) if permits is None
+                   else np.ascontiguousarray(permits, dtype=np.int64))
+        out = self._storage.acquire_many_ids("tb", self._lid, key_ids, permits)
+        allowed = np.asarray(out["allowed"], dtype=bool)
+        n_allowed = int(allowed.sum())
+        self._allowed.add(n_allowed)
+        self._rejected.add(n - n_allowed)
+        return allowed
+
     def get_available_permits(self, key: str) -> int:
         if self._lid is not None:
             return int(self._storage.available_many("tb", self._lid, [key])[0])

@@ -1,0 +1,181 @@
+"""Benchmark harness (C15 parity).
+
+The reference's harness drives N threads of per-request tryAcquire against
+live Redis and reports throughput + latency percentiles
+(RateLimiterBenchmark scenarios; README publishes 80,192 req/s, p99 578 us
+on an M1).  This harness reproduces those scenarios against this framework's
+backends and adds the BASELINE.json driver scenarios (1M-key Zipf token
+bucket, 10M-key uniform sliding window, 100K-tenant mix, burst
+batch-acquire).
+
+Three measurement modes, reported separately and honestly:
+
+- ``engine``     — device-step rate with pre-assigned slots: the kernel's
+                   decision throughput (sort + solve + gather/scatter).
+- ``end_to_end`` — string keys in, decisions out, through the slot index and
+                   storage layer (the number comparable to the reference's
+                   throughput figures).
+- ``threaded``   — T threads of single tryAcquire through the micro-batcher;
+                   per-request wall latencies incl. queue wait -> p50/p95/p99
+                   (the number comparable to the reference's latency figures).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.engine import DeviceEngine
+from ratelimiter_tpu.engine.state import LimiterTable
+
+
+def _pcts(lat_us: np.ndarray) -> Dict[str, float]:
+    lat = np.sort(lat_us)
+    def pct(p):
+        return float(lat[min(len(lat) - 1, int(p * len(lat)))])
+    return {
+        "mean_us": float(lat.mean()),
+        "p50_us": pct(0.50),
+        "p95_us": pct(0.95),
+        "p99_us": pct(0.99),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Key-stream generators (BASELINE.json configs)
+# ---------------------------------------------------------------------------
+
+def uniform_stream(rng, num_keys: int, n: int) -> np.ndarray:
+    return rng.integers(0, num_keys, size=n)
+
+
+def zipf_stream(rng, num_keys: int, n: int, a: float = 1.1) -> np.ndarray:
+    # Bounded Zipf via inverse-CDF over ranks (np.random.zipf is unbounded).
+    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
+    probs = ranks ** (-a)
+    probs /= probs.sum()
+    return rng.choice(num_keys, size=n, p=probs)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level throughput (pre-assigned slots)
+# ---------------------------------------------------------------------------
+
+def bench_engine(
+    engine,
+    algo: str,
+    lid: int,
+    slot_stream: np.ndarray,   # precomputed slots per request
+    permits: np.ndarray,
+    batch: int,
+    warmup_batches: int = 3,
+    now0: int = 1_753_000_000_000,
+) -> Dict:
+    """Feed `slot_stream` through the engine in fixed batches; decisions/sec."""
+    fn = engine.sw_acquire if algo == "sw" else engine.tb_acquire
+    n = (len(slot_stream) // batch) * batch
+    slots = slot_stream[:n].reshape(-1, batch)
+    perms = permits[:n].reshape(-1, batch)
+    lids = np.full(batch, lid, dtype=np.int32)
+
+    for i in range(min(warmup_batches, len(slots))):
+        fn(slots[i], lids, perms[i], now0 + i)
+    engine.block_until_ready()
+
+    lat = []
+    t_all = time.perf_counter()
+    for i in range(len(slots)):
+        t0 = time.perf_counter()
+        fn(slots[i], lids, perms[i], now0 + 10 + i)
+        lat.append((time.perf_counter() - t0) * 1e6)
+    wall = time.perf_counter() - t_all
+    decisions = len(slots) * batch
+    return {
+        "mode": "engine",
+        "decisions": decisions,
+        "batch": batch,
+        "wall_s": wall,
+        "decisions_per_sec": decisions / wall,
+        "batch_latency": _pcts(np.asarray(lat)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# End-to-end (string keys through storage + slot index)
+# ---------------------------------------------------------------------------
+
+def bench_end_to_end(
+    limiter,
+    key_stream: List[str],
+    permits: np.ndarray,
+    batch: int,
+) -> Dict:
+    n = (len(key_stream) // batch) * batch
+    lat = []
+    t_all = time.perf_counter()
+    for i in range(0, n, batch):
+        t0 = time.perf_counter()
+        limiter.try_acquire_many(key_stream[i:i + batch], permits[i:i + batch])
+        lat.append((time.perf_counter() - t0) * 1e6)
+    wall = time.perf_counter() - t_all
+    return {
+        "mode": "end_to_end",
+        "decisions": n,
+        "batch": batch,
+        "wall_s": wall,
+        "decisions_per_sec": n / wall,
+        "batch_latency": _pcts(np.asarray(lat)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Threaded single-request latency (through the micro-batcher)
+# ---------------------------------------------------------------------------
+
+def bench_threaded(
+    limiter,
+    keys_per_thread: Callable[[int], List[str]],
+    n_threads: int,
+    requests_per_thread: int,
+) -> Dict:
+    lat = np.zeros((n_threads, requests_per_thread))
+    barrier = threading.Barrier(n_threads)
+
+    def worker(t):
+        my_keys = keys_per_thread(t)
+        barrier.wait()
+        for i in range(requests_per_thread):
+            t0 = time.perf_counter()
+            limiter.try_acquire(my_keys[i % len(my_keys)])
+            lat[t, i] = (time.perf_counter() - t0) * 1e6
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    t_all = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_all
+    total = n_threads * requests_per_thread
+    return {
+        "mode": "threaded",
+        "threads": n_threads,
+        "decisions": total,
+        "wall_s": wall,
+        "decisions_per_sec": total / wall,
+        "request_latency": _pcts(lat.reshape(-1)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Scenario helpers
+# ---------------------------------------------------------------------------
+
+def make_engine(num_slots: int, configs: List[RateLimitConfig]):
+    table = LimiterTable()
+    lids = [table.register(c) for c in configs]
+    return DeviceEngine(num_slots=num_slots, table=table), lids

@@ -1,0 +1,203 @@
+"""ctypes binding for the native slot index (native/slot_index.cpp).
+
+Same interface as the pure-Python ``SlotIndex`` (engine/slots.py) plus
+vectorized batch assignment, which is what makes the host keep up with the
+device: one C call maps a whole micro-batch of keys to slots.
+
+The shared library is built on demand with the repo Makefile (g++ is in the
+image; pybind11 is not, hence the C ABI + ctypes).  If compilation is
+impossible the caller falls back to the Python index — behavior is
+identical, only slower (tested equivalent in tests/test_native_index.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Hashable, Optional, Set, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libslotindex.so"))
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load_library():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception:  # noqa: BLE001 — any failure => Python fallback
+            _lib_failed = True
+            return None
+        lib.rl_index_new.restype = ctypes.c_void_p
+        lib.rl_index_new.argtypes = [ctypes.c_int64]
+        lib.rl_index_free.argtypes = [ctypes.c_void_p]
+        lib.rl_index_len.restype = ctypes.c_int64
+        lib.rl_index_len.argtypes = [ctypes.c_void_p]
+        lib.rl_index_assign_ints.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_get_bytes.restype = ctypes.c_int32
+        lib.rl_index_get_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.rl_index_get_int.restype = ctypes.c_int32
+        lib.rl_index_get_int.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.rl_index_remove_bytes.restype = ctypes.c_int32
+        lib.rl_index_remove_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.rl_index_remove_int.restype = ctypes.c_int32
+        lib.rl_index_remove_int.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        lib.rl_index_pin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rl_index_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+def _split_key(key: Hashable) -> Tuple[int, bytes | int]:
+    """Index keys arrive as (limiter_id, user_key); the lid becomes the hash
+    seed so tenants are isolated."""
+    if isinstance(key, tuple) and len(key) == 2:
+        lid, user = key
+        seed = int(lid) if isinstance(lid, int) else abs(hash(lid))
+    else:
+        seed, user = 0, key
+    if isinstance(user, int):
+        return seed, user
+    if isinstance(user, bytes):
+        return seed, user
+    return seed, str(user).encode()
+
+
+class NativeSlotIndex:
+    """Drop-in SlotIndex backed by the C++ table (thread-safe via lock —
+    matches the Python index; the batch path amortizes it over 1000s of keys)."""
+
+    def __init__(self, num_slots: int):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native slot index unavailable")
+        self._lib = lib
+        self.num_slots = int(num_slots)
+        self._h = ctypes.c_void_p(lib.rl_index_new(self.num_slots))
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.rl_index_free(h)
+            self._h = None
+
+    # -- scalar interface (SlotIndex parity) ----------------------------------
+    def get(self, key: Hashable) -> Optional[int]:
+        seed, user = _split_key(key)
+        with self._lock:
+            if isinstance(user, int):
+                slot = self._lib.rl_index_get_int(self._h, user, seed)
+            else:
+                slot = self._lib.rl_index_get_bytes(self._h, user, len(user), seed)
+        return None if slot < 0 else slot
+
+    def assign(
+        self, key: Hashable, pinned: Optional[Set[int]] = None
+    ) -> Tuple[int, Optional[int]]:
+        seed, user = _split_key(key)
+        with self._lock:
+            pins = list(pinned) if pinned else []
+            for s in pins:
+                self._lib.rl_index_pin(self._h, s)
+            try:
+                out_slot = np.empty(1, dtype=np.int32)
+                out_ev = np.empty(1, dtype=np.int32)
+                if isinstance(user, int):
+                    keys = np.asarray([user], dtype=np.int64)
+                    self._lib.rl_index_assign_ints(
+                        self._h, keys.ctypes.data, 1, seed,
+                        out_slot.ctypes.data, out_ev.ctypes.data)
+                else:
+                    data = np.frombuffer(user, dtype=np.uint8) if user else \
+                        np.empty(0, dtype=np.uint8)
+                    offs = np.asarray([0, len(user)], dtype=np.int64)
+                    self._lib.rl_index_assign_bytes(
+                        self._h, data.ctypes.data if len(user) else 0,
+                        offs.ctypes.data, 1, seed,
+                        out_slot.ctypes.data, out_ev.ctypes.data)
+            finally:
+                for s in pins:
+                    self._lib.rl_index_unpin(self._h, s)
+        if out_ev[0] == -2:
+            raise RuntimeError("all slots pinned; increase num_slots or flush")
+        evicted = int(out_ev[0]) if out_ev[0] >= 0 else None
+        return int(out_slot[0]), evicted
+
+    def remove(self, key: Hashable) -> Optional[int]:
+        seed, user = _split_key(key)
+        with self._lock:
+            if isinstance(user, int):
+                slot = self._lib.rl_index_remove_int(self._h, user, seed)
+            else:
+                slot = self._lib.rl_index_remove_bytes(self._h, user, len(user), seed)
+        return None if slot < 0 else slot
+
+    def __len__(self) -> int:
+        with self._lock:
+            return int(self._lib.rl_index_len(self._h))
+
+    # -- vectorized interface -------------------------------------------------
+    def assign_batch_ints(self, keys: np.ndarray, lid: int):
+        """Assign slots for an int64 key batch in one C call.
+        Returns (slots i32[n], evictions i32[k])."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        n = len(keys)
+        out_slots = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock:
+            self._lib.rl_index_assign_ints(
+                self._h, keys.ctypes.data, n, int(lid),
+                out_slots.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_slots, out_ev[out_ev >= 0]
+
+    def assign_batch_strs(self, keys, lid: int):
+        """Assign slots for a string key batch in one C call."""
+        encoded = [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
+                           count=len(encoded))
+        offs = np.empty(len(keys) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        n = len(keys)
+        out_slots = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock:
+            self._lib.rl_index_assign_bytes(
+                self._h, packed.ctypes.data if len(packed) else 0,
+                offs.ctypes.data, n, int(lid),
+                out_slots.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_slots, out_ev[out_ev >= 0]

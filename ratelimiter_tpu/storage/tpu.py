@@ -106,6 +106,16 @@ class TpuBatchedStorage(RateLimitStorage):
     ) -> Dict[str, np.ndarray]:
         """Whole-batch synchronous decision (the vectorized/bench path)."""
         index = self._index[algo]
+        lid0 = lid_per_req[0] if lid_per_req else 0
+        uniform_lid = all(l == lid0 for l in lid_per_req)
+        if uniform_lid and hasattr(index, "assign_batch_strs"):
+            # Native fast path: flush queued traffic first (so eviction can't
+            # pull slots out from under pending requests), then one C call
+            # maps the whole batch; same-batch keys are generation-pinned.
+            self._batcher.flush()
+            slots, clears = index.assign_batch_strs(list(keys), lid0)
+            return self._batcher.dispatch_direct(
+                algo, slots, list(lid_per_req), list(permits), list(clears))
         pinned = self._batcher.pending_slots(algo)
         slots: List[int] = []
         clears: List[int] = []
@@ -117,6 +127,34 @@ class TpuBatchedStorage(RateLimitStorage):
             slots.append(slot)
         return self._batcher.dispatch_direct(
             algo, slots, list(lid_per_req), list(permits), clears)
+
+    def acquire_many_ids(
+        self, algo: str, lid: int, key_ids: np.ndarray, permits: np.ndarray,
+    ) -> Dict[str, np.ndarray]:
+        """Int-key whole-batch decision — the hyperscale hot path.
+
+        Integer user/tenant ids skip string hashing entirely: one C call for
+        slot assignment, one device dispatch for the decisions.
+        """
+        index = self._index[algo]
+        if hasattr(index, "assign_batch_ints"):
+            self._batcher.flush()
+            slots, clears = index.assign_batch_ints(
+                np.ascontiguousarray(key_ids, dtype=np.int64), lid)
+            clears = list(clears)
+        else:
+            pinned = self._batcher.pending_slots(algo)
+            slots = []
+            clears = []
+            for k in np.asarray(key_ids):
+                slot, evicted = index.assign((lid, int(k)), pinned=pinned)
+                if evicted is not None:
+                    clears.append(evicted)
+                pinned.add(slot)
+                slots.append(slot)
+            slots = np.asarray(slots, dtype=np.int32)
+        lids = np.full(len(slots), lid, dtype=np.int32)
+        return self._batcher.dispatch_direct(algo, slots, lids, permits, clears)
 
     def available_many(
         self, algo: str, lid: int, keys: Sequence[str]

@@ -1,0 +1,104 @@
+"""Native C++ slot index: semantic equivalence with the Python SlotIndex and
+end-to-end use through the TPU storage (incl. the int-key fast path)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.engine.native_index import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native slot index unavailable (no g++?)")
+
+
+def make_native(n):
+    from ratelimiter_tpu.engine.native_index import NativeSlotIndex
+
+    return NativeSlotIndex(n)
+
+
+def test_scalar_parity_with_python_index():
+    from ratelimiter_tpu.engine.slots import SlotIndex
+
+    rng = random.Random(3)
+    py, nat = SlotIndex(32), make_native(32)
+    key_to_slot_py, key_to_slot_nat = {}, {}
+    keys = [(rng.randrange(3), f"user{rng.randrange(60)}") for _ in range(500)]
+    for i, key in enumerate(keys):
+        op = rng.random()
+        if op < 0.8:
+            sp, _ = py.assign(key)
+            sn, _ = nat.assign(key)
+            key_to_slot_py[key], key_to_slot_nat[key] = sp, sn
+        elif op < 0.9:
+            assert (py.get(key) is None) == (nat.get(key) is None)
+        else:
+            rp, rn = py.remove(key), nat.remove(key)
+            assert (rp is None) == (rn is None)
+        assert len(py) == len(nat), f"step {i}"
+    # Same keys resident (slot numbering may differ; membership must not).
+    for key in set(keys):
+        assert (py.get(key) is None) == (nat.get(key) is None), key
+
+
+def test_batch_ints_identity_and_eviction():
+    nat = make_native(16)
+    slots, ev = nat.assign_batch_ints(np.arange(16), lid=0)
+    assert len(set(slots.tolist())) == 16 and len(ev) == 0
+    # Same keys again: identical slots, no evictions.
+    slots2, ev2 = nat.assign_batch_ints(np.arange(16), lid=0)
+    np.testing.assert_array_equal(slots, slots2)
+    assert len(ev2) == 0
+    # 8 new keys evict the 8 least-recent.
+    slots3, ev3 = nat.assign_batch_ints(np.arange(100, 108), lid=0)
+    assert len(ev3) == 8
+    assert len(nat) == 16
+
+
+def test_lid_isolation():
+    nat = make_native(8)
+    s1, _ = nat.assign((1, 42))
+    s2, _ = nat.assign((2, 42))
+    assert s1 != s2
+    assert nat.get((1, 42)) == s1 and nat.get((2, 42)) == s2
+
+
+def test_same_batch_oversubscription_raises():
+    nat = make_native(4)
+    with pytest.raises(RuntimeError):
+        nat.assign_batch_ints(np.arange(10), lid=0)
+
+
+def test_tpu_storage_int_key_fast_path_matches_oracle():
+    from ratelimiter_tpu import RateLimitConfig
+    from ratelimiter_tpu.algorithms import TokenBucketRateLimiter
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.semantics import TokenBucketOracle
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    T0 = 1_753_000_000_000
+
+    class FakeClock:
+        def __init__(self):
+            self.t = T0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    storage = TpuBatchedStorage(num_slots=1024, max_delay_ms=0.1, clock_ms=clock)
+    cfg = RateLimitConfig(max_permits=12, window_ms=1500, refill_rate=20.0)
+    limiter = TokenBucketRateLimiter(storage, cfg, MeterRegistry(), clock_ms=clock)
+    oracle = TokenBucketOracle(cfg)
+    rng = np.random.default_rng(4)
+    for step in range(25):
+        clock.t += int(rng.integers(0, 500))
+        n = int(rng.integers(1, 40))
+        ids = rng.integers(0, 30, size=n)
+        perms = rng.integers(1, 14, size=n)
+        got = limiter.try_acquire_ids(ids, perms)
+        for j in range(n):
+            want = oracle.try_acquire(int(ids[j]), int(perms[j]), clock.t).allowed
+            assert got[j] == want, (step, j)
+    storage.close()
