@@ -1,0 +1,177 @@
+"""Checkpoint / resume for device-resident limiter state.
+
+The reference delegates durability to Redis AOF persistence
+(docker-compose.yml enables --appendonly): counters survive an app restart
+because they live in Redis.  In this framework the source of truth is HBM,
+which dies with the process — so durability is an explicit subsystem
+(SURVEY.md §5.4): snapshot the slot arrays and the key->slot index to disk,
+restore them on boot.
+
+Format: a directory with
+  - ``state.npz``  — the SW/TB slot arrays (numpy int64)
+  - ``index.json`` — limiter registrations + key->slot mappings + metadata
+
+Snapshots are crash-consistent (written to a temp dir, atomically renamed)
+and backend-portable: a checkpoint taken on a sharded engine restores onto a
+single-device engine and vice versa (state is keyed by global slot id; the
+restore re-routes rows if the slot geometry changed... geometry must match —
+enforced by metadata check; cross-geometry migration is a rebalance, left to
+the operator via export/import of per-key state in a future round).
+
+The native slot index cannot enumerate its keys (it stores fingerprints
+only), so checkpointable deployments either use the Python index
+(``TpuBatchedStorage(checkpointable=True)``) or supply key enumeration at
+snapshot time from the service tier.  The device state itself snapshots
+regardless of index type.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+FORMAT_VERSION = 1
+
+
+def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
+    """Materialize the device state to host numpy (one blocking transfer)."""
+    engine.block_until_ready()
+    sw = engine.sw_state
+    tb = engine.tb_state
+    return {
+        "sw": {f: np.asarray(getattr(sw, f)).reshape(-1) for f in sw._fields},
+        "tb": {f: np.asarray(getattr(tb, f)).reshape(-1) for f in tb._fields},
+        "meta": {
+            "format": FORMAT_VERSION,
+            "num_slots": engine.num_slots,
+            "taken_at_ms": time.time_ns() // 1_000_000,
+            "index": index_dump or {},
+        },
+    }
+
+
+def save_checkpoint(path: str, engine, index_dump: Optional[Dict] = None) -> None:
+    """Write an atomic on-disk checkpoint (temp dir + rename)."""
+    snap = snapshot_engine_state(engine, index_dump)
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
+    try:
+        arrays = {f"sw_{k}": v for k, v in snap["sw"].items()}
+        arrays.update({f"tb_{k}": v for k, v in snap["tb"].items()})
+        np.savez(os.path.join(tmp, "state.npz"), **arrays)
+        with open(os.path.join(tmp, "index.json"), "w") as fh:
+            json.dump(snap["meta"], fh)
+        if os.path.exists(path):
+            old = path + f".old-{os.getpid()}"
+            os.rename(path, old)
+            os.rename(tmp, path)
+            import shutil
+
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, path)
+    except Exception:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_checkpoint(path: str) -> Dict:
+    with open(os.path.join(path, "index.json")) as fh:
+        meta = json.load(fh)
+    if meta.get("format") != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint format: {meta.get('format')}")
+    data = np.load(os.path.join(path, "state.npz"))
+    return {"meta": meta, "arrays": dict(data)}
+
+
+def restore_engine_state(engine, ckpt: Dict) -> None:
+    """Load checkpointed slot arrays into an engine of the same geometry."""
+    import jax.numpy as jnp
+
+    meta = ckpt["meta"]
+    if meta["num_slots"] != engine.num_slots:
+        raise ValueError(
+            f"checkpoint has {meta['num_slots']} slots, engine has "
+            f"{engine.num_slots}; geometry must match")
+    arrays = ckpt["arrays"]
+    sw = engine.sw_state
+    tb = engine.tb_state
+    shape = np.asarray(sw.win_start).shape  # matches engine layout (1D or 2D)
+    engine.sw_state = type(sw)(*(
+        jnp.asarray(arrays[f"sw_{f}"].reshape(shape)) for f in sw._fields))
+    engine.tb_state = type(tb)(*(
+        jnp.asarray(arrays[f"tb_{f}"].reshape(shape)) for f in tb._fields))
+
+
+# ---------------------------------------------------------------------------
+# Index dump/load (Python SlotIndex only — see module docstring)
+# ---------------------------------------------------------------------------
+
+def _dump_flat(index) -> list:
+    with index._lock:
+        return [[list(k) if isinstance(k, tuple) else k, slot]
+                for k, slot in index._map.items()]
+
+
+def _restore_flat(index, entries) -> None:
+    with index._lock:
+        index._map.clear()
+        used = set()
+        for key, slot in entries:
+            key = tuple(key) if isinstance(key, list) else key
+            index._map[key] = int(slot)
+            used.add(int(slot))
+        index._free = [s for s in range(index.num_slots - 1, -1, -1)
+                       if s not in used]
+
+
+def dump_slot_indexes(storage) -> Dict:
+    """Serialize key->slot maps of a TpuBatchedStorage.
+
+    Works for the Python flat index and the sharded index (global slot =
+    shard * slots_per_shard + local).  The native index stores fingerprints
+    only — construct the storage with checkpointable=True to use the
+    enumerable Python index.
+    """
+    out: Dict = {"algos": {}}
+    for algo, index in storage._index.items():
+        if hasattr(index, "_map"):
+            out["algos"][algo] = {"kind": "flat", "entries": _dump_flat(index)}
+        elif hasattr(index, "_sub"):
+            base = index.slots_per_shard
+            entries = []
+            for shard, sub in enumerate(index._sub):
+                for key, local in _dump_flat(sub):
+                    entries.append([key, shard * base + local])
+            out["algos"][algo] = {"kind": "sharded", "entries": entries}
+        else:
+            raise ValueError(
+                "native slot index is not enumerable; construct the storage "
+                "with checkpointable=True to use the Python index")
+    return out
+
+
+def restore_slot_indexes(storage, dump: Dict) -> None:
+    for algo, payload in dump.get("algos", {}).items():
+        index = storage._index[algo]
+        entries = payload["entries"]
+        if hasattr(index, "_map"):
+            _restore_flat(index, entries)
+        elif hasattr(index, "_sub"):
+            base = index.slots_per_shard
+            per_shard = [[] for _ in index._sub]
+            for key, gslot in entries:
+                per_shard[gslot // base].append([key, gslot % base])
+            for sub, sub_entries in zip(index._sub, per_shard):
+                _restore_flat(sub, sub_entries)
+        else:
+            raise ValueError("cannot restore into a native slot index")

@@ -1,0 +1,145 @@
+"""Pallas TPU kernel for the segmented threshold-recurrence solver.
+
+The XLA implementation (ops/segments.py:solve_threshold_recurrence) runs the
+sandwich iteration as a ``lax.while_loop`` whose per-iteration buffers round-
+trip through HBM.  This kernel keeps the whole sorted batch resident in VMEM
+and iterates in place: one launch, log-depth masked segmented scans on the
+VPU, no HBM traffic between iterations.
+
+Arithmetic: int32 with saturating adds.  Exactness argument:
+
+- Sliding window (w == 1): all quantities are counts bounded by the batch
+  size and max_permits; thresholds are clamped to SAT, and any count beyond
+  SAT would reject anyway.
+- Token bucket: the condition  W + req <= v1  has every term a multiple of
+  2**TOKEN_FP_SHIFT (req = permits * 1000 * 2**s), so both sides can be
+  right-shifted by s exactly (callers pass u' = (v1 - req) >> s and
+  w' = req >> s = permits * 1000).  Within-segment sums can still overflow
+  int32 for pathological hot segments, so the scan saturates at SAT; since
+  SAT > any representable u', a saturated prefix correctly rejects.
+  min(a+b, SAT) is associative over non-negatives, so saturation commutes
+  with the scan.
+
+The kernel is gated: ``solve_threshold_recurrence_auto`` tries the Pallas
+path when enabled (RATELIMITER_PALLAS=1) and the platform supports it,
+falling back to the XLA implementation otherwise — decisions are identical
+(differential-tested in tests/test_pallas_solver.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ratelimiter_tpu.ops import segments as _xla
+
+SAT = 1 << 30  # saturation ceiling (python int): above any legal threshold
+
+
+def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
+    """Whole-batch solver in one VMEM block.
+
+    u, w: i32[1, n]; segfirst: i32[1, n] — index of each element's segment
+    head; inc (out): i32[1, n].
+    """
+    u = u_ref[0, :]
+    w = w_ref[0, :]
+    seg_first = segfirst_ref[0, :]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+
+    def seg_cumsum_excl(x):
+        """Saturating segmented inclusive scan minus x (exclusive).
+
+        Masked Hillis-Steele: after step k, v[i] holds the (saturated) sum of
+        x over [max(seg_first[i], i - 2^k + 1), i]; values never leave the
+        segment, so magnitudes stay segment-local.
+        """
+        v = x
+        d = 1
+        while d < n:  # static log2(n) unroll
+            shifted = jnp.concatenate([jnp.zeros((d,), jnp.int32), v[:-d]])
+            ok = (idx - d) >= seg_first
+            v = jnp.minimum(v + jnp.where(ok, shifted, 0), SAT)
+            d *= 2
+        return v - x
+
+    def step(x):
+        s = seg_cumsum_excl(jnp.minimum(w * x, SAT))
+        return (s <= u).astype(jnp.int32)
+
+    def cond(carry):
+        lo, hi, it = carry
+        return jnp.logical_and(jnp.any(lo != hi), it < n + 2)
+
+    def body(carry):
+        lo, hi, it = carry
+        return step(hi), step(lo), it + 1
+
+    lo0 = jnp.zeros((n,), jnp.int32)
+    hi0 = jnp.ones((n,), jnp.int32)
+    lo, _, _ = jax.lax.while_loop(cond, body, (lo0, hi0, jnp.int32(0)))
+    inc_ref[0, :] = lo
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_solve(u32, w32, seg_first, interpret: bool = False):
+    """Run the Pallas solver on i32 inputs shaped [n]."""
+    from jax.experimental import pallas as pl
+
+    n = u32.shape[0]
+    kernel = functools.partial(_solver_kernel, n=n)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        interpret=interpret,
+    )(u32.reshape(1, n), w32.reshape(1, n), seg_first.reshape(1, n))
+    return out[0]
+
+
+def seg_first_index(first: jnp.ndarray) -> jnp.ndarray:
+    """Index of each element's segment head (i32), from the boolean
+    first-occurrence mask."""
+    n = first.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jax.lax.associative_scan(jnp.maximum, jnp.where(first, idx, 0))
+
+
+# ---------------------------------------------------------------------------
+# Auto dispatcher
+# ---------------------------------------------------------------------------
+
+_PALLAS_FLAG = os.environ.get("RATELIMITER_PALLAS", "0") == "1"
+_pallas_ok: bool | None = None
+
+
+def _pallas_supported() -> bool:
+    global _pallas_ok
+    if _pallas_ok is None:
+        try:
+            test = jnp.asarray([5, 5, -1], dtype=jnp.int32)
+            w = jnp.ones(3, dtype=jnp.int32)
+            sf = jnp.zeros(3, dtype=jnp.int32)
+            out = pallas_solve(test, w, sf)
+            _pallas_ok = list(jax.device_get(out)) == [1, 1, 0]
+        except Exception:  # noqa: BLE001 — any lowering failure => fallback
+            _pallas_ok = False
+    return _pallas_ok
+
+
+def solve_threshold_recurrence_auto(u, w, first):
+    """Drop-in for segments.solve_threshold_recurrence with optional Pallas.
+
+    Inputs are int64 (engine convention); the Pallas path clamps thresholds
+    into the saturating-int32 domain, which preserves decisions (see module
+    docstring).  Callers that cannot shift into i32 exactly must use the XLA
+    path directly.
+    """
+    if _PALLAS_FLAG and _pallas_supported():
+        u32 = jnp.clip(u, -1, SAT).astype(jnp.int32)
+        w32 = jnp.clip(w, 0, SAT).astype(jnp.int32)
+        sf = seg_first_index(first)
+        return pallas_solve(u32, w32, sf).astype(jnp.int64)
+    return _xla.solve_threshold_recurrence(u, w, first)

@@ -55,6 +55,7 @@ class TpuBatchedStorage(RateLimitStorage):
         clock_ms: Callable[[], int] = _wall_clock_ms,
         engine: DeviceEngine | None = None,
         table: LimiterTable | None = None,
+        checkpointable: bool = False,
     ):
         self._clock_ms = clock_ms
         if engine is not None and table is None:
@@ -64,10 +65,19 @@ class TpuBatchedStorage(RateLimitStorage):
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
-        self._index = {
-            "sw": self.engine.make_slot_index(),
-            "tb": self.engine.make_slot_index(),
-        }
+        # checkpointable=True swaps a fingerprint-only native index for the
+        # enumerable Python one so the key->slot map can be snapshotted
+        # (engine/checkpoint.py); sharded indexes are already enumerable.
+        def make_index():
+            index = self.engine.make_slot_index()
+            if checkpointable and not hasattr(index, "_map") \
+                    and not hasattr(index, "_sub"):
+                from ratelimiter_tpu.engine.slots import SlotIndex
+
+                index = SlotIndex(self.engine.num_slots)
+            return index
+
+        self._index = {"sw": make_index(), "tb": make_index()}
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
         self._batcher = MicroBatcher(
             dispatch={
@@ -206,6 +216,25 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def flush(self) -> None:
         self._batcher.flush()
+
+    # ------------------------------------------------------------------------
+    # Checkpoint / resume (engine/checkpoint.py; SURVEY.md §5.4)
+    # ------------------------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        """Flush pending work and snapshot device state + key->slot maps."""
+        from ratelimiter_tpu.engine import checkpoint as ckpt
+
+        self._batcher.flush()
+        self.engine.block_until_ready()
+        ckpt.save_checkpoint(path, self.engine, ckpt.dump_slot_indexes(self))
+
+    def restore_checkpoint(self, path: str) -> None:
+        from ratelimiter_tpu.engine import checkpoint as ckpt
+
+        data = ckpt.load_checkpoint(path)
+        self._batcher.flush()
+        ckpt.restore_engine_state(self.engine, data)
+        ckpt.restore_slot_indexes(self, data["meta"]["index"])
 
     # ------------------------------------------------------------------------
     # Legacy 10-method contract (host-side, embedded InMemoryStorage)

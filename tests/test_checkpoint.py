@@ -1,0 +1,108 @@
+"""Checkpoint/resume: device state + key->slot index survive a 'restart'.
+
+The reference leans on Redis AOF for durability; here HBM state is
+explicitly snapshotted and restored (SURVEY.md §5.4).  A restored process
+must continue making the exact decisions the uninterrupted one would.
+"""
+
+import random
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter, TokenBucketRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+T0 = 1_753_000_000_000
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def drive(limiter, oracle, clock, rng, keys, steps):
+    for _ in range(steps):
+        clock.t += rng.randrange(0, 300)
+        n = rng.randrange(1, 16)
+        ks = [rng.choice(keys) for _ in range(n)]
+        perms = [rng.randrange(1, 4) for _ in range(n)]
+        got = limiter.try_acquire_many(ks, perms)
+        for j in range(n):
+            want = oracle.try_acquire(ks[j], perms[j], clock.t).allowed
+            assert got[j] == want
+
+
+def test_checkpoint_restore_continues_identically(tmp_path):
+    clock = FakeClock()
+    rng = random.Random(21)
+    keys = [f"u{i}" for i in range(10)]
+    cfg_sw = RateLimitConfig(max_permits=15, window_ms=2000, enable_local_cache=False)
+    cfg_tb = RateLimitConfig(max_permits=25, window_ms=3000, refill_rate=12.0)
+
+    storage = TpuBatchedStorage(num_slots=256, max_delay_ms=0.1,
+                                clock_ms=clock, checkpointable=True)
+    sw = SlidingWindowRateLimiter(storage, cfg_sw, MeterRegistry(), clock_ms=clock)
+    tb = TokenBucketRateLimiter(storage, cfg_tb, MeterRegistry(), clock_ms=clock)
+    osw, otb = SlidingWindowOracle(cfg_sw), TokenBucketOracle(cfg_tb)
+
+    drive(sw, osw, clock, rng, keys, 20)
+    drive(tb, otb, clock, rng, keys, 20)
+
+    ckpt = str(tmp_path / "ckpt")
+    storage.save_checkpoint(ckpt)
+    storage.close()
+
+    # "Restart": a fresh storage + fresh limiter objects, same configs in the
+    # same registration order, restored from disk.
+    clock2 = FakeClock(clock.t)
+    storage2 = TpuBatchedStorage(num_slots=256, max_delay_ms=0.1,
+                                 clock_ms=clock2, checkpointable=True)
+    sw2 = SlidingWindowRateLimiter(storage2, cfg_sw, MeterRegistry(), clock_ms=clock2)
+    tb2 = TokenBucketRateLimiter(storage2, cfg_tb, MeterRegistry(), clock_ms=clock2)
+    storage2.restore_checkpoint(ckpt)
+
+    # The oracles carry on from their (never-interrupted) state; the restored
+    # stack must agree with them decision-for-decision.
+    drive(sw2, osw, clock2, rng, keys, 20)
+    drive(tb2, otb, clock2, rng, keys, 20)
+    storage2.close()
+
+
+def test_checkpoint_geometry_mismatch_rejected(tmp_path):
+    storage = TpuBatchedStorage(num_slots=128, checkpointable=True)
+    ckpt = str(tmp_path / "ckpt")
+    storage.save_checkpoint(ckpt)
+    storage.close()
+
+    storage2 = TpuBatchedStorage(num_slots=256, checkpointable=True)
+    with pytest.raises(ValueError, match="geometry"):
+        storage2.restore_checkpoint(ckpt)
+    storage2.close()
+
+
+def test_checkpoint_atomic_overwrite(tmp_path):
+    storage = TpuBatchedStorage(num_slots=64, checkpointable=True)
+    ckpt = str(tmp_path / "ckpt")
+    storage.save_checkpoint(ckpt)
+    storage.save_checkpoint(ckpt)  # overwrite in place must not corrupt
+    storage2 = TpuBatchedStorage(num_slots=64, checkpointable=True)
+    storage2.restore_checkpoint(ckpt)
+    storage.close()
+    storage2.close()
+
+
+def test_native_index_checkpoint_refused(tmp_path):
+    from ratelimiter_tpu.engine.native_index import native_available
+
+    if not native_available():
+        pytest.skip("no native index")
+    storage = TpuBatchedStorage(num_slots=64)  # native index by default
+    with pytest.raises(ValueError, match="enumerable"):
+        storage.save_checkpoint(str(tmp_path / "ckpt"))
+    storage.close()
