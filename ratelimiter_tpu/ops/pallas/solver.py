@@ -112,6 +112,8 @@ def seg_first_index(first: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 _PALLAS_FLAG = os.environ.get("RATELIMITER_PALLAS", "0") == "1"
+# Interpret-mode override so the Pallas path can be exercised on CPU in tests.
+_PALLAS_INTERPRET = os.environ.get("RATELIMITER_PALLAS_INTERPRET", "0") == "1"
 _pallas_ok: bool | None = None
 
 
@@ -122,24 +124,29 @@ def _pallas_supported() -> bool:
             test = jnp.asarray([5, 5, -1], dtype=jnp.int32)
             w = jnp.ones(3, dtype=jnp.int32)
             sf = jnp.zeros(3, dtype=jnp.int32)
-            out = pallas_solve(test, w, sf)
+            out = pallas_solve(test, w, sf, interpret=_PALLAS_INTERPRET)
             _pallas_ok = list(jax.device_get(out)) == [1, 1, 0]
         except Exception:  # noqa: BLE001 — any lowering failure => fallback
             _pallas_ok = False
     return _pallas_ok
 
 
-def solve_threshold_recurrence_auto(u, w, first):
+def solve_threshold_recurrence_auto(u, w, first, shift: int = 0):
     """Drop-in for segments.solve_threshold_recurrence with optional Pallas.
 
-    Inputs are int64 (engine convention); the Pallas path clamps thresholds
-    into the saturating-int32 domain, which preserves decisions (see module
-    docstring).  Callers that cannot shift into i32 exactly must use the XLA
-    path directly.
+    Inputs are int64 (engine convention).  ``shift`` right-shifts u and w
+    into the int32 domain; exact when every weight is a multiple of
+    2**shift (token bucket: shift=TOKEN_FP_SHIFT since req_fp =
+    permits * 1000 * 2**shift — the arithmetic shift floors u, and
+    W <= u  <=>  W>>s <= floor(u/2**s) for W a multiple of 2**s).
+    Sliding window uses shift=0.
     """
     if _PALLAS_FLAG and _pallas_supported():
-        u32 = jnp.clip(u, -1, SAT).astype(jnp.int32)
-        w32 = jnp.clip(w, 0, SAT).astype(jnp.int32)
+        u_s = jnp.right_shift(u, shift) if shift else u
+        w_s = jnp.right_shift(w, shift) if shift else w
+        u32 = jnp.clip(u_s, -1, SAT).astype(jnp.int32)
+        w32 = jnp.clip(w_s, 0, SAT).astype(jnp.int32)
         sf = seg_first_index(first)
-        return pallas_solve(u32, w32, sf).astype(jnp.int64)
+        out = pallas_solve(u32, w32, sf, interpret=_PALLAS_INTERPRET)
+        return out.astype(jnp.int64)
     return _xla.solve_threshold_recurrence(u, w, first)

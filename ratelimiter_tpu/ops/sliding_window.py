@@ -25,12 +25,12 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from ratelimiter_tpu.engine.state import SWState, TableArrays
+from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
     segment_totals,
     segmented_cumsum_exclusive,
-    solve_threshold_recurrence,
 )
 from ratelimiter_tpu.ops.sorting import sort_batch, unsort
 
@@ -91,7 +91,7 @@ def sw_step(
     # inc[j] = [ base + curr_e + S[j] + p[j] <= maxp ],  S = prior increments.
     u = jnp.where(valid, maxp - base - curr_e - p, -1)
     first = first_occurrence(s)
-    inc = solve_threshold_recurrence(u, jnp.ones_like(u), first)
+    inc = solve_threshold_recurrence_auto(u, jnp.ones_like(u), first)
     S = segmented_cumsum_exclusive(inc, first)
 
     c_j = curr_e + S                     # raw curr counter seen by request j

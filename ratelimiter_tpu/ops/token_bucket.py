@@ -19,14 +19,14 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE, TOKEN_FP_SHIFT
 from ratelimiter_tpu.engine.state import TBState, TableArrays
+from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
     segment_totals,
     segmented_cumsum_exclusive,
-    solve_threshold_recurrence,
 )
 from ratelimiter_tpu.ops.sorting import sort_batch, unsort
 
@@ -77,7 +77,9 @@ def tb_step(
     # matching the oracle at equal timestamps).
     u = jnp.where(pre_ok, v1 - req, -1)
     first = first_occurrence(s)
-    inc = solve_threshold_recurrence(u, req, first)
+    # Exact i32 shift for the optional Pallas path: req is a multiple of
+    # 2**TOKEN_FP_SHIFT (see solver docstring).
+    inc = solve_threshold_recurrence_auto(u, req, first, shift=TOKEN_FP_SHIFT)
     W = segmented_cumsum_exclusive(req * inc, first)
 
     v_j = v1 - W                         # fp tokens seen by request j

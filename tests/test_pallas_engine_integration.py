@@ -1,0 +1,65 @@
+"""Engine differential with the Pallas solver enabled (interpret mode).
+
+The flags latch at import, so the pallas-enabled engine runs in a
+subprocess; decisions must match the oracle exactly, proving the kernel
+composes correctly with both device steps (incl. the token bucket's exact
+fixed-point shift)."""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = r"""
+import os, random
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine.engine import DeviceEngine
+from ratelimiter_tpu.engine.state import LimiterTable
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.ops.pallas.solver import _pallas_supported
+
+assert _pallas_supported(), "pallas interpret probe failed"
+
+T0 = 1_753_000_000_000
+rng = random.Random(5)
+table = LimiterTable()
+cfg_sw = RateLimitConfig(max_permits=12, window_ms=1500, enable_local_cache=False)
+cfg_tb = RateLimitConfig(max_permits=20, window_ms=2500, refill_rate=15.0)
+lid_sw, lid_tb = table.register(cfg_sw), table.register(cfg_tb)
+osw, otb = SlidingWindowOracle(cfg_sw), TokenBucketOracle(cfg_tb)
+engine = DeviceEngine(num_slots=256, table=table)
+slots = {}
+def slot(lid, k):
+    return slots.setdefault((lid, k), len(slots))
+now = T0
+for step in range(20):
+    now += rng.randrange(0, 700)
+    n = rng.randrange(1, 24)
+    ks = [f"u{rng.randrange(6)}" for _ in range(n)]
+    perms = [rng.randrange(1, 23) for _ in range(n)]
+    out = engine.sw_acquire([slot(lid_sw, k) for k in ks], [lid_sw]*n,
+                            [min(p, 3) for p in perms], now)
+    for j in range(n):
+        d = osw.try_acquire(ks[j], min(perms[j], 3), now)
+        assert out["allowed"][j] == d.allowed, ("sw", step, j)
+    out = engine.tb_acquire([slot(lid_tb, k) for k in ks], [lid_tb]*n, perms, now)
+    for j in range(n):
+        d = otb.try_acquire(ks[j], perms[j], now)
+        assert out["allowed"][j] == d.allowed, ("tb", step, j)
+print("PALLAS_DIFFERENTIAL_OK")
+"""
+
+
+def test_pallas_enabled_engine_matches_oracle():
+    env = dict(os.environ)
+    env.update({
+        "RATELIMITER_PALLAS": "1",
+        "RATELIMITER_PALLAS_INTERPRET": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "PALLAS_DIFFERENTIAL_OK" in proc.stdout, proc.stderr[-3000:]
