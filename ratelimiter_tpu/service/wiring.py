@@ -37,7 +37,7 @@ class AppContext:
         self.storage.close()
 
 
-def build_storage(props: AppProperties) -> RateLimitStorage:
+def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage:
     backend = (props.get("storage.backend") or "tpu").lower()
     if backend == "memory":
         return InMemoryStorage()
@@ -64,6 +64,7 @@ def build_storage(props: AppProperties) -> RateLimitStorage:
             max_batch=props.get_int("batcher.max_batch", 8192),
             max_delay_ms=props.get_float("batcher.max_delay_ms", 0.5),
             engine=engine,
+            meter_registry=meter_registry,
         )
     raise ValueError(f"unknown storage.backend: {backend!r}")
 
@@ -71,8 +72,8 @@ def build_storage(props: AppProperties) -> RateLimitStorage:
 def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
-    storage = storage or build_storage(props)
     registry = MeterRegistry()
+    storage = storage or build_storage(props, meter_registry=registry)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache

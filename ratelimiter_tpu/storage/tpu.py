@@ -56,8 +56,17 @@ class TpuBatchedStorage(RateLimitStorage):
         engine: DeviceEngine | None = None,
         table: LimiterTable | None = None,
         checkpointable: bool = False,
+        meter_registry=None,
     ):
         self._clock_ms = clock_ms
+        # The storage-latency histogram the reference documents but never
+        # ships (ARCHITECTURE notes; SURVEY §5.5): per-dispatch wall time.
+        self._latency = (
+            meter_registry.timer(
+                "ratelimiter.storage.latency",
+                "Device dispatch latency (per micro-batch)")
+            if meter_registry is not None else None
+        )
         if engine is not None and table is None:
             table = engine.table
         self.table = table if table is not None else LimiterTable()
@@ -79,10 +88,20 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._index = {"sw": make_index(), "tb": make_index()}
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
+        def _timed(fn):
+            def run(s, l, p):
+                t0 = time.perf_counter()
+                out = fn(s, l, p, self._clock_ms())
+                if self._latency is not None:
+                    self._latency.record_us((time.perf_counter() - t0) * 1e6)
+                return out
+
+            return run
+
         self._batcher = MicroBatcher(
             dispatch={
-                "sw": lambda s, l, p: self.engine.sw_acquire(s, l, p, self._clock_ms()),
-                "tb": lambda s, l, p: self.engine.tb_acquire(s, l, p, self._clock_ms()),
+                "sw": _timed(self.engine.sw_acquire),
+                "tb": _timed(self.engine.tb_acquire),
             },
             clear={
                 "sw": self.engine.sw_clear,

@@ -1,0 +1,100 @@
+"""Observability + failure paths: dispatch latency histogram, batcher
+exception propagation, and the HTTP service over the real TPU-batched stack."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+
+def test_storage_latency_histogram_populated():
+    registry = MeterRegistry()
+    storage = TpuBatchedStorage(num_slots=64, max_delay_ms=0.1,
+                                meter_registry=registry)
+    limiter = SlidingWindowRateLimiter(
+        storage, RateLimitConfig.per_minute(10), registry)
+    for _ in range(5):
+        limiter.try_acquire("u")
+    storage.flush()
+    snap = registry.scrape()["ratelimiter.storage.latency"]
+    assert snap["count"] >= 1
+    assert snap["p99_us"] > 0
+    storage.close()
+
+
+def test_batcher_dispatch_failure_fails_waiters():
+    from ratelimiter_tpu.engine.batcher import MicroBatcher
+
+    def boom(slots, lids, permits):
+        raise RuntimeError("device fell over")
+
+    batcher = MicroBatcher(
+        dispatch={"sw": boom}, clear={"sw": lambda s: None}, max_delay_ms=0.05)
+    fut = batcher.submit("sw", 0, 0, 1)
+    with pytest.raises(RuntimeError, match="device fell over"):
+        fut.result(timeout=5)
+    batcher.close()
+
+
+def test_service_over_tpu_backend_end_to_end():
+    from ratelimiter_tpu.service.app import make_server
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import build_app
+
+    props = AppProperties({
+        "storage.backend": "tpu",
+        "storage.num_slots": "4096",
+        "batcher.max_delay_ms": "0.2",
+        "parallel.shard": "off",
+    })
+    ctx = build_app(props)
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = srv.server_address[1]
+
+        def req(method, path, body=None, headers=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request(method, path,
+                         body=json.dumps(body) if body else None,
+                         headers=headers or {})
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            conn.close()
+            return resp.status, data
+
+        # Sliding window through the device engine.
+        for i in range(10):
+            status, data = req("POST", "/api/login", {"username": "tpu-user"})
+            assert status == 200, data
+        status, _ = req("POST", "/api/login", {"username": "tpu-user"})
+        assert status == 429
+        # Token bucket burst through the device engine. (Real wall clock:
+        # first-dispatch jit compile time refills a few tokens between the
+        # consume and the availability peek, so only bound the remainder.)
+        status, data = req("POST", "/api/batch", {"size": 50},
+                           {"X-User-ID": "tpu-burst", "Content-Type": "application/json"})
+        assert status == 200 and data["tokens_remaining"] < 50
+        status, _ = req("POST", "/api/batch", {"size": 50},
+                        {"X-User-ID": "tpu-burst", "Content-Type": "application/json"})
+        assert status == 429
+        # Reset restores both.
+        status, _ = req("DELETE", "/api/admin/reset/tpu-user")
+        assert status == 200
+        status, _ = req("POST", "/api/login", {"username": "tpu-user"})
+        assert status == 200
+        # Latency histogram exposed over the actuator.
+        status, data = req("GET", "/actuator/metrics")
+        assert status == 200
+        assert data["meters"]["ratelimiter.storage.latency"]["count"] >= 1
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+        ctx.close()
