@@ -1,0 +1,6 @@
+"""``python -m ratelimiter_tpu`` — run the HTTP demo service."""
+
+from ratelimiter_tpu.service.app import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Multi-host key routing (the DCN tier).
+
+Scaling past one host follows the same rule as scaling past one chip
+(parallel/mesh.py): *pin keys, don't coordinate*.  Each host process owns
+the key-space shards of its local chips; a stateless router in front (or
+embedded in every client) maps a key to its owning host with the same
+deterministic hash used for chip sharding.  The hot path therefore never
+crosses DCN — only client->owner traffic does, exactly like Redis Cluster
+client-side hash-slot routing (the reference's prescribed scale-out,
+ARCHITECTURE notes on Redis Cluster).
+
+``HostRouter`` is that mapping plus sidecar connection management: give it
+the host:port list of the fleet's sidecars (config-distributed, like the
+reference's redis.host property) and call it like a limiter.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ratelimiter_tpu.service.sidecar import SidecarClient
+
+
+def host_of_key(key: str, n_hosts: int) -> int:
+    """Deterministic key -> host hash.
+
+    Uses a different stream than shard_of_key (chip-level) so the two
+    tiers stripe independently.
+    """
+    return zlib.crc32(b"host:" + key.encode()) % n_hosts
+
+
+class HostRouter:
+    """Routes decisions to the owning host's sidecar."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]]):
+        if not endpoints:
+            raise ValueError("at least one endpoint required")
+        self._endpoints = list(endpoints)
+        self._clients: Dict[int, SidecarClient] = {}
+        self._lock = threading.Lock()
+
+    def _client(self, host_idx: int) -> SidecarClient:
+        with self._lock:
+            client = self._clients.get(host_idx)
+            if client is None:
+                host, port = self._endpoints[host_idx]
+                client = SidecarClient(host, port)
+                self._clients[host_idx] = client
+            return client
+
+    def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
+        return self._client(host_of_key(key, len(self._endpoints))).try_acquire(
+            lid, key, permits)
+
+    def acquire_batch(self, lid: int, keys: Sequence[str],
+                      permits: Optional[Sequence[int]] = None) -> List[bool]:
+        """Split a batch by owning host, pipeline each sub-batch, reassemble."""
+        permits = list(permits) if permits is not None else [1] * len(keys)
+        n = len(self._endpoints)
+        per_host: Dict[int, List[int]] = {}
+        for i, key in enumerate(keys):
+            per_host.setdefault(host_of_key(key, n), []).append(i)
+        out: List[bool] = [False] * len(keys)
+        for host_idx, positions in per_host.items():
+            res = self._client(host_idx).acquire_batch(
+                lid, [keys[i] for i in positions],
+                [permits[i] for i in positions])
+            for pos, (_status, allowed, _rem) in zip(positions, res):
+                out[pos] = allowed
+        return out
+
+    def available(self, lid: int, key: str) -> int:
+        return self._client(host_of_key(key, len(self._endpoints))).available(lid, key)
+
+    def reset(self, lid: int, key: str) -> None:
+        self._client(host_of_key(key, len(self._endpoints))).reset(lid, key)
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._clients.values():
+                client.close()
+            self._clients.clear()

@@ -1,0 +1,208 @@
+"""Decision sidecar: a binary TCP protocol replacing the Redis round-trip.
+
+The reference's distributed story is "every app instance speaks RESP to one
+Redis".  This framework's equivalent is the sidecar: non-Python services
+(e.g. a JVM API gateway) connect over TCP and stream decision requests; the
+server funnels every connection into the shared micro-batcher, so requests
+from *all* clients coalesce into the same device batches — the many-clients
+/one-authority topology of Redis, with the TPU engine as the authority.
+
+Wire format (little-endian), deliberately RESP-simple so any language can
+speak it in ~30 lines:
+
+  request  :=  u32 len | u8 op | u32 limiter_id | u32 permits | key bytes
+  response :=  u32 len | u8 status | u8 allowed | i64 remaining
+
+  op: 1 = TRY_ACQUIRE   (allowed + remaining hint)
+      2 = AVAILABLE     (remaining permits; allowed unused)
+      3 = RESET         (admin)
+      4 = PING          (health; allowed=1 when storage is up)
+  status: 0 = ok, 1 = error (remaining carries an errno)
+
+Requests may be pipelined: a client can write N frames before reading N
+responses (the provided ``SidecarClient.acquire_batch`` does exactly this),
+which amortizes syscalls the way Redis pipelining does
+(the reference leans on the same trick for INCR+PEXPIRE).
+
+Limiters are registered server-side by name -> (algo, config); clients
+address them by the integer id returned at registration (distributed via
+config, exactly like the reference's named Spring beans).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+OP_TRY_ACQUIRE = 1
+OP_AVAILABLE = 2
+OP_RESET = 3
+OP_PING = 4
+
+_REQ_BODY = struct.Struct("<BII")    # op, lid, permits (after the u32 len)
+_RESP = struct.Struct("<IBBq")       # len, status, allowed, remaining
+
+ERR_UNKNOWN_OP = 1
+ERR_UNKNOWN_LIMITER = 2
+ERR_INTERNAL = 3
+
+
+class SidecarServer:
+    """Threaded TCP server over a TpuBatchedStorage."""
+
+    def __init__(self, storage: TpuBatchedStorage, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.storage = storage
+        self._limiters: Dict[int, Tuple[str, RateLimitConfig]] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                buf = b""
+                while True:
+                    try:
+                        chunk = sock.recv(65536)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    responses = []
+                    while len(buf) >= 4:
+                        (length,) = struct.unpack_from("<I", buf)
+                        if len(buf) < 4 + length:
+                            break
+                        frame = buf[4:4 + length]
+                        buf = buf[4 + length:]
+                        responses.append(outer._handle_frame(frame))
+                    if responses:
+                        try:
+                            sock.sendall(b"".join(responses))
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sidecar", daemon=True)
+
+    # -- limiter registry -----------------------------------------------------
+    def register(self, algo: str, config: RateLimitConfig) -> int:
+        lid = self.storage.register_limiter(algo, config)
+        self._limiters[lid] = (algo, config)
+        return lid
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "SidecarServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- frame handling -------------------------------------------------------
+    def _handle_frame(self, frame: bytes) -> bytes:
+        def resp(status: int, allowed: int, remaining: int) -> bytes:
+            return _RESP.pack(_RESP.size - 4, status, allowed, remaining)
+
+        try:
+            op, lid, permits = _REQ_BODY.unpack_from(frame)
+            key = frame[_REQ_BODY.size:].decode()
+            if op == OP_PING:
+                return resp(0, 1 if self.storage.is_available() else 0, 0)
+            entry = self._limiters.get(lid)
+            if entry is None:
+                return resp(1, 0, ERR_UNKNOWN_LIMITER)
+            algo, _cfg = entry
+            if op == OP_TRY_ACQUIRE:
+                out = self.storage.acquire(algo, lid, key, max(int(permits), 1))
+                remaining = int(out.get("remaining", out.get("cache_value", 0)))
+                return resp(0, 1 if out["allowed"] else 0, remaining)
+            if op == OP_AVAILABLE:
+                avail = int(self.storage.available_many(algo, lid, [key])[0])
+                return resp(0, 0, avail)
+            if op == OP_RESET:
+                self.storage.reset_key(algo, lid, key)
+                return resp(0, 1, 0)
+            return resp(1, 0, ERR_UNKNOWN_OP)
+        except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
+            return resp(1, 0, ERR_INTERNAL)
+
+
+class SidecarClient:
+    """Minimal pipelining client (reference for other-language ports)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rbuf = b""
+
+    def close(self) -> None:
+        self._sock.close()
+
+    # -- framing --------------------------------------------------------------
+    @staticmethod
+    def _frame(op: int, lid: int, permits: int, key: str) -> bytes:
+        body = struct.pack("<BII", op, lid, permits) + key.encode()
+        return struct.pack("<I", len(body)) + body
+
+    def _read_responses(self, n: int):
+        out = []
+        while len(out) < n:
+            while len(self._rbuf) < _RESP.size:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("sidecar closed connection")
+                self._rbuf += chunk
+            _, status, allowed, remaining = _RESP.unpack_from(self._rbuf)
+            self._rbuf = self._rbuf[_RESP.size:]
+            out.append((status, bool(allowed), remaining))
+        return out
+
+    # -- API ------------------------------------------------------------------
+    def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
+        self._sock.sendall(self._frame(OP_TRY_ACQUIRE, lid, permits, key))
+        status, allowed, _ = self._read_responses(1)[0]
+        if status:
+            raise RuntimeError("sidecar error")
+        return allowed
+
+    def acquire_batch(
+        self, lid: int, keys: Sequence[str],
+        permits: Optional[Sequence[int]] = None,
+    ):
+        """Pipelined batch: N frames out, N responses in, one syscall each way."""
+        permits = permits or [1] * len(keys)
+        payload = b"".join(
+            self._frame(OP_TRY_ACQUIRE, lid, p, k) for k, p in zip(keys, permits))
+        self._sock.sendall(payload)
+        return self._read_responses(len(keys))
+
+    def available(self, lid: int, key: str) -> int:
+        self._sock.sendall(self._frame(OP_AVAILABLE, lid, 0, key))
+        status, _, remaining = self._read_responses(1)[0]
+        if status:
+            raise RuntimeError("sidecar error")
+        return remaining
+
+    def reset(self, lid: int, key: str) -> None:
+        self._sock.sendall(self._frame(OP_RESET, lid, 0, key))
+        self._read_responses(1)
+
+    def ping(self) -> bool:
+        self._sock.sendall(self._frame(OP_PING, 0, 0, ""))
+        _, allowed, _ = self._read_responses(1)[0]
+        return allowed

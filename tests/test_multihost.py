@@ -1,0 +1,66 @@
+"""Multi-host routing: two sidecar "hosts", keys pinned by hash, decisions
+exact across the fleet."""
+
+import numpy as np
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.parallel.multihost import HostRouter, host_of_key
+from ratelimiter_tpu.semantics import SlidingWindowOracle
+from ratelimiter_tpu.service.sidecar import SidecarServer
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+T0 = 1_753_000_000_000
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_router_splits_and_reassembles():
+    clock = FakeClock((T0 // 60_000) * 60_000)
+    cfg = RateLimitConfig(max_permits=4, window_ms=60_000, enable_local_cache=False)
+
+    # Two independent "hosts", each with its own device state — registered
+    # with the same config so limiter ids line up fleet-wide.
+    hosts = []
+    for _ in range(2):
+        storage = TpuBatchedStorage(num_slots=256, max_delay_ms=0.2, clock_ms=clock)
+        server = SidecarServer(storage, host="127.0.0.1").start()
+        lid = server.register("sw", cfg)
+        hosts.append((server, storage, lid))
+    lid = hosts[0][2]
+    assert all(h[2] == lid for h in hosts)
+
+    router = HostRouter([("127.0.0.1", h[0].port) for h in hosts])
+    oracle = SlidingWindowOracle(cfg)
+
+    keys = [f"user{i}" for i in range(12)]
+    # Sanity: both hosts own some keys.
+    owners = {host_of_key(k, 2) for k in keys}
+    assert owners == {0, 1}
+
+    rng = np.random.default_rng(3)
+    for step in range(8):
+        n = int(rng.integers(1, 20))
+        batch = [keys[int(rng.integers(0, len(keys)))] for _ in range(n)]
+        got = router.acquire_batch(lid, batch)
+        for j in range(n):
+            want = oracle.try_acquire(batch[j], 1, clock.t).allowed
+            assert got[j] == want, (step, j)
+
+    # Reset routes to the owner and takes effect.
+    victim = keys[0]
+    while router.try_acquire(lid, victim):
+        oracle.try_acquire(victim, 1, clock.t)
+    router.reset(lid, victim)
+    oracle.reset(victim, clock.t)
+    assert router.try_acquire(lid, victim)
+
+    router.close()
+    for server, storage, _ in hosts:
+        server.stop()
+        storage.close()
