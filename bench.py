@@ -58,6 +58,9 @@ def main() -> None:
     from ratelimiter_tpu.metrics import MeterRegistry
     from ratelimiter_tpu.storage import TpuBatchedStorage
 
+    from ratelimiter_tpu.utils.tracing import device_profile
+
+    profile_dir = os.environ.get("BENCH_PROFILE")
     rng = np.random.default_rng(42)
     detail = {"platform": platform, "scale": scale}
     t_start = time.time()
@@ -84,8 +87,9 @@ def main() -> None:
         tb_limiter.try_acquire_ids(key_ids[w * batch:(w + 1) * batch],
                                    permits[w * batch:(w + 1) * batch])
     t0 = time.perf_counter()
-    for i in range(0, (n_requests // batch) * batch, batch):
-        tb_limiter.try_acquire_ids(key_ids[i:i + batch], permits[i:i + batch])
+    with device_profile(profile_dir):
+        for i in range(0, (n_requests // batch) * batch, batch):
+            tb_limiter.try_acquire_ids(key_ids[i:i + batch], permits[i:i + batch])
     wall = time.perf_counter() - t0
     headline = ((n_requests // batch) * batch) / wall
     detail["tb_1m_zipf_end_to_end_ids"] = {

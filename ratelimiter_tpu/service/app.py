@@ -114,6 +114,11 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
                               {"status": "UP" if up else "DOWN"})
         if self.path == "/actuator/metrics":
             return self._json(200, {"meters": self.ctx.registry.scrape()})
+        if self.path.startswith("/actuator/trace"):
+            trace = getattr(self.ctx.storage, "trace", None)
+            if trace is None:
+                return self._json(200, {"total_dispatches": 0, "recent": []})
+            return self._json(200, trace.snapshot())
         self._json(404, {"error": "not found"})
 
     def do_POST(self):

@@ -88,20 +88,26 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._index = {"sw": make_index(), "tb": make_index()}
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
-        def _timed(fn):
+        from ratelimiter_tpu.utils.tracing import DecisionTrace
+
+        self.trace = DecisionTrace()
+
+        def _timed(algo, fn):
             def run(s, l, p):
                 t0 = time.perf_counter()
                 out = fn(s, l, p, self._clock_ms())
+                dt_us = (time.perf_counter() - t0) * 1e6
                 if self._latency is not None:
-                    self._latency.record_us((time.perf_counter() - t0) * 1e6)
+                    self._latency.record_us(dt_us)
+                self.trace.record(algo, len(s), int(out["allowed"].sum()), dt_us)
                 return out
 
             return run
 
         self._batcher = MicroBatcher(
             dispatch={
-                "sw": _timed(self.engine.sw_acquire),
-                "tb": _timed(self.engine.tb_acquire),
+                "sw": _timed("sw", self.engine.sw_acquire),
+                "tb": _timed("tb", self.engine.tb_acquire),
             },
             clear={
                 "sw": self.engine.sw_clear,

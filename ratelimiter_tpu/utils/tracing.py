@@ -1,0 +1,70 @@
+"""Tracing & profiling.
+
+The reference's only observability beyond counters is TRACE-level logging of
+the window math; SURVEY §5.1 lists tracing/profiling as an absent subsystem.
+Here:
+
+- ``DecisionTrace`` — a lock-protected ring buffer of per-dispatch records
+  (wall time, algo, batch size, allowed count, dispatch latency).  Cheap
+  enough to leave on in production; scraped at ``/actuator/trace``.
+- ``device_profile`` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace of the device steps (used by
+  ``bench.py --profile`` / BENCH_PROFILE=dir).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class DecisionTrace:
+    """Fixed-capacity ring of per-batch dispatch records."""
+
+    __slots__ = ("_records", "_capacity", "_next", "_total", "_lock")
+
+    def __init__(self, capacity: int = 4096):
+        self._capacity = int(capacity)
+        self._records: List[Optional[dict]] = [None] * self._capacity
+        self._next = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def record(self, algo: str, batch: int, allowed: int, latency_us: float) -> None:
+        entry = {
+            "t_ms": time.time_ns() // 1_000_000,
+            "algo": algo,
+            "batch": batch,
+            "allowed": allowed,
+            "latency_us": round(latency_us, 1),
+        }
+        with self._lock:
+            self._records[self._next] = entry
+            self._next = (self._next + 1) % self._capacity
+            self._total += 1
+
+    def snapshot(self, last: int = 100) -> Dict:
+        with self._lock:
+            ordered = [
+                r for r in (
+                    self._records[self._next:] + self._records[:self._next])
+                if r is not None
+            ]
+        return {"total_dispatches": self._total, "recent": ordered[-last:]}
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: Optional[str]):
+    """Profile device execution into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

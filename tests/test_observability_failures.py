@@ -94,6 +94,12 @@ def test_service_over_tpu_backend_end_to_end():
         status, data = req("GET", "/actuator/metrics")
         assert status == 200
         assert data["meters"]["ratelimiter.storage.latency"]["count"] >= 1
+        # Decision trace ring exposed too.
+        status, data = req("GET", "/actuator/trace")
+        assert status == 200
+        assert data["total_dispatches"] >= 1
+        rec = data["recent"][-1]
+        assert {"t_ms", "algo", "batch", "allowed", "latency_us"} <= set(rec)
     finally:
         srv.shutdown()
         thread.join(timeout=5)
