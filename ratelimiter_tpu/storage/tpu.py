@@ -27,6 +27,7 @@ zeroed in the dispatch stream ahead of their reuse.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Sequence, Tuple
 
@@ -91,11 +92,26 @@ class TpuBatchedStorage(RateLimitStorage):
         from ratelimiter_tpu.utils.tracing import DecisionTrace
 
         self.trace = DecisionTrace()
+        # Batch timestamps are clamped monotonically non-decreasing: a wall
+        # clock stepping backwards (NTP) must not roll windows backwards —
+        # the slot model keeps only (curr, prev) buckets, and a regressed
+        # stamp would read as a window change and zero live counts.  (The
+        # reference has the same hazard unmitigated: window keys + TTLs
+        # both misbehave under clock regression.)
+        self._last_stamp = 0
+        self._stamp_lock = threading.Lock()
+
+        def _stamp() -> int:
+            with self._stamp_lock:
+                self._last_stamp = max(self._last_stamp, self._clock_ms())
+                return self._last_stamp
+
+        self._monotonic_now = _stamp
 
         def _timed(algo, fn):
             def run(s, l, p):
                 t0 = time.perf_counter()
-                out = fn(s, l, p, self._clock_ms())
+                out = fn(s, l, p, _stamp())
                 dt_us = (time.perf_counter() - t0) * 1e6
                 if self._latency is not None:
                     self._latency.record_us(dt_us)
@@ -209,7 +225,7 @@ class TpuBatchedStorage(RateLimitStorage):
         if known:
             # Flush queued mutations so the read observes them.
             self._batcher.flush()
-            now = self._clock_ms()
+            now = self._monotonic_now()
             slots = [s for _, s in known]
             if algo == "sw":
                 vals = self.engine.sw_available(slots, [lid] * len(slots), now)

@@ -1,0 +1,114 @@
+"""Boundary-biased soak differential.
+
+Long randomized streams with timestamps deliberately biased onto the
+decision-relevant edges — exact window boundaries, PEXPIRE deadlines,
+TTL expiries, zero-dt repeats — driven through the device engine and the
+oracle in lockstep.  This is the deep-fuzz layer on top of the per-feature
+differentials."""
+
+import random
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine.engine import DeviceEngine
+from ratelimiter_tpu.engine.state import LimiterTable
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+
+T0 = 1_753_000_000_000
+
+
+def biased_dt(rng: random.Random, win: int) -> int:
+    """Time steps concentrated on boundaries."""
+    roll = rng.random()
+    if roll < 0.25:
+        return 0                      # same-ms repeat
+    if roll < 0.40:
+        return rng.choice([1, 2, 3])
+    if roll < 0.60:
+        return rng.choice([win - 1, win, win + 1])
+    if roll < 0.75:
+        return rng.choice([2 * win - 1, 2 * win, 2 * win + 1])
+    if roll < 0.90:
+        return rng.randrange(1, win)
+    return rng.randrange(2 * win, 6 * win)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_sliding_window(seed):
+    rng = random.Random(100 + seed)
+    win = rng.choice([1000, 2500, 60_000])
+    cfg = RateLimitConfig(max_permits=rng.choice([1, 5, 40]), window_ms=win,
+                          enable_local_cache=False)
+    table = LimiterTable()
+    lid = table.register(cfg)
+    engine = DeviceEngine(num_slots=64, table=table)
+    oracle = SlidingWindowOracle(cfg)
+    smap = {}
+    now = T0
+    for step in range(250):
+        now += biased_dt(rng, win)
+        n = rng.randrange(1, 12)
+        ks = [f"k{rng.randrange(6)}" for _ in range(n)]
+        perms = [rng.choice([1, 1, 1, 2, cfg.max_permits,
+                             cfg.max_permits + 1]) for _ in range(n)]
+        slots = [smap.setdefault(k, len(smap)) for k in ks]
+        out = engine.sw_acquire(slots, [lid] * n, perms, now)
+        for j in range(n):
+            d = oracle.try_acquire(ks[j], perms[j], now)
+            assert out["allowed"][j] == d.allowed, (seed, step, j, now - T0)
+            assert out["observed"][j] == d.observed, (seed, step, j)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_token_bucket(seed):
+    rng = random.Random(200 + seed)
+    win = rng.choice([1000, 3000])
+    cap = rng.choice([1, 7, 60])
+    cfg = RateLimitConfig(max_permits=cap, window_ms=win,
+                          refill_rate=rng.choice([0.5, 3.0, 47.0, 1000.0]))
+    table = LimiterTable()
+    lid = table.register(cfg)
+    engine = DeviceEngine(num_slots=64, table=table)
+    oracle = TokenBucketOracle(cfg)
+    smap = {}
+    now = T0
+    for step in range(250):
+        now += biased_dt(rng, win)
+        n = rng.randrange(1, 12)
+        ks = [f"k{rng.randrange(6)}" for _ in range(n)]
+        perms = [rng.choice([1, 1, cap, cap + 1, max(1, cap // 2)])
+                 for _ in range(n)]
+        slots = [smap.setdefault(k, len(smap)) for k in ks]
+        out = engine.tb_acquire(slots, [lid] * n, perms, now)
+        for j in range(n):
+            d = oracle.try_acquire(ks[j], perms[j], now)
+            assert out["allowed"][j] == d.allowed, (seed, step, j, now - T0)
+            assert out["remaining"][j] == d.remaining_hint, (seed, step, j)
+
+
+def test_monotonic_stamp_guards_clock_regression():
+    """A wall clock stepping backwards must not zero live windows."""
+    from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    class JumpyClock:
+        def __init__(self):
+            self.t = (T0 // 60_000) * 60_000
+
+        def __call__(self):
+            return self.t
+
+    clock = JumpyClock()
+    storage = TpuBatchedStorage(num_slots=32, max_delay_ms=0.1, clock_ms=clock)
+    cfg = RateLimitConfig(max_permits=2, window_ms=60_000, enable_local_cache=False)
+    limiter = SlidingWindowRateLimiter(storage, cfg, MeterRegistry(), clock_ms=clock)
+    assert limiter.try_acquire("u")
+    assert limiter.try_acquire("u")
+    assert not limiter.try_acquire("u")
+    clock.t -= 120_000  # NTP-style regression of two windows
+    # Without the monotonic clamp the engine would see an "old" window,
+    # zero the state, and wrongly admit.
+    assert not limiter.try_acquire("u")
+    storage.close()
