@@ -127,6 +127,21 @@ class TokenBucketRateLimiter(RateLimiter):
         self._rejected.add(n - n_allowed)
         return allowed
 
+    def try_acquire_stream_ids(self, key_ids, permits=None, *,
+                               batch: int = 1 << 14, subbatches: int = 4):
+        """Whole-stream integer-key tryAcquire via the pipelined scan path
+        (storage.acquire_stream_ids); decisions match try_acquire_ids."""
+        if self._lid is None:
+            raise NotImplementedError(
+                "try_acquire_stream_ids requires the TPU backend")
+        allowed = self._storage.acquire_stream_ids(
+            "tb", self._lid, key_ids, permits,
+            batch=batch, subbatches=subbatches)
+        n_allowed = int(allowed.sum())
+        self._allowed.add(n_allowed)
+        self._rejected.add(len(allowed) - n_allowed)
+        return allowed
+
     def get_available_permits(self, key: str) -> int:
         if self._lid is not None:
             return int(self._storage.available_many("tb", self._lid, [key])[0])

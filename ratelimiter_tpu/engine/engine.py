@@ -25,8 +25,16 @@ from ratelimiter_tpu.engine.state import (
     make_sw_state,
     make_tb_state,
 )
-from ratelimiter_tpu.ops.sliding_window import sw_peek, sw_reset, sw_step
-from ratelimiter_tpu.ops.token_bucket import tb_peek, tb_reset, tb_step
+from ratelimiter_tpu.ops.packed import (
+    decode_sw_fused,
+    decode_tb_fused,
+    sw_scan_bits,
+    sw_step_fused,
+    tb_scan_bits,
+    tb_step_fused,
+)
+from ratelimiter_tpu.ops.sliding_window import sw_peek, sw_reset
+from ratelimiter_tpu.ops.token_bucket import tb_peek, tb_reset
 
 _MIN_BATCH = 256
 
@@ -63,8 +71,12 @@ class DeviceEngine:
         self._lock = threading.RLock()
         self.sw_state: SWState = make_sw_state(self.num_slots)
         self.tb_state: TBState = make_tb_state(self.num_slots)
-        self._sw_step = jax.jit(sw_step, donate_argnums=0)
-        self._tb_step = jax.jit(tb_step, donate_argnums=0)
+        # Fused steps return all outputs in one array — one D2H transfer per
+        # batch instead of four (the transfer-latency fix; ops/packed.py).
+        self._sw_step = jax.jit(sw_step_fused, donate_argnums=0)
+        self._tb_step = jax.jit(tb_step_fused, donate_argnums=0)
+        self._sw_scan = jax.jit(sw_scan_bits, donate_argnums=0)
+        self._tb_scan = jax.jit(tb_scan_bits, donate_argnums=0)
         self._sw_peek = jax.jit(sw_peek)
         self._tb_peek = jax.jit(tb_peek)
         self._sw_reset = jax.jit(sw_reset, donate_argnums=0)
@@ -80,7 +92,7 @@ class DeviceEngine:
             return self._sw_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
 
     def _sw_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
-        new_state, out = self._sw_step(
+        new_state, packed = self._sw_step(
             self.sw_state,
             self.table.device_arrays,
             _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
@@ -89,12 +101,7 @@ class DeviceEngine:
             jnp.int64(now_ms),
         )
         self.sw_state = new_state
-        return {
-            "allowed": np.asarray(out.allowed)[:n],
-            "mutated": np.asarray(out.mutated)[:n],
-            "observed": np.asarray(out.observed)[:n],
-            "cache_value": np.asarray(out.cache_value)[:n],
-        }
+        return decode_sw_fused(np.asarray(packed)[:, :n])
 
     def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
         n = len(slots)
@@ -103,7 +110,7 @@ class DeviceEngine:
             return self._tb_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
 
     def _tb_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
-        new_state, out = self._tb_step(
+        new_state, packed = self._tb_step(
             self.tb_state,
             self.table.device_arrays,
             _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
@@ -112,11 +119,39 @@ class DeviceEngine:
             jnp.int64(now_ms),
         )
         self.tb_state = new_state
-        return {
-            "allowed": np.asarray(out.allowed)[:n],
-            "observed": np.asarray(out.observed)[:n],
-            "remaining": np.asarray(out.remaining)[:n],
-        }
+        return decode_tb_fused(np.asarray(packed)[:, :n])
+
+    # -- scan dispatch (K sub-batches, bit-packed decisions) -------------------
+    # The hyperscale streaming path: one device dispatch for K*B decisions,
+    # returning a lazy uint8[K, ceil(B/8)] handle — the caller fetches it
+    # (np.asarray) outside the lock, overlapping the next dispatch.
+
+    def sw_scan_dispatch(self, slots_kb, lids, permits_kb, now_k):
+        return self._scan_dispatch("sw", slots_kb, lids, permits_kb, now_k)
+
+    def tb_scan_dispatch(self, slots_kb, lids, permits_kb, now_k):
+        return self._scan_dispatch("tb", slots_kb, lids, permits_kb, now_k)
+
+    def _scan_dispatch(self, algo, slots_kb, lids, permits_kb, now_k):
+        slots_kb = jnp.asarray(np.ascontiguousarray(slots_kb, dtype=np.int32))
+        if np.ndim(lids) == 0:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        if permits_kb is not None:
+            permits_kb = jnp.asarray(
+                np.ascontiguousarray(permits_kb, dtype=np.int32))
+        now_k = jnp.asarray(np.ascontiguousarray(now_k, dtype=np.int64))
+        with self._lock:
+            if algo == "sw":
+                self.sw_state, bits = self._sw_scan(
+                    self.sw_state, self.table.device_arrays,
+                    slots_kb, lids, permits_kb, now_k)
+            else:
+                self.tb_state, bits = self._tb_scan(
+                    self.tb_state, self.table.device_arrays,
+                    slots_kb, lids, permits_kb, now_k)
+        return bits
 
     # -- read-only ------------------------------------------------------------
     def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:

@@ -166,22 +166,32 @@ class NativeSlotIndex:
             return int(self._lib.rl_index_len(self._h))
 
     # -- vectorized interface -------------------------------------------------
-    def assign_batch_ints(self, keys: np.ndarray, lid: int):
+    def assign_batch_ints(self, keys: np.ndarray, lid: int,
+                          pinned: Optional[Set[int]] = None):
         """Assign slots for an int64 key batch in one C call.
+        ``pinned`` slots (queued async requests) are never evicted.
         Returns (slots i32[n], evictions i32[k])."""
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
+        pins = list(pinned) if pinned else []
         with self._lock:
-            self._lib.rl_index_assign_ints(
-                self._h, keys.ctypes.data, n, int(lid),
-                out_slots.ctypes.data, out_ev.ctypes.data)
+            for s in pins:
+                self._lib.rl_index_pin(self._h, s)
+            try:
+                self._lib.rl_index_assign_ints(
+                    self._h, keys.ctypes.data, n, int(lid),
+                    out_slots.ctypes.data, out_ev.ctypes.data)
+            finally:
+                for s in pins:
+                    self._lib.rl_index_unpin(self._h, s)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
-    def assign_batch_strs(self, keys, lid: int):
+    def assign_batch_strs(self, keys, lid: int,
+                          pinned: Optional[Set[int]] = None):
         """Assign slots for a string key batch in one C call."""
         encoded = [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
         packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
@@ -193,11 +203,18 @@ class NativeSlotIndex:
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
+        pins = list(pinned) if pinned else []
         with self._lock:
-            self._lib.rl_index_assign_bytes(
-                self._h, packed.ctypes.data if len(packed) else 0,
-                offs.ctypes.data, n, int(lid),
-                out_slots.ctypes.data, out_ev.ctypes.data)
+            for s in pins:
+                self._lib.rl_index_pin(self._h, s)
+            try:
+                self._lib.rl_index_assign_bytes(
+                    self._h, packed.ctypes.data if len(packed) else 0,
+                    offs.ctypes.data, n, int(lid),
+                    out_slots.ctypes.data, out_ev.ctypes.data)
+            finally:
+                for s in pins:
+                    self._lib.rl_index_unpin(self._h, s)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]

@@ -160,11 +160,12 @@ class TpuBatchedStorage(RateLimitStorage):
         lid0 = lid_per_req[0] if lid_per_req else 0
         uniform_lid = all(l == lid0 for l in lid_per_req)
         if uniform_lid and hasattr(index, "assign_batch_strs"):
-            # Native fast path: flush queued traffic first (so eviction can't
-            # pull slots out from under pending requests), then one C call
-            # maps the whole batch; same-batch keys are generation-pinned.
+            # Native fast path: flush queued traffic first, then one C call
+            # maps the whole batch; same-batch keys are generation-pinned and
+            # slots of requests queued since the flush are pin-protected.
             self._batcher.flush()
-            slots, clears = index.assign_batch_strs(list(keys), lid0)
+            slots, clears = index.assign_batch_strs(
+                list(keys), lid0, pinned=self._batcher.pending_slots(algo))
             return self._batcher.dispatch_direct(
                 algo, slots, list(lid_per_req), list(permits), list(clears))
         pinned = self._batcher.pending_slots(algo)
@@ -191,7 +192,8 @@ class TpuBatchedStorage(RateLimitStorage):
         if hasattr(index, "assign_batch_ints"):
             self._batcher.flush()
             slots, clears = index.assign_batch_ints(
-                np.ascontiguousarray(key_ids, dtype=np.int64), lid)
+                np.ascontiguousarray(key_ids, dtype=np.int64), lid,
+                pinned=self._batcher.pending_slots(algo))
             clears = list(clears)
         else:
             pinned = self._batcher.pending_slots(algo)
@@ -206,6 +208,93 @@ class TpuBatchedStorage(RateLimitStorage):
             slots = np.asarray(slots, dtype=np.int32)
         lids = np.full(len(slots), lid, dtype=np.int32)
         return self._batcher.dispatch_direct(algo, slots, lids, permits, clears)
+
+    def acquire_stream_ids(
+        self,
+        algo: str,
+        lid: int,
+        key_ids: np.ndarray,
+        permits: np.ndarray | None = None,
+        *,
+        batch: int = 1 << 14,
+        subbatches: int = 4,
+    ) -> np.ndarray:
+        """Whole-stream int-key decisions, pipelined — the hyperscale path.
+
+        The stream is cut into super-batches of ``subbatches * batch``
+        requests.  For each: one C call assigns slots, one device dispatch
+        runs ``subbatches`` sequential decision steps (lax.scan), and only
+        the bit-packed allow/deny mask comes back — while it is in flight
+        the next super-batch is being indexed and dispatched, so transfer
+        latency overlaps device compute.  Decisions are identical to
+        ``acquire_many_ids`` called per sub-batch (tests/test_packed.py).
+
+        ``permits=None`` means one permit per request (the permits upload is
+        skipped; the device materializes ones).  Returns bool[n] allowed.
+        """
+        index = self._index[algo]
+        if not hasattr(index, "assign_batch_ints"):
+            # Python-index fallback: plain per-batch path, same decisions.
+            out = np.empty(len(key_ids), dtype=bool)
+            p = np.ones(len(key_ids), dtype=np.int64) if permits is None \
+                else np.asarray(permits)
+            for i in range(0, len(key_ids), batch):
+                out[i:i + batch] = self.acquire_many_ids(
+                    algo, lid, key_ids[i:i + batch], p[i:i + batch])["allowed"]
+            return out
+
+        self._batcher.flush()
+        key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        k, b = int(subbatches), int(batch)
+        super_n = k * b
+        dispatch = (self.engine.sw_scan_dispatch if algo == "sw"
+                    else self.engine.tb_scan_dispatch)
+        clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
+
+        out = np.empty(n, dtype=bool)
+        # (start, count, bits, dispatch_t0) per in-flight super-batch
+        pending: list[tuple[int, int, object, float]] = []
+
+        def drain(handle, start, count, t0):
+            arr = np.asarray(handle)  # uint8[k, b//8] — the one blocking fetch
+            dt_us = (time.perf_counter() - t0) * 1e6
+            flat = np.unpackbits(arr, axis=1)[:, :b].reshape(-1).astype(bool)
+            got = flat[:count]
+            out[start:start + count] = got
+            if self._latency is not None:
+                self._latency.record_us(dt_us)
+            self.trace.record(algo, count, int(got.sum()), dt_us)
+
+        for start in range(0, n, super_n):
+            chunk = key_ids[start:start + super_n]
+            cn = len(chunk)
+            slots, clears = index.assign_batch_ints(
+                chunk, lid, pinned=self._batcher.pending_slots(algo))
+            if len(clears):
+                clear(list(clears))
+            if cn < super_n:
+                slots = np.concatenate(
+                    [slots, np.full(super_n - cn, -1, dtype=np.int32)])
+            p_kb = None
+            if permits is not None:
+                p_chunk = np.ascontiguousarray(
+                    permits[start:start + cn], dtype=np.int32)
+                if cn < super_n:
+                    p_chunk = np.concatenate(
+                        [p_chunk, np.ones(super_n - cn, dtype=np.int32)])
+                p_kb = p_chunk.reshape(k, b)
+            now = self._monotonic_now()
+            t0 = time.perf_counter()
+            bits = dispatch(slots.reshape(k, b), lid, p_kb,
+                            np.full(k, now, dtype=np.int64))
+            pending.append((start, cn, bits, t0))
+            if len(pending) > 1:
+                s0, c0, h0, pt0 = pending.pop(0)
+                drain(h0, s0, c0, pt0)
+        for s0, c0, h0, pt0 in pending:
+            drain(h0, s0, c0, pt0)
+        return out
 
     def available_many(
         self, algo: str, lid: int, keys: Sequence[str]

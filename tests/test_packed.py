@@ -1,0 +1,180 @@
+"""Differential tests for the transfer-minimal step variants (ops/packed.py).
+
+The fused and scan-bits wrappers must produce decisions identical to the
+plain steps they wrap — they exist purely to reduce device->host transfers.
+"""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.engine import DeviceEngine
+from ratelimiter_tpu.engine.state import LimiterTable, make_sw_state, make_tb_state
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+
+@pytest.fixture()
+def table():
+    t = LimiterTable()
+    t.register(RateLimitConfig(max_permits=5, window_ms=1000))          # lid 1 (sw)
+    t.register(RateLimitConfig(max_permits=10, window_ms=1000,
+                               refill_rate=5.0))                        # lid 2 (tb)
+    return t
+
+
+def _steps_outputs(algo, table, slots, lids, permits, now):
+    """Run the plain step and return its output dict (ground truth)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ratelimiter_tpu.ops.sliding_window import sw_step
+    from ratelimiter_tpu.ops.token_bucket import tb_step
+
+    if algo == "sw":
+        state = make_sw_state(64)
+        _, out = jax.jit(sw_step)(state, table.device_arrays,
+                                  jnp.asarray(slots, jnp.int32),
+                                  jnp.asarray(lids, jnp.int32),
+                                  jnp.asarray(permits, jnp.int64),
+                                  jnp.int64(now))
+        return {k: np.asarray(v) for k, v in out._asdict().items()}
+    state = make_tb_state(64)
+    _, out = jax.jit(tb_step)(state, table.device_arrays,
+                              jnp.asarray(slots, jnp.int32),
+                              jnp.asarray(lids, jnp.int32),
+                              jnp.asarray(permits, jnp.int64),
+                              jnp.int64(now))
+    return {k: np.asarray(v) for k, v in out._asdict().items()}
+
+
+def test_fused_sw_matches_plain(table):
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, 8, 32).astype(np.int32)
+    permits = rng.integers(1, 3, 32).astype(np.int64)
+    truth = _steps_outputs("sw", table, slots, [1] * 32, permits, 5_000)
+
+    engine = DeviceEngine(num_slots=64, table=table)
+    got = engine.sw_acquire(slots, [1] * 32, permits, 5_000)
+    np.testing.assert_array_equal(got["allowed"], truth["allowed"])
+    np.testing.assert_array_equal(got["mutated"], truth["mutated"])
+    np.testing.assert_array_equal(got["observed"], truth["observed"])
+    np.testing.assert_array_equal(got["cache_value"], truth["cache_value"])
+
+
+def test_fused_tb_matches_plain(table):
+    rng = np.random.default_rng(1)
+    slots = rng.integers(0, 8, 32).astype(np.int32)
+    permits = rng.integers(1, 4, 32).astype(np.int64)
+    truth = _steps_outputs("tb", table, slots, [2] * 32, permits, 5_000)
+
+    engine = DeviceEngine(num_slots=64, table=table)
+    got = engine.tb_acquire(slots, [2] * 32, permits, 5_000)
+    np.testing.assert_array_equal(got["allowed"], truth["allowed"])
+    np.testing.assert_array_equal(got["observed"], truth["observed"])
+    np.testing.assert_array_equal(got["remaining"], truth["remaining"])
+
+
+@pytest.mark.parametrize("algo,lid", [("sw", 1), ("tb", 2)])
+def test_scan_bits_matches_sequential_batches(table, algo, lid):
+    """K sub-batches in one scan dispatch == K successive plain acquires."""
+    rng = np.random.default_rng(2)
+    k, b = 3, 16
+    slots = rng.integers(0, 6, (k, b)).astype(np.int32)
+    permits = rng.integers(1, 3, (k, b)).astype(np.int32)
+    now = np.full(k, 7_000, dtype=np.int64)
+
+    seq = DeviceEngine(num_slots=64, table=table)
+    expect = []
+    for i in range(k):
+        fn = seq.sw_acquire if algo == "sw" else seq.tb_acquire
+        expect.append(fn(slots[i], [lid] * b, permits[i].astype(np.int64), 7_000)["allowed"])
+    expect = np.concatenate(expect)
+
+    scan = DeviceEngine(num_slots=64, table=table)
+    dispatch = scan.sw_scan_dispatch if algo == "sw" else scan.tb_scan_dispatch
+    bits = np.asarray(dispatch(slots, lid, permits, now))
+    got = np.unpackbits(bits, axis=1)[:, :b].reshape(-1).astype(bool)
+    np.testing.assert_array_equal(got, expect)
+
+
+@pytest.mark.parametrize("algo,lid", [("sw", 1), ("tb", 2)])
+def test_scan_bits_unit_permits_and_uniform_lid(table, algo, lid):
+    rng = np.random.default_rng(3)
+    k, b = 2, 24
+    slots = rng.integers(0, 5, (k, b)).astype(np.int32)
+    now = np.full(k, 9_000, dtype=np.int64)
+
+    seq = DeviceEngine(num_slots=64, table=table)
+    expect = []
+    for i in range(k):
+        fn = seq.sw_acquire if algo == "sw" else seq.tb_acquire
+        expect.append(fn(slots[i], [lid] * b, np.ones(b, np.int64), 9_000)["allowed"])
+    expect = np.concatenate(expect)
+
+    scan = DeviceEngine(num_slots=64, table=table)
+    dispatch = scan.sw_scan_dispatch if algo == "sw" else scan.tb_scan_dispatch
+    bits = np.asarray(dispatch(slots, lid, None, now))
+    got = np.unpackbits(bits, axis=1)[:, :b].reshape(-1).astype(bool)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_stream_ids_matches_batched(tmp_path):
+    """acquire_stream_ids == acquire_many_ids on the same stream."""
+    cfg = RateLimitConfig(max_permits=20, window_ms=1000, refill_rate=10.0)
+    rng = np.random.default_rng(4)
+    key_ids = rng.integers(0, 50, 1000).astype(np.int64)
+    permits = rng.integers(1, 3, 1000).astype(np.int64)
+    clock = lambda: 42_000  # noqa: E731 — frozen clock: identical stamps
+
+    s1 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid1 = s1.register_limiter("tb", cfg)
+    expect = np.empty(1000, dtype=bool)
+    for i in range(0, 1000, 64):
+        expect[i:i + 64] = s1.acquire_many_ids(
+            "tb", lid1, key_ids[i:i + 64], permits[i:i + 64])["allowed"]
+    s1.close()
+
+    s2 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid2 = s2.register_limiter("tb", cfg)
+    got = s2.acquire_stream_ids("tb", lid2, key_ids, permits,
+                                batch=64, subbatches=2)
+    s2.close()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_stream_ids_unit_permits_sliding_window():
+    cfg = RateLimitConfig(max_permits=3, window_ms=1000,
+                          enable_local_cache=False)
+    rng = np.random.default_rng(5)
+    key_ids = rng.integers(0, 10, 300).astype(np.int64)
+    clock = lambda: 10_500  # noqa: E731
+
+    s1 = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    lid1 = s1.register_limiter("sw", cfg)
+    expect = np.empty(300, dtype=bool)
+    for i in range(0, 300, 32):
+        expect[i:i + 32] = s1.acquire_many_ids(
+            "sw", lid1, key_ids[i:i + 32],
+            np.ones(32, np.int64)[: len(key_ids[i:i + 32])])["allowed"]
+    s1.close()
+
+    s2 = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    lid2 = s2.register_limiter("sw", cfg)
+    got = s2.acquire_stream_ids("sw", lid2, key_ids, None,
+                                batch=32, subbatches=2)
+    s2.close()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_stream_ids_tail_padding():
+    """Stream length not a multiple of k*b: tail decided correctly."""
+    cfg = RateLimitConfig(max_permits=2, window_ms=1000,
+                          enable_local_cache=False)
+    clock = lambda: 5_500  # noqa: E731
+    s = TpuBatchedStorage(num_slots=32, clock_ms=clock)
+    lid = s.register_limiter("sw", cfg)
+    key_ids = np.zeros(7, dtype=np.int64)  # same key 7x, limit 2
+    got = s.acquire_stream_ids("sw", lid, key_ids, None, batch=4, subbatches=2)
+    s.close()
+    assert got.tolist() == [True, True, False, False, False, False, False]
