@@ -1,0 +1,42 @@
+import jax
+jax.config.update("jax_enable_x64", True)
+import time, numpy as np, jax.numpy as jnp
+
+B = 1 << 20
+N = 1 << 21
+R = 20
+rng = np.random.default_rng(0)
+slots = jnp.asarray(rng.integers(0, N, B).astype(np.int32))
+staterow32 = jnp.zeros((N, 8), jnp.int32)
+staterow64 = jnp.zeros((N, 4), jnp.int64)
+
+def timed(name, fn, *args):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:52s} {(dt-0.11)/R*1e3:8.1f} ms/iter", flush=True)
+
+@jax.jit
+def rows32(st, idx):
+    def body(i, st):
+        rows = st[idx] + 1
+        return st.at[idx].set(rows)
+    return jax.lax.fori_loop(0, R, body, st)
+
+@jax.jit
+def rows64_via_bitcast(st, idx):
+    def body(i, st):
+        st32 = jax.lax.bitcast_convert_type(st, jnp.int32)  # [N,4,2]
+        st32 = st32.reshape(N, 8)
+        rows32 = st32[idx]                                   # i32 row gather
+        rows64 = jax.lax.bitcast_convert_type(
+            rows32.reshape(B, 4, 2), jnp.int64)              # [B,4]
+        rows64 = rows64 + 1
+        up32 = jax.lax.bitcast_convert_type(rows64, jnp.int32).reshape(B, 8)
+        st32 = st32.at[idx].set(up32)
+        return jax.lax.bitcast_convert_type(st32.reshape(N, 4, 2), jnp.int64)
+    return jax.lax.fori_loop(0, R, body, st)
+
+timed("i32[2M,8] row gather+scatter @1M", rows32, staterow32, slots)
+timed("i64[2M,4] rows via i32 bitcast @1M", rows64_via_bitcast, staterow64, slots)

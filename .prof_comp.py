@@ -1,0 +1,71 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from functools import partial
+
+B = 1 << 20
+N = 1 << 21
+rng = np.random.default_rng(0)
+slots = jnp.asarray(rng.integers(0, N, B).astype(np.int32))
+vals64 = jnp.asarray(rng.integers(0, 1 << 40, B).astype(np.int64))
+state = jnp.zeros((N,), jnp.int64)
+R = 20
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    s = np.asarray(jax.tree_util.tree_leaves(out)[0])  # force
+    t0 = time.perf_counter()
+    out = fn(*args)
+    s = np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:42s} {(dt - 0.11)/R*1e3:8.1f} ms/iter (total {dt:.2f}s)", flush=True)
+
+@jax.jit
+def loop_sort(x):
+    def body(i, x):
+        return jnp.argsort(x, stable=True).astype(jnp.int32)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+@jax.jit
+def loop_sort_unstable(x):
+    def body(i, x):
+        return jnp.argsort(x).astype(jnp.int32)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+@jax.jit
+def loop_scan64(x):
+    def body(i, x):
+        return jax.lax.associative_scan(jnp.add, x)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+@jax.jit
+def loop_scan32(x):
+    x = x.astype(jnp.int32)
+    def body(i, x):
+        return jax.lax.associative_scan(jnp.add, x)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+@jax.jit
+def loop_cumsum64(x):
+    def body(i, x):
+        return jnp.cumsum(x)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+@jax.jit
+def loop_gather_scatter(st, idx):
+    def body(i, st):
+        v = st[idx] + 1
+        return st.at[idx].set(v)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, st))
+
+@jax.jit
+def loop_take64(x, idx):
+    def body(i, x):
+        return x[idx]
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x))
+
+timed("argsort stable i32[1M]", loop_sort, slots)
+timed("argsort unstable i32[1M]", loop_sort_unstable, slots)
+timed("assoc_scan add i64[1M]", loop_scan64, vals64)
+timed("assoc_scan add i32[1M]", loop_scan32, vals64)
+timed("cumsum i64[1M]", loop_cumsum64, vals64)
+timed("gather+scatter i64[2M] by i32[1M]", loop_gather_scatter, state, slots)
+timed("take i64[1M] by perm", loop_take64, vals64, slots % B)

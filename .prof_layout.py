@@ -1,0 +1,60 @@
+import jax
+jax.config.update("jax_enable_x64", True)
+import time, numpy as np, jax.numpy as jnp
+
+B = 1 << 20
+N = 1 << 21
+R = 10
+rng = np.random.default_rng(0)
+idx = jnp.asarray(np.sort(rng.integers(0, N, B)).astype(np.int32))
+
+def timed(name, fn, *args):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:50s} {(dt-0.11)/R*1e3:8.1f} ms/iter", flush=True)
+
+def soa(k, dtype):
+    arrs = tuple(jnp.zeros((N,), dtype) for _ in range(k))
+    @jax.jit
+    def f(arrs):
+        def body(i, arrs):
+            vals = tuple(a[idx] + 1 for a in arrs)
+            return tuple(a.at[idx].set(v) for a, v in zip(arrs, vals))
+        return jax.lax.fori_loop(0, R, body, arrs)
+    return f, arrs
+
+def row(k, dtype):
+    arr = jnp.zeros((N, k), dtype)
+    @jax.jit
+    def f(arr):
+        def body(i, arr):
+            return arr.at[idx].set(arr[idx] + 1)
+        return jax.lax.fori_loop(0, R, body, arr)
+    return f, arr
+
+for k in (1, 2, 4):
+    f, a = soa(k, jnp.int32); timed(f"SoA {k}x flat i32 g+s", f, a)
+for k in (2, 4, 8):
+    f, a = row(k, jnp.int32); timed(f"row i32[N,{k}] g+s", f, a)
+f, a = soa(1, jnp.int64); timed("SoA 1x flat i64 g+s", f, a)
+f, a = soa(2, jnp.int64); timed("SoA 2x flat i64 g+s", f, a)
+
+# scatter-only (values independent of gathered data, dependency via first elem)
+arr2 = jnp.zeros((N,), jnp.int32)
+vals = jnp.ones((B,), jnp.int32)
+@jax.jit
+def scat_only(st):
+    def body(i, st):
+        return st.at[idx].set(vals + st[0])
+    return jax.lax.fori_loop(0, R, body, st)
+timed("scatter-only flat i32", scat_only, arr2)
+
+@jax.jit
+def gath_only(x):
+    def body(i, x):
+        g = x[idx][:N // 2] if False else x[idx]
+        return x.at[0].add(g[0] + g[-1])
+    return jax.lax.fori_loop(0, R, body, x)
+timed("gather-only flat i32 (approx)", gath_only, arr2)

@@ -1,0 +1,71 @@
+import jax
+jax.config.update("jax_enable_x64", True)
+import time, numpy as np, jax.numpy as jnp
+
+B = 1 << 20
+N = 1 << 21
+R = 20
+rng = np.random.default_rng(0)
+idx_rand = rng.integers(0, N, B).astype(np.int32)
+idx_sorted = np.sort(idx_rand).astype(np.int32)
+d_rand = jnp.asarray(idx_rand); d_sorted = jnp.asarray(idx_sorted)
+st64 = jnp.zeros((N,), jnp.int64)
+row64 = jnp.zeros((N, 4), jnp.int64)
+
+def timed(name, fn, *args):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:56s} {(dt-0.11)/R*1e3:8.1f} ms/iter", flush=True)
+
+def mk_gather(sorted_flag):
+    @jax.jit
+    def f(st, idx):
+        def body(i, carry):
+            acc, st = carry
+            v = st.take(idx, indices_are_sorted=sorted_flag) if False else \
+                jax.lax.gather(st[:, None], idx[:, None],
+                    jax.lax.GatherDimensionNumbers(
+                        offset_dims=(1,), collapsed_slice_dims=(0,),
+                        start_index_map=(0,)),
+                    (1, 1), indices_are_sorted=sorted_flag).squeeze(-1)
+            return (acc + v[0], st)
+        return jax.lax.fori_loop(0, R, body, (jnp.int64(0), st))[0]
+    return f
+
+# simpler: use jnp.take with mode + at[].get with flags
+def mk_take(sorted_flag, idx):
+    @jax.jit
+    def f(st):
+        def body(i, acc):
+            v = st.at[idx].get(indices_are_sorted=sorted_flag, mode="promise_in_bounds")
+            return acc + v[0] + i
+        return jax.lax.fori_loop(0, R, body, jnp.int64(0))
+    return f
+
+def mk_rowtake(sorted_flag, idx):
+    @jax.jit
+    def f(st):
+        def body(i, acc):
+            v = st.at[idx].get(indices_are_sorted=sorted_flag, mode="promise_in_bounds")
+            return acc + v[0, 0] + i
+        return jax.lax.fori_loop(0, R, body, jnp.int64(0))
+    return f
+
+def mk_rowscatter(sorted_flag, unique, idx):
+    @jax.jit
+    def f(st):
+        def body(i, st):
+            rows = st.at[idx].get(indices_are_sorted=sorted_flag, mode="promise_in_bounds")
+            return st.at[idx].set(rows + 1, indices_are_sorted=sorted_flag,
+                                  unique_indices=unique, mode="promise_in_bounds")
+        return jax.lax.fori_loop(0, R, body, st)
+    return f
+
+timed("flat i64 take, random", mk_take(False, d_rand), st64)
+timed("flat i64 take, sorted+flag", mk_take(True, d_sorted), st64)
+timed("row i64[*,4] take, random", mk_rowtake(False, d_rand), row64)
+timed("row i64[*,4] take, sorted+flag", mk_rowtake(True, d_sorted), row64)
+timed("row g+s, random noflags", mk_rowscatter(False, False, d_rand), row64)
+timed("row g+s, sorted+unique flags", mk_rowscatter(True, True, d_sorted), row64)

@@ -1,0 +1,32 @@
+import time, numpy as np
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import TokenBucketRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
+storage = TpuBatchedStorage(num_slots=1 << 21)
+lim = TokenBucketRateLimiter(storage, cfg, MeterRegistry())
+rng = np.random.default_rng(7)
+
+# isolate: native index batch assign throughput
+keys = rng.integers(0, 1_000_000, 1 << 20)
+idx = storage._index["tb"]
+t0 = time.perf_counter()
+slots, clears = idx.assign_batch_ints(keys, 1)
+print(f"index assign 1M keys: {(time.perf_counter()-t0)*1e3:.0f} ms", flush=True)
+t0 = time.perf_counter()
+slots, clears = idx.assign_batch_ints(keys, 1)
+print(f"index assign 1M keys (warm): {(time.perf_counter()-t0)*1e3:.0f} ms", flush=True)
+
+for B, K in [(1 << 17, 8), (1 << 19, 8), (1 << 20, 8)]:
+    n = B * K * 4
+    key_ids = rng.integers(0, 1_000_000, n)
+    t0 = time.perf_counter()
+    lim.try_acquire_stream_ids(key_ids[:B * K], batch=B, subbatches=K)
+    print(f"B={B} K={K}: compile+first {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    lim.try_acquire_stream_ids(key_ids, batch=B, subbatches=K)
+    dt = time.perf_counter() - t0
+    print(f"B={B} K={K}: {n} decisions {dt:.2f}s -> {n/dt/1e6:.2f}M/s", flush=True)
+storage.close()

@@ -1,0 +1,42 @@
+import jax
+jax.config.update("jax_enable_x64", True)
+import time, numpy as np, jax.numpy as jnp
+
+R = 10
+rng = np.random.default_rng(0)
+
+def timed(name, fn, *args):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:54s} {(dt-0.11)/R*1e3:8.1f} ms/iter", flush=True)
+
+def mk(M, lanes, key_dtype):
+    keys = jnp.asarray(rng.integers(0, M, M).astype(key_dtype))
+    payloads = tuple(jnp.zeros((M,), jnp.int32) for _ in range(lanes))
+    @jax.jit
+    def f(keys, payloads):
+        def body(i, carry):
+            k, ps = carry
+            out = jax.lax.sort((k,) + ps, num_keys=1, is_stable=True)
+            return (out[0], out[1:])
+        return jax.lax.fori_loop(0, R, body, (keys, payloads))
+    return f, keys, payloads
+
+for M in (1 << 21, 3 << 20, 1 << 22):
+    for lanes in (2, 4, 6):
+        f, k, p = mk(M, lanes, np.int32)
+        timed(f"lax.sort stable {M>>20}M el, 1 key + {lanes} i32 lanes", f, k, p)
+
+# i64 payload lanes (for full i64 state without bitcast plumbing)
+keys = jnp.asarray(rng.integers(0, 1 << 21, 3 << 20).astype(np.int32))
+p64 = tuple(jnp.zeros((3 << 20,), jnp.int64) for _ in range(3))
+@jax.jit
+def f64(keys, ps):
+    def body(i, carry):
+        k, ps = carry
+        out = jax.lax.sort((k,) + ps, num_keys=1, is_stable=True)
+        return (out[0], out[1:])
+    return jax.lax.fori_loop(0, R, body, (keys, ps))
+timed("lax.sort stable 3M el, 1 key + 3 i64 lanes", f64, keys, p64)

@@ -1,0 +1,48 @@
+import jax
+jax.config.update("jax_enable_x64", True)
+import time, numpy as np, jax.numpy as jnp
+
+B = 1 << 20
+N = 1 << 21
+R = 20
+rng = np.random.default_rng(0)
+slots = jnp.asarray(rng.integers(0, N, B).astype(np.int32))
+state64 = jnp.zeros((N,), jnp.int64)
+staterow = jnp.zeros((N, 4), jnp.int64)
+
+def timed(name, fn, *args):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {(dt-0.11)/R*1e3:8.1f} ms/iter", flush=True)
+
+@jax.jit
+def g3s3(st, idx):
+    # 3 separate gathers + 3 scatters (current TB layout, i64)
+    def body(i, st):
+        a, b, c = st
+        va, vb, vc = a[idx] + 1, b[idx] + 1, c[idx] + 1
+        return (a.at[idx].set(va), b.at[idx].set(vb), c.at[idx].set(vc))
+    return jax.lax.fori_loop(0, R, body, (st, st + 1, st + 2))
+
+@jax.jit
+def g1s1_rows(st, idx):
+    # 1 row gather + 1 row scatter (packed [N,4] layout, i64)
+    def body(i, st):
+        rows = st[idx] + 1
+        return st.at[idx].set(rows)
+    return jax.lax.fori_loop(0, R, body, st)
+
+@jax.jit
+def sort_take_unsort(x):
+    def body(i, x):
+        order = jnp.argsort(x, stable=True)
+        s = x[order]
+        back = jnp.zeros_like(s).at[order].set(s)
+        return back
+    return jax.lax.fori_loop(0, R, body, x)
+
+timed("3x gather + 3x scatter i64[2M] @1M", g3s3, state64, slots)
+timed("1x row-gather + row-scatter i64[2M,4] @1M", g1s1_rows, staterow, slots)
+timed("argsort+take+unsort i32[1M]", sort_take_unsort, slots)

@@ -84,7 +84,7 @@ def _scan(step, state, table, slots, lids, permits, now):
         s = xs[0]
         i = 1
         if uniform_lid:
-            l = jnp.full(s.shape, lids, dtype=jnp.int32)
+            l = lids  # 0-d: steps take the zero-table-gather scalar path
         else:
             l = xs[i]
             i += 1

@@ -1,0 +1,53 @@
+"""Bitcast row-packing for slot-state access.
+
+TPU XLA lowers an int64 gather/scatter to roughly 3x the cost of an int32
+one, and pays per array: N separate field arrays mean N gathers + N
+scatters per decision step.  These helpers view a set of i64[S] field
+arrays as ONE i32[S, 2F] row matrix (pure bitcast + reshape — dense, ~free
+at HBM bandwidth) so each step does a single row gather and a single row
+scatter regardless of field count.  Values are exactly preserved: the
+int64 <-> 2x int32 round trip is a bit-level identity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pack_fields(*fields: jnp.ndarray) -> jnp.ndarray:
+    """i64[S] x F  ->  i32[S, 2F] (bitcast view, concatenated)."""
+    cols = [jax.lax.bitcast_convert_type(f, jnp.int32) for f in fields]  # [S,2]
+    return jnp.concatenate(cols, axis=1)
+
+
+def unpack_fields(packed: jnp.ndarray, n_fields: int):
+    """i32[S, 2F] -> tuple of F i64[S] arrays."""
+    s = packed.shape[0]
+    return tuple(
+        jax.lax.bitcast_convert_type(
+            packed[:, 2 * i:2 * i + 2].reshape(s, 2), jnp.int64)
+        for i in range(n_fields)
+    )
+
+
+def gather_rows(packed: jnp.ndarray, idx: jnp.ndarray, n_fields: int):
+    """One i32 row gather; returns F i64[B] field vectors."""
+    rows = packed[idx]  # i32[B, 2F]
+    b = rows.shape[0]
+    return tuple(
+        jax.lax.bitcast_convert_type(
+            rows[:, 2 * i:2 * i + 2].reshape(b, 2), jnp.int64)
+        for i in range(n_fields)
+    )
+
+
+def scatter_rows(packed: jnp.ndarray, idx: jnp.ndarray, *fields: jnp.ndarray):
+    """One i32 row scatter of F i64[B] field vectors at ``idx``.
+
+    Out-of-range idx rows are dropped (the padding discipline: callers pass
+    an index >= S for lanes that must not write).
+    """
+    cols = [jax.lax.bitcast_convert_type(f, jnp.int32) for f in fields]  # [B,2]
+    rows = jnp.concatenate(cols, axis=1)
+    return packed.at[idx].set(rows, mode="drop")

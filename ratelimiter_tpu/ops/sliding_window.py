@@ -26,6 +26,12 @@ import jax.numpy as jnp
 
 from ratelimiter_tpu.engine.state import SWState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
+from ratelimiter_tpu.ops.rows import (
+    gather_rows,
+    pack_fields,
+    scatter_rows,
+    unpack_fields,
+)
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -70,8 +76,16 @@ def sw_step(
     permits: jnp.ndarray,     # i64[B]
     now: jnp.ndarray,         # i64 scalar
 ):
-    """Returns (new_state, SWOut) — jit with donate_argnums=0."""
-    order, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
+    """Returns (new_state, SWOut) — jit with donate_argnums=0.
+
+    ``limiter_ids`` may be a 0-d scalar (uniform-tenant batch): the policy
+    row is read once instead of gathered per request.
+    """
+    if jnp.ndim(limiter_ids) == 0:
+        inv, s, (p,) = sort_batch(slots, permits)
+        lid = limiter_ids
+    else:
+        inv, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
     valid = s >= 0
     sc = jnp.clip(s, 0, state.win_start.shape[0] - 1)
     lidc = jnp.clip(lid, 0, table.max_permits.shape[0] - 1)
@@ -79,8 +93,9 @@ def sw_step(
     maxp = table.max_permits[lidc]
     win = table.window_ms[lidc]
 
-    rows = (state.win_start[sc], state.curr[sc], state.curr_dl[sc],
-            state.prev[sc], state.prev_dl[sc])
+    packed = pack_fields(state.win_start, state.curr, state.curr_dl,
+                         state.prev, state.prev_dl)
+    rows = gather_rows(packed, sc, 5)
     curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
 
     # Weighted estimate base: exact integer floor of prev * (1 - rem/win)
@@ -112,19 +127,16 @@ def sw_step(
 
     n_slots = state.win_start.shape[0]
     widx = jnp.where(lastm, sc, n_slots)  # out-of-range -> dropped
-    new_state = SWState(
-        win_start=state.win_start.at[widx].set(curr_ws, mode="drop"),
-        curr=state.curr.at[widx].set(curr_new, mode="drop"),
-        curr_dl=state.curr_dl.at[widx].set(cdl_new, mode="drop"),
-        prev=state.prev.at[widx].set(prev_e, mode="drop"),
-        prev_dl=state.prev_dl.at[widx].set(prev_dl_e, mode="drop"),
-    )
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    packed_new = scatter_rows(packed, widx, curr_ws_b, curr_new, cdl_new,
+                              prev_e, prev_dl_e)
+    new_state = SWState(*unpack_fields(packed_new, 5))
 
     out = SWOut(
-        allowed=unsort(allowed & valid, order),
-        mutated=unsort((inc == 1) & valid, order),
-        observed=unsort(observed, order),
-        cache_value=unsort(cache_value, order),
+        allowed=unsort(allowed & valid, inv),
+        mutated=unsort((inc == 1) & valid, inv),
+        observed=unsort(observed, inv),
+        cache_value=unsort(cache_value, inv),
     )
     return new_state, out
 

@@ -22,6 +22,12 @@ import jax.numpy as jnp
 from ratelimiter_tpu.core.config import TOKEN_FP_ONE, TOKEN_FP_SHIFT
 from ratelimiter_tpu.engine.state import TBState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
+from ratelimiter_tpu.ops.rows import (
+    gather_rows,
+    pack_fields,
+    scatter_rows,
+    unpack_fields,
+)
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -55,8 +61,17 @@ def tb_step(
     permits: jnp.ndarray,      # i64[B]
     now: jnp.ndarray,          # i64 scalar
 ):
-    """Returns (new_state, TBOut) — jit with donate_argnums=0."""
-    order, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
+    """Returns (new_state, TBOut) — jit with donate_argnums=0.
+
+    ``limiter_ids`` may be a 0-d scalar (uniform-tenant batch): the policy
+    row is then read once instead of gathered per request — the common hot
+    path pays zero table gathers.
+    """
+    if jnp.ndim(limiter_ids) == 0:
+        inv, s, (p,) = sort_batch(slots, permits)
+        lid = limiter_ids
+    else:
+        inv, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
     valid = s >= 0
     sc = jnp.clip(s, 0, state.tokens_fp.shape[0] - 1)
     lidc = jnp.clip(lid, 0, table.cap_fp.shape[0] - 1)
@@ -66,7 +81,8 @@ def tb_step(
     maxp = table.max_permits[lidc]
     ttl2 = table.ttl2_ms[lidc]
 
-    rows = (state.tokens_fp[sc], state.last_refill[sc], state.deadline[sc])
+    packed = pack_fields(state.tokens_fp, state.last_refill, state.deadline)
+    rows = gather_rows(packed, sc, 3)
     v1 = _refilled(rows, cap, rate, now)
 
     req = p * TOKEN_FP_ONE
@@ -97,16 +113,13 @@ def tb_step(
 
     n_slots = state.tokens_fp.shape[0]
     widx = jnp.where(lastm, sc, n_slots)
-    new_state = TBState(
-        tokens_fp=state.tokens_fp.at[widx].set(tokens_new, mode="drop"),
-        last_refill=state.last_refill.at[widx].set(last_new, mode="drop"),
-        deadline=state.deadline.at[widx].set(dl_new, mode="drop"),
-    )
+    packed_new = scatter_rows(packed, widx, tokens_new, last_new, dl_new)
+    new_state = TBState(*unpack_fields(packed_new, 3))
 
     out = TBOut(
-        allowed=unsort(allowed & valid, order),
-        observed=unsort(v_j // TOKEN_FP_ONE, order),
-        remaining=unsort(after // TOKEN_FP_ONE, order),
+        allowed=unsort(allowed & valid, inv),
+        observed=unsort(v_j // TOKEN_FP_ONE, inv),
+        remaining=unsort(after // TOKEN_FP_ONE, inv),
     )
     return new_state, out
 
