@@ -1,0 +1,16 @@
+import numpy as np, jax.numpy as jnp
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine.state import LimiterTable
+from ratelimiter_tpu.engine.engine import DeviceEngine
+
+table = LimiterTable()
+lid = table.register(RateLimitConfig(max_permits=50, window_ms=60_000, refill_rate=10.0))
+e = DeviceEngine(num_slots=64, table=table)
+now = 1_753_000_000_000
+out = e.tb_acquire([7], [lid], [45], now)
+print("first 45:", out["allowed"][0], "remaining", out["remaining"][0])
+print("raw packed row:", np.asarray(e.tb_packed)[7])
+st = e.tb_state
+print("decoded tokens_fp:", int(np.asarray(st.tokens_fp)[7]), "last:", int(np.asarray(st.last_refill)[7]))
+out = e.tb_acquire([7], [lid], [45], now + 100)
+print("second 45 (+100ms):", out["allowed"][0], "remaining", out["remaining"][0])

@@ -9,16 +9,14 @@ storage = TpuBatchedStorage(num_slots=1 << 21)
 tb = TokenBucketRateLimiter(storage, RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0), MeterRegistry())
 sw = SlidingWindowRateLimiter(storage, RateLimitConfig(max_permits=100, window_ms=60_000, enable_local_cache=False), MeterRegistry())
 
+B, K = 1 << 19, 8
+n = B * K * 2
 for name, lim in (("tb", tb), ("sw", sw)):
-    B, K = 1 << 19, 8
-    n = B * K * 4
     key_ids = rng.integers(0, 1_000_000, n)
-    t0 = time.perf_counter()
-    lim.try_acquire_stream_ids(key_ids[:B * K], batch=B, subbatches=K)
-    print(f"{name}: compile+first {time.perf_counter()-t0:.1f}s", flush=True)
-    t0 = time.perf_counter()
-    lim.try_acquire_stream_ids(key_ids, batch=B, subbatches=K)
-    dt = time.perf_counter() - t0
-    print(f"{name}: {n} decisions {dt:.2f}s -> {n/dt/1e6:.2f}M/s", flush=True)
+    lim.try_acquire_stream_ids(key_ids[:B * K], batch=B, subbatches=K)  # compile
+    for rep in range(4):
+        t0 = time.perf_counter()
+        lim.try_acquire_stream_ids(key_ids, batch=B, subbatches=K)
+        dt = time.perf_counter() - t0
+        print(f"{name} rep{rep}: {n/dt/1e6:.2f}M/s", flush=True)
 storage.close()
-# second pass to gauge run-to-run variance (cache warm)

@@ -63,8 +63,17 @@ class RateLimitConfig:
     def validate(self) -> "RateLimitConfig":
         if self.max_permits <= 0:
             raise ValueError("maxPermits must be positive")
+        if self.max_permits > 2**31 - 1:
+            # Java-int parity with the reference (int maxPermits); also what
+            # lets device counters travel as one i32 lane (ops/sliding_window).
+            raise ValueError("maxPermits must fit a 32-bit signed int")
         if self.window_ms <= 0:
             raise ValueError("window must be a positive duration")
+        if self.window_ms > 2**30:
+            # ~12.4 days; keeps 2*window deadline offsets within i32 on the
+            # device path. The reference's Duration has no bound, but windows
+            # beyond days are outside rate-limiting semantics.
+            raise ValueError("window must be at most 2^30 ms (~12 days)")
         if self.refill_rate < 0:
             raise ValueError("refillRate cannot be negative")
         return self

@@ -18,13 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ratelimiter_tpu.engine.state import (
-    LimiterTable,
-    SWState,
-    TBState,
-    make_sw_state,
-    make_tb_state,
-)
+from ratelimiter_tpu.engine.state import LimiterTable, SWState, TBState
 from ratelimiter_tpu.ops.packed import (
     decode_sw_fused,
     decode_tb_fused,
@@ -33,8 +27,20 @@ from ratelimiter_tpu.ops.packed import (
     tb_scan_bits,
     tb_step_fused,
 )
-from ratelimiter_tpu.ops.sliding_window import sw_peek, sw_reset
-from ratelimiter_tpu.ops.token_bucket import tb_peek, tb_reset
+from ratelimiter_tpu.ops.sliding_window import (
+    make_sw_packed,
+    sw_pack_state,
+    sw_peek_p,
+    sw_reset_p,
+    sw_unpack_state,
+)
+from ratelimiter_tpu.ops.token_bucket import (
+    make_tb_packed,
+    tb_pack_state,
+    tb_peek_p,
+    tb_reset_p,
+    tb_unpack_state,
+)
 
 _MIN_BATCH = 256
 
@@ -69,18 +75,40 @@ class DeviceEngine:
         # reference that a concurrent step is about to invalidate — is
         # serialized through this lock.
         self._lock = threading.RLock()
-        self.sw_state: SWState = make_sw_state(self.num_slots)
-        self.tb_state: TBState = make_tb_state(self.num_slots)
+        # State lives packed (i32 lanes — see ops/{sliding_window,token_bucket})
+        # for gather/scatter speed; the sw_state/tb_state properties expose the
+        # i64 field view for checkpointing and inspection.
+        self.sw_packed = make_sw_packed(self.num_slots)
+        self.tb_packed = make_tb_packed(self.num_slots)
         # Fused steps return all outputs in one array — one D2H transfer per
         # batch instead of four (the transfer-latency fix; ops/packed.py).
         self._sw_step = jax.jit(sw_step_fused, donate_argnums=0)
         self._tb_step = jax.jit(tb_step_fused, donate_argnums=0)
         self._sw_scan = jax.jit(sw_scan_bits, donate_argnums=0)
         self._tb_scan = jax.jit(tb_scan_bits, donate_argnums=0)
-        self._sw_peek = jax.jit(sw_peek)
-        self._tb_peek = jax.jit(tb_peek)
-        self._sw_reset = jax.jit(sw_reset, donate_argnums=0)
-        self._tb_reset = jax.jit(tb_reset, donate_argnums=0)
+        self._sw_peek = jax.jit(sw_peek_p)
+        self._tb_peek = jax.jit(tb_peek_p)
+        self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
+        self._tb_reset = jax.jit(tb_reset_p, donate_argnums=0)
+
+    # -- i64 field view (checkpoint/compat) ------------------------------------
+    @property
+    def sw_state(self) -> SWState:
+        return sw_unpack_state(self.sw_packed)
+
+    @sw_state.setter
+    def sw_state(self, state: SWState) -> None:
+        self.sw_packed = sw_pack_state(
+            SWState(*(jnp.asarray(f) for f in state)))
+
+    @property
+    def tb_state(self) -> TBState:
+        return tb_unpack_state(self.tb_packed)
+
+    @tb_state.setter
+    def tb_state(self, state: TBState) -> None:
+        self.tb_packed = tb_pack_state(
+            TBState(*(jnp.asarray(f) for f in state)))
 
     # -- acquire --------------------------------------------------------------
     def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
@@ -93,14 +121,14 @@ class DeviceEngine:
 
     def _sw_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
         new_state, packed = self._sw_step(
-            self.sw_state,
+            self.sw_packed,
             self.table.device_arrays,
             _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
             _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
             _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
             jnp.int64(now_ms),
         )
-        self.sw_state = new_state
+        self.sw_packed = new_state
         return decode_sw_fused(np.asarray(packed)[:, :n])
 
     def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
@@ -111,14 +139,14 @@ class DeviceEngine:
 
     def _tb_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
         new_state, packed = self._tb_step(
-            self.tb_state,
+            self.tb_packed,
             self.table.device_arrays,
             _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
             _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
             _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
             jnp.int64(now_ms),
         )
-        self.tb_state = new_state
+        self.tb_packed = new_state
         return decode_tb_fused(np.asarray(packed)[:, :n])
 
     # -- scan dispatch (K sub-batches, bit-packed decisions) -------------------
@@ -144,12 +172,12 @@ class DeviceEngine:
         now_k = jnp.asarray(np.ascontiguousarray(now_k, dtype=np.int64))
         with self._lock:
             if algo == "sw":
-                self.sw_state, bits = self._sw_scan(
-                    self.sw_state, self.table.device_arrays,
+                self.sw_packed, bits = self._sw_scan(
+                    self.sw_packed, self.table.device_arrays,
                     slots_kb, lids, permits_kb, now_k)
             else:
-                self.tb_state, bits = self._tb_scan(
-                    self.tb_state, self.table.device_arrays,
+                self.tb_packed, bits = self._tb_scan(
+                    self.tb_packed, self.table.device_arrays,
                     slots_kb, lids, permits_kb, now_k)
         return bits
 
@@ -159,7 +187,7 @@ class DeviceEngine:
         size = _bucket_size(n)
         with self._lock:
             out = self._sw_peek(
-                self.sw_state,
+                self.sw_packed,
                 self.table.device_arrays,
                 _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
                 _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
@@ -172,7 +200,7 @@ class DeviceEngine:
         size = _bucket_size(n)
         with self._lock:
             out = self._tb_peek(
-                self.tb_state,
+                self.tb_packed,
                 self.table.device_arrays,
                 _pad_i32(np.asarray(slots, dtype=np.int32), size, 0),
                 _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
@@ -184,18 +212,18 @@ class DeviceEngine:
     def sw_clear(self, slots: Sequence[int]) -> None:
         size = _bucket_size(max(len(slots), 1))
         with self._lock:
-            self.sw_state = self._sw_reset(
-                self.sw_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+            self.sw_packed = self._sw_reset(
+                self.sw_packed, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
         size = _bucket_size(max(len(slots), 1))
         with self._lock:
-            self.tb_state = self._tb_reset(
-                self.tb_state, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
+            self.tb_packed = self._tb_reset(
+                self.tb_packed, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
     def block_until_ready(self) -> None:
         with self._lock:
-            jax.block_until_ready((self.sw_state, self.tb_state))
+            jax.block_until_ready((self.sw_packed, self.tb_packed))
 
     def make_slot_index(self):
         # Prefer the C++ index (tens of M ops/s); identical semantics to the

@@ -48,12 +48,17 @@ class SWState(NamedTuple):
 
 
 class TBState(NamedTuple):
-    """Token-bucket per-slot state (the Redis hash {tokens, last_refill} plus
-    its PEXPIRE deadline)."""
+    """Token-bucket per-slot state (the Redis hash {tokens, last_refill}).
+
+    The PEXPIRE deadline is not stored: it is always ``last_refill + 2*window``
+    (both are written together on every allow), so expiry is recomputed from
+    ``last_refill`` and the limiter's ttl2 — one fewer i64 lane through the
+    gather/scatter hot path.  ``last_refill == 0`` is the absent-key sentinel
+    (a fresh slot reads as an expired bucket, i.e. lazy init to full capacity,
+    exactly like a missing Redis key)."""
 
     tokens_fp: jax.Array    # i64[S]
     last_refill: jax.Array  # i64[S]
-    deadline: jax.Array     # i64[S]
 
 
 class TableArrays(NamedTuple):
@@ -77,7 +82,7 @@ def make_sw_state(num_slots: int) -> SWState:
 
 
 def make_tb_state(num_slots: int) -> TBState:
-    return TBState(*(_zeros(num_slots) for _ in range(3)))
+    return TBState(*(_zeros(num_slots) for _ in range(2)))
 
 
 class LimiterTable:

@@ -29,22 +29,24 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ratelimiter_tpu.ops.sliding_window import sw_step
-from ratelimiter_tpu.ops.token_bucket import tb_step
+from ratelimiter_tpu.ops.sliding_window import sw_step_p
+from ratelimiter_tpu.ops.token_bucket import tb_step_p
 
 # -- fused full-output steps (one i64[3, B] fetch) ---------------------------
+# All wrappers operate on the engine's packed-resident state form
+# (i32[S, 6] sliding window, i32[S, 4] token bucket — see the ops modules).
 
 
 def sw_step_fused(state, table, slots, limiter_ids, permits, now):
     """Row 0: allowed | mutated<<1;  row 1: observed;  row 2: cache_value."""
-    state, out = sw_step(state, table, slots, limiter_ids, permits, now)
+    state, out = sw_step_p(state, table, slots, limiter_ids, permits, now)
     flags = out.allowed.astype(jnp.int64) | (out.mutated.astype(jnp.int64) << 1)
     return state, jnp.stack([flags, out.observed, out.cache_value])
 
 
 def tb_step_fused(state, table, slots, limiter_ids, permits, now):
     """Row 0: allowed;  row 1: observed;  row 2: remaining."""
-    state, out = tb_step(state, table, slots, limiter_ids, permits, now)
+    state, out = tb_step_p(state, table, slots, limiter_ids, permits, now)
     return state, jnp.stack(
         [out.allowed.astype(jnp.int64), out.observed, out.remaining])
 
@@ -107,8 +109,8 @@ def _scan(step, state, table, slots, lids, permits, now):
 
 
 def sw_scan_bits(state, table, slots, lids, permits, now):
-    return _scan(sw_step, state, table, slots, lids, permits, now)
+    return _scan(sw_step_p, state, table, slots, lids, permits, now)
 
 
 def tb_scan_bits(state, table, slots, lids, permits, now):
-    return _scan(tb_step, state, table, slots, lids, permits, now)
+    return _scan(tb_step_p, state, table, slots, lids, permits, now)

@@ -24,14 +24,10 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+import jax
+
 from ratelimiter_tpu.engine.state import SWState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
-from ratelimiter_tpu.ops.rows import (
-    gather_rows,
-    pack_fields,
-    scatter_rows,
-    unpack_fields,
-)
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -39,6 +35,40 @@ from ratelimiter_tpu.ops.segments import (
     segmented_cumsum_exclusive,
 )
 from ratelimiter_tpu.ops.sorting import sort_batch, unsort
+
+
+# -- compact row codec --------------------------------------------------------
+# The five i64 fields travel through the gather/scatter hot path as SIX i32
+# lanes: [ws_lo, ws_hi, curr, prev, cdl_off, pdl_off].  Counts fit i32 by
+# construction (counter <= max_permits <= 2^31-1, Java-int parity with the
+# reference), and the PEXPIRE deadlines are stored as offsets from the row's
+# own win_start (alive offsets < 2*window < 2^31 given the validated
+# window_ms bound).  A dead deadline (0) encodes as offset 0, which decodes
+# to win_start — in every comparison (`now < deadline` with now >= win_start)
+# that value is equally dead, so decisions are unchanged.
+
+
+def _sw_encode(ws, curr, cdl, prev, pdl):
+    """5 x i64[...] -> i32[..., 6] (dense, ~free at HBM bandwidth)."""
+    ws32 = jax.lax.bitcast_convert_type(ws, jnp.int32)  # [..., 2]
+    cols = [
+        ws32,
+        curr.astype(jnp.int32)[..., None],
+        prev.astype(jnp.int32)[..., None],
+        jnp.maximum(cdl - ws, 0).astype(jnp.int32)[..., None],
+        jnp.maximum(pdl - ws, 0).astype(jnp.int32)[..., None],
+    ]
+    return jnp.concatenate(cols, axis=-1)
+
+
+def _sw_decode(rows):
+    """i32[..., 6] -> (ws, curr, cdl, prev, pdl) as i64[...]."""
+    ws = jax.lax.bitcast_convert_type(rows[..., 0:2], jnp.int64)
+    curr = rows[..., 2].astype(jnp.int64)
+    prev = rows[..., 3].astype(jnp.int64)
+    cdl = ws + rows[..., 4]
+    pdl = ws + rows[..., 5]
+    return ws, curr, cdl, prev, pdl
 
 
 class SWOut(NamedTuple):
@@ -68,15 +98,29 @@ def _rolled(state_rows, win, now):
     return curr_ws, curr_e, prev_e, prev_dl_e
 
 
-def sw_step(
-    state: SWState,
+def sw_pack_state(state: SWState) -> jnp.ndarray:
+    """SWState (5 x i64[S]) -> resident packed form i32[S, 6]."""
+    return _sw_encode(state.win_start, state.curr, state.curr_dl,
+                      state.prev, state.prev_dl)
+
+
+def sw_unpack_state(packed: jnp.ndarray) -> SWState:
+    return SWState(*_sw_decode(packed))
+
+
+def make_sw_packed(num_slots: int) -> jnp.ndarray:
+    return jnp.zeros((num_slots, 6), dtype=jnp.int32)
+
+
+def sw_step_p(
+    packed: jnp.ndarray,      # i32[S, 6] — resident packed state
     table: TableArrays,
     slots: jnp.ndarray,       # i32[B]; < 0 = padding
-    limiter_ids: jnp.ndarray, # i32[B]
+    limiter_ids: jnp.ndarray, # i32[B] or 0-d (uniform tenant)
     permits: jnp.ndarray,     # i64[B]
     now: jnp.ndarray,         # i64 scalar
 ):
-    """Returns (new_state, SWOut) — jit with donate_argnums=0.
+    """Returns (new_packed, SWOut) — jit with donate_argnums=0.
 
     ``limiter_ids`` may be a 0-d scalar (uniform-tenant batch): the policy
     row is read once instead of gathered per request.
@@ -87,15 +131,13 @@ def sw_step(
     else:
         inv, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
     valid = s >= 0
-    sc = jnp.clip(s, 0, state.win_start.shape[0] - 1)
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
     lidc = jnp.clip(lid, 0, table.max_permits.shape[0] - 1)
 
     maxp = table.max_permits[lidc]
     win = table.window_ms[lidc]
 
-    packed = pack_fields(state.win_start, state.curr, state.curr_dl,
-                         state.prev, state.prev_dl)
-    rows = gather_rows(packed, sc, 5)
+    rows = _sw_decode(packed[sc])  # one 6-lane i32 row gather
     curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
 
     # Weighted estimate base: exact integer floor of prev * (1 - rem/win)
@@ -125,12 +167,11 @@ def sw_step(
     samew = ws0 == curr_ws
     cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
 
-    n_slots = state.win_start.shape[0]
+    n_slots = packed.shape[0]
     widx = jnp.where(lastm, sc, n_slots)  # out-of-range -> dropped
     curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
-    packed_new = scatter_rows(packed, widx, curr_ws_b, curr_new, cdl_new,
-                              prev_e, prev_dl_e)
-    new_state = SWState(*unpack_fields(packed_new, 5))
+    new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
+    packed_new = packed.at[widx].set(new_rows, mode="drop")
 
     out = SWOut(
         allowed=unsort(allowed & valid, inv),
@@ -138,11 +179,27 @@ def sw_step(
         observed=unsort(observed, inv),
         cache_value=unsort(cache_value, inv),
     )
-    return new_state, out
+    return packed_new, out
 
 
-def sw_peek(
+def sw_step(
     state: SWState,
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    permits: jnp.ndarray,
+    now: jnp.ndarray,
+):
+    """Tuple-state compatibility wrapper around :func:`sw_step_p` (used by
+    the sharded shard_map path and the driver entry; the engine runs the
+    packed-resident form directly)."""
+    packed, out = sw_step_p(sw_pack_state(state), table, slots, limiter_ids,
+                            permits, now)
+    return sw_unpack_state(packed), out
+
+
+def sw_peek_p(
+    packed: jnp.ndarray,
     table: TableArrays,
     slots: jnp.ndarray,
     limiter_ids: jnp.ndarray,
@@ -150,28 +207,29 @@ def sw_peek(
 ) -> jnp.ndarray:
     """Read-only availablePermits: max(0, maxPermits - estimate)
     (SlidingWindowRateLimiter.java:134-137). No sort needed — no mutation."""
-    sc = jnp.clip(slots, 0, state.win_start.shape[0] - 1)
+    sc = jnp.clip(slots, 0, packed.shape[0] - 1)
     lidc = jnp.clip(limiter_ids, 0, table.max_permits.shape[0] - 1)
     maxp = table.max_permits[lidc]
     win = table.window_ms[lidc]
-    rows = (state.win_start[sc], state.curr[sc], state.curr_dl[sc],
-            state.prev[sc], state.prev_dl[sc])
+    rows = _sw_decode(packed[sc])
     _, curr_e, prev_e, _ = _rolled(rows, win, now)
     rem = now % win
     est = curr_e + (prev_e * (win - rem)) // win
     return jnp.maximum(0, maxp - est)
 
 
-def sw_reset(state: SWState, slots: jnp.ndarray) -> SWState:
+def sw_peek(state: SWState, table, slots, limiter_ids, now) -> jnp.ndarray:
+    return sw_peek_p(sw_pack_state(state), table, slots, limiter_ids, now)
+
+
+def sw_reset_p(packed: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     """Zero the given slots (delete curr+prev buckets,
     SlidingWindowRateLimiter.java:140-153). Negative slots are dropped."""
-    n = state.win_start.shape[0]
+    n = packed.shape[0]
     widx = jnp.where(slots >= 0, slots, n)
-    z = jnp.zeros_like(slots, dtype=jnp.int64)
-    return SWState(
-        win_start=state.win_start.at[widx].set(z, mode="drop"),
-        curr=state.curr.at[widx].set(z, mode="drop"),
-        curr_dl=state.curr_dl.at[widx].set(z, mode="drop"),
-        prev=state.prev.at[widx].set(z, mode="drop"),
-        prev_dl=state.prev_dl.at[widx].set(z, mode="drop"),
-    )
+    z = jnp.zeros((slots.shape[0], packed.shape[1]), dtype=jnp.int32)
+    return packed.at[widx].set(z, mode="drop")
+
+
+def sw_reset(state: SWState, slots: jnp.ndarray) -> SWState:
+    return sw_unpack_state(sw_reset_p(sw_pack_state(state), slots))

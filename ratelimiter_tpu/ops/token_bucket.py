@@ -19,15 +19,11 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+import jax
+
 from ratelimiter_tpu.core.config import TOKEN_FP_ONE, TOKEN_FP_SHIFT
 from ratelimiter_tpu.engine.state import TBState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
-from ratelimiter_tpu.ops.rows import (
-    gather_rows,
-    pack_fields,
-    scatter_rows,
-    unpack_fields,
-)
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -37,31 +33,67 @@ from ratelimiter_tpu.ops.segments import (
 from ratelimiter_tpu.ops.sorting import sort_batch, unsort
 
 
+# -- packed resident form -----------------------------------------------------
+# (tokens_fp, last_refill) live as FOUR i32 lanes [tok_lo, tok_hi, last_lo,
+# last_hi]: int64 gathers/scatters lower ~3x slower than int32 on TPU, and
+# one row op replaces two flat ones.  Pure bitcast — bit-exact round trip.
+
+
+def _tb_encode(tokens, last):
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(tokens, jnp.int32),
+         jax.lax.bitcast_convert_type(last, jnp.int32)], axis=-1)
+
+
+def _tb_decode(rows):
+    tokens = jax.lax.bitcast_convert_type(rows[..., 0:2], jnp.int64)
+    last = jax.lax.bitcast_convert_type(rows[..., 2:4], jnp.int64)
+    return tokens, last
+
+
+def tb_pack_state(state: TBState) -> jnp.ndarray:
+    return _tb_encode(state.tokens_fp, state.last_refill)
+
+
+def tb_unpack_state(packed: jnp.ndarray) -> TBState:
+    return TBState(*_tb_decode(packed))
+
+
+def make_tb_packed(num_slots: int) -> jnp.ndarray:
+    return jnp.zeros((num_slots, 4), dtype=jnp.int32)
+
+
 class TBOut(NamedTuple):
     allowed: jnp.ndarray    # bool[B]
     observed: jnp.ndarray   # i64[B] — whole tokens available pre-consume
     remaining: jnp.ndarray  # i64[B] — whole tokens after the operation
 
 
-def _refilled(state_rows, cap, rate, now):
-    """Lazy-init + exact fixed-point refill (oracle: _refilled)."""
-    tokens, last, dl = state_rows
-    expired = now >= dl  # zero state reads as expired -> fresh full bucket
+def _refilled(state_rows, cap, rate, ttl2, now):
+    """Lazy-init + exact fixed-point refill (oracle: _refilled).
+
+    Expiry is ``now >= last_refill + ttl2`` — identical to the stored-deadline
+    model (deadline was always written as last_refill + ttl2), with
+    ``last_refill == 0`` as the absent-key sentinel (fresh slot => expired =>
+    lazy init to full capacity, like a missing Redis key).
+    """
+    tokens, last = state_rows
+    expired = (last == 0) | (now >= last + ttl2)
     v0 = jnp.where(expired, cap, tokens)
     last_e = jnp.where(expired, now, last)
     elapsed = jnp.clip(now - last_e, 0, cap // jnp.maximum(rate, 1) + 1)
     return jnp.minimum(cap, v0 + elapsed * rate)
 
 
-def tb_step(
-    state: TBState,
+def tb_step_p(
+    packed: jnp.ndarray,       # i32[S, 4] — resident packed state
     table: TableArrays,
     slots: jnp.ndarray,        # i32[B]; < 0 = padding
-    limiter_ids: jnp.ndarray,  # i32[B]
+    limiter_ids: jnp.ndarray,  # i32[B] or 0-d (uniform tenant)
     permits: jnp.ndarray,      # i64[B]
     now: jnp.ndarray,          # i64 scalar
 ):
-    """Returns (new_state, TBOut) — jit with donate_argnums=0.
+    """Returns (new_packed, TBOut) — jit with donate_argnums=0.
 
     ``limiter_ids`` may be a 0-d scalar (uniform-tenant batch): the policy
     row is then read once instead of gathered per request — the common hot
@@ -73,7 +105,7 @@ def tb_step(
     else:
         inv, s, (lid, p) = sort_batch(slots, limiter_ids, permits)
     valid = s >= 0
-    sc = jnp.clip(s, 0, state.tokens_fp.shape[0] - 1)
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
     lidc = jnp.clip(lid, 0, table.cap_fp.shape[0] - 1)
 
     cap = table.cap_fp[lidc]
@@ -81,9 +113,8 @@ def tb_step(
     maxp = table.max_permits[lidc]
     ttl2 = table.ttl2_ms[lidc]
 
-    packed = pack_fields(state.tokens_fp, state.last_refill, state.deadline)
-    rows = gather_rows(packed, sc, 3)
-    v1 = _refilled(rows, cap, rate, now)
+    rows = _tb_decode(packed[sc])  # one 4-lane i32 row gather
+    v1 = _refilled(rows, cap, rate, ttl2, now)
 
     req = p * TOKEN_FP_ONE
     # Client-side reject above capacity; padding never passes.
@@ -108,24 +139,41 @@ def tb_step(
     tot_inc = segment_totals(inc, first)
     any_inc = tot_inc > 0
     tokens_new = jnp.where(any_inc, v1 - tot_w, rows[0])
-    last_new = jnp.where(any_inc, now, rows[1])
-    dl_new = jnp.where(any_inc, now + ttl2, rows[2])
+    # Clamp to >= 1 so a write at epoch instant 0 cannot alias the
+    # absent-key sentinel (last_refill == 0); costs at most 1 ms of refill
+    # skew for clocks that start exactly at 0.
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
 
-    n_slots = state.tokens_fp.shape[0]
+    n_slots = packed.shape[0]
     widx = jnp.where(lastm, sc, n_slots)
-    packed_new = scatter_rows(packed, widx, tokens_new, last_new, dl_new)
-    new_state = TBState(*unpack_fields(packed_new, 3))
+    packed_new = packed.at[widx].set(
+        _tb_encode(tokens_new, last_new), mode="drop")
 
     out = TBOut(
         allowed=unsort(allowed & valid, inv),
         observed=unsort(v_j // TOKEN_FP_ONE, inv),
         remaining=unsort(after // TOKEN_FP_ONE, inv),
     )
-    return new_state, out
+    return packed_new, out
 
 
-def tb_peek(
+def tb_step(
     state: TBState,
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    permits: jnp.ndarray,
+    now: jnp.ndarray,
+):
+    """Tuple-state compatibility wrapper around :func:`tb_step_p` (sharded
+    shard_map path and driver entry; the engine runs the packed form)."""
+    packed, out = tb_step_p(tb_pack_state(state), table, slots, limiter_ids,
+                            permits, now)
+    return tb_unpack_state(packed), out
+
+
+def tb_peek_p(
+    packed: jnp.ndarray,
     table: TableArrays,
     slots: jnp.ndarray,
     limiter_ids: jnp.ndarray,
@@ -133,22 +181,27 @@ def tb_peek(
 ) -> jnp.ndarray:
     """Read-only refilled whole-token count (the fixed availablePermits —
     quirk Q3 in the reference always crashed here)."""
-    sc = jnp.clip(slots, 0, state.tokens_fp.shape[0] - 1)
+    sc = jnp.clip(slots, 0, packed.shape[0] - 1)
     lidc = jnp.clip(limiter_ids, 0, table.cap_fp.shape[0] - 1)
     cap = table.cap_fp[lidc]
     rate = table.rate_fp[lidc]
-    rows = (state.tokens_fp[sc], state.last_refill[sc], state.deadline[sc])
-    v1 = _refilled(rows, cap, rate, now)
+    ttl2 = table.ttl2_ms[lidc]
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
     return v1 // TOKEN_FP_ONE
 
 
-def tb_reset(state: TBState, slots: jnp.ndarray) -> TBState:
+def tb_peek(state: TBState, table, slots, limiter_ids, now) -> jnp.ndarray:
+    return tb_peek_p(tb_pack_state(state), table, slots, limiter_ids, now)
+
+
+def tb_reset_p(packed: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     """Zero the given slots (delete bucket, TokenBucketRateLimiter.java:154-158)."""
-    n = state.tokens_fp.shape[0]
+    n = packed.shape[0]
     widx = jnp.where(slots >= 0, slots, n)
-    z = jnp.zeros_like(slots, dtype=jnp.int64)
-    return TBState(
-        tokens_fp=state.tokens_fp.at[widx].set(z, mode="drop"),
-        last_refill=state.last_refill.at[widx].set(z, mode="drop"),
-        deadline=state.deadline.at[widx].set(z, mode="drop"),
-    )
+    z = jnp.zeros((slots.shape[0], packed.shape[1]), dtype=jnp.int32)
+    return packed.at[widx].set(z, mode="drop")
+
+
+def tb_reset(state: TBState, slots: jnp.ndarray) -> TBState:
+    return tb_unpack_state(tb_reset_p(tb_pack_state(state), slots))

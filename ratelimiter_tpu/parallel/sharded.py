@@ -28,13 +28,23 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ratelimiter_tpu.engine.slots import SlotIndex
-from ratelimiter_tpu.engine.state import (
-    LimiterTable,
-    SWState,
-    TBState,
+from ratelimiter_tpu.engine.state import LimiterTable
+from ratelimiter_tpu.ops.sliding_window import (
+    SWOut,
+    sw_pack_state,
+    sw_peek_p,
+    sw_reset_p,
+    sw_step_p,
+    sw_unpack_state,
 )
-from ratelimiter_tpu.ops.sliding_window import SWOut, sw_peek, sw_reset, sw_step
-from ratelimiter_tpu.ops.token_bucket import TBOut, tb_peek, tb_reset, tb_step
+from ratelimiter_tpu.ops.token_bucket import (
+    TBOut,
+    tb_pack_state,
+    tb_peek_p,
+    tb_reset_p,
+    tb_step_p,
+    tb_unpack_state,
+)
 from ratelimiter_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 _MIN_BATCH = 256
@@ -100,25 +110,17 @@ class ShardedSlotIndex:
 # Sharded step construction
 # ---------------------------------------------------------------------------
 
-def _squeeze(state):
-    return type(state)(*(f[0] for f in state))
-
-
-def _expand(state):
-    return type(state)(*(f[None] for f in state))
-
-
 def build_sharded_sw_step(mesh):
-    """shard_map'd sliding-window step over (n_shards, S_local) state and
-    (n_shards, B) batches; returns (state, out, global allow/deny totals)."""
+    """shard_map'd sliding-window step over (n_shards, S_local, 6) packed
+    state and (n_shards, B) batches; returns (state, out, global totals)."""
 
     def local_step(state, table, slots, lids, permits, now):
-        new_state, out = sw_step(_squeeze(state), table, slots[0], lids[0],
-                                 permits[0], now)
+        new_state, out = sw_step_p(state[0], table, slots[0], lids[0],
+                                   permits[0], now)
         n_allowed = jnp.sum(out.allowed.astype(jnp.int64))
         n_total = jnp.sum((slots[0] >= 0).astype(jnp.int64))
         totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
-        return _expand(new_state), SWOut(*(f[None] for f in out)), totals
+        return new_state[None], SWOut(*(f[None] for f in out)), totals
 
     return jax.shard_map(
         local_step,
@@ -130,12 +132,12 @@ def build_sharded_sw_step(mesh):
 
 def build_sharded_tb_step(mesh):
     def local_step(state, table, slots, lids, permits, now):
-        new_state, out = tb_step(_squeeze(state), table, slots[0], lids[0],
-                                 permits[0], now)
+        new_state, out = tb_step_p(state[0], table, slots[0], lids[0],
+                                   permits[0], now)
         n_allowed = jnp.sum(out.allowed.astype(jnp.int64))
         n_total = jnp.sum((slots[0] >= 0).astype(jnp.int64))
         totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
-        return _expand(new_state), TBOut(*(f[None] for f in out)), totals
+        return new_state[None], TBOut(*(f[None] for f in out)), totals
 
     return jax.shard_map(
         local_step,
@@ -147,7 +149,7 @@ def build_sharded_tb_step(mesh):
 
 def build_sharded_peek(mesh, peek_fn):
     def local_peek(state, table, slots, lids, now):
-        out = peek_fn(_squeeze(state), table, slots[0], lids[0], now)
+        out = peek_fn(state[0], table, slots[0], lids[0], now)
         return out[None]
 
     return jax.shard_map(
@@ -160,7 +162,7 @@ def build_sharded_peek(mesh, peek_fn):
 
 def build_sharded_reset(mesh, reset_fn):
     def local_reset(state, slots):
-        return _expand(reset_fn(_squeeze(state), slots[0]))
+        return reset_fn(state[0], slots[0])[None]
 
     return jax.shard_map(
         local_reset,
@@ -192,21 +194,45 @@ class ShardedDeviceEngine:
         self._lock = threading.RLock()
         self.last_step_totals = (0, 0)
 
-        shape = (self.n_shards, self.slots_per_shard)
-        sharding = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+        self._state_sharding = NamedSharding(self.mesh, P(SHARD_AXIS, None, None))
 
-        def zeros():
-            return jax.device_put(jnp.zeros(shape, dtype=jnp.int64), sharding)
+        def zeros(lanes):
+            return jax.device_put(
+                jnp.zeros((self.n_shards, self.slots_per_shard, lanes),
+                          dtype=jnp.int32),
+                self._state_sharding)
 
-        self.sw_state = SWState(*(zeros() for _ in range(5)))
-        self.tb_state = TBState(*(zeros() for _ in range(3)))
+        # Packed-resident per-shard state (same codec as DeviceEngine).
+        self.sw_packed = zeros(6)
+        self.tb_packed = zeros(4)
 
         self._sw_step = jax.jit(build_sharded_sw_step(self.mesh), donate_argnums=0)
         self._tb_step = jax.jit(build_sharded_tb_step(self.mesh), donate_argnums=0)
-        self._sw_peek = jax.jit(build_sharded_peek(self.mesh, sw_peek))
-        self._tb_peek = jax.jit(build_sharded_peek(self.mesh, tb_peek))
-        self._sw_reset = jax.jit(build_sharded_reset(self.mesh, sw_reset), donate_argnums=0)
-        self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset), donate_argnums=0)
+        self._sw_peek = jax.jit(build_sharded_peek(self.mesh, sw_peek_p))
+        self._tb_peek = jax.jit(build_sharded_peek(self.mesh, tb_peek_p))
+        self._sw_reset = jax.jit(build_sharded_reset(self.mesh, sw_reset_p), donate_argnums=0)
+        self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset_p), donate_argnums=0)
+
+    # -- i64 field view (checkpoint/compat) ------------------------------------
+    @property
+    def sw_state(self):
+        return sw_unpack_state(self.sw_packed)
+
+    @sw_state.setter
+    def sw_state(self, state) -> None:
+        self.sw_packed = jax.device_put(
+            sw_pack_state(type(state)(*(jnp.asarray(f) for f in state))),
+            self._state_sharding)
+
+    @property
+    def tb_state(self):
+        return tb_unpack_state(self.tb_packed)
+
+    @tb_state.setter
+    def tb_state(self, state) -> None:
+        self.tb_packed = jax.device_put(
+            tb_pack_state(type(state)(*(jnp.asarray(f) for f in state))),
+            self._state_sharding)
 
     def make_slot_index(self) -> ShardedSlotIndex:
         return ShardedSlotIndex(self.slots_per_shard, self.n_shards)
@@ -244,10 +270,10 @@ class ShardedDeviceEngine:
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         with self._lock:
             new_state, out, totals = self._sw_step(
-                self.sw_state, self.table.device_arrays,
+                self.sw_packed, self.table.device_arrays,
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
                 jnp.int64(now_ms))
-            self.sw_state = new_state
+            self.sw_packed = new_state
             totals = np.asarray(totals)
             self.last_step_totals = (int(totals[0]), int(totals[1]))
             return {
@@ -261,10 +287,10 @@ class ShardedDeviceEngine:
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         with self._lock:
             new_state, out, totals = self._tb_step(
-                self.tb_state, self.table.device_arrays,
+                self.tb_packed, self.table.device_arrays,
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
                 jnp.int64(now_ms))
-            self.tb_state = new_state
+            self.tb_packed = new_state
             totals = np.asarray(totals)
             self.last_step_totals = (int(totals[0]), int(totals[1]))
             return {
@@ -279,7 +305,7 @@ class ShardedDeviceEngine:
         lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
         mat = np.maximum(mat, 0)  # peek clamps; padding read is discarded
         with self._lock:
-            out = self._sw_peek(self.sw_state, self.table.device_arrays,
+            out = self._sw_peek(self.sw_packed, self.table.device_arrays,
                                 jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
         return np.asarray(out)[shard, cols]
 
@@ -289,20 +315,20 @@ class ShardedDeviceEngine:
         lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
         mat = np.maximum(mat, 0)
         with self._lock:
-            out = self._tb_peek(self.tb_state, self.table.device_arrays,
+            out = self._tb_peek(self.tb_packed, self.table.device_arrays,
                                 jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
         return np.asarray(out)[shard, cols]
 
     def sw_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
         with self._lock:
-            self.sw_state = self._sw_reset(self.sw_state, jnp.asarray(mat))
+            self.sw_packed = self._sw_reset(self.sw_packed, jnp.asarray(mat))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
         with self._lock:
-            self.tb_state = self._tb_reset(self.tb_state, jnp.asarray(mat))
+            self.tb_packed = self._tb_reset(self.tb_packed, jnp.asarray(mat))
 
     def block_until_ready(self) -> None:
         with self._lock:
-            jax.block_until_ready((self.sw_state, self.tb_state))
+            jax.block_until_ready((self.sw_packed, self.tb_packed))

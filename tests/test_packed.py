@@ -167,6 +167,17 @@ def test_stream_ids_unit_permits_sliding_window():
     np.testing.assert_array_equal(got, expect)
 
 
+def test_tb_drain_at_epoch_zero_stays_drained(table):
+    """A bucket drained at now=0 must NOT alias the absent-key sentinel and
+    refill instantly (regression: last_refill clamps to >= 1)."""
+    engine = DeviceEngine(num_slots=64, table=table)
+    # lid 2: cap 10, refill 5/s -> 0.005/ms
+    out = engine.tb_acquire([3], [2], [10], 0)       # drain all 10 at t=0
+    assert out["allowed"][0]
+    out = engine.tb_acquire([3], [2], [10], 5)       # 5 ms later: ~0 tokens
+    assert not out["allowed"][0]
+
+
 def test_stream_ids_tail_padding():
     """Stream length not a multiple of k*b: tail decided correctly."""
     cfg = RateLimitConfig(max_permits=2, window_ms=1000,
