@@ -36,7 +36,13 @@ from typing import Dict, Optional
 import numpy as np
 
 
-FORMAT_VERSION = 1
+# v1: TBState carried a stored deadline array; v2 derives it from
+# last_refill + 2*window and drops the lane. Restore iterates the CURRENT
+# field set, so v1 checkpoints load in v2 binaries (the extra tb_deadline
+# array is ignored); v2 checkpoints refuse to load in v1 binaries via the
+# version check rather than failing on a missing array.
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 
 def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
@@ -87,7 +93,7 @@ def save_checkpoint(path: str, engine, index_dump: Optional[Dict] = None) -> Non
 def load_checkpoint(path: str) -> Dict:
     with open(os.path.join(path, "index.json")) as fh:
         meta = json.load(fh)
-    if meta.get("format") != FORMAT_VERSION:
+    if meta.get("format") not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported checkpoint format: {meta.get('format')}")
     data = np.load(os.path.join(path, "state.npz"))
     return {"meta": meta, "arrays": dict(data)}
