@@ -156,35 +156,35 @@ def main() -> None:
     log(f"  stream: {res['decisions_per_sec']:,.0f} decisions/s")
     storage3.close()
 
-    # -- scenario 4: 100K-tenant multi-config mix (fused engine path) --------
+    # -- scenario 4: 100K-tenant multi-config mix (multi-lid stream) ---------
     n_tenants = 1000 if small else 100_000
-    n4 = 200_000 if small else 2_000_000
-    batch4 = 4096 if small else 65_536
-    log(f"scenario 4: {n_tenants}-tenant mix...")
+    n4 = super_n * (2 if small else 3)
+    log(f"scenario 4: {n_tenants}-tenant mix (stream)...")
     table = LimiterTable(capacity=n_tenants + 2)
     lids = np.asarray(
         [table.register(RateLimitConfig(
             max_permits=50 + (i % 100), window_ms=60_000,
             refill_rate=float(5 + i % 20)))
-         for i in range(n_tenants)], dtype=np.int32)
-    engine4 = DeviceEngine(num_slots=max(n_tenants * 8, 1 << 16), table=table)
+         for i in range(n_tenants)], dtype=np.int64)
+    storage4 = TpuBatchedStorage(
+        engine=DeviceEngine(num_slots=max(n_tenants * 8, 1 << 16), table=table))
     tenant_of_req = rng.integers(0, n_tenants, size=n4)
-    slots4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
-    fn_lids = lids[tenant_of_req]
-    n4b = (n4 // batch4) * batch4
-    engine4.tb_acquire(slots4[:batch4], fn_lids[:batch4],
-                       np.ones(batch4, dtype=np.int64), 1_752_999_999_000)
-    engine4.block_until_ready()
+    # ~8 user keys per tenant, per-request tenant policy.
+    keys4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
+    lids4 = lids[tenant_of_req]
+    storage4.acquire_stream_ids("tb", lids4[:super_n], keys4[:super_n],
+                                batch=B, subbatches=K)
     t0_all = time.perf_counter()
-    for i in range(0, n4b, batch4):
-        engine4.tb_acquire(slots4[i:i + batch4], fn_lids[i:i + batch4],
-                           np.ones(batch4, dtype=np.int64), 1_753_000_000_000 + i)
+    allowed4 = storage4.acquire_stream_ids("tb", lids4, keys4,
+                                           batch=B, subbatches=K)
     wall = time.perf_counter() - t0_all
-    detail["multi_tenant_100k_engine"] = {
-        "mode": "engine", "decisions": n4b, "wall_s": wall,
-        "decisions_per_sec": n4b / wall, "tenants": n_tenants,
+    detail["multi_tenant_100k_stream"] = {
+        "mode": "stream_ids_multi", "decisions": n4, "wall_s": wall,
+        "decisions_per_sec": n4 / wall, "tenants": n_tenants,
+        "allowed": int(allowed4.sum()),
     }
-    log(f"  engine: {n4b / wall:,.0f} decisions/s")
+    log(f"  stream: {n4 / wall:,.0f} decisions/s")
+    storage4.close()
 
     # -- scenario 5: burst batch-acquire over 1M keys (streaming) ------------
     num_keys5 = 20_000 if small else 1_000_000

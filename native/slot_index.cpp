@@ -242,6 +242,21 @@ void rl_index_assign_ints(void* h, const int64_t* keys, int64_t n,
   }
 }
 
+// Batch assign for int64 keys with PER-REQUEST seeds (multi-tenant batches:
+// seed = limiter id, so the namespace is identical to per-lid scalar calls).
+void rl_index_assign_ints_multi(void* h, const int64_t* keys,
+                                const uint64_t* seeds, int64_t n,
+                                int32_t* out_slots, int32_t* out_evicted) {
+  Index* ix = static_cast<Index*>(h);
+  ix->gen++;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    hash_int(keys[i], seeds[i], h1, h2);
+    int64_t ev = assign_hashed(ix, h1, h2, &out_slots[i]);
+    out_evicted[i] = static_cast<int32_t>(ev);
+  }
+}
+
 // Batch assign for string keys packed as bytes + offsets (offsets[n] entries
 // of start positions, key i = data[offsets[i]..offsets[i+1])).
 void rl_index_assign_bytes(void* h, const uint8_t* data, const int64_t* offsets,

@@ -51,6 +51,9 @@ def _load_library():
         lib.rl_index_assign_ints.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_ints_multi.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
         lib.rl_index_assign_bytes.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
@@ -182,6 +185,31 @@ class NativeSlotIndex:
             try:
                 self._lib.rl_index_assign_ints(
                     self._h, keys.ctypes.data, n, int(lid),
+                    out_slots.ctypes.data, out_ev.ctypes.data)
+            finally:
+                for s in pins:
+                    self._lib.rl_index_unpin(self._h, s)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_slots, out_ev[out_ev >= 0]
+
+    def assign_batch_ints_multi(self, keys: np.ndarray, lids: np.ndarray,
+                                pinned: Optional[Set[int]] = None):
+        """Assign slots for an int64 key batch with per-request limiter ids
+        in one C call.  Same key namespace as per-lid assign_batch_ints —
+        (lid, key) maps to the same slot whichever path touches it first."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        seeds = np.ascontiguousarray(lids, dtype=np.uint64)
+        n = len(keys)
+        out_slots = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        pins = list(pinned) if pinned else []
+        with self._lock:
+            for s in pins:
+                self._lib.rl_index_pin(self._h, s)
+            try:
+                self._lib.rl_index_assign_ints_multi(
+                    self._h, keys.ctypes.data, seeds.ctypes.data, n,
                     out_slots.ctypes.data, out_ev.ctypes.data)
             finally:
                 for s in pins:

@@ -212,7 +212,7 @@ class TpuBatchedStorage(RateLimitStorage):
     def acquire_stream_ids(
         self,
         algo: str,
-        lid: int,
+        lid,
         key_ids: np.ndarray,
         permits: np.ndarray | None = None,
         *,
@@ -229,18 +229,46 @@ class TpuBatchedStorage(RateLimitStorage):
         latency overlaps device compute.  Decisions are identical to
         ``acquire_many_ids`` called per sub-batch (tests/test_packed.py).
 
-        ``permits=None`` means one permit per request (the permits upload is
-        skipped; the device materializes ones).  Returns bool[n] allowed.
+        ``lid`` is either one limiter id for the whole stream (the device
+        reads that policy row once — zero table gathers) or an int array of
+        per-request limiter ids (multi-tenant stream).  Both modes index a
+        bucket under the same (lid, key) namespace as ``acquire_many_ids``
+        and ``acquire``, so paths can be mixed freely.  ``permits=None``
+        means one permit per request (the permits upload is skipped; the
+        device materializes ones).  Returns bool[n] allowed.
         """
+        multi_lid = np.ndim(lid) != 0
+        if multi_lid:
+            lid_arr = np.ascontiguousarray(lid, dtype=np.int64)
+            if lid_arr.size and ((lid_arr < 0) | (lid_arr >= len(self.table))).any():
+                raise ValueError("limiter ids out of range")
+
         index = self._index[algo]
         if not hasattr(index, "assign_batch_ints"):
             # Python-index fallback: plain per-batch path, same decisions.
-            out = np.empty(len(key_ids), dtype=bool)
-            p = np.ones(len(key_ids), dtype=np.int64) if permits is None \
+            n = len(key_ids)
+            out = np.empty(n, dtype=bool)
+            p = np.ones(n, dtype=np.int64) if permits is None \
                 else np.asarray(permits)
-            for i in range(0, len(key_ids), batch):
-                out[i:i + batch] = self.acquire_many_ids(
-                    algo, lid, key_ids[i:i + batch], p[i:i + batch])["allowed"]
+            for i in range(0, n, batch):
+                chunk = key_ids[i:i + batch]
+                if multi_lid:
+                    chunk_lids = lid_arr[i:i + batch]
+                    pinned = self._batcher.pending_slots(algo)
+                    slots, clears = [], []
+                    for l, k in zip(chunk_lids, chunk):
+                        s, ev = index.assign((int(l), int(k)), pinned=pinned)
+                        if ev is not None:
+                            clears.append(ev)
+                        pinned.add(s)
+                        slots.append(s)
+                    res = self._batcher.dispatch_direct(
+                        algo, slots, list(chunk_lids), list(p[i:i + batch]),
+                        clears)
+                    out[i:i + batch] = res["allowed"]
+                else:
+                    out[i:i + batch] = self.acquire_many_ids(
+                        algo, lid, chunk, p[i:i + batch])["allowed"]
             return out
 
         self._batcher.flush()
@@ -269,13 +297,27 @@ class TpuBatchedStorage(RateLimitStorage):
         for start in range(0, n, super_n):
             chunk = key_ids[start:start + super_n]
             cn = len(chunk)
-            slots, clears = index.assign_batch_ints(
-                chunk, lid, pinned=self._batcher.pending_slots(algo))
+            if multi_lid:
+                slots, clears = index.assign_batch_ints_multi(
+                    chunk, lid_arr[start:start + cn],
+                    pinned=self._batcher.pending_slots(algo))
+            else:
+                slots, clears = index.assign_batch_ints(
+                    chunk, lid, pinned=self._batcher.pending_slots(algo))
             if len(clears):
                 clear(list(clears))
             if cn < super_n:
                 slots = np.concatenate(
                     [slots, np.full(super_n - cn, -1, dtype=np.int32)])
+            if multi_lid:
+                l_chunk = np.ascontiguousarray(
+                    lid_arr[start:start + cn], dtype=np.int32)
+                if cn < super_n:
+                    l_chunk = np.concatenate(
+                        [l_chunk, np.zeros(super_n - cn, dtype=np.int32)])
+                lid_kb = l_chunk.reshape(k, b)
+            else:
+                lid_kb = lid
             p_kb = None
             if permits is not None:
                 p_chunk = np.ascontiguousarray(
@@ -286,7 +328,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 p_kb = p_chunk.reshape(k, b)
             now = self._monotonic_now()
             t0 = time.perf_counter()
-            bits = dispatch(slots.reshape(k, b), lid, p_kb,
+            bits = dispatch(slots.reshape(k, b), lid_kb, p_kb,
                             np.full(k, now, dtype=np.int64))
             pending.append((start, cn, bits, t0))
             if len(pending) > 1:

@@ -167,6 +167,70 @@ def test_stream_ids_unit_permits_sliding_window():
     np.testing.assert_array_equal(got, expect)
 
 
+def test_stream_ids_multi_tenant_matches_batched():
+    """Per-request limiter ids through the stream path == the string-keyed
+    mixed-lid acquire_many path on the same request sequence."""
+    rng = np.random.default_rng(6)
+    clock = lambda: 77_000  # noqa: E731
+    cfg_a = RateLimitConfig(max_permits=5, window_ms=1000, refill_rate=2.0)
+    cfg_b = RateLimitConfig(max_permits=10, window_ms=1000, refill_rate=5.0)
+
+    n, b = 200, 25
+    keys = rng.integers(0, 20, n).astype(np.int64)
+    permits = rng.integers(1, 3, n).astype(np.int64)
+
+    s1 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid_a1 = s1.register_limiter("tb", cfg_a)
+    lid_b1 = s1.register_limiter("tb", cfg_b)
+    lids1 = np.where(keys % 2 == 0, lid_a1, lid_b1).astype(np.int64)
+    expect = np.empty(n, dtype=bool)
+    for i in range(0, n, b):
+        expect[i:i + b] = s1.acquire_many(
+            "tb", list(lids1[i:i + b]),
+            [f"u{k}" for k in keys[i:i + b]],
+            list(permits[i:i + b]))["allowed"]
+    s1.close()
+
+    s2 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid_a2 = s2.register_limiter("tb", cfg_a)
+    lid_b2 = s2.register_limiter("tb", cfg_b)
+    assert (lid_a2, lid_b2) == (lid_a1, lid_b1)
+    lids2 = np.where(keys % 2 == 0, lid_a2, lid_b2).astype(np.int64)
+    got = s2.acquire_stream_ids("tb", lids2, keys, permits,
+                                batch=b, subbatches=2)
+    s2.close()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_stream_multi_lid_shares_namespace_with_scalar_paths():
+    """The multi-lid stream and acquire_many_ids address the SAME (lid, key)
+    bucket — consuming via one path is visible to the other."""
+    clock = lambda: 33_000  # noqa: E731
+    s = TpuBatchedStorage(num_slots=128, clock_ms=clock)
+    lid = s.register_limiter("tb", RateLimitConfig(
+        max_permits=10, window_ms=1000, refill_rate=1.0))
+    # Drain 10 tokens of key 7 via the scalar path.
+    out = s.acquire_many_ids("tb", lid, np.asarray([7]), np.asarray([10]))
+    assert out["allowed"][0]
+    # The multi-lid stream must see the empty bucket, not a fresh one.
+    got = s.acquire_stream_ids(
+        "tb", np.asarray([lid]), np.asarray([7]), np.asarray([10]),
+        batch=8, subbatches=1)
+    s.close()
+    assert not got[0]
+
+
+def test_stream_multi_lid_rejects_bad_lids():
+    s = TpuBatchedStorage(num_slots=64)
+    s.register_limiter("tb", RateLimitConfig(
+        max_permits=5, window_ms=1000, refill_rate=1.0))
+    with pytest.raises(ValueError):
+        s.acquire_stream_ids("tb", np.asarray([99]), np.asarray([1]))
+    with pytest.raises(ValueError):
+        s.acquire_stream_ids("tb", np.asarray([-1]), np.asarray([1]))
+    s.close()
+
+
 def test_tb_drain_at_epoch_zero_stays_drained(table):
     """A bucket drained at now=0 must NOT alias the absent-key sentinel and
     refill instantly (regression: last_refill clamps to >= 1)."""
