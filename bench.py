@@ -39,13 +39,10 @@ def log(msg: str) -> None:
 def main() -> None:
     import jax
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+    from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
     platform = jax.devices()[0].platform
     scale = os.environ.get("BENCH_SCALE") or ("full" if platform == "tpu" else "small")

@@ -37,6 +37,44 @@ class AppContext:
         self.storage.close()
 
 
+def warmup_shapes(storage: RateLimitStorage, max_batch: int = 8192) -> None:
+    """Compile the hot dispatch shapes before traffic arrives.
+
+    A cold service otherwise spends its first requests inside 40-90 s jit
+    compiles, during which token buckets legitimately refill — confusing
+    and latency-hostile.  Padding-only batches (slot -1) compile the exact
+    shapes the micro-batcher uses without touching any real slot state.
+
+    Warms the smallest bucket (single requests) and the full-flush bucket;
+    intermediate power-of-two buckets compile on demand (or come from the
+    persistent cache).  Each call is independently best-effort — e.g. the
+    sharded router rejects padding-only batches, but its peeks still warm.
+    """
+    engine = getattr(storage, "engine", None)
+    if engine is None:
+        return
+    now = 1  # any positive stamp; padding batches never write state
+    calls = [
+        lambda: engine.sw_acquire([-1], [0], [1], now),
+        lambda: engine.tb_acquire([-1], [0], [1], now),
+        lambda: engine.sw_acquire([-1] * max_batch, [0] * max_batch,
+                                  [1] * max_batch, now),
+        lambda: engine.tb_acquire([-1] * max_batch, [0] * max_batch,
+                                  [1] * max_batch, now),
+        lambda: engine.sw_available([0], [0], now),
+        lambda: engine.tb_available([0], [0], now),
+    ]
+    for call in calls:
+        try:
+            call()
+        except Exception:  # noqa: BLE001 — warmup is best-effort
+            pass
+    try:
+        engine.block_until_ready()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage:
     backend = (props.get("storage.backend") or "tpu").lower()
     if backend == "memory":
@@ -72,8 +110,15 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
 def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
+    from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(props.get("jax.cache.dir"))
     registry = MeterRegistry()
+    own_storage = storage is None
     storage = storage or build_storage(props, meter_registry=registry)
+    if own_storage and props.get_bool("warmup.enabled", True):
+        warmup_shapes(storage,
+                      max_batch=props.get_int("batcher.max_batch", 8192))
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache
