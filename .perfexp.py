@@ -1,0 +1,27 @@
+import os, sys, time, numpy as np
+from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache("/root/repo/.jax_cache")
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import TokenBucketRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage import TpuBatchedStorage
+from ratelimiter_tpu.bench.harness import zipf_stream
+
+rng = np.random.default_rng(42)
+num_keys = 1_000_000
+for B, K in [(1 << 19, 8), (1 << 20, 8), (1 << 19, 16)]:
+    storage = TpuBatchedStorage(num_slots=2_000_000)
+    tb = TokenBucketRateLimiter(storage, RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0), MeterRegistry())
+    n = B * K * 2
+    ids = zipf_stream(rng, num_keys, n)
+    t0 = time.perf_counter()
+    tb.try_acquire_stream_ids(ids[:B * K], batch=B, subbatches=K)
+    c = time.perf_counter() - t0
+    best = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        tb.try_acquire_stream_ids(ids, batch=B, subbatches=K)
+        best = max(best, n / (time.perf_counter() - t0))
+    print(f"B={B} K={K} pallas={os.environ.get('RATELIMITER_PALLAS','0')}: "
+          f"compile {c:.0f}s, best {best/1e6:.2f}M/s", flush=True)
+    storage.close()

@@ -1,9 +1,18 @@
-# ratelimiter_tpu service image (C16 parity: the reference ships a two-stage
-# JVM build; a Python/JAX service needs no build stage — the "compile" happens
-# at first jit, cached via a warmed persistent compilation cache layer).
+# ratelimiter_tpu service image (C16 parity: two-stage like the reference's
+# maven -> JRE build — here a g++ stage compiles the native slot index and a
+# slim runtime serves; jit "compilation" happens at boot warmup and persists
+# via the compilation cache).
 #
 # For TPU hosts, swap the base image for one with libtpu and run with
 # --privileged (or the TPU device plugin under Kubernetes).
+
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /build
+COPY native/ native/
+RUN make -B -C native ARCH=x86-64-v2
+
 FROM python:3.12-slim
 
 RUN useradd --create-home ratelimiter
@@ -14,6 +23,7 @@ WORKDIR /app
 RUN pip install --no-cache-dir "jax[cpu]" numpy
 
 COPY ratelimiter_tpu/ ratelimiter_tpu/
+COPY --from=native-build /build/native/libslotindex.so native/
 COPY application.properties .
 
 USER ratelimiter

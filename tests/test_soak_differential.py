@@ -87,6 +87,55 @@ def test_soak_token_bucket(seed):
             assert out["remaining"][j] == d.remaining_hint, (seed, step, j)
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_mixed_paths_vs_oracle(seed):
+    """Interleave every storage decision path — single acquire, batched
+    string keys, int-key batches, and the pipelined stream — on ONE storage
+    against the oracle.  All paths must address the same buckets and agree
+    with the sequential semantics."""
+    import numpy as np
+
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    rng = random.Random(300 + seed)
+    win = 2000
+    cfg = RateLimitConfig(max_permits=20, window_ms=win, refill_rate=10.0)
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=128,
+                                clock_ms=lambda: clock["t"])
+    lid = storage.register_limiter("tb", cfg)
+    oracle = TokenBucketOracle(cfg)
+
+    n_keys = 5
+    for step in range(60):
+        clock["t"] += biased_dt(rng, win)
+        now = clock["t"]
+        mode = rng.randrange(3)
+        n = rng.randrange(1, 10)
+        key_ids = [rng.randrange(n_keys) for _ in range(n)]
+        perms = [rng.choice([1, 2, 5, 21]) for _ in range(n)]
+        if mode == 0:
+            # String-key path — its own bucket family ("s:K" != int K).
+            got = [storage.acquire("tb", lid, f"s:{k}", p)["allowed"]
+                   for k, p in zip(key_ids, perms)]
+            okeys = [f"s:{k}" for k in key_ids]
+        elif mode == 1:
+            # Int-key batch — same buckets as the stream path.
+            got = storage.acquire_many_ids(
+                "tb", lid, np.asarray(key_ids),
+                np.asarray(perms))["allowed"]
+            okeys = [f"int:{k}" for k in key_ids]
+        else:
+            got = storage.acquire_stream_ids(
+                "tb", np.full(n, lid), np.asarray(key_ids),
+                np.asarray(perms), batch=16, subbatches=1)
+            okeys = [f"int:{k}" for k in key_ids]
+        for j in range(n):
+            d = oracle.try_acquire(okeys[j], perms[j], now)
+            assert bool(got[j]) == d.allowed, (seed, step, j, mode)
+    storage.close()
+
+
 def test_monotonic_stamp_guards_clock_regression():
     """A wall clock stepping backwards must not zero live windows."""
     from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter
