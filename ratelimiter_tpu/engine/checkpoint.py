@@ -44,6 +44,13 @@ import numpy as np
 FORMAT_VERSION = 2
 SUPPORTED_VERSIONS = (1, 2)
 
+# Identity of the key->shard routing hash used by sharded indexes
+# (parallel/sharded.py:shard_of_key): crc32-of-repr for string keys,
+# splitmix64 for int keys.  Stored in sharded index dumps so a restore into
+# a binary with a different routing function fails loudly instead of
+# silently orphaning entries.
+SHARD_HASH_VERSION = "crc32-repr/splitmix64-v1"
+
 
 def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
     """Materialize the device state to host numpy (one blocking transfer)."""
@@ -153,12 +160,23 @@ def dump_slot_indexes(storage) -> Dict:
         if hasattr(index, "_map"):
             out["algos"][algo] = {"kind": "flat", "entries": _dump_flat(index)}
         elif hasattr(index, "_sub"):
+            if not all(hasattr(s, "_map") for s in index._sub):
+                raise ValueError(
+                    "native slot sub-indexes are not enumerable; construct "
+                    "the storage with checkpointable=True to use Python subs")
             base = index.slots_per_shard
             entries = []
             for shard, sub in enumerate(index._sub):
                 for key, local in _dump_flat(sub):
                     entries.append([key, shard * base + local])
-            out["algos"][algo] = {"kind": "sharded", "entries": entries}
+            out["algos"][algo] = {
+                "kind": "sharded",
+                # Key->shard hash identity: a restore into a binary with a
+                # different shard hash would silently orphan every entry
+                # (lookups would miss the restored shard), so it is refused.
+                "shard_hash": SHARD_HASH_VERSION,
+                "entries": entries,
+            }
         else:
             raise ValueError(
                 "native slot index is not enumerable; construct the storage "
@@ -170,6 +188,13 @@ def restore_slot_indexes(storage, dump: Dict) -> None:
     for algo, payload in dump.get("algos", {}).items():
         index = storage._index[algo]
         entries = payload["entries"]
+        if payload.get("kind") == "sharded":
+            stored_hash = payload.get("shard_hash", SHARD_HASH_VERSION)
+            if stored_hash != SHARD_HASH_VERSION:
+                raise ValueError(
+                    f"checkpoint used shard hash {stored_hash!r}; this "
+                    f"binary routes with {SHARD_HASH_VERSION!r} — restoring "
+                    "would orphan every entry (export/import per key instead)")
         if hasattr(index, "_map"):
             _restore_flat(index, entries)
         elif hasattr(index, "_sub"):

@@ -57,9 +57,27 @@ def _bucket(n: int) -> int:
     return size
 
 
+def shard_of_int_keys(key_ids, n_shards: int):
+    """Vectorized deterministic shard hash for int64 user keys (splitmix64
+    finalizer).  The scalar path routes int keys through this same function,
+    so stream and scalar calls always agree on a key's shard."""
+    x = np.asarray(key_ids).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(n_shards)).astype(np.int64)
+
+
 def shard_of_key(key, n_shards: int) -> int:
-    """Deterministic, process-independent key -> shard hash (crc32), so a
-    multi-host router and this engine always agree."""
+    """Deterministic, process-independent key -> shard hash, so a multi-host
+    router and this engine always agree.  Int user keys use the vectorizable
+    splitmix hash (same as the stream path); everything else uses crc32 of
+    the repr."""
+    user = key[1] if isinstance(key, tuple) and len(key) == 2 else key
+    if isinstance(user, (int, np.integer)):
+        return int(shard_of_int_keys(np.asarray([user]), n_shards)[0])
     return zlib.crc32(repr(key).encode()) % n_shards
 
 
@@ -70,11 +88,24 @@ class ShardedSlotIndex:
     shard-local (a key's state never migrates between shards).
     """
 
-    def __init__(self, slots_per_shard: int, n_shards: int):
+    def __init__(self, slots_per_shard: int, n_shards: int,
+                 native: bool = True):
         self.slots_per_shard = int(slots_per_shard)
         self.n_shards = int(n_shards)
         self.num_slots = self.slots_per_shard * self.n_shards
-        self._sub = [SlotIndex(self.slots_per_shard) for _ in range(self.n_shards)]
+        sub_cls = SlotIndex
+        if native:
+            from ratelimiter_tpu.engine.native_index import (
+                NativeSlotIndex,
+                native_available,
+            )
+
+            if native_available():
+                sub_cls = NativeSlotIndex
+        self._sub = [sub_cls(self.slots_per_shard) for _ in range(self.n_shards)]
+        # The sharded stream path needs per-shard vectorized assignment.
+        self.supports_batch_ints = all(
+            hasattr(s, "assign_batch_ints") for s in self._sub)
 
     def _split(self, global_slot: int):
         return divmod(global_slot, self.slots_per_shard)
@@ -147,6 +178,41 @@ def build_sharded_tb_step(mesh):
     )
 
 
+def build_sharded_scan(mesh, step_p, lids_scalar: bool, has_permits: bool):
+    """shard_map'd K-sub-batch scan with bit-packed decisions.
+
+    Shapes: state (n_shards, S_local, L) packed; slots (n_shards, K, B);
+    lids 0-d or (n_shards, K, B); permits None or (n_shards, K, B);
+    now (K,).  Returns (state, bits (n_shards, K, ceil(B/8))).
+    """
+    from ratelimiter_tpu.ops.packed import _scan
+
+    lid_spec = P() if lids_scalar else P(SHARD_AXIS)
+    if has_permits:
+        def local_scan(state, table, slots, lids, permits, now):
+            st, bits = _scan(step_p, state[0], table, slots[0],
+                             lids if lids_scalar else lids[0],
+                             permits[0], now)
+            return st[None], bits[None]
+
+        in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec,
+                    P(SHARD_AXIS), P())
+    else:
+        def local_scan(state, table, slots, lids, now):
+            st, bits = _scan(step_p, state[0], table, slots[0],
+                             lids if lids_scalar else lids[0],
+                             None, now)
+            return st[None], bits[None]
+
+        in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P())
+    return jax.shard_map(
+        local_scan,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+
+
 def build_sharded_peek(mesh, peek_fn):
     def local_peek(state, table, slots, lids, now):
         out = peek_fn(state[0], table, slots[0], lids[0], now)
@@ -212,6 +278,7 @@ class ShardedDeviceEngine:
         self._tb_peek = jax.jit(build_sharded_peek(self.mesh, tb_peek_p))
         self._sw_reset = jax.jit(build_sharded_reset(self.mesh, sw_reset_p), donate_argnums=0)
         self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset_p), donate_argnums=0)
+        self._scan_fns = {}
 
     # -- i64 field view (checkpoint/compat) ------------------------------------
     @property
@@ -236,6 +303,53 @@ class ShardedDeviceEngine:
 
     def make_slot_index(self) -> ShardedSlotIndex:
         return ShardedSlotIndex(self.slots_per_shard, self.n_shards)
+
+    # -- scan dispatch (sharded streaming; mirrors DeviceEngine's) ------------
+    def sw_scan_dispatch(self, slots_skb, lids, permits_skb, now_k):
+        return self._scan_dispatch("sw", slots_skb, lids, permits_skb, now_k)
+
+    def tb_scan_dispatch(self, slots_skb, lids, permits_skb, now_k):
+        return self._scan_dispatch("tb", slots_skb, lids, permits_skb, now_k)
+
+    def _scan_fn(self, algo: str, lids_scalar: bool, has_permits: bool):
+        key = (algo, lids_scalar, has_permits)
+        fn = self._scan_fns.get(key)
+        if fn is None:
+            step_p = sw_step_p if algo == "sw" else tb_step_p
+            fn = jax.jit(
+                build_sharded_scan(self.mesh, step_p, lids_scalar, has_permits),
+                donate_argnums=0)
+            self._scan_fns[key] = fn
+        return fn
+
+    def _scan_dispatch(self, algo, slots_skb, lids, permits_skb, now_k):
+        """slots_skb: i32[n_shards, K, B_local] LOCAL slot ids (-1 padding);
+        lids: scalar or i32[n_shards, K, B_local]; permits likewise or None;
+        now_k: i64[K].  Returns a lazy uint8[n_shards, K, ceil(B/8)] handle."""
+        slots_skb = jnp.asarray(np.ascontiguousarray(slots_skb, dtype=np.int32))
+        lids_scalar = np.ndim(lids) == 0
+        if lids_scalar:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        has_permits = permits_skb is not None
+        now_k = jnp.asarray(np.ascontiguousarray(now_k, dtype=np.int64))
+        fn = self._scan_fn(algo, lids_scalar, has_permits)
+        with self._lock:
+            state = self.sw_packed if algo == "sw" else self.tb_packed
+            if has_permits:
+                permits_skb = jnp.asarray(
+                    np.ascontiguousarray(permits_skb, dtype=np.int32))
+                state, bits = fn(state, self.table.device_arrays,
+                                 slots_skb, lids, permits_skb, now_k)
+            else:
+                state, bits = fn(state, self.table.device_arrays,
+                                 slots_skb, lids, now_k)
+            if algo == "sw":
+                self.sw_packed = state
+            else:
+                self.tb_packed = state
+        return bits
 
     # -- routing --------------------------------------------------------------
     def _route(self, slots, fill_extra=None):

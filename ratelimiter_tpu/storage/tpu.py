@@ -75,13 +75,21 @@ class TpuBatchedStorage(RateLimitStorage):
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
-        # checkpointable=True swaps a fingerprint-only native index for the
-        # enumerable Python one so the key->slot map can be snapshotted
-        # (engine/checkpoint.py); sharded indexes are already enumerable.
+        # checkpointable=True swaps fingerprint-only native (sub-)indexes
+        # for enumerable Python ones so the key->slot map can be snapshotted
+        # (engine/checkpoint.py).
         def make_index():
             index = self.engine.make_slot_index()
-            if checkpointable and not hasattr(index, "_map") \
-                    and not hasattr(index, "_sub"):
+            if not checkpointable:
+                return index
+            if hasattr(index, "_sub"):
+                if not all(hasattr(s, "_map") for s in index._sub):
+                    # Native sub-indexes are fingerprint-only; checkpoints
+                    # need the enumerable Python subs.
+                    index = type(index)(index.slots_per_shard,
+                                        index.n_shards, native=False)
+                return index
+            if not hasattr(index, "_map"):
                 from ratelimiter_tpu.engine.slots import SlotIndex
 
                 index = SlotIndex(self.engine.num_slots)
@@ -244,6 +252,14 @@ class TpuBatchedStorage(RateLimitStorage):
                 raise ValueError("limiter ids out of range")
 
         index = self._index[algo]
+        if hasattr(index, "_sub") and getattr(index, "supports_batch_ints", False):
+            # Sharded engine: route keys to shards host-side, one shard_map'd
+            # scan dispatch per super-batch, zero cross-shard device traffic.
+            self._batcher.flush()
+            return self._stream_sharded(
+                algo, lid, np.ascontiguousarray(key_ids, dtype=np.int64),
+                permits, batch, subbatches, index, multi_lid,
+                lid_arr if multi_lid else None)
         if not hasattr(index, "assign_batch_ints"):
             # Python-index fallback: plain per-batch path, same decisions.
             n = len(key_ids)
@@ -336,6 +352,95 @@ class TpuBatchedStorage(RateLimitStorage):
                 drain(h0, s0, c0, pt0)
         for s0, c0, h0, pt0 in pending:
             drain(h0, s0, c0, pt0)
+        return out
+
+    def _stream_sharded(self, algo, lid, key_ids, permits, batch, subbatches,
+                        index, multi_lid, lid_arr) -> np.ndarray:
+        """Sharded-engine streaming: per-super-batch host routing (key ->
+        shard by the deterministic splitmix hash), per-shard native slot
+        assignment, one shard_map'd scan dispatch, pipelined bitmask fetch.
+        Decision semantics match the flat stream: sub-batch j of the chunk is
+        decided before sub-batch j+1, and duplicates within a (shard, j) row
+        keep arrival order."""
+        from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+        eng = self.engine
+        n_sh, sps = eng.n_shards, eng.slots_per_shard
+        k, b = int(subbatches), int(batch)
+        super_n = k * b
+        dispatch = (eng.sw_scan_dispatch if algo == "sw"
+                    else eng.tb_scan_dispatch)
+        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+        n = len(key_ids)
+        out = np.empty(n, dtype=bool)
+        pending: list = []
+
+        def drain(handle, start, cnt, shard, j, cols, b_loc, t0):
+            arr = np.asarray(handle)  # uint8[n_sh, k, b_loc//8]
+            dt_us = (time.perf_counter() - t0) * 1e6
+            bits = np.unpackbits(arr, axis=2)[:, :, :b_loc].astype(bool)
+            got = bits[shard, j, cols]
+            out[start:start + cnt] = got
+            if self._latency is not None:
+                self._latency.record_us(dt_us)
+            self.trace.record(algo, cnt, int(got.sum()), dt_us)
+
+        for start in range(0, n, super_n):
+            chunk = key_ids[start:start + super_n]
+            cn = len(chunk)
+            j = np.arange(cn) // b  # sub-batch of each request
+            shard = shard_of_int_keys(chunk, n_sh)
+            # Per-shard slot assignment (one C call each), chunk order kept.
+            local = np.empty(cn, dtype=np.int32)
+            clears: list = []
+            pins_global = self._batcher.pending_slots(algo)
+            for s in range(n_sh):
+                m = shard == s
+                if not m.any():
+                    continue
+                pins = {g % sps for g in pins_global if g // sps == s}
+                sub = index._sub[s]
+                if multi_lid:
+                    sl, ev = sub.assign_batch_ints_multi(
+                        chunk[m], lid_arr[start:start + cn][m], pinned=pins)
+                else:
+                    sl, ev = sub.assign_batch_ints(chunk[m], lid, pinned=pins)
+                local[m] = sl
+                clears.extend(s * sps + int(e) for e in ev)
+            if clears:
+                clear(clears)
+            # Column of each request within its (shard, sub-batch) row.
+            grp = j * n_sh + shard
+            order = np.argsort(grp, kind="stable")
+            counts = np.bincount(grp, minlength=n_sh * k)
+            offs = np.zeros(n_sh * k + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            cols = np.empty(cn, dtype=np.int64)
+            cols[order] = np.arange(cn) - offs[grp[order]]
+            from ratelimiter_tpu.parallel.sharded import _bucket
+
+            b_loc = _bucket(int(counts.max(initial=1)))
+            slots_mat = np.full((n_sh, k, b_loc), -1, dtype=np.int32)
+            slots_mat[shard, j, cols] = local
+            lid_kb = lid
+            if multi_lid:
+                lid_mat = np.zeros((n_sh, k, b_loc), dtype=np.int32)
+                lid_mat[shard, j, cols] = lid_arr[start:start + cn]
+                lid_kb = lid_mat
+            p_kb = None
+            if permits is not None:
+                p_mat = np.ones((n_sh, k, b_loc), dtype=np.int32)
+                p_mat[shard, j, cols] = permits[start:start + cn]
+                p_kb = p_mat
+            now = self._monotonic_now()
+            t0 = time.perf_counter()
+            bits = dispatch(slots_mat, lid_kb, p_kb,
+                            np.full(k, now, dtype=np.int64))
+            pending.append((bits, start, cn, shard, j, cols, b_loc, t0))
+            if len(pending) > 1:
+                drain(*pending.pop(0))
+        for item in pending:
+            drain(*item)
         return out
 
     def available_many(
