@@ -290,6 +290,50 @@ def test_stream_sharded_multi_lid_and_scalar_agree():
     assert not out["allowed"]
 
 
+def test_stream_concurrent_with_queued_acquires():
+    """A long-running stream must not evict slots out from under requests
+    concurrently queued in the micro-batcher (pin protection), and the
+    total admitted across both paths must respect every bucket's cap."""
+    import threading
+
+    clock = lambda: 44_000  # noqa: E731
+    cap = 10
+    s = TpuBatchedStorage(num_slots=512, clock_ms=clock, max_delay_ms=0.2)
+    lid = s.register_limiter("tb", RateLimitConfig(
+        max_permits=cap, window_ms=60_000, refill_rate=0.001))
+    rng = np.random.default_rng(9)
+
+    hot_allowed = []
+    stop = threading.Event()
+
+    def hammer():
+        # Single-key acquires through the batcher while the stream runs.
+        while not stop.is_set():
+            out = s.acquire("tb", lid, "hot", 1)
+            hot_allowed.append(bool(out["allowed"]))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    stream_allowed = 0
+    stream_n = 0
+    for _ in range(6):
+        ids = rng.integers(0, 200, 2000)
+        got = s.acquire_stream_ids("tb", lid, ids, None,
+                                   batch=256, subbatches=2)
+        stream_allowed += int(got.sum())
+        stream_n += len(ids)
+    stop.set()
+    for t in threads:
+        t.join()
+    s.close()
+    # The hot key (string namespace) has its own bucket: exactly cap allowed.
+    assert sum(hot_allowed) == cap, sum(hot_allowed)
+    # Stream buckets: every int key admits at most cap.
+    assert stream_allowed <= 200 * cap
+    assert stream_n == 12_000
+
+
 def test_tb_drain_at_epoch_zero_stays_drained(table):
     """A bucket drained at now=0 must NOT alias the absent-key sentinel and
     refill instantly (regression: last_refill clamps to >= 1)."""
