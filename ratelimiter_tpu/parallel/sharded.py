@@ -358,8 +358,10 @@ class ShardedDeviceEngine:
         Returns (mat_local_slots, row_of_req, col_of_req, B).
         """
         slots = np.asarray(slots, dtype=np.int64)
-        shard = slots // self.slots_per_shard
-        local = slots % self.slots_per_shard
+        # Padding slots (< 0, e.g. warmup batches) route to shard 0 as local
+        # padding: every kernel masks negative slots out.
+        shard = np.clip(slots, 0, None) // self.slots_per_shard
+        local = np.where(slots < 0, -1, slots % self.slots_per_shard)
         counts = np.bincount(shard, minlength=self.n_shards)
         B = _bucket(max(int(counts.max(initial=0)), 1))
         order = np.argsort(shard, kind="stable")

@@ -31,6 +31,10 @@ DEFAULTS = {
     "warmup.enabled": "true",
     # Persistent XLA compile-cache dir; empty -> ~/.cache/ratelimiter_tpu/jax.
     "jax.cache.dir": "",
+    # Chaos drill: inject StorageException on this fraction of storage ops
+    # (0 = off) and/or add latency to every op (fault-tolerance rehearsal).
+    "chaos.failure_rate": "0",
+    "chaos.latency_ms": "0",
 }
 
 

@@ -22,7 +22,12 @@ from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.core.limiter import RateLimiter
 from ratelimiter_tpu.metrics import MeterRegistry
 from ratelimiter_tpu.service.props import AppProperties
-from ratelimiter_tpu.storage import InMemoryStorage, RateLimitStorage, TpuBatchedStorage
+from ratelimiter_tpu.storage import (
+    FaultInjectingStorage,
+    InMemoryStorage,
+    RateLimitStorage,
+    TpuBatchedStorage,
+)
 
 
 @dataclasses.dataclass
@@ -47,8 +52,9 @@ def warmup_shapes(storage: RateLimitStorage, max_batch: int = 8192) -> None:
 
     Warms the smallest bucket (single requests) and the full-flush bucket;
     intermediate power-of-two buckets compile on demand (or come from the
-    persistent cache).  Each call is independently best-effort — e.g. the
-    sharded router rejects padding-only batches, but its peeks still warm.
+    persistent cache).  Each call is independently best-effort (padding-only
+    batches route as shard-0 padding on the sharded engine, so both engine
+    kinds warm their acquire and peek shapes).
     """
     engine = getattr(storage, "engine", None)
     if engine is None:
@@ -107,6 +113,16 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
     raise ValueError(f"unknown storage.backend: {backend!r}")
 
 
+def _maybe_chaos(storage: RateLimitStorage, props: AppProperties):
+    """Wrap the backend in the fault injector when a chaos drill is on."""
+    rate = props.get_float("chaos.failure_rate", 0.0)
+    latency = props.get_float("chaos.latency_ms", 0.0)
+    if rate <= 0 and latency <= 0:
+        return storage
+    return FaultInjectingStorage(storage, failure_rate=rate,
+                                 latency_ms=latency)
+
+
 def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
@@ -116,9 +132,11 @@ def build_app(props: AppProperties | None = None,
     registry = MeterRegistry()
     own_storage = storage is None
     storage = storage or build_storage(props, meter_registry=registry)
-    if own_storage and props.get_bool("warmup.enabled", True):
-        warmup_shapes(storage,
-                      max_batch=props.get_int("batcher.max_batch", 8192))
+    if own_storage:
+        if props.get_bool("warmup.enabled", True):
+            warmup_shapes(storage,
+                          max_batch=props.get_int("batcher.max_batch", 8192))
+        storage = _maybe_chaos(storage, props)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache

@@ -1,9 +1,11 @@
 from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.storage.chaos import FaultInjectingStorage
 from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
 from ratelimiter_tpu.storage.memory import InMemoryStorage
 from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
 __all__ = [
+    "FaultInjectingStorage",
     "RateLimitStorage",
     "InMemoryStorage",
     "TpuBatchedStorage",

@@ -1,0 +1,105 @@
+"""Fault-injecting storage wrapper (chaos testing).
+
+The reference has no fault injection at all (SURVEY.md §5.3 — its failure
+handling is asserted, not exercised). This wrapper makes failure paths
+first-class testable: it delegates to any ``RateLimitStorage`` and injects
+``StorageException`` (and optional latency) on a configurable schedule, so
+retry logic, fail-open policy, and metric accounting can be driven
+deterministically in tests and chaos drills.
+
+Determinism: failures come from a seeded RNG; ``fail_next(n)`` forces the
+next n operations to fail regardless of probability — the tool for exact
+retry-count assertions (the reference's retry wrapper does 3 attempts with
+linear backoff; ``service/app.py`` implements the documented fail-open on
+exhaustion).
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+
+from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.storage.errors import StorageException
+
+_DECISION_OPS = ("acquire", "acquire_many", "acquire_many_ids",
+                 "acquire_stream_ids", "available_many", "reset_key")
+_LEGACY_OPS = ("increment_and_expire", "get", "set", "compare_and_set",
+               "delete", "z_add", "z_remove_range_by_score", "z_count",
+               "eval_script")
+
+
+class FaultInjectingStorage(RateLimitStorage):
+    """Wraps a real backend; injects failures/latency on configured ops."""
+
+    def __init__(
+        self,
+        inner: RateLimitStorage,
+        failure_rate: float = 0.0,
+        latency_ms: float = 0.0,
+        seed: int = 0,
+        ops: tuple = _DECISION_OPS + _LEGACY_OPS,
+    ):
+        self._inner = inner
+        self.failure_rate = float(failure_rate)
+        self.latency_ms = float(latency_ms)
+        self._rng = random.Random(seed)
+        self._ops = set(ops)
+        self._lock = threading.Lock()
+        self._forced = 0
+        self.injected_failures = 0
+        # Recent op names only — bounded so long-running drills can't leak.
+        self.calls = collections.deque(maxlen=1024)
+
+    # -- control surface ------------------------------------------------------
+    def fail_next(self, n: int = 1) -> None:
+        """Force the next ``n`` wrapped operations to fail."""
+        with self._lock:
+            self._forced += int(n)
+
+    def _maybe_fail(self, op: str) -> None:
+        if op not in self._ops:
+            return
+        with self._lock:
+            self.calls.append(op)
+            if self._forced > 0:
+                self._forced -= 1
+                self.injected_failures += 1
+                raise StorageException(f"injected failure in {op}")
+            if self.failure_rate and self._rng.random() < self.failure_rate:
+                self.injected_failures += 1
+                raise StorageException(f"injected failure in {op}")
+        if self.latency_ms:
+            time.sleep(self.latency_ms / 1000.0)
+
+    def __getattr__(self, name):
+        # Everything not explicitly wrapped (register_limiter, flush,
+        # checkpoints, attributes like engine/trace) passes straight through.
+        return getattr(self._inner, name)
+
+    # -- wrapped surface ------------------------------------------------------
+    @property
+    def supports_device_batching(self):  # type: ignore[override]
+        return getattr(self._inner, "supports_device_batching", False)
+
+
+def _wrap(op: str):
+    def method(self, *args, **kwargs):
+        self._maybe_fail(op)
+        return getattr(self._inner, op)(*args, **kwargs)
+
+    method.__name__ = op
+    return method
+
+
+for _op in _DECISION_OPS + _LEGACY_OPS + ("is_available", "close"):
+    setattr(FaultInjectingStorage, _op, _wrap(_op))
+# is_available/close are wrapped for delegation but never injected by
+# default (they are the health/shutdown path; pass them in ``ops`` to
+# chaos-test the health check itself).
+#
+# The abstract-method set was frozen before the loop above filled the
+# contract in; clear it so the wrapper instantiates.
+FaultInjectingStorage.__abstractmethods__ = frozenset()

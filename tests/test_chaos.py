@@ -1,0 +1,94 @@
+"""Fault injection (storage/chaos.py) driving the documented failure
+machinery: retry-with-backoff, fail-open, and metric accounting."""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.storage import (
+    FaultInjectingStorage,
+    InMemoryStorage,
+    StorageException,
+    TpuBatchedStorage,
+)
+from ratelimiter_tpu.storage.errors import RetryPolicy
+
+
+def test_forced_failures_then_recovery():
+    chaos = FaultInjectingStorage(InMemoryStorage())
+    chaos.fail_next(2)
+    with pytest.raises(StorageException):
+        chaos.increment_and_expire("k", 1000)
+    with pytest.raises(StorageException):
+        chaos.increment_and_expire("k", 1000)
+    # Third call succeeds and state is consistent (failures left no trace).
+    assert chaos.increment_and_expire("k", 1000) == 1
+    assert chaos.injected_failures == 2
+
+
+def test_retry_policy_survives_transient_faults():
+    """RetryPolicy (the reference's 3-attempt linear-backoff analog) rides
+    over injected transients."""
+    chaos = FaultInjectingStorage(InMemoryStorage())
+    retry = RetryPolicy(max_retries=3, retry_delay_ms=0.1)
+    chaos.fail_next(2)  # two transients, third attempt lands
+    value = retry.execute(lambda: chaos.increment_and_expire("k", 1000))
+    assert value == 1
+    # Exhaustion: more faults than attempts -> StorageException escapes.
+    chaos.fail_next(3)
+    with pytest.raises(StorageException):
+        retry.execute(lambda: chaos.increment_and_expire("k", 1000))
+
+
+def test_probabilistic_faults_are_deterministic_by_seed():
+    a = FaultInjectingStorage(InMemoryStorage(), failure_rate=0.5, seed=7)
+    b = FaultInjectingStorage(InMemoryStorage(), failure_rate=0.5, seed=7)
+
+    def drive(s):
+        outcomes = []
+        for i in range(50):
+            try:
+                s.increment_and_expire(f"k{i}", 1000)
+                outcomes.append(True)
+            except StorageException:
+                outcomes.append(False)
+        return outcomes
+
+    assert drive(a) == drive(b)
+    assert 0 < a.injected_failures < 50
+
+
+def test_limiter_fail_open_over_chaos_storage():
+    """The service-documented fail-open policy: storage outage => allow.
+    (The reference documents this and actually 500s; SURVEY §5.3.)
+    StorageException surfaces from the limiter, which is exactly what
+    service/app.py's _try_acquire converts into allow-and-count."""
+    chaos = FaultInjectingStorage(InMemoryStorage())
+    limiter = SlidingWindowRateLimiter(
+        chaos,
+        RateLimitConfig(max_permits=2, window_ms=1000,
+                        enable_local_cache=False),
+        MeterRegistry())
+    assert limiter.try_acquire("u")
+    chaos.fail_next(10)
+    with pytest.raises(StorageException):
+        limiter.try_acquire("u")
+
+
+def test_chaos_wraps_device_storage_stream():
+    """The wrapper composes with the TPU-batched backend: injected faults
+    surface from the stream path, clean calls pass through unchanged."""
+    clock = lambda: 12_000  # noqa: E731
+    inner = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    chaos = FaultInjectingStorage(inner)
+    lid = chaos.register_limiter("tb", RateLimitConfig(
+        max_permits=3, window_ms=1000, refill_rate=1.0))
+    ids = np.zeros(5, dtype=np.int64)
+    got = chaos.acquire_stream_ids("tb", lid, ids, None, batch=4, subbatches=1)
+    assert got.tolist() == [True, True, True, False, False]
+    chaos.fail_next(1)
+    with pytest.raises(StorageException):
+        chaos.acquire_stream_ids("tb", lid, ids, None, batch=4, subbatches=1)
+    chaos.close()

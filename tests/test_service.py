@@ -117,6 +117,30 @@ def test_unknown_route_404(server):
     assert status == 404
 
 
+def test_chaos_drill_fail_open_end_to_end():
+    """chaos.failure_rate=1 via config wires the fault injector around the
+    backend; every decision op fails and the service fail-opens at HTTP."""
+    props = AppProperties({
+        "storage.backend": "memory",
+        "ratelimiter.fail_open": "true",
+        "chaos.failure_rate": "1",
+    })
+    ctx = build_app(props)
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, data, _ = req(srv, "GET", "/api/data",
+                              headers={"X-User-ID": "c"})
+        assert status == 200
+        assert ctx.registry.scrape()["ratelimiter.failopen.allowed"] >= 1
+        assert ctx.storage.injected_failures >= 1
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+        ctx.close()
+
+
 def test_fail_open_allows_on_storage_outage():
     props = AppProperties({"storage.backend": "memory", "ratelimiter.fail_open": "true"})
     storage = InMemoryStorage()
