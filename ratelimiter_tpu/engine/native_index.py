@@ -12,6 +12,7 @@ identical, only slower (tested equivalent in tests/test_native_index.py).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
@@ -113,6 +114,19 @@ class NativeSlotIndex:
             self._lib.rl_index_free(h)
             self._h = None
 
+    @contextlib.contextmanager
+    def _pinned(self, pinned):
+        """Hold pin refcounts on the given slots for the enclosed call.
+        Must be entered with self._lock held."""
+        pins = list(pinned) if pinned else []
+        for s in pins:
+            self._lib.rl_index_pin(self._h, s)
+        try:
+            yield
+        finally:
+            for s in pins:
+                self._lib.rl_index_unpin(self._h, s)
+
     # -- scalar interface (SlotIndex parity) ----------------------------------
     def get(self, key: Hashable) -> Optional[int]:
         seed, user = _split_key(key)
@@ -127,29 +141,22 @@ class NativeSlotIndex:
         self, key: Hashable, pinned: Optional[Set[int]] = None
     ) -> Tuple[int, Optional[int]]:
         seed, user = _split_key(key)
-        with self._lock:
-            pins = list(pinned) if pinned else []
-            for s in pins:
-                self._lib.rl_index_pin(self._h, s)
-            try:
-                out_slot = np.empty(1, dtype=np.int32)
-                out_ev = np.empty(1, dtype=np.int32)
-                if isinstance(user, int):
-                    keys = np.asarray([user], dtype=np.int64)
-                    self._lib.rl_index_assign_ints(
-                        self._h, keys.ctypes.data, 1, seed,
-                        out_slot.ctypes.data, out_ev.ctypes.data)
-                else:
-                    data = np.frombuffer(user, dtype=np.uint8) if user else \
-                        np.empty(0, dtype=np.uint8)
-                    offs = np.asarray([0, len(user)], dtype=np.int64)
-                    self._lib.rl_index_assign_bytes(
-                        self._h, data.ctypes.data if len(user) else 0,
-                        offs.ctypes.data, 1, seed,
-                        out_slot.ctypes.data, out_ev.ctypes.data)
-            finally:
-                for s in pins:
-                    self._lib.rl_index_unpin(self._h, s)
+        out_slot = np.empty(1, dtype=np.int32)
+        out_ev = np.empty(1, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            if isinstance(user, int):
+                keys = np.asarray([user], dtype=np.int64)
+                self._lib.rl_index_assign_ints(
+                    self._h, keys.ctypes.data, 1, seed,
+                    out_slot.ctypes.data, out_ev.ctypes.data)
+            else:
+                data = np.frombuffer(user, dtype=np.uint8) if user else \
+                    np.empty(0, dtype=np.uint8)
+                offs = np.asarray([0, len(user)], dtype=np.int64)
+                self._lib.rl_index_assign_bytes(
+                    self._h, data.ctypes.data if len(user) else 0,
+                    offs.ctypes.data, 1, seed,
+                    out_slot.ctypes.data, out_ev.ctypes.data)
         if out_ev[0] == -2:
             raise RuntimeError("all slots pinned; increase num_slots or flush")
         evicted = int(out_ev[0]) if out_ev[0] >= 0 else None
@@ -178,17 +185,10 @@ class NativeSlotIndex:
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
-        pins = list(pinned) if pinned else []
-        with self._lock:
-            for s in pins:
-                self._lib.rl_index_pin(self._h, s)
-            try:
-                self._lib.rl_index_assign_ints(
-                    self._h, keys.ctypes.data, n, int(lid),
-                    out_slots.ctypes.data, out_ev.ctypes.data)
-            finally:
-                for s in pins:
-                    self._lib.rl_index_unpin(self._h, s)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_ints(
+                self._h, keys.ctypes.data, n, int(lid),
+                out_slots.ctypes.data, out_ev.ctypes.data)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
@@ -203,17 +203,10 @@ class NativeSlotIndex:
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
-        pins = list(pinned) if pinned else []
-        with self._lock:
-            for s in pins:
-                self._lib.rl_index_pin(self._h, s)
-            try:
-                self._lib.rl_index_assign_ints_multi(
-                    self._h, keys.ctypes.data, seeds.ctypes.data, n,
-                    out_slots.ctypes.data, out_ev.ctypes.data)
-            finally:
-                for s in pins:
-                    self._lib.rl_index_unpin(self._h, s)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_ints_multi(
+                self._h, keys.ctypes.data, seeds.ctypes.data, n,
+                out_slots.ctypes.data, out_ev.ctypes.data)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
@@ -231,18 +224,11 @@ class NativeSlotIndex:
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
-        pins = list(pinned) if pinned else []
-        with self._lock:
-            for s in pins:
-                self._lib.rl_index_pin(self._h, s)
-            try:
-                self._lib.rl_index_assign_bytes(
-                    self._h, packed.ctypes.data if len(packed) else 0,
-                    offs.ctypes.data, n, int(lid),
-                    out_slots.ctypes.data, out_ev.ctypes.data)
-            finally:
-                for s in pins:
-                    self._lib.rl_index_unpin(self._h, s)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_bytes(
+                self._h, packed.ctypes.data if len(packed) else 0,
+                offs.ctypes.data, n, int(lid),
+                out_slots.ctypes.data, out_ev.ctypes.data)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]

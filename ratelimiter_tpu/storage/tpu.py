@@ -45,6 +45,15 @@ def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+def _pad_tail(arr: np.ndarray, size: int, fill, dtype) -> np.ndarray:
+    """Contiguous cast + right-pad with ``fill`` up to ``size``."""
+    arr = np.ascontiguousarray(arr, dtype=dtype)
+    if len(arr) < size:
+        arr = np.concatenate(
+            [arr, np.full(size - len(arr), fill, dtype=dtype)])
+    return arr
+
+
 class TpuBatchedStorage(RateLimitStorage):
     supports_device_batching = True
 
@@ -306,9 +315,7 @@ class TpuBatchedStorage(RateLimitStorage):
             flat = np.unpackbits(arr, axis=1)[:, :b].reshape(-1).astype(bool)
             got = flat[:count]
             out[start:start + count] = got
-            if self._latency is not None:
-                self._latency.record_us(dt_us)
-            self.trace.record(algo, count, int(got.sum()), dt_us)
+            self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
         for start in range(0, n, super_n):
             chunk = key_ids[start:start + super_n]
@@ -322,26 +329,11 @@ class TpuBatchedStorage(RateLimitStorage):
                     chunk, lid, pinned=self._batcher.pending_slots(algo))
             if len(clears):
                 clear(list(clears))
-            if cn < super_n:
-                slots = np.concatenate(
-                    [slots, np.full(super_n - cn, -1, dtype=np.int32)])
-            if multi_lid:
-                l_chunk = np.ascontiguousarray(
-                    lid_arr[start:start + cn], dtype=np.int32)
-                if cn < super_n:
-                    l_chunk = np.concatenate(
-                        [l_chunk, np.zeros(super_n - cn, dtype=np.int32)])
-                lid_kb = l_chunk.reshape(k, b)
-            else:
-                lid_kb = lid
-            p_kb = None
-            if permits is not None:
-                p_chunk = np.ascontiguousarray(
-                    permits[start:start + cn], dtype=np.int32)
-                if cn < super_n:
-                    p_chunk = np.concatenate(
-                        [p_chunk, np.ones(super_n - cn, dtype=np.int32)])
-                p_kb = p_chunk.reshape(k, b)
+            slots = _pad_tail(slots, super_n, -1, np.int32)
+            lid_kb = lid if not multi_lid else _pad_tail(
+                lid_arr[start:start + cn], super_n, 0, np.int32).reshape(k, b)
+            p_kb = None if permits is None else _pad_tail(
+                permits[start:start + cn], super_n, 1, np.int32).reshape(k, b)
             now = self._monotonic_now()
             t0 = time.perf_counter()
             bits = dispatch(slots.reshape(k, b), lid_kb, p_kb,
@@ -381,9 +373,7 @@ class TpuBatchedStorage(RateLimitStorage):
             bits = np.unpackbits(arr, axis=2)[:, :, :b_loc].astype(bool)
             got = bits[shard, j, cols]
             out[start:start + cnt] = got
-            if self._latency is not None:
-                self._latency.record_us(dt_us)
-            self.trace.record(algo, cnt, int(got.sum()), dt_us)
+            self._record_dispatch(algo, cnt, int(got.sum()), dt_us)
 
         for start in range(0, n, super_n):
             chunk = key_ids[start:start + super_n]
@@ -393,16 +383,19 @@ class TpuBatchedStorage(RateLimitStorage):
             # Per-shard slot assignment (one C call each), chunk order kept.
             local = np.empty(cn, dtype=np.int32)
             clears: list = []
-            pins_global = self._batcher.pending_slots(algo)
+            pins_by_shard: dict = {}
+            for g in self._batcher.pending_slots(algo):
+                pins_by_shard.setdefault(g // sps, set()).add(g % sps)
+            l_chunk = lid_arr[start:start + cn] if multi_lid else None
             for s in range(n_sh):
                 m = shard == s
                 if not m.any():
                     continue
-                pins = {g % sps for g in pins_global if g // sps == s}
+                pins = pins_by_shard.get(s)
                 sub = index._sub[s]
                 if multi_lid:
                     sl, ev = sub.assign_batch_ints_multi(
-                        chunk[m], lid_arr[start:start + cn][m], pinned=pins)
+                        chunk[m], l_chunk[m], pinned=pins)
                 else:
                     sl, ev = sub.assign_batch_ints(chunk[m], lid, pinned=pins)
                 local[m] = sl
@@ -425,7 +418,7 @@ class TpuBatchedStorage(RateLimitStorage):
             lid_kb = lid
             if multi_lid:
                 lid_mat = np.zeros((n_sh, k, b_loc), dtype=np.int32)
-                lid_mat[shard, j, cols] = lid_arr[start:start + cn]
+                lid_mat[shard, j, cols] = l_chunk
                 lid_kb = lid_mat
             p_kb = None
             if permits is not None:
@@ -493,6 +486,13 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def flush(self) -> None:
         self._batcher.flush()
+
+    def _record_dispatch(self, algo: str, n: int, allowed: int,
+                         dt_us: float) -> None:
+        """Latency histogram + decision trace for a completed dispatch."""
+        if self._latency is not None:
+            self._latency.record_us(dt_us)
+        self.trace.record(algo, n, allowed, dt_us)
 
     # ------------------------------------------------------------------------
     # Checkpoint / resume (engine/checkpoint.py; SURVEY.md §5.4)
