@@ -12,11 +12,11 @@ Format: a directory with
   - ``index.json`` — limiter registrations + key->slot mappings + metadata
 
 Snapshots are crash-consistent (written to a temp dir, atomically renamed)
-and backend-portable: a checkpoint taken on a sharded engine restores onto a
-single-device engine and vice versa (state is keyed by global slot id; the
-restore re-routes rows if the slot geometry changed... geometry must match —
-enforced by metadata check; cross-geometry migration is a rebalance, left to
-the operator via export/import of per-key state in a future round).
+but geometry-locked (slot arrays restore 1:1; enforced by metadata check).
+Cross-geometry migration — growing the table, changing shard counts,
+flat <-> sharded — uses the per-KEY path instead: :func:`export_keys` /
+:func:`import_keys` (also on ``TpuBatchedStorage``), which re-assign slots
+in the target and carry each key's packed state row across.
 
 The native slot index cannot enumerate its keys (it stores fingerprints
 only), so checkpointable deployments either use the Python index
@@ -123,6 +123,106 @@ def restore_engine_state(engine, ckpt: Dict) -> None:
         jnp.asarray(arrays[f"sw_{f}"].reshape(shape)) for f in sw._fields))
     engine.tb_state = type(tb)(*(
         jnp.asarray(arrays[f"tb_{f}"].reshape(shape)) for f in tb._fields))
+
+
+# ---------------------------------------------------------------------------
+# Per-key export/import (geometry-free rebalance)
+# ---------------------------------------------------------------------------
+# Checkpoints are geometry-locked (slot arrays restore 1:1). Rebalancing —
+# growing the slot table, changing shard counts, moving to different
+# hardware — goes through per-KEY state instead: export every live
+# (key -> packed state row), import assigns fresh slots in the target and
+# writes the rows back. Works across any source/target geometry, flat or
+# sharded, as long as the index is enumerable (checkpointable=True).
+
+
+def _limiter_table_dump(storage) -> Dict:
+    """Registered limiter policies, keyed by lid (import-side validation)."""
+    return {
+        str(lid): {
+            "algo": algo,
+            "max_permits": cfg.max_permits,
+            "window_ms": cfg.window_ms,
+            "refill_rate": cfg.refill_rate,
+        }
+        for lid, (algo, cfg) in storage._configs.items()
+    }
+
+
+def export_keys(storage) -> Dict:
+    """All live per-key state of a storage: {algo: [[key, row-ints], ...]}."""
+    index_dump = dump_slot_indexes(storage)
+    storage.flush()
+    storage.engine.block_until_ready()
+    out: Dict = {
+        "format": FORMAT_VERSION,
+        "limiters": _limiter_table_dump(storage),
+        "algos": {},
+    }
+    for algo, payload in index_dump["algos"].items():
+        entries = payload["entries"]
+        if not entries:
+            out["algos"][algo] = []
+            continue
+        slots = [slot for _, slot in entries]
+        rows = storage.engine.read_rows(algo, slots)
+        out["algos"][algo] = [
+            [key, [int(v) for v in row]] for (key, _), row in zip(entries, rows)
+        ]
+    return out
+
+
+def import_keys(storage, dump: Dict) -> None:
+    """Assign slots for exported keys in ``storage`` and write their state.
+
+    The target may have any geometry (more slots, different shard count,
+    flat vs sharded). Keys route through the target's own index, so shard
+    placement follows the target's hash — this IS the rebalance.
+
+    Refuses up front (before touching the target) when the dump's format
+    differs, when limiter registrations don't line up, or when the target
+    lacks capacity for the new keys — a partial import would silently hand
+    fresh quota to keys the export showed as consumed.
+    """
+    if dump.get("format", FORMAT_VERSION) not in SUPPORTED_VERSIONS:
+        raise ValueError(
+            f"unsupported export format: {dump.get('format')}")
+    # Limiter ids inside index keys are SOURCE lids; they must mean the
+    # same policy in the target or imported state attaches to the wrong
+    # limiter (or to none).
+    target = _limiter_table_dump(storage)
+    for lid, src_cfg in dump.get("limiters", {}).items():
+        dst_cfg = target.get(lid)
+        if dst_cfg != src_cfg:
+            raise ValueError(
+                f"limiter id {lid} mismatch: export has {src_cfg}, "
+                f"target has {dst_cfg}; register identical limiters in the "
+                "same order before importing")
+    # Capacity pre-check: every key not already present needs a free slot.
+    for algo, entries in dump.get("algos", {}).items():
+        index = storage._index[algo]
+        new = sum(
+            1 for key, _ in entries
+            if index.get(tuple(key) if isinstance(key, list) else key) is None)
+        free = index.num_slots - len(index)
+        if new > free:
+            raise ValueError(
+                f"target storage is too small for the export ({new} new "
+                f"{algo} keys, {free} free slots)")
+    for algo, entries in dump.get("algos", {}).items():
+        if not entries:
+            continue
+        index = storage._index[algo]
+        slots = []
+        for key, _ in entries:
+            key = tuple(key) if isinstance(key, list) else key
+            slot, evicted = index.assign(key)
+            if evicted is not None:  # pre-check makes this unreachable
+                raise ValueError("eviction during import despite capacity check")
+            slots.append(slot)
+        rows = np.asarray([row for _, row in entries], dtype=np.int32)
+        storage.engine.write_rows(algo, slots, rows)
+    storage.engine.block_until_ready()
 
 
 # ---------------------------------------------------------------------------

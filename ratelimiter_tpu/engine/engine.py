@@ -221,6 +221,24 @@ class DeviceEngine:
             self.tb_packed = self._tb_reset(
                 self.tb_packed, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
+    # -- raw packed-row access (export/import rebalance; engine/checkpoint.py)
+    def read_rows(self, algo: str, slots) -> np.ndarray:
+        """Packed state rows for the given slots (host numpy i32[n, lanes])."""
+        with self._lock:
+            packed = self.sw_packed if algo == "sw" else self.tb_packed
+            return np.asarray(packed[jnp.asarray(
+                np.ascontiguousarray(slots, dtype=np.int32))])
+
+    def write_rows(self, algo: str, slots, rows: np.ndarray) -> None:
+        """Overwrite packed state rows (import side of a rebalance)."""
+        with self._lock:
+            idx = jnp.asarray(np.ascontiguousarray(slots, dtype=np.int32))
+            vals = jnp.asarray(np.ascontiguousarray(rows, dtype=np.int32))
+            if algo == "sw":
+                self.sw_packed = self.sw_packed.at[idx].set(vals)
+            else:
+                self.tb_packed = self.tb_packed.at[idx].set(vals)
+
     def block_until_ready(self) -> None:
         with self._lock:
             jax.block_until_ready((self.sw_packed, self.tb_packed))

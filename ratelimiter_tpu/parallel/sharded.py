@@ -445,6 +445,32 @@ class ShardedDeviceEngine:
         with self._lock:
             self.tb_packed = self._tb_reset(self.tb_packed, jnp.asarray(mat))
 
+    # -- raw packed-row access (export/import rebalance) ----------------------
+    def read_rows(self, algo: str, slots) -> np.ndarray:
+        slots = np.asarray(slots, dtype=np.int64)
+        shard = slots // self.slots_per_shard
+        local = slots % self.slots_per_shard
+        with self._lock:
+            packed = self.sw_packed if algo == "sw" else self.tb_packed
+            host = np.asarray(packed)  # [n_shards, S_local, lanes]
+        return host[shard, local]
+
+    def write_rows(self, algo: str, slots, rows: np.ndarray) -> None:
+        slots = np.asarray(slots, dtype=np.int64)
+        shard = jnp.asarray(slots // self.slots_per_shard, dtype=jnp.int32)
+        local = jnp.asarray(slots % self.slots_per_shard, dtype=jnp.int32)
+        vals = jnp.asarray(np.ascontiguousarray(rows, dtype=np.int32))
+        with self._lock:
+            packed = self.sw_packed if algo == "sw" else self.tb_packed
+            # Device-side scatter (no full-array host roundtrip), then
+            # re-constrain to the shard placement.
+            new = jax.device_put(packed.at[shard, local].set(vals),
+                                 self._state_sharding)
+            if algo == "sw":
+                self.sw_packed = new
+            else:
+                self.tb_packed = new
+
     def block_until_ready(self) -> None:
         with self._lock:
             jax.block_until_ready((self.sw_packed, self.tb_packed))

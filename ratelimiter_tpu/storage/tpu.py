@@ -513,6 +513,22 @@ class TpuBatchedStorage(RateLimitStorage):
         ckpt.restore_engine_state(self.engine, data)
         ckpt.restore_slot_indexes(self, data["meta"]["index"])
 
+    def export_keys(self) -> Dict:
+        """Geometry-free export of all live per-key state (the rebalance
+        counterpart to checkpoints; engine/checkpoint.py:export_keys —
+        which flushes pending traffic itself)."""
+        from ratelimiter_tpu.engine import checkpoint as ckpt
+
+        return ckpt.export_keys(self)
+
+    def import_keys(self, dump: Dict) -> None:
+        """Import an export into THIS storage's geometry (slots assigned by
+        this storage's own index/shard hash — this is the rebalance)."""
+        from ratelimiter_tpu.engine import checkpoint as ckpt
+
+        self._batcher.flush()
+        ckpt.import_keys(self, dump)
+
     # ------------------------------------------------------------------------
     # Legacy 10-method contract (host-side, embedded InMemoryStorage)
     # ------------------------------------------------------------------------

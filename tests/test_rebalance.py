@@ -1,0 +1,111 @@
+"""Per-key export/import (engine/checkpoint.py): geometry-free rebalance.
+
+Checkpoints restore 1:1 into the same geometry; a rebalance exports live
+(key, state) pairs and imports them into a target of ANY geometry — more
+slots, different shard count, flat <-> sharded. Decisions must continue
+exactly where the source left off.
+"""
+
+import numpy as np
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine import checkpoint as ck
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+
+def _consume(storage, lid, key_ids, permits):
+    return storage.acquire_stream_ids(
+        "tb", lid, np.asarray(key_ids, dtype=np.int64),
+        np.asarray(permits, dtype=np.int64), batch=16, subbatches=1)
+
+
+def test_rebalance_flat_to_larger_flat():
+    clock = lambda: 21_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=5, window_ms=60_000, refill_rate=0.001)
+
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    lid = src.register_limiter("tb", cfg)
+    # Drain keys 0..9 fully, key 10 partially.
+    _consume(src, lid, list(range(10)) * 5 + [10], [1] * 51)
+    dump = ck.export_keys(src)
+    src.close()
+
+    dst = TpuBatchedStorage(num_slots=1024, clock_ms=clock,
+                            checkpointable=True)
+    lid2 = dst.register_limiter("tb", cfg)
+    assert lid2 == lid
+    ck.import_keys(dst, dump)
+    # Drained keys stay drained; the partial key has exactly 4 left.
+    got = _consume(dst, lid2, list(range(10)), [1] * 10)
+    assert not got.any()
+    got = _consume(dst, lid2, [10] * 5, [1] * 5)
+    assert got.tolist() == [True, True, True, True, False]
+    dst.close()
+
+
+def test_rebalance_flat_to_sharded():
+    import jax
+
+    if jax.device_count() < 2:
+        import pytest
+
+        pytest.skip("needs a multi-device mesh")
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+
+    clock = lambda: 31_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=2, window_ms=60_000, refill_rate=0.001)
+
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    lid = src.register_limiter("tb", cfg)
+    _consume(src, lid, [7, 7, 8], [1, 1, 1])  # key 7 drained, key 8 at 1/2
+    dump = ck.export_keys(src)
+    src.close()
+
+    engine = ShardedDeviceEngine(slots_per_shard=32, table=LimiterTable(),
+                                 mesh=make_mesh())
+    dst = TpuBatchedStorage(engine=engine, clock_ms=clock,
+                            checkpointable=True)
+    lid2 = dst.register_limiter("tb", cfg)
+    assert lid2 == lid
+    ck.import_keys(dst, dump)
+    got = _consume(dst, lid2, [7, 8, 8], [1, 1, 1])
+    assert got.tolist() == [False, True, False]
+    dst.close()
+
+
+def test_rebalance_refuses_limiter_mismatch():
+    import pytest
+
+    clock = lambda: 51_000  # noqa: E731
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    lid = src.register_limiter("tb", RateLimitConfig(
+        max_permits=5, window_ms=60_000, refill_rate=1.0))
+    _consume(src, lid, [1], [1])
+    dump = ck.export_keys(src)
+    src.close()
+
+    dst = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    dst.register_limiter("tb", RateLimitConfig(
+        max_permits=99, window_ms=60_000, refill_rate=1.0))  # different policy
+    with pytest.raises(ValueError, match="mismatch"):
+        ck.import_keys(dst, dump)
+    dst.close()
+
+
+def test_rebalance_refuses_undersized_target():
+    import pytest
+
+    clock = lambda: 41_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=2, window_ms=60_000, refill_rate=0.001)
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    lid = src.register_limiter("tb", cfg)
+    _consume(src, lid, list(range(40)), [1] * 40)
+    dump = ck.export_keys(src)
+    src.close()
+
+    dst = TpuBatchedStorage(num_slots=8, clock_ms=clock, checkpointable=True)
+    dst.register_limiter("tb", cfg)
+    with pytest.raises(ValueError, match="too small"):
+        ck.import_keys(dst, dump)
+    dst.close()
