@@ -50,6 +50,12 @@ SUPPORTED_VERSIONS = (1, 2)
 # a binary with a different routing function fails loudly instead of
 # silently orphaning entries.
 SHARD_HASH_VERSION = "crc32-repr/splitmix64-v1"
+# Sharded dumps written before the shard_hash field existed were produced by
+# binaries that routed int user keys via crc32-of-repr (strings routed the
+# same as today).  A missing field therefore marks the LEGACY hash, not the
+# current one — restoring a legacy dump with int user keys under the current
+# splitmix64 routing would silently orphan every int-key entry.
+LEGACY_SHARD_HASH = "crc32-repr-v0"
 
 
 def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
@@ -199,16 +205,36 @@ def import_keys(storage, dump: Dict) -> None:
                 f"target has {dst_cfg}; register identical limiters in the "
                 "same order before importing")
     # Capacity pre-check: every key not already present needs a free slot.
+    # For sharded targets the check is PER SHARD — capacity there is not
+    # fungible (a key's shard is fixed by hash), so a global count could
+    # pass while one shard overflows mid-import, leaving a partial import.
     for algo, entries in dump.get("algos", {}).items():
         index = storage._index[algo]
-        new = sum(
-            1 for key, _ in entries
-            if index.get(tuple(key) if isinstance(key, list) else key) is None)
-        free = index.num_slots - len(index)
-        if new > free:
-            raise ValueError(
-                f"target storage is too small for the export ({new} new "
-                f"{algo} keys, {free} free slots)")
+        if hasattr(index, "_sub"):
+            from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+            new_per_shard = [0] * index.n_shards
+            for key, _ in entries:
+                key = tuple(key) if isinstance(key, list) else key
+                shard = shard_of_key(key, index.n_shards)
+                if index._sub[shard].get(key) is None:
+                    new_per_shard[shard] += 1
+            for shard, (sub, new) in enumerate(zip(index._sub, new_per_shard)):
+                free = index.slots_per_shard - len(sub)
+                if new > free:
+                    raise ValueError(
+                        f"target shard {shard} is too small for the export "
+                        f"({new} new {algo} keys, {free} free slots)")
+        else:
+            new = sum(
+                1 for key, _ in entries
+                if index.get(tuple(key) if isinstance(key, list) else key)
+                is None)
+            free = index.num_slots - len(index)
+            if new > free:
+                raise ValueError(
+                    f"target storage is too small for the export ({new} new "
+                    f"{algo} keys, {free} free slots)")
     for algo, entries in dump.get("algos", {}).items():
         if not entries:
             continue
@@ -288,13 +314,30 @@ def restore_slot_indexes(storage, dump: Dict) -> None:
     for algo, payload in dump.get("algos", {}).items():
         index = storage._index[algo]
         entries = payload["entries"]
-        if payload.get("kind") == "sharded":
-            stored_hash = payload.get("shard_hash", SHARD_HASH_VERSION)
+        if payload.get("kind") == "sharded" and hasattr(index, "_sub"):
+            stored_hash = payload.get("shard_hash", LEGACY_SHARD_HASH)
             if stored_hash != SHARD_HASH_VERSION:
-                raise ValueError(
-                    f"checkpoint used shard hash {stored_hash!r}; this "
-                    f"binary routes with {SHARD_HASH_VERSION!r} — restoring "
-                    "would orphan every entry (export/import per key instead)")
+                # A dump written under a different routing hash restores
+                # safely only if every entry already sits where the CURRENT
+                # hash routes its key (true for legacy string keys — crc32
+                # of repr then and now).  Checking placement directly is
+                # divergence-proof: it needs no model of what the old hash
+                # did, so legacy int/bool keys (which routed differently)
+                # fail it, and any entry that happens to match routes —
+                # and therefore resolves — correctly.
+                from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+                sps = index.slots_per_shard
+                ok = stored_hash == LEGACY_SHARD_HASH and all(
+                    shard_of_key(tuple(key) if isinstance(key, list)
+                                 else key, index.n_shards) == gslot // sps
+                    for key, gslot in entries)
+                if not ok:
+                    raise ValueError(
+                        f"checkpoint used shard hash {stored_hash!r}; this "
+                        f"binary routes with {SHARD_HASH_VERSION!r} — "
+                        "restoring would orphan entries (export/import per "
+                        "key instead)")
         if hasattr(index, "_map"):
             _restore_flat(index, entries)
         elif hasattr(index, "_sub"):

@@ -244,7 +244,10 @@ class TpuBatchedStorage(RateLimitStorage):
         the bit-packed allow/deny mask comes back — while it is in flight
         the next super-batch is being indexed and dispatched, so transfer
         latency overlaps device compute.  Decisions are identical to
-        ``acquire_many_ids`` called per sub-batch (tests/test_packed.py).
+        ``acquire_many_ids`` called per sub-batch (tests/test_packed.py);
+        permits above 2^31-1 — above any limiter's max_permits, which is
+        bounded to int32 — are denied without touching state, exactly as
+        the i64 batch path rejects them.
 
         ``lid`` is either one limiter id for the whole stream (the device
         reads that policy row once — zero table gathers) or an int array of
@@ -259,6 +262,22 @@ class TpuBatchedStorage(RateLimitStorage):
             lid_arr = np.ascontiguousarray(lid, dtype=np.int64)
             if lid_arr.size and ((lid_arr < 0) | (lid_arr >= len(self.table))).any():
                 raise ValueError("limiter ids out of range")
+        # The stream paths carry permits as i32 lanes; a value past 2^31-1
+        # would wrap negative and read as an ALLOW (a negative "request"
+        # credits tokens) where the i64 batch path rejects it.  max_permits
+        # always fits int32 (Java-int parity bound in core/config.py), so
+        # any such request is above every limiter's cap: force-deny it by
+        # dispatching its lane as padding (slot -1) — decision identical to
+        # the batch path's reject, state untouched.
+        oversize = None
+        if permits is not None:
+            permits = np.asarray(permits)
+            if permits.size and int(permits.min(initial=0)) < np.iinfo(
+                    np.int32).min:
+                raise ValueError("permits below int32 range")
+            over = permits > np.iinfo(np.int32).max
+            if over.any():
+                oversize = over
 
         index = self._index[algo]
         if hasattr(index, "_sub") and getattr(index, "supports_batch_ints", False):
@@ -268,7 +287,7 @@ class TpuBatchedStorage(RateLimitStorage):
             return self._stream_sharded(
                 algo, lid, np.ascontiguousarray(key_ids, dtype=np.int64),
                 permits, batch, subbatches, index, multi_lid,
-                lid_arr if multi_lid else None)
+                lid_arr if multi_lid else None, oversize)
         if not hasattr(index, "assign_batch_ints"):
             # Python-index fallback: plain per-batch path, same decisions.
             n = len(key_ids)
@@ -298,6 +317,8 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._batcher.flush()
         key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+        if oversize is not None:
+            permits = np.where(oversize, 1, permits)  # lanes masked, see above
         n = len(key_ids)
         k, b = int(subbatches), int(batch)
         super_n = k * b
@@ -330,6 +351,8 @@ class TpuBatchedStorage(RateLimitStorage):
             if len(clears):
                 clear(list(clears))
             slots = _pad_tail(slots, super_n, -1, np.int32)
+            if oversize is not None:
+                slots[:cn][oversize[start:start + cn]] = -1  # force-deny
             lid_kb = lid if not multi_lid else _pad_tail(
                 lid_arr[start:start + cn], super_n, 0, np.int32).reshape(k, b)
             p_kb = None if permits is None else _pad_tail(
@@ -347,7 +370,8 @@ class TpuBatchedStorage(RateLimitStorage):
         return out
 
     def _stream_sharded(self, algo, lid, key_ids, permits, batch, subbatches,
-                        index, multi_lid, lid_arr) -> np.ndarray:
+                        index, multi_lid, lid_arr,
+                        oversize=None) -> np.ndarray:
         """Sharded-engine streaming: per-super-batch host routing (key ->
         shard by the deterministic splitmix hash), per-shard native slot
         assignment, one shard_map'd scan dispatch, pipelined bitmask fetch.
@@ -357,6 +381,9 @@ class TpuBatchedStorage(RateLimitStorage):
         from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
 
         eng = self.engine
+        if oversize is not None:
+            permits = np.where(oversize, 1, permits)  # lanes masked; the
+            # oversized requests dispatch as padding (slot -1) below.
         n_sh, sps = eng.n_shards, eng.slots_per_shard
         k, b = int(subbatches), int(batch)
         super_n = k * b
@@ -415,6 +442,9 @@ class TpuBatchedStorage(RateLimitStorage):
             b_loc = _bucket(int(counts.max(initial=1)))
             slots_mat = np.full((n_sh, k, b_loc), -1, dtype=np.int32)
             slots_mat[shard, j, cols] = local
+            if oversize is not None:
+                ov = oversize[start:start + cn]
+                slots_mat[shard[ov], j[ov], cols[ov]] = -1  # force-deny
             lid_kb = lid
             if multi_lid:
                 lid_mat = np.zeros((n_sh, k, b_loc), dtype=np.int32)

@@ -106,3 +106,58 @@ def test_native_index_checkpoint_refused(tmp_path):
     with pytest.raises(ValueError, match="enumerable"):
         storage.save_checkpoint(str(tmp_path / "ckpt"))
     storage.close()
+
+
+def test_legacy_sharded_dump_int_keys_refused():
+    """A sharded dump with NO shard_hash predates the splitmix64 int-key
+    routing: restoring its int-key entries under current routing would
+    silently orphan them (lookups hit a different shard), so it is refused.
+    String-key-only legacy dumps routed identically then and now — those
+    restore fine."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    from ratelimiter_tpu.engine import checkpoint as ck
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+    cfg = RateLimitConfig(max_permits=5, window_ms=60_000, refill_rate=1.0)
+
+    def fresh():
+        engine = ShardedDeviceEngine(slots_per_shard=16, table=LimiterTable(),
+                                     mesh=make_mesh())
+        st = TpuBatchedStorage(engine=engine, checkpointable=True)
+        st.register_limiter("tb", cfg)
+        return st
+
+    st = fresh()
+    n_shards = st.engine.n_shards
+    sps = st.engine.slots_per_shard
+
+    def misplaced(key):
+        """A placement that current routing would NOT pick (what a legacy
+        crc32 binary can produce for int/bool keys)."""
+        return ((shard_of_key(key, n_shards) + 1) % n_shards) * sps
+
+    for user in (42, False):  # int and bool route via splitmix64 today
+        for key, entry_key in (((1, user), [1, user]), (user, user)):
+            dump = {"algos": {"tb": {
+                "kind": "sharded",  # no shard_hash field — a legacy dump
+                "entries": [[entry_key, misplaced(key)]],
+            }}}
+            with pytest.raises(ValueError, match="shard hash"):
+                ck.restore_slot_indexes(st, dump)
+    st.close()
+
+    st = fresh()
+    n_shards = st.engine.n_shards
+    shard = shard_of_key((1, "alice"), n_shards)  # crc32 then == crc32 now
+    legacy_str = {"algos": {"tb": {
+        "kind": "sharded",
+        "entries": [[[1, "alice"], shard * st.engine.slots_per_shard + 3]],
+    }}}
+    ck.restore_slot_indexes(st, legacy_str)
+    assert st._index["tb"].get((1, "alice")) is not None
+    st.close()

@@ -109,3 +109,42 @@ def test_rebalance_refuses_undersized_target():
     with pytest.raises(ValueError, match="too small"):
         ck.import_keys(dst, dump)
     dst.close()
+
+
+def test_rebalance_refuses_overfull_shard():
+    """Capacity is per shard, not fungible: a target whose GLOBAL free count
+    covers the export must still refuse when one shard overflows."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    import pytest
+
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+    clock = lambda: 61_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=9, window_ms=60_000, refill_rate=0.001)
+    engine = ShardedDeviceEngine(slots_per_shard=4, table=LimiterTable(),
+                                 mesh=make_mesh())
+    n_shards = engine.n_shards
+
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock, checkpointable=True)
+    lid = src.register_limiter("tb", cfg)
+    # More keys on ONE target shard than its 4 local slots, while total
+    # stays far under the target's global capacity.
+    hot = [k for k in range(1000)
+           if shard_of_key((lid, k), n_shards) == 0][:6]
+    assert len(hot) == 6
+    _consume(src, lid, hot, [1] * len(hot))
+    dump = ck.export_keys(src)
+    src.close()
+
+    dst = TpuBatchedStorage(engine=engine, clock_ms=clock, checkpointable=True)
+    dst.register_limiter("tb", cfg)
+    with pytest.raises(ValueError, match="shard 0 is too small"):
+        ck.import_keys(dst, dump)
+    # The refusal must be up-front: nothing was assigned in the target.
+    assert len(dst._index["tb"]) == 0
+    dst.close()

@@ -208,3 +208,27 @@ def test_legacy_contract_still_works_on_tpu_storage():
     assert storage.z_count("z", 0, 2) == 1
     assert storage.is_available()
     storage.close()
+
+
+def test_stream_permits_over_i32_denied_not_wrapped():
+    """The stream path carries permits as i32 lanes; a value past 2^31-1
+    would wrap negative and turn a reject into an allow-with-credit.  It
+    must be DENIED (identical to the i64 batch path, where any permits
+    above int32 exceeds every limiter's max_permits) — and must not
+    consume or credit tokens for neighbouring requests."""
+    import numpy as np
+
+    storage = TpuBatchedStorage(num_slots=64, clock_ms=lambda: 10_000)
+    lid = storage.register_limiter(
+        "tb", RateLimitConfig(max_permits=5, window_ms=1000, refill_rate=1.0))
+    got = storage.acquire_stream_ids(
+        "tb", lid, np.asarray([1, 1, 1], dtype=np.int64),
+        np.asarray([1, 1 << 31, 4], dtype=np.int64), batch=16, subbatches=1)
+    # 1 allowed; oversized denied; 4 still allowed (bucket untouched by #2).
+    assert got.tolist() == [True, False, True]
+    # Batch-path agreement on a fresh key.
+    batch = storage.acquire_many_ids(
+        "tb", lid, np.asarray([2], dtype=np.int64),
+        np.asarray([1 << 31], dtype=np.int64))
+    assert not batch["allowed"][0]
+    storage.close()
