@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ratelimiter_tpu.engine.state import LimiterTable, SWState, TBState
+from ratelimiter_tpu.ops.flat import sw_flat_bits, tb_flat_bits
 from ratelimiter_tpu.ops.packed import (
     decode_sw_fused,
     decode_tb_fused,
@@ -86,6 +87,8 @@ class DeviceEngine:
         self._tb_step = jax.jit(tb_step_fused, donate_argnums=0)
         self._sw_scan = jax.jit(sw_scan_bits, donate_argnums=0)
         self._tb_scan = jax.jit(tb_scan_bits, donate_argnums=0)
+        self._sw_flat = jax.jit(sw_flat_bits, donate_argnums=0)
+        self._tb_flat = jax.jit(tb_flat_bits, donate_argnums=0)
         self._sw_peek = jax.jit(sw_peek_p)
         self._tb_peek = jax.jit(tb_peek_p)
         self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
@@ -179,6 +182,37 @@ class DeviceEngine:
                 self.tb_packed, bits = self._tb_scan(
                     self.tb_packed, self.table.device_arrays,
                     slots_kb, lids, permits_kb, now_k)
+        return bits
+
+    # -- flat mega-batch dispatch (ops/flat.py) --------------------------------
+    # The streaming hot path: one flat sorted batch per dispatch (all
+    # requests share the dispatch timestamp), bit-packed decisions back.
+
+    def sw_flat_dispatch(self, slots, lids, permits, now_ms):
+        return self._flat_dispatch("sw", slots, lids, permits, now_ms)
+
+    def tb_flat_dispatch(self, slots, lids, permits, now_ms):
+        return self._flat_dispatch("tb", slots, lids, permits, now_ms)
+
+    def _flat_dispatch(self, algo, slots, lids, permits, now_ms):
+        slots = jnp.asarray(np.ascontiguousarray(slots, dtype=np.int32))
+        if np.ndim(lids) == 0:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        if permits is not None:
+            permits = jnp.asarray(
+                np.ascontiguousarray(permits, dtype=np.int32))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, bits = self._sw_flat(
+                    self.sw_packed, self.table.device_arrays,
+                    slots, lids, permits, now)
+            else:
+                self.tb_packed, bits = self._tb_flat(
+                    self.tb_packed, self.table.device_arrays,
+                    slots, lids, permits, now)
         return bits
 
     # -- read-only ------------------------------------------------------------

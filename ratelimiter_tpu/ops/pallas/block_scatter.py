@@ -1,0 +1,161 @@
+"""Pallas TPU dense block-scatter for sorted-unique row updates.
+
+XLA's generic scatter on TPU costs ~45 ns/index (~179 ms to write 4M rows
+of a 1M-slot table — bench/profile_step.py), far above the HBM-bandwidth
+floor for the same bytes.  But the streaming step's scatter has structure
+XLA cannot exploit: the batch is sorted by slot and carries at most one
+surviving write per slot (the segment-last row of each sorted duplicate
+run).  That makes the scatter expressible as a DENSE sweep:
+
+    for each aligned block of T consecutive state rows:
+        the updates touching it sit in a contiguous window of the
+        (compacted, slot-sorted) update array, at most T long
+        -> load block + window into VMEM, select per row, write back
+
+Pipeline:
+1. Compact: one payload-carrying ``lax.sort`` moves masked-out lanes to
+   the tail (key = slot for live updates, S sentinel otherwise), leaving
+   live updates sorted by slot and unique.
+2. Window map: ``searchsorted`` of the T-aligned block boundaries over the
+   compacted keys, divided down to block granularity — per state block i a
+   scalar sigma[i] such that update-blocks [sigma[i], sigma[i]+1] cover
+   every update for block i (<= T updates, any exact window start spans at
+   most two aligned T-blocks).
+3. One ``pallas_call`` over the S/T state blocks: the update windows are
+   pulled through VMEM by BlockSpec index_maps reading sigma (scalar
+   prefetch — DMA double-buffering comes free from the grid pipeline);
+   per row the matching update (if any) is selected by compare-and-sum
+   over the window, which is exact because slots are unique.
+
+HBM traffic: read S + 2B rows, write S rows — bandwidth-bound instead of
+per-index-bound.  The state output aliases the state input (in-place in
+HBM, composing with the caller's donated buffers).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+T = 256          # state rows per block; S must divide by this
+_CHUNK = 128     # window columns folded per VPU select-sum pass
+
+_FLAG = os.environ.get("RATELIMITER_BLOCK_SCATTER", "1") == "1"
+_INTERPRET = os.environ.get("RATELIMITER_BLOCK_SCATTER_INTERPRET", "0") == "1"
+_probe_ok: bool | None = None
+
+
+def _kernel(sigma_ref, state_ref, upd_a_ref, upd_b_ref, out_ref, *, lanes):
+    del sigma_ref, lanes  # sigma is consumed by the index_maps
+    block = state_ref[...]                       # (T, lanes)
+    win = jnp.concatenate([upd_a_ref[...], upd_b_ref[...]], axis=0)
+    w_slot = win[:, 0]                           # (2T,) compacted slot keys
+    w_rows = win[:, 1:]                          # (2T, lanes)
+    t_slot = T * pl.program_id(0) + jax.lax.broadcasted_iota(
+        jnp.int32, (T,), 0)
+
+    acc = jnp.zeros(block.shape, dtype=jnp.int32)
+    anym = jnp.zeros((T,), dtype=jnp.bool_)
+    for c in range(0, 2 * T, _CHUNK):
+        eq = w_slot[None, c:c + _CHUNK] == t_slot[:, None]   # (T, CHUNK)
+        anym = anym | eq.any(axis=1)
+        # Unique slots => at most one hit per row: select-sum is exact.
+        acc = acc + jnp.sum(
+            eq[:, :, None].astype(jnp.int32) * w_rows[None, c:c + _CHUNK, :],
+            axis=1, dtype=jnp.int32)
+    out_ref[...] = jnp.where(anym[:, None], acc, block)
+
+
+try:  # import guarded so CPU-only environments can still load the module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # noqa: BLE001
+    pl = None
+    pltpu = None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _block_scatter(state, upd, sigma, interpret: bool = False):
+    """state (S, L) i32; upd (B, 1+L) i32 lane0=compacted slot key;
+    sigma (S/T,) i32 aligned window starts (units of T)."""
+    s_rows, lanes = state.shape
+    grid = s_rows // T
+    kernel = functools.partial(_kernel, lanes=lanes)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((T, lanes), lambda i, sig: (i, 0)),
+            pl.BlockSpec((T, 1 + lanes), lambda i, sig: (sig[i], 0)),
+            pl.BlockSpec((T, 1 + lanes), lambda i, sig: (sig[i] + 1, 0)),
+        ],
+        out_specs=pl.BlockSpec((T, lanes), lambda i, sig: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct(state.shape, state.dtype),
+        input_output_aliases={1: 0},  # state buffer updated in place
+        interpret=interpret,
+    )(sigma, state, upd, upd)
+
+
+def scatter_rows(state, sorted_slots, write_mask, rows,
+                 interpret: bool | None = None):
+    """Drop-in for the XLA drop-mode scatter over sorted-unique writes.
+
+    state i32[S, L]; sorted_slots i32[B] ascending (padding < 0 first);
+    write_mask bool[B] with at most one True per slot; rows i32[B, L].
+    """
+    if interpret is None:
+        interpret = _INTERPRET
+    s_rows, lanes = state.shape
+    n = sorted_slots.shape[0]
+    key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
+    ops = jax.lax.sort(
+        (key,) + tuple(rows[:, j] for j in range(lanes)), num_keys=1)
+    upd = jnp.stack(ops, axis=1)                 # (B, 1+L), live-first
+    bounds = jnp.arange(s_rows // T, dtype=jnp.int32) * T
+    starts = jnp.searchsorted(ops[0], bounds).astype(jnp.int32)
+    sigma = jnp.clip(starts // T, 0, n // T - 2)
+    return _block_scatter(state, upd, sigma, interpret=interpret)
+
+
+def supported(state_shape, batch: int) -> bool:
+    """Static geometry gate: aligned table, window-coverable batch."""
+    s_rows = state_shape[0]
+    return (pl is not None and s_rows % T == 0 and s_rows // T >= 1
+            and batch >= 2 * T and batch % T == 0)
+
+
+def _probe() -> bool:
+    """One-time self-check on this platform: tiny scatter vs XLA truth."""
+    global _probe_ok
+    if _probe_ok is None:
+        try:
+            rng = np.random.default_rng(7)
+            s = jnp.asarray(rng.integers(0, 1 << 30, (2 * T, 3), np.int32))
+            slots = np.sort(rng.choice(2 * T, size=2 * T, replace=True))
+            mask = np.r_[np.diff(slots) != 0, True]
+            rows = rng.integers(0, 1 << 30, (2 * T, 3), np.int32)
+            got = np.asarray(scatter_rows(
+                s, jnp.asarray(slots.astype(np.int32)), jnp.asarray(mask),
+                jnp.asarray(rows), interpret=_INTERPRET))
+            want = np.asarray(s).copy()
+            want[slots[mask]] = rows[mask]
+            _probe_ok = bool((got == want).all())
+        except Exception:  # noqa: BLE001 — any lowering failure => fallback
+            _probe_ok = False
+    return _probe_ok
+
+
+def enabled(state_shape, batch: int) -> bool:
+    if not _FLAG or not supported(state_shape, batch):
+        return False
+    if not (_INTERPRET or jax.default_backend() == "tpu"):
+        return False
+    return _probe()

@@ -35,6 +35,11 @@ DEFAULTS = {
     # (0 = off) and/or add latency to every op (fault-tolerance rehearsal).
     "chaos.failure_rate": "0",
     "chaos.latency_ms": "0",
+    # Per-op storage retry (RedisRateLimitStorage.java:155-178 analog):
+    # attempts with linear backoff delay*attempt, then StorageException
+    # escalates to fail-open. 0 retries disables the wrapper.
+    "storage.retry.max_retries": "3",
+    "storage.retry.delay_ms": "10",
 }
 
 

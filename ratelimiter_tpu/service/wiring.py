@@ -123,6 +123,21 @@ def _maybe_chaos(storage: RateLimitStorage, props: AppProperties):
                                  latency_ms=latency)
 
 
+def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
+    """Per-op retry around the (possibly chaos-wrapped) backend — the
+    RedisRateLimitStorage.java:155-178 analog, composed so transient faults
+    are absorbed here and only retry exhaustion reaches fail-open."""
+    from ratelimiter_tpu.storage.errors import RetryPolicy
+    from ratelimiter_tpu.storage.retry import RetryingStorage
+
+    attempts = props.get_int("storage.retry.max_retries", 3)
+    if attempts <= 0:
+        return storage
+    return RetryingStorage(storage, RetryPolicy(
+        max_retries=attempts,
+        retry_delay_ms=props.get_float("storage.retry.delay_ms", 10.0)))
+
+
 def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
@@ -136,7 +151,7 @@ def build_app(props: AppProperties | None = None,
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
-        storage = _maybe_chaos(storage, props)
+        storage = _maybe_retry(_maybe_chaos(storage, props), props)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache

@@ -2,12 +2,14 @@ from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.chaos import FaultInjectingStorage
 from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
 from ratelimiter_tpu.storage.memory import InMemoryStorage
+from ratelimiter_tpu.storage.retry import RetryingStorage
 from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
 __all__ = [
     "FaultInjectingStorage",
     "RateLimitStorage",
     "InMemoryStorage",
+    "RetryingStorage",
     "TpuBatchedStorage",
     "RetryPolicy",
     "StorageException",

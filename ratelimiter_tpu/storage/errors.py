@@ -21,17 +21,27 @@ class StorageException(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
-    """Linear-backoff retry (RedisRateLimitStorage.java:19-20,155-178)."""
+    """Linear-backoff retry (RedisRateLimitStorage.java:19-20,155-178).
+
+    Caller-side programming/validation errors (``no_retry``) pass straight
+    through: the Java wrapper retried JedisException — transport faults —
+    not argument errors, and converting a ValueError into StorageException
+    would hand it to the fail-open policy, silently allowing requests a
+    caller bug produced.
+    """
 
     max_retries: int = 3
     retry_delay_ms: float = 10.0
+    no_retry: tuple = (ValueError, TypeError, KeyError)
 
     def execute(self, operation: Callable[[], T], sleep=time.sleep) -> T:
         last_exc: Exception | None = None
         for attempt in range(self.max_retries):
             try:
                 return operation()
-            except Exception as exc:  # noqa: BLE001 — parity: catches everything
+            except self.no_retry:
+                raise
+            except Exception as exc:  # noqa: BLE001 — transport/storage faults
                 last_exc = exc
                 if attempt < self.max_retries - 1:
                     sleep(self.retry_delay_ms * (attempt + 1) / 1000.0)

@@ -320,10 +320,13 @@ class TpuBatchedStorage(RateLimitStorage):
         if oversize is not None:
             permits = np.where(oversize, 1, permits)  # lanes masked, see above
         n = len(key_ids)
-        k, b = int(subbatches), int(batch)
-        super_n = k * b
-        dispatch = (self.engine.sw_scan_dispatch if algo == "sw"
-                    else self.engine.tb_scan_dispatch)
+        super_n = int(subbatches) * int(batch)
+        # One FLAT dispatch per super-batch (ops/flat.py): every request in
+        # a dispatch shares its timestamp, so the flat sorted batch decides
+        # identically to `subbatches` sequential scan steps — at a fraction
+        # of the device time (payload-carrying sorts + closed-form solve).
+        dispatch = (self.engine.sw_flat_dispatch if algo == "sw"
+                    else self.engine.tb_flat_dispatch)
         clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
 
         out = np.empty(n, dtype=bool)
@@ -331,10 +334,9 @@ class TpuBatchedStorage(RateLimitStorage):
         pending: list[tuple[int, int, object, float]] = []
 
         def drain(handle, start, count, t0):
-            arr = np.asarray(handle)  # uint8[k, b//8] — the one blocking fetch
+            arr = np.asarray(handle)  # uint8[super_n//8] — the one blocking fetch
             dt_us = (time.perf_counter() - t0) * 1e6
-            flat = np.unpackbits(arr, axis=1)[:, :b].reshape(-1).astype(bool)
-            got = flat[:count]
+            got = np.unpackbits(arr)[:count].astype(bool)
             out[start:start + count] = got
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
@@ -353,14 +355,13 @@ class TpuBatchedStorage(RateLimitStorage):
             slots = _pad_tail(slots, super_n, -1, np.int32)
             if oversize is not None:
                 slots[:cn][oversize[start:start + cn]] = -1  # force-deny
-            lid_kb = lid if not multi_lid else _pad_tail(
-                lid_arr[start:start + cn], super_n, 0, np.int32).reshape(k, b)
-            p_kb = None if permits is None else _pad_tail(
-                permits[start:start + cn], super_n, 1, np.int32).reshape(k, b)
+            lid_flat = lid if not multi_lid else _pad_tail(
+                lid_arr[start:start + cn], super_n, 0, np.int32)
+            p_flat = None if permits is None else _pad_tail(
+                permits[start:start + cn], super_n, 1, np.int32)
             now = self._monotonic_now()
             t0 = time.perf_counter()
-            bits = dispatch(slots.reshape(k, b), lid_kb, p_kb,
-                            np.full(k, now, dtype=np.int64))
+            bits = dispatch(slots, lid_flat, p_flat, now)
             pending.append((start, cn, bits, t0))
             if len(pending) > 1:
                 s0, c0, h0, pt0 = pending.pop(0)

@@ -92,3 +92,134 @@ def test_chaos_wraps_device_storage_stream():
     with pytest.raises(StorageException):
         chaos.acquire_stream_ids("tb", lid, ids, None, batch=4, subbatches=1)
     chaos.close()
+
+
+def test_default_wiring_composes_retry_over_chaos():
+    """build_app wires retry(chaos(storage)): transient faults are absorbed
+    by the retry layer (the RedisRateLimitStorage.java:155-178 analog) and
+    never reach the caller; only exhaustion escalates."""
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import build_app
+    from ratelimiter_tpu.storage.retry import RetryingStorage
+
+    props = AppProperties({
+        "storage.backend": "memory",
+        "chaos.failure_rate": "0.3",   # any nonzero rate arms the injector
+        "storage.retry.max_retries": "3",
+        "storage.retry.delay_ms": "0.1",
+        "warmup.enabled": "false",
+    })
+    ctx = build_app(props)
+    try:
+        assert isinstance(ctx.storage, RetryingStorage)
+        chaos = ctx.storage._inner
+        assert isinstance(chaos, FaultInjectingStorage)
+        chaos.failure_rate = 0.0  # deterministic: forced faults only
+
+        # Two transients: absorbed (3 attempts) — the decision still lands.
+        chaos.fail_next(2)
+        assert ctx.limiters["auth"].try_acquire("bob")
+        assert chaos.injected_failures >= 2
+
+        # Exhaustion: three forced faults beat 3 attempts on ONE op.
+        chaos.fail_next(3)
+        with pytest.raises(StorageException):
+            ctx.limiters["auth"].try_acquire("bob")
+    finally:
+        ctx.close()
+
+
+def test_retry_exhaustion_reaches_fail_open_counter():
+    """Service-level accounting: only retry exhaustion lands in the
+    fail-open counter; absorbed transients don't."""
+    from ratelimiter_tpu.service.app import make_server
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import build_app
+    import json
+    import urllib.request
+
+    props = AppProperties({
+        "storage.backend": "memory",
+        "chaos.failure_rate": "0.0001",  # armed but ~quiet
+        "storage.retry.max_retries": "2",
+        "storage.retry.delay_ms": "0.1",
+        "warmup.enabled": "false",
+        "server.port": "0",
+    })
+    ctx = build_app(props)
+    server = make_server(ctx)
+    import threading
+
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    port = server.server_address[1]
+    chaos = ctx.storage._inner
+    chaos.failure_rate = 0.0  # deterministic: forced faults only
+
+    def hit():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/data",
+            headers={"X-User-ID": "carol"})
+        with urllib.request.urlopen(req) as resp:
+            return resp.status
+
+    def metric():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/actuator/metrics") as resp:
+            data = json.loads(resp.read())
+        return data["meters"].get("ratelimiter.failopen.allowed", 0)
+
+    try:
+        assert hit() == 200
+        # One transient: retry absorbs it, no fail-open.
+        chaos.fail_next(1)
+        assert hit() == 200
+        assert metric() == 0
+        # Exhaustion (2 attempts, 2 faults): fail-open allows and counts.
+        chaos.fail_next(2)
+        assert hit() == 200
+        assert metric() == 1
+    finally:
+        server.shutdown()
+        ctx.close()
+
+
+def test_retry_policy_skips_validation_errors():
+    """Programming/validation errors are not transport faults: no retry, no
+    StorageException conversion — they must never reach fail-open."""
+    calls = []
+
+    def op():
+        calls.append(1)
+        raise ValueError("bad arg")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=3, retry_delay_ms=0.1).execute(op)
+    assert len(calls) == 1
+
+
+def test_stream_ops_pass_through_retry_unreplayed():
+    """Batch/stream decision ops mutate state per super-batch: a replay
+    would re-charge already-committed requests, so the retry wrapper must
+    NOT replay them — while single acquire (replay-safe, reference parity)
+    is retried."""
+    from ratelimiter_tpu.storage.retry import RetryingStorage
+
+    clock = lambda: 20_000  # noqa: E731
+    inner = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    chaos = FaultInjectingStorage(inner)
+    st = RetryingStorage(chaos, RetryPolicy(max_retries=3,
+                                            retry_delay_ms=0.1))
+    lid = st.register_limiter("tb", RateLimitConfig(
+        max_permits=5, window_ms=1000, refill_rate=1.0))
+
+    chaos.fail_next(1)
+    with pytest.raises(StorageException):
+        st.acquire_stream_ids("tb", lid, np.zeros(4, np.int64), None,
+                              batch=4, subbatches=1)
+    assert chaos.injected_failures == 1  # exactly one attempt — no replay
+
+    chaos.fail_next(1)  # transient on the single-acquire path: absorbed
+    out = st.acquire("tb", lid, "k", 1)
+    assert out["allowed"]
+    st.close()
