@@ -56,6 +56,7 @@ def main() -> None:
     )
     from ratelimiter_tpu.bench.harness import (
         bench_end_to_end,
+        bench_end_to_end_stream,
         bench_threaded,
         uniform_stream,
         zipf_stream,
@@ -109,11 +110,10 @@ def main() -> None:
     headline = res["decisions_per_sec"]
     log(f"  stream (int keys): {headline:,.0f} decisions/s")
 
-    # String-key end-to-end (Python key handling included; batcher path).
-    n_str = min(n_requests, 200_000)
+    # String-key end-to-end (Python key handling included; streamed).
+    n_str = min(n_requests, 50_000 if small else 2_000_000)
     keys = [f"k{i}" for i in key_ids[:n_str]]
-    res = bench_end_to_end(tb_limiter, keys,
-                           np.ones(n_str, dtype=np.int64), 1 << 14)
+    res = bench_end_to_end_stream(tb_limiter, keys, None)
     detail["tb_1m_zipf_end_to_end_strs"] = res
     log(f"  end-to-end (str keys): {res['decisions_per_sec']:,.0f} decisions/s")
     storage.close()

@@ -25,6 +25,11 @@ from ratelimiter_tpu.core.limiter import RateLimiter
 from ratelimiter_tpu.metrics import MeterRegistry
 from ratelimiter_tpu.storage.base import RateLimitStorage
 
+# Batches at or above this size route through the pipelined
+# string-stream path (storage.acquire_stream_strs) instead of one
+# synchronous device batch.
+_STREAM_MIN = 1 << 15
+
 
 def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
@@ -124,6 +129,18 @@ class SlidingWindowRateLimiter(RateLimiter):
         permits = [1] * n if permits is None else [int(p) for p in permits]
         if any(p <= 0 for p in permits):
             raise ValueError("permits must be positive")
+        if (n >= _STREAM_MIN and self._local_cache is None
+                and hasattr(self._storage, "acquire_stream_strs")):
+            # Large cache-less call: pipelined string streaming — decisions
+            # identical to acquire_many (cache-enabled limiters keep the
+            # batch path, which returns the cache_value lane).
+            allowed = np.asarray(self._storage.acquire_stream_strs(
+                "sw", self._lid, list(keys),
+                np.asarray(permits, dtype=np.int64)), dtype=bool)
+            n_allowed = int(allowed.sum())
+            self._allowed.add(n_allowed)
+            self._rejected.add(n - n_allowed)
+            return allowed
         out = self._storage.acquire_many(
             "sw", [self._lid] * n, list(keys), permits)
         allowed = np.asarray(out["allowed"], dtype=bool)

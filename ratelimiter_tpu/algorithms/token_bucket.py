@@ -24,6 +24,11 @@ from ratelimiter_tpu.core.limiter import RateLimiter
 from ratelimiter_tpu.metrics import MeterRegistry
 from ratelimiter_tpu.storage.base import RateLimitStorage
 
+# Batches at or above this size route through the pipelined
+# string-stream path (storage.acquire_stream_strs) instead of one
+# synchronous device batch.
+_STREAM_MIN = 1 << 15
+
 
 def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
@@ -101,9 +106,16 @@ class TokenBucketRateLimiter(RateLimiter):
         if any(p <= 0 for p in permits):
             raise ValueError("permits must be positive")
         # The device kernel itself rejects permits > capacity pre-consume.
-        out = self._storage.acquire_many(
-            "tb", [self._lid] * n, list(keys), permits)
-        allowed = np.asarray(out["allowed"], dtype=bool)
+        if n >= _STREAM_MIN and hasattr(self._storage, "acquire_stream_strs"):
+            # Large call: pipelined string streaming (host hashing rides in
+            # the fetch shadow) — decisions identical to acquire_many.
+            allowed = self._storage.acquire_stream_strs(
+                "tb", self._lid, list(keys),
+                np.asarray(permits, dtype=np.int64))
+        else:
+            out = self._storage.acquire_many(
+                "tb", [self._lid] * n, list(keys), permits)
+            allowed = np.asarray(out["allowed"], dtype=bool)
         n_allowed = int(allowed.sum())
         self._allowed.add(n_allowed)
         self._rejected.add(n - n_allowed)

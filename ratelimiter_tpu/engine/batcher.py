@@ -2,25 +2,36 @@
 
 The reference's unit of concurrency is a servlet thread blocking on a Redis
 round-trip (~800 us, ARCHITECTURE.md latency model); ours is a Future that
-resolves when the next device batch lands.  Threads submit requests; a
+resolves when its device batch's results land.  Threads submit requests; a
 dedicated flusher thread dispatches a batch when either
 
 - the pending batch reaches ``max_batch``, or
 - the oldest pending request has waited ``max_delay_ms`` (adaptive flush:
   size OR deadline — SURVEY.md §7 "Batching latency vs p99"),
 
-whichever comes first.  The queue lock is released during device execution
-so new requests accumulate while the previous batch runs (host/device
-pipelining); dispatches are serialized, preserving batch order, which is
-what makes eviction-clears safe (cleared slots are zeroed in the same
-dispatch stream before the batch that reuses them).
+whichever comes first.
+
+**Pipelined dispatch/drain.**  Dispatching a batch (enqueue on device,
+state advanced) and draining it (the blocking device->host fetch that
+resolves the waiters' futures) are decoupled: the flusher only dispatches;
+a pool of drain threads fetches.  Up to ``max_inflight`` batches ride the
+wire at once — the fetches themselves overlap each other, not just the
+next dispatch, which matters on a high-latency link (the tunneled
+device's ~110 ms fetch is round-trip latency, not occupancy): throughput
+goes from one batch per round trip to one batch per flush interval.
+Correctness does not depend on drain order: dispatches are serialized
+(single flusher + the dispatch lock), so device state advances in
+submission order; each drain only reads its own batch's output buffer.
+
+Eviction-clears stay safe for the same reason: cleared slots are zeroed in
+the dispatch stream ahead of the batch that reuses them.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
 
@@ -41,19 +52,31 @@ class MicroBatcher:
 
     def __init__(
         self,
-        dispatch: Dict[str, Callable],      # algo -> fn(slots, lids, permits) -> dict
+        dispatch: Dict[str, Callable],      # algo -> fn(slots, lids, permits) -> handle
         clear: Dict[str, Callable],         # algo -> fn(slots) -> None
+        drain: Dict[str, Callable] | None = None,  # algo -> fn(handle, n) -> dict
         max_batch: int = 8192,
         max_delay_ms: float = 0.5,
+        max_inflight: int = 4,
     ):
         self._dispatch = dispatch
+        # Without a drain fn the dispatch result IS the output dict
+        # (synchronous mode — tests and simple backends).
+        self._drain = drain or {}
         self._clear = clear
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.max_inflight = max(int(max_inflight), 1)
         self._cv = threading.Condition()
         self._pending: Dict[str, _Pending] = {a: _Pending() for a in dispatch}
         self._dispatch_lock = threading.Lock()  # serializes device batches
         self._closed = False
+        # Concurrent fetches: one worker per in-flight batch; the semaphore
+        # is the backpressure bound on the device queue.
+        self._drain_pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight,
+            thread_name_prefix="ratelimiter-drain")
+        self._inflight_sem = threading.Semaphore(self.max_inflight)
         self._flusher = threading.Thread(
             target=self._run, name="ratelimiter-flusher", daemon=True)
         self._flusher.start()
@@ -97,10 +120,39 @@ class MicroBatcher:
         return pend
 
     def flush(self) -> None:
-        """Synchronously dispatch everything pending (admin/reset/shutdown)."""
+        """Dispatch everything pending (admin/reset/shutdown and read
+        barriers).  Returns once the batches are in the device stream —
+        later reads observe them (dispatch order == device order); the
+        waiters' futures resolve asynchronously via the drainer."""
         with self._cv:
             taken = {a: self._take(a) for a in self._pending}
         self._execute(taken)
+
+    def _resolve(self, algo: str, handle, futures: List[Future]) -> None:
+        """Fetch a dispatched batch's results and resolve its futures."""
+        try:
+            drain = self._drain.get(algo)
+            out = drain(handle, len(futures)) if drain else handle
+            for i, fut in enumerate(futures):
+                fut.set_result({k: v[i] for k, v in out.items()})
+        except Exception as exc:  # noqa: BLE001 — fail every waiter
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    def _enqueue_drain(self, algo: str, handle, futures: List[Future]) -> None:
+        self._inflight_sem.acquire()  # backpressure on the device queue
+
+        def job():
+            try:
+                self._resolve(algo, handle, futures)
+            finally:
+                self._inflight_sem.release()
+
+        try:
+            self._drain_pool.submit(job)
+        except RuntimeError:  # pool shut down mid-close: resolve inline
+            job()
 
     def _execute(self, taken) -> None:
         with self._dispatch_lock:
@@ -114,9 +166,9 @@ class MicroBatcher:
                 if pend.clears:
                     self._clear[algo](pend.clears)
                 if pend.slots:
-                    out = self._dispatch[algo](pend.slots, pend.lids, pend.permits)
-                    for i, fut in enumerate(pend.futures):
-                        fut.set_result({k: v[i] for k, v in out.items()})
+                    handle = self._dispatch[algo](
+                        pend.slots, pend.lids, pend.permits)
+                    self._enqueue_drain(algo, handle, pend.futures)
             except Exception as exc:  # noqa: BLE001 — fail every waiter
                 for fut in pend.futures:
                     if not fut.done():
@@ -125,9 +177,11 @@ class MicroBatcher:
     def dispatch_direct(self, algo: str, slots, lids, permits, clears=None):
         """Synchronous whole-batch dispatch (the vectorized/bench path).
 
-        Flushes everything pending first, then runs this batch under the same
-        dispatch lock — so direct batches serialize with queued traffic and
-        see a consistent state stream.
+        Flushes everything pending first, then runs this batch under the
+        same dispatch lock — so direct batches serialize with queued
+        traffic and see a consistent state stream.  The direct batch's own
+        fetch happens inline (its results are independent of the queued
+        batches' fetches, which continue to drain in the background).
         """
         with self._cv:
             taken = {a: self._take(a) for a in self._pending}
@@ -135,7 +189,9 @@ class MicroBatcher:
             self._execute_locked(taken)
             if clears:
                 self._clear[algo](clears)
-            return self._dispatch[algo](slots, lids, permits)
+            handle = self._dispatch[algo](slots, lids, permits)
+        drain = self._drain.get(algo)
+        return drain(handle, len(slots)) if drain else handle
 
     def _run(self) -> None:
         while True:
@@ -168,3 +224,5 @@ class MicroBatcher:
             self._cv.notify_all()
         self._flusher.join(timeout=5)
         self.flush()
+        # Resolve whatever is still on the wire before returning.
+        self._drain_pool.shutdown(wait=True)

@@ -114,43 +114,59 @@ class DeviceEngine:
             TBState(*(jnp.asarray(f) for f in state)))
 
     # -- acquire --------------------------------------------------------------
+    # Each step is split into DISPATCH (enqueue on device, state updated,
+    # returns a lazy output handle — engine lock held only here) and DRAIN
+    # (the blocking device->host fetch + decode, outside the lock).  The
+    # split is what lets the micro-batcher keep several batches in flight:
+    # the next dispatch runs while previous fetches are still on the wire.
+
+    def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
+        """Dispatch a sliding-window batch; returns a lazy fused handle
+        (pass to :meth:`sw_acquire_drain` with the batch length)."""
+        size = _bucket_size(len(slots))
+        with self._lock:
+            new_state, packed = self._sw_step(
+                self.sw_packed,
+                self.table.device_arrays,
+                _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
+                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+                _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
+                jnp.int64(now_ms),
+            )
+            self.sw_packed = new_state
+        return packed
+
+    @staticmethod
+    def sw_acquire_drain(handle, n: int):
+        return decode_sw_fused(np.asarray(handle)[:, :n])
+
     def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
         """Batched sliding-window tryAcquire. Returns dict of numpy arrays
         (allowed, mutated, observed, cache_value), trimmed to the input size."""
-        n = len(slots)
-        size = _bucket_size(n)
-        with self._lock:
-            return self._sw_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
+        handle = self.sw_acquire_dispatch(slots, limiter_ids, permits, now_ms)
+        return self.sw_acquire_drain(handle, len(slots))
 
-    def _sw_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
-        new_state, packed = self._sw_step(
-            self.sw_packed,
-            self.table.device_arrays,
-            _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
-            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-            _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
-            jnp.int64(now_ms),
-        )
-        self.sw_packed = new_state
-        return decode_sw_fused(np.asarray(packed)[:, :n])
+    def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
+        size = _bucket_size(len(slots))
+        with self._lock:
+            new_state, packed = self._tb_step(
+                self.tb_packed,
+                self.table.device_arrays,
+                _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
+                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
+                _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
+                jnp.int64(now_ms),
+            )
+            self.tb_packed = new_state
+        return packed
+
+    @staticmethod
+    def tb_acquire_drain(handle, n: int):
+        return decode_tb_fused(np.asarray(handle)[:, :n])
 
     def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
-        n = len(slots)
-        size = _bucket_size(n)
-        with self._lock:
-            return self._tb_acquire_locked(n, size, slots, limiter_ids, permits, now_ms)
-
-    def _tb_acquire_locked(self, n, size, slots, limiter_ids, permits, now_ms):
-        new_state, packed = self._tb_step(
-            self.tb_packed,
-            self.table.device_arrays,
-            _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
-            _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-            _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
-            jnp.int64(now_ms),
-        )
-        self.tb_packed = new_state
-        return decode_tb_fused(np.asarray(packed)[:, :n])
+        handle = self.tb_acquire_dispatch(slots, limiter_ids, permits, now_ms)
+        return self.tb_acquire_drain(handle, len(slots))
 
     # -- scan dispatch (K sub-batches, bit-packed decisions) -------------------
     # The hyperscale streaming path: one device dispatch for K*B decisions,

@@ -259,6 +259,11 @@ class ShardedDeviceEngine:
         self.table = table
         self._lock = threading.RLock()
         self.last_step_totals = (0, 0)
+        # Monotone stamp so concurrent drains (the batcher's drain pool
+        # completes batches in arbitrary order) can't regress
+        # last_step_totals to an older batch.
+        self._totals_seq = 0
+        self._totals_seen = 0
 
         self._state_sharding = NamedSharding(self.mesh, P(SHARD_AXIS, None, None))
 
@@ -381,8 +386,9 @@ class ShardedDeviceEngine:
         perms[shard, cols] = np.asarray(permits, dtype=np.int64)
         return mat, lids, perms, shard, cols
 
-    # -- public API (mirrors DeviceEngine) ------------------------------------
-    def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
+    # -- public API (mirrors DeviceEngine, incl. the dispatch/drain split
+    # that lets the micro-batcher pipeline fetches against dispatches) ------
+    def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         with self._lock:
             new_state, out, totals = self._sw_step(
@@ -390,16 +396,32 @@ class ShardedDeviceEngine:
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
                 jnp.int64(now_ms))
             self.sw_packed = new_state
-            totals = np.asarray(totals)
-            self.last_step_totals = (int(totals[0]), int(totals[1]))
-            return {
-                "allowed": np.asarray(out.allowed)[shard, cols],
-                "mutated": np.asarray(out.mutated)[shard, cols],
-                "observed": np.asarray(out.observed)[shard, cols],
-                "cache_value": np.asarray(out.cache_value)[shard, cols],
-            }
+            self._totals_seq += 1
+            seq = self._totals_seq
+        return (out, totals, shard, cols, seq)
 
-    def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
+    def sw_acquire_drain(self, handle, n: int):
+        out, totals, shard, cols, seq = handle
+        totals = np.asarray(totals)
+        self._set_totals(seq, (int(totals[0]), int(totals[1])))
+        return {
+            "allowed": np.asarray(out.allowed)[shard, cols],
+            "mutated": np.asarray(out.mutated)[shard, cols],
+            "observed": np.asarray(out.observed)[shard, cols],
+            "cache_value": np.asarray(out.cache_value)[shard, cols],
+        }
+
+    def _set_totals(self, seq: int, totals) -> None:
+        with self._lock:
+            if seq > self._totals_seen:
+                self._totals_seen = seq
+                self.last_step_totals = totals
+
+    def sw_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        handle = self.sw_acquire_dispatch(slots, limiter_ids, permits, now_ms)
+        return self.sw_acquire_drain(handle, len(slots))
+
+    def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         with self._lock:
             new_state, out, totals = self._tb_step(
@@ -407,13 +429,23 @@ class ShardedDeviceEngine:
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
                 jnp.int64(now_ms))
             self.tb_packed = new_state
-            totals = np.asarray(totals)
-            self.last_step_totals = (int(totals[0]), int(totals[1]))
-            return {
-                "allowed": np.asarray(out.allowed)[shard, cols],
-                "observed": np.asarray(out.observed)[shard, cols],
-                "remaining": np.asarray(out.remaining)[shard, cols],
-            }
+            self._totals_seq += 1
+            seq = self._totals_seq
+        return (out, totals, shard, cols, seq)
+
+    def tb_acquire_drain(self, handle, n: int):
+        out, totals, shard, cols, seq = handle
+        totals = np.asarray(totals)
+        self._set_totals(seq, (int(totals[0]), int(totals[1])))
+        return {
+            "allowed": np.asarray(out.allowed)[shard, cols],
+            "observed": np.asarray(out.observed)[shard, cols],
+            "remaining": np.asarray(out.remaining)[shard, cols],
+        }
+
+    def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
+        handle = self.tb_acquire_dispatch(slots, limiter_ids, permits, now_ms)
+        return self.tb_acquire_drain(handle, len(slots))
 
     def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
         mat, shard, cols, B = self._route(slots)

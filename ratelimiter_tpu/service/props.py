@@ -20,6 +20,9 @@ DEFAULTS = {
     "storage.num_slots": str(1 << 20),
     "batcher.max_batch": "8192",
     "batcher.max_delay_ms": "0.5",
+    # Device batches allowed in flight at once (dispatched, fetch pending).
+    # >1 overlaps fetch latency with the next dispatches.
+    "batcher.max_inflight": "4",
     # Fail-open on storage failure: documented in the reference's
     # architecture notes but never implemented there (SURVEY.md §5.3);
     # implemented here and ON by default as documented.

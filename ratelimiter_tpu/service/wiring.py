@@ -107,6 +107,7 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
             num_slots=num_slots,
             max_batch=props.get_int("batcher.max_batch", 8192),
             max_delay_ms=props.get_float("batcher.max_delay_ms", 0.5),
+            max_inflight=props.get_int("batcher.max_inflight", 4),
             engine=engine,
             meter_registry=meter_registry,
         )

@@ -25,7 +25,8 @@ from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.errors import StorageException
 
 _DECISION_OPS = ("acquire", "acquire_many", "acquire_many_ids",
-                 "acquire_stream_ids", "available_many", "reset_key")
+                 "acquire_stream_ids", "acquire_stream_strs",
+                 "available_many", "reset_key")
 _LEGACY_OPS = ("increment_and_expire", "get", "set", "compare_and_set",
                "delete", "z_add", "z_remove_range_by_score", "z_count",
                "eval_script")

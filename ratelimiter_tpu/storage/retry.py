@@ -27,7 +27,8 @@ from ratelimiter_tpu.storage.chaos import _LEGACY_OPS
 from ratelimiter_tpu.storage.errors import RetryPolicy
 
 REPLAY_SAFE_OPS = ("acquire", "available_many", "reset_key") + _LEGACY_OPS
-_PASSTHROUGH_OPS = ("acquire_many", "acquire_many_ids", "acquire_stream_ids")
+_PASSTHROUGH_OPS = ("acquire_many", "acquire_many_ids", "acquire_stream_ids",
+                    "acquire_stream_strs")
 
 
 class RetryingStorage(RateLimitStorage):

@@ -62,6 +62,7 @@ class TpuBatchedStorage(RateLimitStorage):
         num_slots: int = 1 << 20,
         max_batch: int = 8192,
         max_delay_ms: float = 0.5,
+        max_inflight: int = 4,
         clock_ms: Callable[[], int] = _wall_clock_ms,
         engine: DeviceEngine | None = None,
         table: LimiterTable | None = None,
@@ -125,22 +126,34 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._monotonic_now = _stamp
 
-        def _timed(algo, fn):
+        # Dispatch/drain split (engine + batcher): the flusher only enqueues
+        # device work; the drainer fetches — several batches in flight at
+        # once, so fetch latency overlaps the next dispatches.
+        def _dispatcher(fn):
             def run(s, l, p):
-                t0 = time.perf_counter()
-                out = fn(s, l, p, _stamp())
+                return (fn(s, l, p, _stamp()), time.perf_counter())
+
+            return run
+
+        def _drainer(algo, fn):
+            def run(handle_t0, n):
+                handle, t0 = handle_t0
+                out = fn(handle, n)
                 dt_us = (time.perf_counter() - t0) * 1e6
-                if self._latency is not None:
-                    self._latency.record_us(dt_us)
-                self.trace.record(algo, len(s), int(out["allowed"].sum()), dt_us)
+                self._record_dispatch(algo, n, int(out["allowed"].sum()),
+                                      dt_us)
                 return out
 
             return run
 
         self._batcher = MicroBatcher(
             dispatch={
-                "sw": _timed("sw", self.engine.sw_acquire),
-                "tb": _timed("tb", self.engine.tb_acquire),
+                "sw": _dispatcher(self.engine.sw_acquire_dispatch),
+                "tb": _dispatcher(self.engine.tb_acquire_dispatch),
+            },
+            drain={
+                "sw": _drainer("sw", self.engine.sw_acquire_drain),
+                "tb": _drainer("tb", self.engine.tb_acquire_drain),
             },
             clear={
                 "sw": self.engine.sw_clear,
@@ -148,6 +161,7 @@ class TpuBatchedStorage(RateLimitStorage):
             },
             max_batch=max_batch,
             max_delay_ms=max_delay_ms,
+            max_inflight=max_inflight,
         )
 
     # ------------------------------------------------------------------------
@@ -319,12 +333,30 @@ class TpuBatchedStorage(RateLimitStorage):
         key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
         if oversize is not None:
             permits = np.where(oversize, 1, permits)  # lanes masked, see above
-        n = len(key_ids)
+
+        def assign(start, chunk_n):
+            chunk = key_ids[start:start + chunk_n]
+            if multi_lid:
+                return index.assign_batch_ints_multi(
+                    chunk, lid_arr[start:start + chunk_n],
+                    pinned=self._batcher.pending_slots(algo))
+            return index.assign_batch_ints(
+                chunk, lid, pinned=self._batcher.pending_slots(algo))
+
+        return self._stream_flat(algo, lid, assign, len(key_ids), permits,
+                                 oversize, batch, subbatches,
+                                 lid_arr if multi_lid else None)
+
+    def _stream_flat(self, algo, lid, assign, n, permits, oversize,
+                     batch, subbatches, lid_arr=None) -> np.ndarray:
+        """Common flat-streaming loop: per super-batch, one host slot
+        assignment (``assign(start, count) -> (slots, clears)``), one FLAT
+        device dispatch (ops/flat.py — every request in a dispatch shares
+        its timestamp, so the flat sorted batch decides identically to
+        ``subbatches`` sequential scan steps), and a pipelined bitmask
+        fetch that overlaps the next super-batch's indexing + dispatch."""
+        multi_lid = lid_arr is not None
         super_n = int(subbatches) * int(batch)
-        # One FLAT dispatch per super-batch (ops/flat.py): every request in
-        # a dispatch shares its timestamp, so the flat sorted batch decides
-        # identically to `subbatches` sequential scan steps — at a fraction
-        # of the device time (payload-carrying sorts + closed-form solve).
         dispatch = (self.engine.sw_flat_dispatch if algo == "sw"
                     else self.engine.tb_flat_dispatch)
         clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
@@ -341,15 +373,8 @@ class TpuBatchedStorage(RateLimitStorage):
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
         for start in range(0, n, super_n):
-            chunk = key_ids[start:start + super_n]
-            cn = len(chunk)
-            if multi_lid:
-                slots, clears = index.assign_batch_ints_multi(
-                    chunk, lid_arr[start:start + cn],
-                    pinned=self._batcher.pending_slots(algo))
-            else:
-                slots, clears = index.assign_batch_ints(
-                    chunk, lid, pinned=self._batcher.pending_slots(algo))
+            cn = min(super_n, n - start)
+            slots, clears = assign(start, cn)
             if len(clears):
                 clear(list(clears))
             slots = _pad_tail(slots, super_n, -1, np.int32)
@@ -369,6 +394,62 @@ class TpuBatchedStorage(RateLimitStorage):
         for s0, c0, h0, pt0 in pending:
             drain(h0, s0, c0, pt0)
         return out
+
+    def acquire_stream_strs(
+        self,
+        algo: str,
+        lid: int,
+        keys: Sequence[str],
+        permits: np.ndarray | None = None,
+        *,
+        batch: int = 1 << 14,
+        subbatches: int = 4,
+    ) -> np.ndarray:
+        """Whole-stream STRING-key decisions, pipelined — the end-to-end
+        analog of :meth:`acquire_stream_ids` (VERDICT r1 #3).
+
+        Per super-batch: one C call hashes+assigns the whole key chunk
+        (``assign_batch_strs``), one flat device dispatch decides it, and
+        the bit-packed fetch overlaps the next chunk's host work — so the
+        Python/ctypes string handling rides in the fetch shadow instead of
+        serializing with it.  Decisions are identical to ``acquire_many``
+        on the same chunks (same index namespace, same kernels).  Returns
+        bool[n] allowed.
+        """
+        index = self._index[algo]
+        oversize = None
+        if permits is not None:
+            permits = np.asarray(permits)
+            if permits.size and int(permits.min(initial=0)) < np.iinfo(
+                    np.int32).min:
+                raise ValueError("permits below int32 range")
+            over = permits > np.iinfo(np.int32).max
+            if over.any():
+                oversize = over
+        if not hasattr(index, "assign_batch_strs"):
+            # Python-index / sharded fallback: chunked batch path, same
+            # decisions (no pipelining).
+            n = len(keys)
+            out = np.empty(n, dtype=bool)
+            for i in range(0, n, batch):
+                chunk = list(keys[i:i + batch])
+                p = ([1] * len(chunk) if permits is None
+                     else list(permits[i:i + batch]))
+                res = self.acquire_many(algo, [lid] * len(chunk), chunk, p)
+                out[i:i + len(chunk)] = res["allowed"]
+            return out
+
+        self._batcher.flush()
+        if oversize is not None:
+            permits = np.where(oversize, 1, permits)
+
+        def assign(start, chunk_n):
+            return index.assign_batch_strs(
+                list(keys[start:start + chunk_n]), lid,
+                pinned=self._batcher.pending_slots(algo))
+
+        return self._stream_flat(algo, lid, assign, len(keys), permits,
+                                 oversize, batch, subbatches)
 
     def _stream_sharded(self, algo, lid, key_ids, permits, batch, subbatches,
                         index, multi_lid, lid_arr,

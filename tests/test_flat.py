@@ -189,3 +189,95 @@ def test_flat_step_through_block_scatter_interpret(table, monkeypatch):
     np.testing.assert_array_equal(
         pal_eng.read_rows("tb", np.arange(S)),
         ref_eng.read_rows("tb", np.arange(S)))
+
+
+def test_stream_strs_matches_acquire_many():
+    """String-key streaming == chunked acquire_many on the same stream
+    (same index namespace, same kernels, pipelining must not change
+    decisions)."""
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    cfg = RateLimitConfig(max_permits=6, window_ms=1000, refill_rate=4.0)
+    rng = np.random.default_rng(14)
+    n = 600
+    keys = [f"user-{k}" for k in rng.integers(0, 35, n)]
+    permits = rng.integers(1, 3, n).astype(np.int64)
+    clock = lambda: 88_000  # noqa: E731
+
+    s1 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid1 = s1.register_limiter("tb", cfg)
+    expect = np.empty(n, dtype=bool)
+    for i in range(0, n, 64):
+        chunk = keys[i:i + 64]
+        expect[i:i + len(chunk)] = s1.acquire_many(
+            "tb", [lid1] * len(chunk), chunk,
+            list(permits[i:i + len(chunk)]))["allowed"]
+    s1.close()
+
+    s2 = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+    lid2 = s2.register_limiter("tb", cfg)
+    got = s2.acquire_stream_strs("tb", lid2, keys, permits,
+                                 batch=64, subbatches=2)
+    s2.close()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_stream_strs_shares_namespace_with_scalar_path():
+    """Stream-consumed string keys are the same buckets the scalar path
+    sees."""
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    clock = lambda: 44_000  # noqa: E731
+    s = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    lid = s.register_limiter("tb", RateLimitConfig(
+        max_permits=3, window_ms=1000, refill_rate=0.001))
+    got = s.acquire_stream_strs("tb", lid, ["alice"] * 5, None,
+                                batch=8, subbatches=1)
+    assert got.tolist() == [True, True, True, False, False]
+    out = s.acquire("tb", lid, "alice", 1)
+    s.close()
+    assert not out["allowed"]
+
+
+def test_try_acquire_many_routes_large_calls_to_stream(monkeypatch):
+    """Above the size threshold the limiters stream; decisions must be the
+    same either way (cache-less SW and TB)."""
+    from ratelimiter_tpu.algorithms import (
+        SlidingWindowRateLimiter,
+        TokenBucketRateLimiter,
+    )
+    from ratelimiter_tpu.algorithms import sliding_window as swmod
+    from ratelimiter_tpu.algorithms import token_bucket as tbmod
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    monkeypatch.setattr(swmod, "_STREAM_MIN", 64)
+    monkeypatch.setattr(tbmod, "_STREAM_MIN", 64)
+    rng = np.random.default_rng(15)
+    n = 300
+    keys = [f"u{k}" for k in rng.integers(0, 20, n)]
+    clock = lambda: 66_000  # noqa: E731
+
+    results = {}
+    for threshold_hit in (False, True):
+        st = TpuBatchedStorage(num_slots=256, clock_ms=clock)
+        sw = SlidingWindowRateLimiter(
+            st, RateLimitConfig(max_permits=8, window_ms=1000,
+                                enable_local_cache=False),
+            MeterRegistry(), clock_ms=clock)
+        tb = TokenBucketRateLimiter(
+            st, RateLimitConfig(max_permits=5, window_ms=1000,
+                                refill_rate=1.0),
+            MeterRegistry(), clock_ms=clock)
+        if threshold_hit:
+            got_sw = sw.try_acquire_many(keys)           # n >= 64: streams
+            got_tb = tb.try_acquire_many(keys)
+        else:
+            got_sw = np.concatenate(
+                [sw.try_acquire_many(keys[i:i + 50]) for i in range(0, n, 50)])
+            got_tb = np.concatenate(
+                [tb.try_acquire_many(keys[i:i + 50]) for i in range(0, n, 50)])
+        results[threshold_hit] = (got_sw, got_tb)
+        st.close()
+    np.testing.assert_array_equal(results[False][0], results[True][0])
+    np.testing.assert_array_equal(results[False][1], results[True][1])
