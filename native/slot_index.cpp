@@ -22,6 +22,7 @@
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in the image).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -326,6 +327,87 @@ int32_t rl_index_remove_int(void* h, int64_t key, uint64_t lid_seed) {
   ix->size--;
   ix->free_slots.push_back(slot);
   return slot;
+}
+
+// -- enumeration / restore (checkpointing at native speed) -------------------
+// The table stores fingerprints, not keys, so enumeration yields
+// (h1, h2, slot) triples.  Dump order is LRU order, most-recent first;
+// restore rebuilds the exact same recency order, so eviction behavior
+// continues unchanged across a snapshot/restore cycle.
+
+int64_t rl_index_dump(void* h, uint64_t* out_h1, uint64_t* out_h2,
+                      int32_t* out_slots) {
+  Index* ix = static_cast<Index*>(h);
+  int64_t i = 0;
+  for (int32_t pos = ix->lru_head; pos >= 0; pos = ix->table[pos].lru_next) {
+    const Entry& e = ix->table[pos];
+    out_h1[i] = e.h1;
+    out_h2[i] = e.h2;
+    out_slots[i] = e.slot;
+    i++;
+  }
+  return i;
+}
+
+// Rebuild from a dump (MRU-first order, as produced by rl_index_dump).
+// Returns 0 on success, -1 on invalid input (bad slot, duplicate slot or
+// fingerprint, zero fingerprint, n > num_slots).  The index is cleared
+// first; on failure it is left cleared.
+static void reset_empty(Index* ix) {
+  std::fill(ix->table.begin(), ix->table.end(), Entry{});
+  std::fill(ix->entry_of_slot.begin(), ix->entry_of_slot.end(), -1);
+  ix->size = 0;
+  ix->lru_head = ix->lru_tail = -1;
+  ix->free_slots.clear();
+  for (int64_t s = ix->num_slots - 1; s >= 0; s--)
+    ix->free_slots.push_back(static_cast<int32_t>(s));
+}
+
+int32_t rl_index_restore(void* h, const uint64_t* h1s, const uint64_t* h2s,
+                         const int32_t* slots, int64_t n) {
+  Index* ix = static_cast<Index*>(h);
+  reset_empty(ix);
+  if (n > ix->num_slots) return -1;  // index left empty-but-usable
+  ix->free_slots.clear();
+  // Insert tail-first so entry 0 ends at the LRU head (most recent).
+  for (int64_t i = n - 1; i >= 0; i--) {
+    uint64_t h1 = h1s[i], h2 = h2s[i];
+    int32_t slot = slots[i];
+    if (slot < 0 || slot >= ix->num_slots || (h1 == 0 && h2 == 0) ||
+        ix->entry_of_slot[slot] >= 0 || find(ix, h1, h2) >= 0) {
+      reset_empty(ix);
+      return -1;
+    }
+    insert(ix, h1, h2, slot);
+  }
+  for (int64_t s = ix->num_slots - 1; s >= 0; s--)
+    if (ix->entry_of_slot[s] < 0)
+      ix->free_slots.push_back(static_cast<int32_t>(s));
+  return 0;
+}
+
+// Fingerprint-level lookup/assign (flat-to-flat rebalance: fingerprints are
+// geometry-independent for LRU-assigned tables, so a dump from a smaller
+// index can be imported into a larger one without knowing the keys).
+void rl_index_lookup_fps(void* h, const uint64_t* h1s, const uint64_t* h2s,
+                         int64_t n, int32_t* out_slots) {
+  Index* ix = static_cast<Index*>(h);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t pos = find(ix, h1s[i], h2s[i]);
+    out_slots[i] = pos < 0 ? -1 : ix->table[pos].slot;
+  }
+}
+
+void rl_index_assign_fps(void* h, const uint64_t* h1s, const uint64_t* h2s,
+                         int64_t n, int32_t* out_slots, int32_t* out_evicted) {
+  Index* ix = static_cast<Index*>(h);
+  ix->gen++;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1 = h1s[i], h2 = h2s[i];
+    if (h1 == 0 && h2 == 0) h2 = 1;
+    int64_t ev = assign_hashed(ix, h1, h2, &out_slots[i]);
+    out_evicted[i] = static_cast<int32_t>(ev);
+  }
 }
 
 void rl_index_pin(void* h, int32_t slot) {

@@ -18,11 +18,13 @@ flat <-> sharded — uses the per-KEY path instead: :func:`export_keys` /
 :func:`import_keys` (also on ``TpuBatchedStorage``), which re-assign slots
 in the target and carry each key's packed state row across.
 
-The native slot index cannot enumerate its keys (it stores fingerprints
-only), so checkpointable deployments either use the Python index
-(``TpuBatchedStorage(checkpointable=True)``) or supply key enumeration at
-snapshot time from the service tier.  The device state itself snapshots
-regardless of index type.
+The native slot index enumerates as (h1, h2, slot) fingerprint triples
+(native/slot_index.cpp:rl_index_dump), so the DEFAULT storage checkpoints
+at native speed: snapshots carry the fingerprints (state.npz) and restore
+rebuilds the table with its exact LRU order.  Fingerprints are one-way,
+so only dumps from the keyed Python index (checkpointable=True) can be
+re-sharded or re-keyed; flat-to-flat rebalance works from fingerprints
+directly (LRU tables assign slots geometry-independently).
 """
 
 from __future__ import annotations
@@ -75,6 +77,42 @@ def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
     }
 
 
+def _detach_index_arrays(index_dump: Dict, arrays: Dict) -> Dict:
+    """Move fingerprint numpy arrays out of the index dump into the npz
+    payload (JSON holds a marker; arrays go to state.npz as idx_*)."""
+    out = {"algos": {}}
+    for algo, payload in index_dump.get("algos", {}).items():
+        p = dict(payload)
+        if p.get("kind") == "native_fp":
+            for f in ("h1", "h2", "slots"):
+                arrays[f"idx_{algo}_{f}"] = p.pop(f)
+            p["array_ref"] = f"idx_{algo}"
+        elif p.get("kind") == "sharded_native_fp":
+            for j, shard_p in enumerate(p.pop("per_shard")):
+                for f in ("h1", "h2", "slots"):
+                    arrays[f"idx_{algo}_s{j}_{f}"] = shard_p[f]
+            p["array_ref"] = f"idx_{algo}"
+        out["algos"][algo] = p
+    return out
+
+
+def _attach_index_arrays(meta_index: Dict, arrays: Dict) -> Dict:
+    """Inverse of :func:`_detach_index_arrays` at load time."""
+    out = {"algos": {}}
+    for algo, payload in meta_index.get("algos", {}).items():
+        p = dict(payload)
+        ref = p.pop("array_ref", None)
+        if p.get("kind") == "native_fp":
+            for f in ("h1", "h2", "slots"):
+                p[f] = arrays[f"{ref}_{f}"]
+        elif p.get("kind") == "sharded_native_fp":
+            p["per_shard"] = [
+                {f: arrays[f"{ref}_s{j}_{f}"] for f in ("h1", "h2", "slots")}
+                for j in range(p["n_shards"])]
+        out["algos"][algo] = p
+    return out
+
+
 def save_checkpoint(path: str, engine, index_dump: Optional[Dict] = None) -> None:
     """Write an atomic on-disk checkpoint (temp dir + rename)."""
     snap = snapshot_engine_state(engine, index_dump)
@@ -84,6 +122,8 @@ def save_checkpoint(path: str, engine, index_dump: Optional[Dict] = None) -> Non
     try:
         arrays = {f"sw_{k}": v for k, v in snap["sw"].items()}
         arrays.update({f"tb_{k}": v for k, v in snap["tb"].items()})
+        snap["meta"]["index"] = _detach_index_arrays(
+            snap["meta"].get("index", {}), arrays)
         np.savez(os.path.join(tmp, "state.npz"), **arrays)
         with open(os.path.join(tmp, "index.json"), "w") as fh:
             json.dump(snap["meta"], fh)
@@ -108,8 +148,9 @@ def load_checkpoint(path: str) -> Dict:
         meta = json.load(fh)
     if meta.get("format") not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported checkpoint format: {meta.get('format')}")
-    data = np.load(os.path.join(path, "state.npz"))
-    return {"meta": meta, "arrays": dict(data)}
+    data = dict(np.load(os.path.join(path, "state.npz")))
+    meta["index"] = _attach_index_arrays(meta.get("index", {}), data)
+    return {"meta": meta, "arrays": data}
 
 
 def restore_engine_state(engine, ckpt: Dict) -> None:
@@ -156,16 +197,41 @@ def _limiter_table_dump(storage) -> Dict:
 
 
 def export_keys(storage) -> Dict:
-    """All live per-key state of a storage: {algo: [[key, row-ints], ...]}."""
-    index_dump = dump_slot_indexes(storage)
+    """All live per-key state of a storage.
+
+    Keyed (Python) indexes export ``{algo: [[key, row-ints], ...]}`` —
+    importable into ANY geometry (keys re-hash in the target).  Native flat
+    indexes export fingerprint payloads ``{kind: 'fp', h1, h2, rows}`` —
+    importable into flat native targets of any size (fingerprints are
+    geometry-independent for LRU-assigned tables) but not re-shardable.
+    """
+    # Flush BEFORE dumping: a flush can assign/evict, reusing a dumped
+    # slot — the fp export reads rows by slot, so a stale dump would
+    # attribute another key's state to a dumped fingerprint.
     storage.flush()
     storage.engine.block_until_ready()
+    index_dump = dump_slot_indexes(storage)
     out: Dict = {
         "format": FORMAT_VERSION,
         "limiters": _limiter_table_dump(storage),
         "algos": {},
     }
     for algo, payload in index_dump["algos"].items():
+        if payload.get("kind") == "native_fp":
+            slots = payload["slots"]
+            out["algos"][algo] = {
+                "kind": "fp",
+                "h1": payload["h1"],
+                "h2": payload["h2"],
+                "rows": (storage.engine.read_rows(algo, slots)
+                         if len(slots) else np.empty((0, 0), np.int32)),
+            }
+            continue
+        if payload.get("kind") == "sharded_native_fp":
+            raise ValueError(
+                "sharded native dumps cannot be exported per key "
+                "(fingerprints cannot be re-sharded); construct the "
+                "storage with checkpointable=True for keyed export")
         entries = payload["entries"]
         if not entries:
             out["algos"][algo] = []
@@ -210,7 +276,20 @@ def import_keys(storage, dump: Dict) -> None:
     # pass while one shard overflows mid-import, leaving a partial import.
     for algo, entries in dump.get("algos", {}).items():
         index = storage._index[algo]
-        if hasattr(index, "_sub"):
+        if isinstance(entries, dict) and entries.get("kind") == "fp":
+            if not hasattr(index, "assign_batch_fps"):
+                raise ValueError(
+                    "fingerprint export requires a flat native-index "
+                    "target (fingerprints cannot be re-keyed or "
+                    "re-sharded)")
+            present = index.lookup_fps(entries["h1"], entries["h2"]) >= 0
+            new = int((~present).sum())
+            free = index.num_slots - len(index)
+            if new > free:
+                raise ValueError(
+                    f"target storage is too small for the export ({new} "
+                    f"new {algo} fingerprints, {free} free slots)")
+        elif hasattr(index, "_sub"):
             from ratelimiter_tpu.parallel.sharded import shard_of_key
 
             new_per_shard = [0] * index.n_shards
@@ -236,6 +315,21 @@ def import_keys(storage, dump: Dict) -> None:
                     f"target storage is too small for the export ({new} new "
                     f"{algo} keys, {free} free slots)")
     for algo, entries in dump.get("algos", {}).items():
+        if isinstance(entries, dict) and entries.get("kind") == "fp":
+            if not len(entries["h1"]):
+                continue
+            index = storage._index[algo]
+            # Dump order is MRU-first; assign REVERSED so the source's
+            # most-recent fingerprint is also assigned last (= most recent
+            # in the target), preserving eviction order across a rebalance.
+            slots, evicted = index.assign_batch_fps(
+                entries["h1"][::-1], entries["h2"][::-1])
+            if len(evicted):  # pre-check makes this unreachable
+                raise ValueError(
+                    "eviction during import despite capacity check")
+            rows = np.asarray(entries["rows"], dtype=np.int32)[::-1]
+            storage.engine.write_rows(algo, slots, rows)
+            continue
         if not entries:
             continue
         index = storage._index[algo]
@@ -261,6 +355,13 @@ def _dump_flat(index) -> list:
                 for k, slot in index._map.items()]
 
 
+def _fp_payload(index) -> Dict:
+    """Fingerprint dump of a native index (h1/h2/slot numpy arrays, MRU
+    order).  save_checkpoint moves the arrays into state.npz."""
+    h1, h2, slots = index.dump_fp()
+    return {"h1": h1, "h2": h2, "slots": slots}
+
+
 def _restore_flat(index, entries) -> None:
     with index._lock:
         index._map.clear()
@@ -276,43 +377,78 @@ def _restore_flat(index, entries) -> None:
 def dump_slot_indexes(storage) -> Dict:
     """Serialize key->slot maps of a TpuBatchedStorage.
 
-    Works for the Python flat index and the sharded index (global slot =
-    shard * slots_per_shard + local).  The native index stores fingerprints
-    only — construct the storage with checkpointable=True to use the
-    enumerable Python index.
+    Python indexes dump their keys; native indexes dump (h1, h2, slot)
+    fingerprint triples at native speed (rl_index_dump) — checkpoints
+    round-trip either way.  Fingerprints are one-way, so dumps that must
+    carry keys (cross-shard rebalance) need the Python index
+    (checkpointable=True).
     """
     out: Dict = {"algos": {}}
     for algo, index in storage._index.items():
         if hasattr(index, "_map"):
             out["algos"][algo] = {"kind": "flat", "entries": _dump_flat(index)}
+        elif hasattr(index, "dump_fp"):
+            payload = _fp_payload(index)
+            payload["kind"] = "native_fp"
+            out["algos"][algo] = payload
         elif hasattr(index, "_sub"):
-            if not all(hasattr(s, "_map") for s in index._sub):
-                raise ValueError(
-                    "native slot sub-indexes are not enumerable; construct "
-                    "the storage with checkpointable=True to use Python subs")
-            base = index.slots_per_shard
-            entries = []
-            for shard, sub in enumerate(index._sub):
-                for key, local in _dump_flat(sub):
-                    entries.append([key, shard * base + local])
-            out["algos"][algo] = {
-                "kind": "sharded",
-                # Key->shard hash identity: a restore into a binary with a
-                # different shard hash would silently orphan every entry
-                # (lookups would miss the restored shard), so it is refused.
-                "shard_hash": SHARD_HASH_VERSION,
-                "entries": entries,
-            }
+            if all(hasattr(s, "_map") for s in index._sub):
+                base = index.slots_per_shard
+                entries = []
+                for shard, sub in enumerate(index._sub):
+                    for key, local in _dump_flat(sub):
+                        entries.append([key, shard * base + local])
+                out["algos"][algo] = {
+                    "kind": "sharded",
+                    # Key->shard hash identity: a restore into a binary with
+                    # a different shard hash would silently orphan every
+                    # entry (lookups would miss the restored shard).
+                    "shard_hash": SHARD_HASH_VERSION,
+                    "entries": entries,
+                }
+            elif all(hasattr(s, "dump_fp") for s in index._sub):
+                out["algos"][algo] = {
+                    "kind": "sharded_native_fp",
+                    "shard_hash": SHARD_HASH_VERSION,
+                    "n_shards": index.n_shards,
+                    "per_shard": [_fp_payload(s) for s in index._sub],
+                }
+            else:
+                raise ValueError("slot sub-indexes are not enumerable")
         else:
-            raise ValueError(
-                "native slot index is not enumerable; construct the storage "
-                "with checkpointable=True to use the Python index")
+            raise ValueError("slot index is not enumerable")
     return out
 
 
 def restore_slot_indexes(storage, dump: Dict) -> None:
     for algo, payload in dump.get("algos", {}).items():
         index = storage._index[algo]
+        kind = payload.get("kind")
+        if kind == "native_fp":
+            if not hasattr(index, "restore_fp"):
+                raise ValueError(
+                    "fingerprint checkpoint needs the native index "
+                    "(restoring binary lacks it)")
+            index.restore_fp(payload["h1"], payload["h2"], payload["slots"])
+            continue
+        if kind == "sharded_native_fp":
+            if payload.get("shard_hash") != SHARD_HASH_VERSION:
+                raise ValueError(
+                    f"checkpoint used shard hash "
+                    f"{payload.get('shard_hash')!r}; this binary routes "
+                    f"with {SHARD_HASH_VERSION!r} — fingerprints cannot be "
+                    "re-sharded (export/import per key instead)")
+            if (not hasattr(index, "_sub")
+                    or payload["n_shards"] != index.n_shards
+                    or not all(hasattr(s, "restore_fp")
+                               for s in index._sub)):
+                raise ValueError(
+                    "sharded fingerprint checkpoint needs a native sharded "
+                    f"index with {payload['n_shards']} shards")
+            for sub, shard_p in zip(index._sub, payload["per_shard"]):
+                sub.restore_fp(shard_p["h1"], shard_p["h2"],
+                               shard_p["slots"])
+            continue
         entries = payload["entries"]
         if payload.get("kind") == "sharded" and hasattr(index, "_sub"):
             stored_hash = payload.get("shard_hash", LEGACY_SHARD_HASH)

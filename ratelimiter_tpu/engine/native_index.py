@@ -72,6 +72,19 @@ def _load_library():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
         lib.rl_index_pin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.rl_index_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rl_index_dump.restype = ctypes.c_int64
+        lib.rl_index_dump.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_restore.restype = ctypes.c_int32
+        lib.rl_index_restore.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.rl_index_lookup_fps.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.rl_index_assign_fps.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -206,6 +219,65 @@ class NativeSlotIndex:
         with self._lock, self._pinned(pinned):
             self._lib.rl_index_assign_ints_multi(
                 self._h, keys.ctypes.data, seeds.ctypes.data, n,
+                out_slots.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_slots, out_ev[out_ev >= 0]
+
+    # -- fingerprint enumeration (checkpoint/restore at native speed) ---------
+    def dump_fp(self):
+        """All live entries as (h1 u64[n], h2 u64[n], slots i32[n]), in LRU
+        order most-recent first — the native-speed checkpoint payload.
+        Fingerprints are one-way: use the Python index when a dump must
+        carry the original keys (cross-shard rebalance)."""
+        cap = self.num_slots
+        h1 = np.empty(cap, dtype=np.uint64)
+        h2 = np.empty(cap, dtype=np.uint64)
+        slots = np.empty(cap, dtype=np.int32)
+        with self._lock:
+            n = self._lib.rl_index_dump(
+                self._h, h1.ctypes.data, h2.ctypes.data, slots.ctypes.data)
+        return h1[:n].copy(), h2[:n].copy(), slots[:n].copy()
+
+    def restore_fp(self, h1: np.ndarray, h2: np.ndarray,
+                   slots: np.ndarray) -> None:
+        """Rebuild from a dump_fp payload (exact LRU order restored)."""
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        n = len(h1)
+        if len(h2) != n or len(slots) != n:
+            raise ValueError("fingerprint dump arrays disagree on length")
+        with self._lock:
+            rc = self._lib.rl_index_restore(
+                self._h, h1.ctypes.data, h2.ctypes.data, slots.ctypes.data, n)
+        if rc != 0:
+            raise ValueError(
+                "invalid fingerprint dump (bad slot, duplicate, or size)")
+
+    def lookup_fps(self, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+        """Slots of the given fingerprints (-1 if absent); no LRU touch."""
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+        out = np.empty(len(h1), dtype=np.int32)
+        with self._lock:
+            self._lib.rl_index_lookup_fps(
+                self._h, h1.ctypes.data, h2.ctypes.data, len(h1),
+                out.ctypes.data)
+        return out
+
+    def assign_batch_fps(self, h1: np.ndarray, h2: np.ndarray,
+                         pinned: Optional[Set[int]] = None):
+        """Assign slots for raw fingerprints (flat-to-flat rebalance import).
+        Returns (slots i32[n], evictions i32[k])."""
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+        n = len(h1)
+        out_slots = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_fps(
+                self._h, h1.ctypes.data, h2.ctypes.data, n,
                 out_slots.ctypes.data, out_ev.ctypes.data)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")

@@ -85,9 +85,10 @@ class TpuBatchedStorage(RateLimitStorage):
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
-        # checkpointable=True swaps fingerprint-only native (sub-)indexes
-        # for enumerable Python ones so the key->slot map can be snapshotted
-        # (engine/checkpoint.py).
+        # The native index checkpoints at fingerprint level by default;
+        # checkpointable=True swaps in enumerable KEYED Python indexes —
+        # needed only for dumps that must re-hash keys in a different
+        # geometry (cross-shard rebalance; engine/checkpoint.py).
         def make_index():
             index = self.engine.make_slot_index()
             if not checkpointable:

@@ -97,15 +97,81 @@ def test_checkpoint_atomic_overwrite(tmp_path):
     storage2.close()
 
 
-def test_native_index_checkpoint_refused(tmp_path):
+def test_native_index_checkpoint_round_trips(tmp_path):
+    """The DEFAULT (native-index) storage checkpoints and restores: the
+    index dumps fingerprint triples at native speed, and the restored
+    process continues the exact decisions — durability and hyperscale
+    indexing are no longer mutually exclusive."""
     from ratelimiter_tpu.engine.native_index import native_available
 
     if not native_available():
         pytest.skip("no native index")
-    storage = TpuBatchedStorage(num_slots=64)  # native index by default
-    with pytest.raises(ValueError, match="enumerable"):
-        storage.save_checkpoint(str(tmp_path / "ckpt"))
+    clock = FakeClock()
+    rng = random.Random(33)
+    keys = [f"n{i}" for i in range(12)]
+    cfg_sw = RateLimitConfig(max_permits=9, window_ms=2500,
+                             enable_local_cache=False)
+    cfg_tb = RateLimitConfig(max_permits=14, window_ms=2000, refill_rate=6.0)
+
+    storage = TpuBatchedStorage(num_slots=64, max_delay_ms=0.1,
+                                clock_ms=clock)  # native index by default
+    sw = SlidingWindowRateLimiter(storage, cfg_sw, MeterRegistry(),
+                                  clock_ms=clock)
+    tb = TokenBucketRateLimiter(storage, cfg_tb, MeterRegistry(),
+                                clock_ms=clock)
+    osw, otb = SlidingWindowOracle(cfg_sw), TokenBucketOracle(cfg_tb)
+    drive(sw, osw, clock, rng, keys, 15)
+    drive(tb, otb, clock, rng, keys, 15)
+    ckpt = str(tmp_path / "ckpt")
+    storage.save_checkpoint(ckpt)
     storage.close()
+
+    clock2 = FakeClock(clock.t)
+    storage2 = TpuBatchedStorage(num_slots=64, max_delay_ms=0.1,
+                                 clock_ms=clock2)
+    sw2 = SlidingWindowRateLimiter(storage2, cfg_sw, MeterRegistry(),
+                                   clock_ms=clock2)
+    tb2 = TokenBucketRateLimiter(storage2, cfg_tb, MeterRegistry(),
+                                 clock_ms=clock2)
+    storage2.restore_checkpoint(ckpt)
+    drive(sw2, osw, clock2, rng, keys, 15)
+    drive(tb2, otb, clock2, rng, keys, 15)
+    storage2.close()
+
+
+def test_native_fp_rebalance_flat_to_larger_flat(tmp_path):
+    """Fingerprint export from the default native index imports into a
+    LARGER flat native target (geometry-free for LRU tables), carrying
+    consumed state."""
+    from ratelimiter_tpu.engine import checkpoint as ck
+    from ratelimiter_tpu.engine.native_index import native_available
+
+    if not native_available():
+        pytest.skip("no native index")
+    import numpy as np
+
+    clock = lambda: 91_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=4, window_ms=60_000, refill_rate=0.001)
+    src = TpuBatchedStorage(num_slots=64, clock_ms=clock)
+    lid = src.register_limiter("tb", cfg)
+    drained = src.acquire_stream_ids(
+        "tb", lid, np.asarray([5] * 4 + [6], dtype=np.int64),
+        np.ones(5, dtype=np.int64), batch=16, subbatches=1)
+    assert drained.tolist() == [True] * 5
+    dump = ck.export_keys(src)
+    src.close()
+    assert dump["algos"]["tb"]["kind"] == "fp"
+
+    dst = TpuBatchedStorage(num_slots=1024, clock_ms=clock)
+    lid2 = dst.register_limiter("tb", cfg)
+    assert lid2 == lid
+    ck.import_keys(dst, dump)
+    got = dst.acquire_stream_ids(
+        "tb", lid2, np.asarray([5, 6, 6, 6, 6], dtype=np.int64),
+        np.ones(5, dtype=np.int64), batch=16, subbatches=1)
+    dst.close()
+    # key 5 was fully drained; key 6 had 3 of 4 left.
+    assert got.tolist() == [False, True, True, True, False]
 
 
 def test_legacy_sharded_dump_int_keys_refused():
@@ -161,3 +227,46 @@ def test_legacy_sharded_dump_int_keys_refused():
     ck.restore_slot_indexes(st, legacy_str)
     assert st._index["tb"].get((1, "alice")) is not None
     st.close()
+
+
+def test_sharded_native_checkpoint_round_trips(tmp_path):
+    """Sharded DEFAULT storage (native sub-indexes): checkpoint carries
+    per-shard fingerprints and restores into the same shard geometry."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    from ratelimiter_tpu.engine.native_index import native_available
+
+    if not native_available():
+        pytest.skip("no native index")
+    import numpy as np
+
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+
+    clock = lambda: 71_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=3, window_ms=60_000, refill_rate=0.001)
+
+    def fresh():
+        eng = ShardedDeviceEngine(slots_per_shard=16, table=LimiterTable(),
+                                  mesh=make_mesh())
+        return TpuBatchedStorage(engine=eng, clock_ms=clock)
+
+    src = fresh()
+    lid = src.register_limiter("tb", cfg)
+    ids = np.asarray([11] * 3 + [12], dtype=np.int64)
+    assert src.acquire_stream_ids("tb", lid, ids, None,
+                                  batch=16, subbatches=1).all()
+    ckpt = str(tmp_path / "ckpt")
+    src.save_checkpoint(ckpt)
+    src.close()
+
+    dst = fresh()
+    dst.register_limiter("tb", cfg)
+    dst.restore_checkpoint(ckpt)
+    got = dst.acquire_stream_ids(
+        "tb", lid, np.asarray([11, 12, 12, 12], dtype=np.int64), None,
+        batch=16, subbatches=1)
+    dst.close()
+    assert got.tolist() == [False, True, True, False]

@@ -102,3 +102,84 @@ def test_tpu_storage_int_key_fast_path_matches_oracle():
             want = oracle.try_acquire(int(ids[j]), int(perms[j]), clock.t).allowed
             assert got[j] == want, (step, j)
     storage.close()
+
+
+def test_fp_dump_restore_preserves_lru_and_survives_bad_input():
+    """Fingerprint restore rebuilds the exact LRU recency order; an invalid
+    or oversized dump refuses but leaves the index empty-and-usable."""
+    import numpy as np
+    import pytest
+
+    from ratelimiter_tpu.engine.native_index import (
+        NativeSlotIndex,
+        native_available,
+    )
+
+    if not native_available():
+        pytest.skip("no native index")
+    ix = NativeSlotIndex(8)
+    for k in range(8):
+        ix.assign((1, k))
+    ix.assign((1, 2))  # key 2 -> MRU; LRU victim is key 0
+    h1, h2, slots = ix.dump_fp()
+
+    ix2 = NativeSlotIndex(8)
+    ix2.restore_fp(h1, h2, slots)
+    # Same eviction order: assigning a NEW key must evict the dump's LRU
+    # tail (last dump entry).  No get() here — get touches the LRU.
+    ix2_lru_before = len(ix2)
+    _, evicted = ix2.assign((1, 99))
+    assert evicted == slots[-1] and len(ix2) == ix2_lru_before
+
+    # Oversized dump refused; index stays usable.
+    big = NativeSlotIndex(4)
+    with pytest.raises(ValueError):
+        big.restore_fp(h1, h2, slots)
+    s, ev = big.assign((1, 7))
+    assert s >= 0 and ev is None
+
+    # Duplicate-slot dump refused; index stays usable.
+    bad = NativeSlotIndex(8)
+    dup = slots.copy()
+    dup[1] = dup[0]
+    with pytest.raises(ValueError):
+        bad.restore_fp(h1, h2, dup)
+    s, ev = bad.assign((1, 7))
+    assert s >= 0 and ev is None
+
+
+def test_fp_rebalance_import_preserves_recency_order():
+    """import_keys of an fp export keeps the source's eviction order in the
+    target (MRU-first dump is assigned in reverse)."""
+    import numpy as np
+    import pytest
+
+    from ratelimiter_tpu.engine import checkpoint as ck
+    from ratelimiter_tpu.engine.native_index import native_available
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+    from ratelimiter_tpu import RateLimitConfig
+
+    if not native_available():
+        pytest.skip("no native index")
+    clock = lambda: 95_000  # noqa: E731
+    cfg = RateLimitConfig(max_permits=4, window_ms=60_000, refill_rate=0.001)
+    src = TpuBatchedStorage(num_slots=8, clock_ms=clock)
+    lid = src.register_limiter("tb", cfg)
+    src.acquire_stream_ids("tb", lid, np.arange(8, dtype=np.int64), None,
+                           batch=8, subbatches=1)
+    src.acquire_stream_ids("tb", lid, np.asarray([0], dtype=np.int64), None,
+                           batch=8, subbatches=1)  # key 0 -> MRU; LRU = key 1
+    dump = ck.export_keys(src)
+    src.close()
+
+    dst = TpuBatchedStorage(num_slots=8, clock_ms=clock)
+    dst.register_limiter("tb", cfg)
+    ck.import_keys(dst, dump)
+    index = dst._index["tb"]
+    # Source LRU tail = last fp in the MRU-first dump; lookup_fps does not
+    # touch the LRU (get would).
+    fp = dump["algos"]["tb"]
+    lru_victim_slot = int(index.lookup_fps(fp["h1"][-1:], fp["h2"][-1:])[0])
+    _, evicted = index.assign((lid, 99))
+    dst.close()
+    assert evicted == lru_victim_slot
