@@ -199,6 +199,31 @@ def main() -> None:
     log(f"  stream: {res['decisions_per_sec']:,.0f} decisions/s")
     storage5.close()
 
+    # -- sharded scaling (virtual CPU mesh, subprocess) ----------------------
+    # The multi-chip sharding machinery measured 1 -> 8 shards; a separate
+    # process because the CPU backend must be selected before any device
+    # work (this process owns the TPU).
+    log("sharded scaling (8-device virtual CPU mesh, subprocess)...")
+    try:
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench", "sharded_scaling.py")],
+            capture_output=True, timeout=600, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"rc={proc.returncode} stderr={proc.stderr[-500:]!r}")
+        detail["sharded_scaling"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+        for p in detail["sharded_scaling"]["points"]:
+            log(f"  {p['n_shards']} shard(s): "
+                f"{p['decisions_per_sec']:,.0f} decisions/s")
+    except Exception as exc:  # noqa: BLE001 — aux section must not kill bench
+        detail["sharded_scaling"] = {"error": str(exc)}
+        log(f"  sharded scaling failed: {exc}")
+
     detail["total_bench_seconds"] = time.time() - t_start
 
     with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"), "w") as fh:

@@ -1,0 +1,86 @@
+"""Sharded-engine scaling measurement on the virtual CPU mesh.
+
+Runs the TB Zipf stream over 1 / 2 / 4 / 8 shards of a fixed-size global
+slot table and reports decisions/s per shard count (VERDICT r1 #7: the
+multi-chip story needs a measured slope, not just a compile proof).
+
+On the virtual mesh every "device" is a slice of ONE host CPU, so the
+slope here measures the sharding machinery's overhead (host routing,
+shard_map dispatch, per-shard padding), not parallel speedup — the
+speedup model for a real v5e slice is in ARCHITECTURE.md (each shard
+executes its slice of every dispatch concurrently; per-chip cost follows
+the single-chip cost model at B/n_shards batch rows).
+
+Invoked by bench.py in a subprocess (it must force the CPU backend before
+any device is touched); standalone:  python bench/sharded_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.extend  # noqa: E402
+
+jax.extend.backend.clear_backends()
+jax.config.update("jax_num_cpu_devices", 8)
+
+import os  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ratelimiter_tpu.core.config import RateLimitConfig  # noqa: E402
+from ratelimiter_tpu.engine.state import LimiterTable  # noqa: E402
+from ratelimiter_tpu.storage import TpuBatchedStorage  # noqa: E402
+
+
+def run(n_shards: int, num_slots: int, key_ids, batch, subbatches) -> dict:
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
+    clock = lambda: 100_000  # noqa: E731 — frozen: identical decisions per point
+    if n_shards == 1:
+        storage = TpuBatchedStorage(num_slots=num_slots, clock_ms=clock)
+    else:
+        from ratelimiter_tpu.parallel import ShardedDeviceEngine
+        from ratelimiter_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices()[:n_shards])
+        engine = ShardedDeviceEngine(
+            slots_per_shard=num_slots // n_shards,
+            table=LimiterTable(), mesh=mesh)
+        storage = TpuBatchedStorage(engine=engine, clock_ms=clock)
+    lid = storage.register_limiter("tb", cfg)
+    super_n = batch * subbatches
+    storage.acquire_stream_ids("tb", lid, key_ids[:super_n], None,
+                               batch=batch, subbatches=subbatches)  # compile
+    t0 = time.perf_counter()
+    allowed = storage.acquire_stream_ids("tb", lid, key_ids, None,
+                                         batch=batch, subbatches=subbatches)
+    wall = time.perf_counter() - t0
+    storage.close()
+    return {
+        "n_shards": n_shards,
+        "decisions": len(key_ids),
+        "wall_s": wall,
+        "decisions_per_sec": len(key_ids) / wall,
+        "allowed": int(allowed.sum()),
+    }
+
+
+def main() -> None:
+    rng = np.random.default_rng(7)
+    num_keys, n = 50_000, 1 << 18
+    key_ids = (rng.zipf(1.1, size=n).astype(np.int64) % num_keys)
+    out = {"mesh": "virtual-cpu-8", "num_keys": num_keys,
+           "points": [run(s, 1 << 17, key_ids, 1 << 13, 2)
+                      for s in (1, 2, 4, 8)]}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,9 @@ from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.core.limiter import RateLimiter
 from ratelimiter_tpu.metrics import MeterRegistry
 from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("algorithms.sliding_window")
 
 # Batches at or above this size route through the pipelined
 # string-stream path (storage.acquire_stream_strs) instead of one
@@ -91,6 +94,9 @@ class SlidingWindowRateLimiter(RateLimiter):
             if self._local_cache is not None:
                 self._local_cache.put(key, int(out["cache_value"]))
             allowed = bool(out["allowed"])
+            # Decision trace (SlidingWindowRateLimiter.java:176-177 analog).
+            log.debug("sw decision key=%s permits=%d observed=%d allowed=%s",
+                      key, permits, int(out["observed"]), allowed)
             (self._allowed if allowed else self._rejected).increment()
             return allowed
 
@@ -115,6 +121,8 @@ class SlidingWindowRateLimiter(RateLimiter):
             self._local_cache.put(key, new_count)
 
         allowed = new_count <= self._config.max_permits
+        log.debug("sw decision key=%s permits=%d count=%d allowed=%s",
+                  key, permits, new_count, allowed)
         (self._allowed if allowed else self._rejected).increment()
         return allowed
 

@@ -23,6 +23,9 @@ from ratelimiter_tpu.core.config import RateLimitConfig, TOKEN_FP_ONE
 from ratelimiter_tpu.core.limiter import RateLimiter
 from ratelimiter_tpu.metrics import MeterRegistry
 from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("algorithms.token_bucket")
 
 # Batches at or above this size route through the pipelined
 # string-stream path (storage.acquire_stream_strs) instead of one
@@ -76,6 +79,8 @@ class TokenBucketRateLimiter(RateLimiter):
         if self._lid is not None:
             out = self._storage.acquire("tb", self._lid, key, permits)
             allowed = bool(out["allowed"])
+            log.debug("tb decision key=%s permits=%d remaining=%d allowed=%s",
+                      key, permits, int(out["remaining"]), allowed)
             (self._allowed if allowed else self._rejected).increment()
             return allowed
 
@@ -92,6 +97,8 @@ class TokenBucketRateLimiter(RateLimiter):
             ],
         )
         allowed = allowed_flag == 1
+        log.debug("tb decision key=%s permits=%d tokens_fp=%d allowed=%s",
+                  key, permits, _tokens_fp, allowed)
         (self._allowed if allowed else self._rejected).increment()
         return allowed
 

@@ -34,6 +34,10 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("engine.batcher")
+
 
 class _Pending:
     __slots__ = ("slots", "lids", "permits", "futures", "clears", "born")
@@ -166,10 +170,14 @@ class MicroBatcher:
                 if pend.clears:
                     self._clear[algo](pend.clears)
                 if pend.slots:
+                    log.debug("dispatch algo=%s batch=%d clears=%d",
+                              algo, len(pend.slots), len(pend.clears))
                     handle = self._dispatch[algo](
                         pend.slots, pend.lids, pend.permits)
                     self._enqueue_drain(algo, handle, pend.futures)
             except Exception as exc:  # noqa: BLE001 — fail every waiter
+                log.warning("dispatch failed algo=%s batch=%d: %s",
+                            algo, len(pend.slots), exc)
                 for fut in pend.futures:
                     if not fut.done():
                         fut.set_exception(exc)

@@ -106,11 +106,19 @@ class LimiterTable:
         self._device: TableArrays | None = None
 
     def register(self, config: RateLimitConfig) -> int:
-        """Add a policy row; returns its limiter id."""
+        """Add a policy row; returns its limiter id.
+
+        Safe during traffic: the device mirror is updated row-wise (five
+        scalar device updates) instead of being invalidated, so concurrent
+        dispatches never trigger a full-table re-upload mid-flight.  Only
+        a capacity grow (table shape change — which also recompiles the
+        step) rebuilds the mirror from host arrays.
+        """
         config.validate()
         with self._lock:
             if self._n == self._capacity:
                 self._grow()
+                self._device = None  # shape changed: rebuild lazily
             lid = self._n
             self._n += 1
             self._max_permits[lid] = config.max_permits
@@ -118,7 +126,15 @@ class LimiterTable:
             self._cap_fp[lid] = config.max_permits_fp
             self._rate_fp[lid] = config.refill_rate_fp
             self._ttl2_ms[lid] = 2 * config.window_ms
-            self._device = None  # re-upload lazily
+            if self._device is not None:
+                d = self._device
+                self._device = TableArrays(
+                    max_permits=d.max_permits.at[lid].set(config.max_permits),
+                    window_ms=d.window_ms.at[lid].set(config.window_ms),
+                    cap_fp=d.cap_fp.at[lid].set(config.max_permits_fp),
+                    rate_fp=d.rate_fp.at[lid].set(config.refill_rate_fp),
+                    ttl2_ms=d.ttl2_ms.at[lid].set(2 * config.window_ms),
+                )
             return lid
 
     def _grow(self) -> None:

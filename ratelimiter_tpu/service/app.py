@@ -38,6 +38,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ratelimiter_tpu.service.wiring import AppContext, build_app
 from ratelimiter_tpu.storage.errors import StorageException
+from ratelimiter_tpu.utils.logging import get_logger
+
+_log = get_logger("service.app")
 
 _RESET_RE = re.compile(r"^/(?:api/)?admin/reset/([^/]+)$")
 
@@ -93,8 +96,10 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         trade the reference documents."""
         try:
             return limiter.try_acquire(key, permits)
-        except StorageException:
+        except StorageException as exc:
             if self.ctx.fail_open:
+                _log.warning("storage failure for key=%s: %s — failing open",
+                             key, exc)
                 self.ctx.registry.counter(
                     "ratelimiter.failopen.allowed",
                     "Requests allowed due to fail-open on storage errors",

@@ -38,6 +38,11 @@ DEFAULTS = {
     # (0 = off) and/or add latency to every op (fault-tolerance rehearsal).
     "chaos.failure_rate": "0",
     "chaos.latency_ms": "0",
+    # Console logging (application.properties:9-11 analog): level for the
+    # ratelimiter_tpu logger hierarchy + the console pattern (single
+    # source of truth for the default lives in utils/logging.py).
+    "logging.level": "INFO",
+    "logging.pattern": "",  # empty -> utils/logging.DEFAULT_PATTERN
     # Per-op storage retry (RedisRateLimitStorage.java:155-178 analog):
     # attempts with linear backoff delay*attempt, then StorageException
     # escalates to fail-open. 0 retries disables the wrapper.

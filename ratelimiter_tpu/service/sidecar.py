@@ -60,9 +60,29 @@ class SidecarServer:
                  port: int = 0):
         self.storage = storage
         self._limiters: Dict[int, Tuple[str, RateLimitConfig]] = {}
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._stopped = False
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conn_lock:
+                    if outer._stopped:
+                        # Accepted in the shutdown race window: close now
+                        # rather than serving from a closed storage.
+                        try:
+                            self.request.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        self.request.close()
+                        return
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conn_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 sock: socket.socket = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -112,6 +132,23 @@ class SidecarServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Close ACCEPTED connections too: a stopped sidecar must not leave
+        # zombie handler threads answering clients from a closed storage
+        # (clients would see protocol errors instead of a dead connection
+        # and never reconnect).
+        with self._conn_lock:
+            self._stopped = True
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # -- frame handling -------------------------------------------------------
     def _handle_frame(self, frame: bytes) -> bytes:
@@ -142,6 +179,13 @@ class SidecarServer:
             return resp(1, 0, ERR_INTERNAL)
 
 
+class SidecarSendError(ConnectionError):
+    """Connection died while SENDING a request — the server cannot have
+    processed it, so a caller may safely replay on a fresh connection.
+    Read-phase failures stay plain ConnectionError: the server may have
+    executed the request before dying, so replay risks double-charging."""
+
+
 class SidecarClient:
     """Minimal pipelining client (reference for other-language ports)."""
 
@@ -149,6 +193,12 @@ class SidecarClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rbuf = b""
+
+    def _send(self, payload: bytes) -> None:
+        try:
+            self._sock.sendall(payload)
+        except OSError as exc:
+            raise SidecarSendError(str(exc)) from exc
 
     def close(self) -> None:
         self._sock.close()
@@ -174,7 +224,7 @@ class SidecarClient:
 
     # -- API ------------------------------------------------------------------
     def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
-        self._sock.sendall(self._frame(OP_TRY_ACQUIRE, lid, permits, key))
+        self._send(self._frame(OP_TRY_ACQUIRE, lid, permits, key))
         status, allowed, _ = self._read_responses(1)[0]
         if status:
             raise RuntimeError("sidecar error")
@@ -188,18 +238,18 @@ class SidecarClient:
         permits = permits or [1] * len(keys)
         payload = b"".join(
             self._frame(OP_TRY_ACQUIRE, lid, p, k) for k, p in zip(keys, permits))
-        self._sock.sendall(payload)
+        self._send(payload)
         return self._read_responses(len(keys))
 
     def available(self, lid: int, key: str) -> int:
-        self._sock.sendall(self._frame(OP_AVAILABLE, lid, 0, key))
+        self._send(self._frame(OP_AVAILABLE, lid, 0, key))
         status, _, remaining = self._read_responses(1)[0]
         if status:
             raise RuntimeError("sidecar error")
         return remaining
 
     def reset(self, lid: int, key: str) -> None:
-        self._sock.sendall(self._frame(OP_RESET, lid, 0, key))
+        self._send(self._frame(OP_RESET, lid, 0, key))
         self._read_responses(1)
 
     def ping(self) -> bool:

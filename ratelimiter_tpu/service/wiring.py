@@ -143,7 +143,9 @@ def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
     from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+    from ratelimiter_tpu.utils.logging import setup_logging
 
+    setup_logging(props)
     enable_compile_cache(props.get("jax.cache.dir"))
     registry = MeterRegistry()
     own_storage = storage is None

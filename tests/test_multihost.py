@@ -64,3 +64,110 @@ def test_router_splits_and_reassembles():
     for server, storage, _ in hosts:
         server.stop()
         storage.close()
+
+
+def _one_host(clock, cfg):
+    storage = TpuBatchedStorage(num_slots=128, max_delay_ms=0.2,
+                                clock_ms=clock)
+    server = SidecarServer(storage, host="127.0.0.1").start()
+    lid = server.register("sw", cfg)
+    return server, storage, lid
+
+
+def test_router_surfaces_down_endpoint():
+    """A dead owner surfaces a connection error to the caller — no silent
+    cross-host failover (a different host would hand the key fresh quota)."""
+    import socket
+
+    import pytest
+
+    clock = FakeClock()
+    cfg = RateLimitConfig(max_permits=4, window_ms=60_000,
+                          enable_local_cache=False)
+    server, storage, lid = _one_host(clock, cfg)
+    # Reserve a port and close it: a definitely-down second endpoint.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    router = HostRouter([("127.0.0.1", server.port),
+                         ("127.0.0.1", dead_port)])
+    keys = [f"user{i}" for i in range(20)]
+    up = [k for k in keys if host_of_key(k, 2) == 0]
+    down = [k for k in keys if host_of_key(k, 2) == 1]
+    assert up and down
+
+    assert router.try_acquire(lid, up[0])  # live host unaffected
+    with pytest.raises(OSError):
+        router.try_acquire(lid, down[0])
+    # Batches touching the dead owner error too; live-only batches work.
+    assert router.acquire_batch(lid, up[:3]) == [True] * 3
+    with pytest.raises(OSError):
+        router.acquire_batch(lid, keys)
+
+    router.close()
+    server.stop()
+    storage.close()
+
+
+def test_router_reconnects_after_host_restart():
+    """An owner restart (same endpoint, new process/socket) is absorbed by
+    the router's drop-and-retry — callers never see the stale connection."""
+    clock = FakeClock()
+    cfg = RateLimitConfig(max_permits=10, window_ms=60_000,
+                          enable_local_cache=False)
+    server, storage, lid = _one_host(clock, cfg)
+    port = server.port
+    router = HostRouter([("127.0.0.1", port)])
+    assert router.try_acquire(lid, "alice")
+
+    # "Restart": stop the sidecar, bring a fresh one up on the SAME port.
+    server.stop()
+    storage.close()
+    storage2 = TpuBatchedStorage(num_slots=128, max_delay_ms=0.2,
+                                 clock_ms=clock)
+    server2 = SidecarServer(storage2, host="127.0.0.1", port=port).start()
+    lid2 = server2.register("sw", cfg)
+    assert lid2 == lid
+
+    # The cached connection is stale; the router must reconnect and decide.
+    assert router.try_acquire(lid, "alice")
+    # State belongs to the (restarted) host: fresh quota there is expected;
+    # subsequent calls keep working on the new connection.
+    assert router.available(lid, "alice") == cfg.max_permits - 1
+
+    router.close()
+    server2.stop()
+    storage2.close()
+
+
+def test_router_down_endpoint_recovers_without_restart():
+    """A previously-down endpoint that comes up is usable on the next call
+    (failed connections are never cached)."""
+    import socket
+
+    import pytest
+
+    clock = FakeClock()
+    cfg = RateLimitConfig(max_permits=4, window_ms=60_000,
+                          enable_local_cache=False)
+    # Pick the port first so the router can point at it while it's down.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    router = HostRouter([("127.0.0.1", port)])
+    with pytest.raises(OSError):
+        router.try_acquire(1, "bob")
+
+    storage = TpuBatchedStorage(num_slots=128, max_delay_ms=0.2,
+                                clock_ms=clock)
+    server = SidecarServer(storage, host="127.0.0.1", port=port).start()
+    lid = server.register("sw", cfg)
+    assert router.try_acquire(lid, "bob")  # same router object recovered
+
+    router.close()
+    server.stop()
+    storage.close()

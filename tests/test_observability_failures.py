@@ -135,3 +135,33 @@ def test_batcher_pipelines_drains():
     elapsed = _time.perf_counter() - t0
     batcher.close()
     assert elapsed < 0.15, f"drains serialized: {elapsed:.3f}s"
+
+
+def test_logging_configured_from_props_and_emits_decisions(caplog):
+    """Logging parity (SURVEY §5.5): level/pattern come from props; the
+    decision and dispatch layers emit debug records."""
+    import logging
+
+    from ratelimiter_tpu.algorithms import TokenBucketRateLimiter
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.storage import InMemoryStorage
+    from ratelimiter_tpu.utils.logging import setup_logging
+
+    logger = setup_logging(AppProperties({"logging.level": "DEBUG"}))
+    assert logger.level == logging.DEBUG
+    # Idempotent: re-setup must not stack handlers.
+    n_handlers = len(logger.handlers)
+    setup_logging(AppProperties({"logging.level": "DEBUG"}))
+    assert len(logger.handlers) == n_handlers
+
+    limiter = TokenBucketRateLimiter(
+        InMemoryStorage(clock_ms=lambda: 50_000),
+        RateLimitConfig(max_permits=3, window_ms=1000, refill_rate=1.0),
+        MeterRegistry(), clock_ms=lambda: 50_000)
+    with caplog.at_level(logging.DEBUG, logger="ratelimiter_tpu"):
+        # caplog attaches its own handler; propagate briefly for capture.
+        logging.getLogger("ratelimiter_tpu").propagate = True
+        limiter.try_acquire("carol")
+        logging.getLogger("ratelimiter_tpu").propagate = False
+    assert any("tb decision key=carol" in r.message for r in caplog.records)
+    logger.setLevel(logging.INFO)
