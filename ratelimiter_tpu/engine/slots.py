@@ -19,7 +19,13 @@ be pinned so eviction never pulls state out from under queued requests.
 
 A faster C++ implementation with the same interface lives in
 ``native/slot_index.cpp`` (see engine/native_index.py); this pure-Python
-version is the portable fallback and the semantic reference.
+version is the portable fallback and the semantic reference for the
+scalar ops.  Recency is defined at BATCH granularity: all touches of a
+key within one batch-assign call count as a single touch at its first
+occurrence (the native index exploits this to skip LRU re-links on
+repeat hits — the dominant host cost on Zipf traffic; Redis makes the
+same resolution trade with its sampled LRU).  This scalar index sees one
+key per call, so each call is its own batch and the contracts coincide.
 """
 
 from __future__ import annotations

@@ -183,3 +183,27 @@ def test_fp_rebalance_import_preserves_recency_order():
     _, evicted = index.assign((lid, 99))
     dst.close()
     assert evicted == lru_victim_slot
+
+
+def test_batch_recency_is_first_occurrence_granular():
+    """Documented contract: within ONE batch call, repeat hits of a key do
+    not re-touch the LRU — recency among same-batch keys follows first
+    occurrence.  Batch [A, B, A] therefore leaves B most-recent; a later
+    eviction takes A's slot (not B's, as per-occurrence touching would)."""
+    import numpy as np
+    import pytest
+
+    from ratelimiter_tpu.engine.native_index import (
+        NativeSlotIndex,
+        native_available,
+    )
+
+    if not native_available():
+        pytest.skip("no native index")
+    ix = NativeSlotIndex(2)
+    slots, ev = ix.assign_batch_ints(np.asarray([7, 8, 7], dtype=np.int64), 1)
+    assert slots[0] == slots[2] and len(ev) == 0
+    # Table full; next NEW key evicts the batch's first-touched key (7).
+    _, evicted = ix.assign((1, 9))
+    assert evicted == slots[0]
+    assert ix.get((1, 8)) is not None
