@@ -213,6 +213,40 @@ def build_sharded_scan(mesh, step_p, lids_scalar: bool, has_permits: bool):
     )
 
 
+def build_sharded_flat(mesh, flat_fn, lids_scalar: bool, has_permits: bool):
+    """shard_map'd FLAT mega-batch with bit-packed decisions (ops/flat.py —
+    payload sorts, closed-form solve, block-scatter, per shard).
+
+    Shapes: state (n_shards, S_local, L); slots (n_shards, B) local ids
+    (-1 padding); lids 0-d or (n_shards, B); permits None or (n_shards, B);
+    now i64 scalar.  Returns (state, bits (n_shards, ceil(B/8))).
+    """
+    lid_spec = P() if lids_scalar else P(SHARD_AXIS)
+    if has_permits:
+        def local_flat(state, table, slots, lids, permits, now):
+            st, bits = flat_fn(state[0], table, slots[0],
+                               lids if lids_scalar else lids[0],
+                               permits[0], now)
+            return st[None], bits[None]
+
+        in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec,
+                    P(SHARD_AXIS), P())
+    else:
+        def local_flat(state, table, slots, lids, now):
+            st, bits = flat_fn(state[0], table, slots[0],
+                               lids if lids_scalar else lids[0],
+                               None, now)
+            return st[None], bits[None]
+
+        in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P())
+    return jax.shard_map(
+        local_flat,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+
+
 def build_sharded_peek(mesh, peek_fn):
     def local_peek(state, table, slots, lids, now):
         out = peek_fn(state[0], table, slots[0], lids[0], now)
@@ -326,6 +360,55 @@ class ShardedDeviceEngine:
                 donate_argnums=0)
             self._scan_fns[key] = fn
         return fn
+
+    # -- flat mega-batch dispatch (the streaming hot path; ops/flat.py) -------
+    def sw_flat_sharded_dispatch(self, slots_sb, lids, permits_sb, now_ms):
+        return self._flat_dispatch("sw", slots_sb, lids, permits_sb, now_ms)
+
+    def tb_flat_sharded_dispatch(self, slots_sb, lids, permits_sb, now_ms):
+        return self._flat_dispatch("tb", slots_sb, lids, permits_sb, now_ms)
+
+    def _flat_fn(self, algo: str, lids_scalar: bool, has_permits: bool):
+        from ratelimiter_tpu.ops.flat import sw_flat_bits, tb_flat_bits
+
+        key = ("flat", algo, lids_scalar, has_permits)
+        fn = self._scan_fns.get(key)
+        if fn is None:
+            flat = sw_flat_bits if algo == "sw" else tb_flat_bits
+            fn = jax.jit(
+                build_sharded_flat(self.mesh, flat, lids_scalar, has_permits),
+                donate_argnums=0)
+            self._scan_fns[key] = fn
+        return fn
+
+    def _flat_dispatch(self, algo, slots_sb, lids, permits_sb, now_ms):
+        """slots_sb: i32[n_shards, B_local] LOCAL slot ids (-1 padding);
+        lids scalar or i32[n_shards, B_local]; permits likewise or None;
+        now_ms scalar.  Returns a lazy uint8[n_shards, ceil(B/8)] handle."""
+        slots_sb = jnp.asarray(np.ascontiguousarray(slots_sb, dtype=np.int32))
+        lids_scalar = np.ndim(lids) == 0
+        if lids_scalar:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        has_permits = permits_sb is not None
+        now = jnp.int64(now_ms)
+        fn = self._flat_fn(algo, lids_scalar, has_permits)
+        with self._lock:
+            state = self.sw_packed if algo == "sw" else self.tb_packed
+            if has_permits:
+                permits_sb = jnp.asarray(
+                    np.ascontiguousarray(permits_sb, dtype=np.int32))
+                state, bits = fn(state, self.table.device_arrays,
+                                 slots_sb, lids, permits_sb, now)
+            else:
+                state, bits = fn(state, self.table.device_arrays,
+                                 slots_sb, lids, now)
+            if algo == "sw":
+                self.sw_packed = state
+            else:
+                self.tb_packed = state
+        return bits
 
     def _scan_dispatch(self, algo, slots_skb, lids, permits_skb, now_k):
         """slots_skb: i32[n_shards, K, B_local] LOCAL slot ids (-1 padding);

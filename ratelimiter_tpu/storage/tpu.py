@@ -457,10 +457,11 @@ class TpuBatchedStorage(RateLimitStorage):
                         oversize=None) -> np.ndarray:
         """Sharded-engine streaming: per-super-batch host routing (key ->
         shard by the deterministic splitmix hash), per-shard native slot
-        assignment, one shard_map'd scan dispatch, pipelined bitmask fetch.
-        Decision semantics match the flat stream: sub-batch j of the chunk is
-        decided before sub-batch j+1, and duplicates within a (shard, j) row
-        keep arrival order."""
+        assignment, one shard_map'd FLAT dispatch (ops/flat.py — the
+        sub-batch dimension is gone: all requests in a dispatch share its
+        timestamp, so each shard decides its whole slice as one sorted
+        batch), pipelined bitmask fetch.  Decisions are identical to the
+        flat single-device stream on the same per-key request order."""
         from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
 
         eng = self.engine
@@ -468,27 +469,25 @@ class TpuBatchedStorage(RateLimitStorage):
             permits = np.where(oversize, 1, permits)  # lanes masked; the
             # oversized requests dispatch as padding (slot -1) below.
         n_sh, sps = eng.n_shards, eng.slots_per_shard
-        k, b = int(subbatches), int(batch)
-        super_n = k * b
-        dispatch = (eng.sw_scan_dispatch if algo == "sw"
-                    else eng.tb_scan_dispatch)
+        super_n = int(subbatches) * int(batch)
+        dispatch = (eng.sw_flat_sharded_dispatch if algo == "sw"
+                    else eng.tb_flat_sharded_dispatch)
         clear = eng.sw_clear if algo == "sw" else eng.tb_clear
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
         pending: list = []
 
-        def drain(handle, start, cnt, shard, j, cols, b_loc, t0):
-            arr = np.asarray(handle)  # uint8[n_sh, k, b_loc//8]
+        def drain(handle, start, cnt, shard, cols, b_loc, t0):
+            arr = np.asarray(handle)  # uint8[n_sh, b_loc//8]
             dt_us = (time.perf_counter() - t0) * 1e6
-            bits = np.unpackbits(arr, axis=2)[:, :, :b_loc].astype(bool)
-            got = bits[shard, j, cols]
+            bits = np.unpackbits(arr, axis=1)[:, :b_loc].astype(bool)
+            got = bits[shard, cols]
             out[start:start + cnt] = got
             self._record_dispatch(algo, cnt, int(got.sum()), dt_us)
 
         for start in range(0, n, super_n):
             chunk = key_ids[start:start + super_n]
             cn = len(chunk)
-            j = np.arange(cn) // b  # sub-batch of each request
             shard = shard_of_int_keys(chunk, n_sh)
             # Per-shard slot assignment (one C call each), chunk order kept.
             local = np.empty(cn, dtype=np.int32)
@@ -512,37 +511,36 @@ class TpuBatchedStorage(RateLimitStorage):
                 clears.extend(s * sps + int(e) for e in ev)
             if clears:
                 clear(clears)
-            # Column of each request within its (shard, sub-batch) row.
-            grp = j * n_sh + shard
-            order = np.argsort(grp, kind="stable")
-            counts = np.bincount(grp, minlength=n_sh * k)
-            offs = np.zeros(n_sh * k + 1, dtype=np.int64)
+            # Column of each request within its shard row (arrival order —
+            # the stable per-slot segment order the flat step sorts by).
+            order = np.argsort(shard, kind="stable")
+            counts = np.bincount(shard, minlength=n_sh)
+            offs = np.zeros(n_sh + 1, dtype=np.int64)
             np.cumsum(counts, out=offs[1:])
             cols = np.empty(cn, dtype=np.int64)
-            cols[order] = np.arange(cn) - offs[grp[order]]
+            cols[order] = np.arange(cn) - offs[shard[order]]
             from ratelimiter_tpu.parallel.sharded import _bucket
 
             b_loc = _bucket(int(counts.max(initial=1)))
-            slots_mat = np.full((n_sh, k, b_loc), -1, dtype=np.int32)
-            slots_mat[shard, j, cols] = local
+            slots_mat = np.full((n_sh, b_loc), -1, dtype=np.int32)
+            slots_mat[shard, cols] = local
             if oversize is not None:
                 ov = oversize[start:start + cn]
-                slots_mat[shard[ov], j[ov], cols[ov]] = -1  # force-deny
-            lid_kb = lid
+                slots_mat[shard[ov], cols[ov]] = -1  # force-deny
+            lid_sb = lid
             if multi_lid:
-                lid_mat = np.zeros((n_sh, k, b_loc), dtype=np.int32)
-                lid_mat[shard, j, cols] = l_chunk
-                lid_kb = lid_mat
-            p_kb = None
+                lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                lid_mat[shard, cols] = l_chunk
+                lid_sb = lid_mat
+            p_sb = None
             if permits is not None:
-                p_mat = np.ones((n_sh, k, b_loc), dtype=np.int32)
-                p_mat[shard, j, cols] = permits[start:start + cn]
-                p_kb = p_mat
+                p_mat = np.ones((n_sh, b_loc), dtype=np.int32)
+                p_mat[shard, cols] = permits[start:start + cn]
+                p_sb = p_mat
             now = self._monotonic_now()
             t0 = time.perf_counter()
-            bits = dispatch(slots_mat, lid_kb, p_kb,
-                            np.full(k, now, dtype=np.int64))
-            pending.append((bits, start, cn, shard, j, cols, b_loc, t0))
+            bits = dispatch(slots_mat, lid_sb, p_sb, now)
+            pending.append((bits, start, cn, shard, cols, b_loc, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
         for item in pending:
