@@ -1,0 +1,73 @@
+"""Isolate compile-time and runtime of the flat-step building blocks at
+increasing mega-batch sizes on the real device.
+
+Run: python bench/profile_compile.py [sizes...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+S = 1 << 20
+
+
+def timed_compile(name, fn, *args):
+    t0 = time.perf_counter()
+    c = jax.jit(fn).lower(*args).compile()
+    tc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = c(*args)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+    t1 = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = c(*args)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        times.append(time.perf_counter() - t0)
+    print(f"  {name}: compile {tc:6.1f}s  run {min(times)*1000:7.1f} ms",
+          flush=True)
+
+
+def main():
+    sizes = [int(x) for x in sys.argv[1:]] or [1 << 19, 1 << 20, 1 << 21]
+    rng = np.random.default_rng(0)
+    for B in sizes:
+        print(f"B={B}", flush=True)
+        slots = jnp.asarray(
+            (rng.zipf(1.1, size=B).astype(np.int64) % S).astype(np.int32))
+        iota = jnp.arange(B, dtype=jnp.int32)
+        state = jnp.zeros((S, 2), dtype=jnp.int32)
+        rows = jnp.zeros((B, 2), dtype=jnp.int32)
+        mask = jnp.asarray(rng.random(B) < 0.5)
+
+        timed_compile("sort2", lambda s, i: jax.lax.sort((s, i), num_keys=1,
+                                                         is_stable=True),
+                      slots, iota)
+        timed_compile("cummax", lambda s: jax.lax.associative_scan(
+            jnp.maximum, s), slots)
+        timed_compile("gather", lambda st, s: st[s], state, slots)
+        timed_compile("xla_scatter",
+                      lambda st, s, m, r: st.at[jnp.where(m, s, S)].set(
+                          r, mode="drop"),
+                      state, slots, mask, rows)
+        timed_compile("packbits", lambda m: jnp.packbits(m), mask)
+
+        from ratelimiter_tpu.ops.pallas import block_scatter
+        if block_scatter.supported((S, 2), B):
+            srt = jnp.sort(slots)
+            timed_compile("pallas_block_scatter",
+                          lambda st, s, m, r: block_scatter.scatter_rows(
+                              st, s, m, r),
+                          state, srt, mask, rows)
+
+
+if __name__ == "__main__":
+    main()

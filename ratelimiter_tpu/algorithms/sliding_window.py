@@ -134,23 +134,29 @@ class SlidingWindowRateLimiter(RateLimiter):
         import numpy as np
 
         n = len(keys)
-        permits = [1] * n if permits is None else [int(p) for p in permits]
-        if any(p <= 0 for p in permits):
-            raise ValueError("permits must be positive")
+        unit = permits is None
+        if not unit:
+            permits = [int(p) for p in permits]
+            if any(p <= 0 for p in permits):
+                raise ValueError("permits must be positive")
         if (n >= _STREAM_MIN and self._local_cache is None
                 and hasattr(self._storage, "acquire_stream_strs")):
             # Large cache-less call: pipelined string streaming — decisions
             # identical to acquire_many (cache-enabled limiters keep the
-            # batch path, which returns the cache_value lane).
+            # batch path, which returns the cache_value lane).  permits=None
+            # is forwarded as-is: the unit-permit stream takes the relay
+            # path (no permits lane, no device sort/scan).
             allowed = np.asarray(self._storage.acquire_stream_strs(
                 "sw", self._lid, list(keys),
-                np.asarray(permits, dtype=np.int64)), dtype=bool)
+                None if unit else np.asarray(permits, dtype=np.int64)),
+                dtype=bool)
             n_allowed = int(allowed.sum())
             self._allowed.add(n_allowed)
             self._rejected.add(n - n_allowed)
             return allowed
         out = self._storage.acquire_many(
-            "sw", [self._lid] * n, list(keys), permits)
+            "sw", [self._lid] * n, list(keys),
+            [1] * n if unit else permits)
         allowed = np.asarray(out["allowed"], dtype=bool)
         if self._local_cache is not None:
             for k, v in zip(keys, out["cache_value"]):

@@ -109,19 +109,24 @@ class TokenBucketRateLimiter(RateLimiter):
         import numpy as np
 
         n = len(keys)
-        permits = [1] * n if permits is None else [int(p) for p in permits]
-        if any(p <= 0 for p in permits):
-            raise ValueError("permits must be positive")
+        unit = permits is None
+        if not unit:
+            permits = [int(p) for p in permits]
+            if any(p <= 0 for p in permits):
+                raise ValueError("permits must be positive")
         # The device kernel itself rejects permits > capacity pre-consume.
         if n >= _STREAM_MIN and hasattr(self._storage, "acquire_stream_strs"):
             # Large call: pipelined string streaming (host hashing rides in
             # the fetch shadow) — decisions identical to acquire_many.
+            # permits=None forwards as-is so the unit-permit stream takes
+            # the relay path (no permits lane, no device sort/scan).
             allowed = self._storage.acquire_stream_strs(
                 "tb", self._lid, list(keys),
-                np.asarray(permits, dtype=np.int64))
+                None if unit else np.asarray(permits, dtype=np.int64))
         else:
             out = self._storage.acquire_many(
-                "tb", [self._lid] * n, list(keys), permits)
+                "tb", [self._lid] * n, list(keys),
+                [1] * n if unit else permits)
             allowed = np.asarray(out["allowed"], dtype=bool)
         n_allowed = int(allowed.sum())
         self._allowed.add(n_allowed)

@@ -11,6 +11,7 @@ index + micro-batcher) lives in engine/slots.py and engine/batcher.py.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Sequence
 
@@ -20,6 +21,12 @@ import numpy as np
 
 from ratelimiter_tpu.engine.state import LimiterTable, SWState, TBState
 from ratelimiter_tpu.ops.flat import sw_flat_bits, tb_flat_bits
+from ratelimiter_tpu.ops.relay import (
+    sw_relay_bits,
+    sw_relay_counts,
+    tb_relay_bits,
+    tb_relay_counts,
+)
 from ratelimiter_tpu.ops.packed import (
     decode_sw_fused,
     decode_tb_fused,
@@ -89,6 +96,16 @@ class DeviceEngine:
         self._tb_scan = jax.jit(tb_scan_bits, donate_argnums=0)
         self._sw_flat = jax.jit(sw_flat_bits, donate_argnums=0)
         self._tb_flat = jax.jit(tb_flat_bits, donate_argnums=0)
+        # Relay word layout (ops/relay.py): slot_bits must cover num_slots
+        # with the all-ones padding word left over; the remaining bits of
+        # the uint32 carry the duplicate rank + last flag.
+        self.slot_bits = max(int(self.num_slots).bit_length(), 1)
+        self.rank_bits = 31 - self.slot_bits
+        self._sw_relay = jax.jit(functools.partial(
+            sw_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
+        self._tb_relay = jax.jit(functools.partial(
+            tb_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
+        self._relay_counts = {}  # (algo, out_dtype name) -> jitted step
         self._sw_peek = jax.jit(sw_peek_p)
         self._tb_peek = jax.jit(tb_peek_p)
         self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
@@ -230,6 +247,90 @@ class DeviceEngine:
                     self.tb_packed, self.table.device_arrays,
                     slots, lids, permits, now)
         return bits
+
+    # -- relay dispatch (ops/relay.py) -----------------------------------------
+    # The unit-permit streaming hot path: slot + duplicate-rank + last flag
+    # packed into one uint32 per request by the host index; the device step
+    # is gather + elementwise + masked scatter + packbits (no sort/scan).
+
+    def relay_usable(self) -> bool:
+        """Whether the word layout can carry this engine's traffic: the
+        rank clamp ceiling (2^rank_bits - 1, a deny sentinel) must exceed
+        every registered limiter's max_permits."""
+        return (self.rank_bits >= 1
+                and (1 << self.rank_bits) - 2
+                >= self.table.max_permits_registered)
+
+    def sw_relay_dispatch(self, words, lids, now_ms):
+        return self._relay_dispatch("sw", words, lids, now_ms)
+
+    def tb_relay_dispatch(self, words, lids, now_ms):
+        return self._relay_dispatch("tb", words, lids, now_ms)
+
+    def _relay_dispatch(self, algo, words, lids, now_ms):
+        """words uint32[B] (padding 0xFFFFFFFF); lids scalar or i32[B];
+        returns a lazy uint8[B/8] arrival-order allow bitmask handle."""
+        words = jnp.asarray(np.ascontiguousarray(words, dtype=np.uint32))
+        if np.ndim(lids) == 0:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, bits = self._sw_relay(
+                    self.sw_packed, self.table.device_arrays, words, lids, now)
+            else:
+                self.tb_packed, bits = self._tb_relay(
+                    self.tb_packed, self.table.device_arrays, words, lids, now)
+        return bits
+
+    def counts_dtype(self):
+        """Smallest dtype that can carry per-unique allowed counts (None
+        if none fits — the per-request relay path has no such bound)."""
+        m = self.table.max_permits_registered
+        if m <= 255:
+            return np.uint8
+        if m <= 65535:
+            return np.uint16
+        return None
+
+    def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
+        return self._relay_counts_dispatch("sw", uwords, lids, now_ms,
+                                           out_dtype)
+
+    def tb_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
+        return self._relay_counts_dispatch("tb", uwords, lids, now_ms,
+                                           out_dtype)
+
+    def _relay_counts_dispatch(self, algo, uwords, lids, now_ms, out_dtype):
+        """uwords uint32[U] (slot | clamped count; padding 0xFFFFFFFF);
+        returns a lazy out_dtype[U] per-unique allowed-count handle."""
+        jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
+        key = (algo, out_dtype().dtype.name)
+        fn = self._relay_counts.get(key)
+        if fn is None:
+            base = sw_relay_counts if algo == "sw" else tb_relay_counts
+            fn = jax.jit(functools.partial(
+                base, rank_bits=self.rank_bits, out_dtype=jdt),
+                donate_argnums=0)
+            self._relay_counts[key] = fn
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        if np.ndim(lids) == 0:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, counts = fn(
+                    self.sw_packed, self.table.device_arrays, uwords, lids,
+                    now)
+            else:
+                self.tb_packed, counts = fn(
+                    self.tb_packed, self.table.device_arrays, uwords, lids,
+                    now)
+        return counts
 
     # -- read-only ------------------------------------------------------------
     def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:

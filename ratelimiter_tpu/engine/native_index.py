@@ -36,10 +36,22 @@ def _load_library():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_LIB_PATH):
-                subprocess.run(
-                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                    check=True, capture_output=True, timeout=120)
+            # Rebuild when the source is newer than the .so (a stale
+            # library would silently miss newer entry points).  A failed
+            # build — e.g. a deployment with a prebuilt .so but no
+            # toolchain — falls through to loading the existing library.
+            src = os.path.join(os.path.abspath(_NATIVE_DIR), "slot_index.cpp")
+            stale = (not os.path.exists(_LIB_PATH)
+                     or (os.path.exists(src) and os.path.getmtime(src)
+                         > os.path.getmtime(_LIB_PATH)))
+            if stale:
+                try:
+                    subprocess.run(
+                        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                        check=True, capture_output=True, timeout=120)
+                except Exception:  # noqa: BLE001
+                    if not os.path.exists(_LIB_PATH):
+                        raise
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception:  # noqa: BLE001 — any failure => Python fallback
             _lib_failed = True
@@ -58,6 +70,30 @@ def _load_library():
         lib.rl_index_assign_bytes.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_ints_words.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_ints_multi_words.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_bytes_words.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_ints_uniques.restype = ctypes.c_int64
+        lib.rl_index_assign_ints_uniques.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_ints_multi_uniques.restype = ctypes.c_int64
+        lib.rl_index_assign_ints_multi_uniques.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_index_assign_bytes_uniques.restype = ctypes.c_int64
+        lib.rl_index_assign_bytes_uniques.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.rl_index_get_bytes.restype = ctypes.c_int32
         lib.rl_index_get_bytes.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
@@ -224,7 +260,131 @@ class NativeSlotIndex:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
-    # -- fingerprint enumeration (checkpoint/restore at native speed) ---------
+    # -- words interface (the relay streaming path; ops/relay.py) -------------
+    # One uint32 per request: slot | duplicate-rank | last-occurrence flag
+    # (layout in native/slot_index.cpp:assign_batch_words).  Evictions are
+    # reported exactly like the plain batch assigns.
+
+    def assign_batch_ints_words(self, keys: np.ndarray, lid: int,
+                                rank_bits: int,
+                                pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        n = len(keys)
+        out_words = np.empty(n, dtype=np.uint32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_ints_words(
+                self._h, keys.ctypes.data, n, int(lid), int(rank_bits),
+                out_words.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_words, out_ev[out_ev >= 0]
+
+    def assign_batch_ints_multi_words(self, keys: np.ndarray,
+                                      lids: np.ndarray, rank_bits: int,
+                                      pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        seeds = np.ascontiguousarray(lids, dtype=np.uint64)
+        n = len(keys)
+        out_words = np.empty(n, dtype=np.uint32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_ints_multi_words(
+                self._h, keys.ctypes.data, seeds.ctypes.data, n,
+                int(rank_bits), out_words.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_words, out_ev[out_ev >= 0]
+
+    def assign_batch_strs_words(self, keys, lid: int, rank_bits: int,
+                                pinned: Optional[Set[int]] = None):
+        encoded = [k.encode() if isinstance(k, str) else bytes(k)
+                   for k in keys]
+        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
+                           count=len(encoded))
+        offs = np.empty(len(keys) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        n = len(keys)
+        out_words = np.empty(n, dtype=np.uint32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            self._lib.rl_index_assign_bytes_words(
+                self._h, packed.ctypes.data if len(packed) else 0,
+                offs.ctypes.data, n, int(lid), int(rank_bits),
+                out_words.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return out_words, out_ev[out_ev >= 0]
+
+    def assign_batch_ints_uniques(self, keys: np.ndarray, lid: int,
+                                  rank_bits: int,
+                                  pinned: Optional[Set[int]] = None):
+        """Unique-compaction assign (segment-digest path): returns
+        (uwords uint32[u], uidx i32[n], rank i32[n], evictions).  uwords
+        carries (slot | clamped-count) per unique in first-appearance
+        order; uidx/rank stay host-side for decision reconstruction."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        n = len(keys)
+        uwords = np.empty(n, dtype=np.uint32)
+        uidx = np.empty(n, dtype=np.int32)
+        rank = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            u = self._lib.rl_index_assign_ints_uniques(
+                self._h, keys.ctypes.data, n, int(lid), int(rank_bits),
+                uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
+                out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
+
+    def assign_batch_ints_multi_uniques(self, keys: np.ndarray,
+                                        lids: np.ndarray, rank_bits: int,
+                                        pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        seeds = np.ascontiguousarray(lids, dtype=np.uint64)
+        n = len(keys)
+        uwords = np.empty(n, dtype=np.uint32)
+        uidx = np.empty(n, dtype=np.int32)
+        rank = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            u = self._lib.rl_index_assign_ints_multi_uniques(
+                self._h, keys.ctypes.data, seeds.ctypes.data, n,
+                int(rank_bits), uwords.ctypes.data, uidx.ctypes.data,
+                rank.ctypes.data, out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
+
+    def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
+                                  pinned: Optional[Set[int]] = None):
+        encoded = [k.encode() if isinstance(k, str) else bytes(k)
+                   for k in keys]
+        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
+                           count=len(encoded))
+        offs = np.empty(len(keys) + 1, dtype=np.int64)
+        offs[0] = 0
+        np.cumsum(lens, out=offs[1:])
+        n = len(keys)
+        uwords = np.empty(n, dtype=np.uint32)
+        uidx = np.empty(n, dtype=np.int32)
+        rank = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            u = self._lib.rl_index_assign_bytes_uniques(
+                self._h, packed.ctypes.data if len(packed) else 0,
+                offs.ctypes.data, n, int(lid), int(rank_bits),
+                uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
+                out_ev.ctypes.data)
+        if (out_ev == -2).any():
+            raise RuntimeError("slot capacity exhausted (all pinned)")
+        return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
+
+    # -- fingerprint enumeration (checkpoint/resume at native speed) ----------
     def dump_fp(self):
         """All live entries as (h1 u64[n], h2 u64[n], slots i32[n]), in LRU
         order most-recent first — the native-speed checkpoint payload.

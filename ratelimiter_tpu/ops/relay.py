@@ -1,0 +1,239 @@
+"""Relay decision steps — the unit-permit streaming hot path.
+
+The host slot index already walks every request of a batch in arrival
+order to assign slots, so it can ALSO hand the device each request's
+within-batch duplicate rank and a last-occurrence flag for free
+(native/slot_index.cpp:assign_batch_words — O(1) extra work per request,
+epoch-tagged per-slot scratch).  With unit permits the whole threshold
+recurrence of the sorted step (ops/flat.py) has a closed form in that
+rank: within a segment every request carries the same weight and
+threshold, so request j passes iff ``rank_j < avail`` and the slot's
+single write needs only the segment length (= rank + 1 at the last
+occurrence).  That deletes the device-side sort, segment scans, and
+unsort entirely:
+
+    decode word -> gather row -> elementwise math -> masked scatter
+                                                  -> packbits
+
+which is the entire step.  On XLA:TPU this matters twice over: the
+sort/associative-scan ops the sorted step leans on compile
+super-linearly in lane count (minutes at 2M lanes) and run far above the
+bandwidth floor, while gather/scatter/elementwise compile in ~1 s at any
+size and run near memory speed (bench/profile_compile.py,
+bench/profile_ops.py).
+
+Everything about a request travels in ONE uint32 word:
+
+    bit 0                   last-occurrence flag
+    bits 1 .. rank_bits     duplicate rank, clamped to 2^rank_bits - 1
+                            (the clamp value is a sentinel: the layout
+                            guarantees 2^rank_bits - 2 >= every
+                            registered limiter's max_permits, and no
+                            request with rank above max_permits can ever
+                            be allowed, so "clamped" decides as deny)
+    bits rank_bits+1 .. 31  slot id; the all-ones padding word decodes
+                            to a slot field >= num_slots => invalid lane
+
+so the host->device traffic is 4 bytes/request — the same as the sorted
+step's bare slot lane, with the rank riding in bits the slot never uses.
+
+Rank clamping is exact, not approximate: ``avail <= max_permits``
+always (token bucket: refilled tokens <= capacity; sliding window:
+remaining budget <= max_permits), so any rank at or past the clamp
+ceiling compares >= avail and is denied either way, and the write's
+``n_allowed = min(seg_len, avail)`` saturates identically.
+
+Decision math references: semantics/oracle.py (the executable spec);
+ops/flat.py (the sorted step these decisions are bit-identical to —
+tests/test_relay.py drives both on identical streams); reference
+behaviors SlidingWindowRateLimiter.java:86-131 and
+TokenBucketRateLimiter.java:38-68.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+from ratelimiter_tpu.ops.sliding_window import _rolled, _sw_decode, _sw_encode
+from ratelimiter_tpu.ops.token_bucket import _refilled, _tb_decode, _tb_encode
+
+
+def decode_words(words, rank_bits: int, num_slots: int):
+    """uint32[B] -> (slot i32[B], rank i64[B], last bool[B], valid bool[B]).
+
+    Padding lanes (0xFFFFFFFF) decode to slot >= num_slots => invalid.
+    """
+    w = words.astype(jnp.uint32)
+    slot = (w >> (rank_bits + 1)).astype(jnp.int32)
+    rank = ((w >> 1) & jnp.uint32((1 << rank_bits) - 1)).astype(jnp.int64)
+    last = (w & 1) == 1
+    valid = slot < num_slots
+    return slot, rank, last, valid
+
+
+def tb_relay_bits(packed, table, words, lids, now, *, rank_bits: int):
+    """One relay batch of unit-permit token-bucket decisions.
+
+    words uint32[B]; lids 0-d i32 (single tenant) or i32[B] lane; now i64
+    scalar.  Returns (new_packed, uint8[B/8] arrival-order allow bits).
+    Decisions are identical to tb_flat_bits(permits=None) on the same
+    batch (tests/test_relay.py).
+    """
+    num_slots = packed.shape[0]
+    slot, rank, last, valid = decode_words(words, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    scalar_lid = jnp.ndim(lids) == 0
+    lidc = lids if scalar_lid else jnp.clip(
+        lids, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    maxp = table.max_permits[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+
+    # Segment-uniform closed form (ops/flat.py:tb_flat_bits, permits=None):
+    # u = v1 - FP_ONE; request passes iff rank * FP_ONE <= u, i.e.
+    # rank < avail with avail = u // FP_ONE + 1 (0 when u < 0).
+    pre_ok = valid & (1 <= maxp)
+    u = jnp.where(pre_ok, v1 - TOKEN_FP_ONE, jnp.int64(-1))
+    avail = jnp.where(u >= 0, u // TOKEN_FP_ONE + 1, jnp.int64(0))
+    allowed = valid & (rank < avail)
+
+    # Single write per touched slot, at its last occurrence: seg_len is
+    # rank + 1 there (the clamp saturates seg_len and avail coherently).
+    seg_len = rank + 1
+    n_alw = jnp.minimum(avail, seg_len)
+    any_inc = n_alw > 0
+    tokens_new = jnp.where(any_inc, v1 - n_alw * TOKEN_FP_ONE, rows[0])
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
+
+    mask = valid & last
+    widx = jnp.where(mask, slot, jnp.int32(num_slots))  # out-of-range drops
+    packed_new = packed.at[widx].set(
+        _tb_encode(tokens_new, last_new), mode="drop")
+    return packed_new, jnp.packbits(allowed)
+
+
+def tb_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
+                    out_dtype=jnp.uint8):
+    """Segment-digest token-bucket step: one lane per UNIQUE slot.
+
+    uwords uint32[U] carries (slot | clamped segment count); the step
+    returns how many of each segment's requests are allowed (`n_allowed`,
+    clipped into out_dtype — the caller guarantees every limiter's
+    max_permits fits), and the host reconstructs per-request booleans as
+    ``rank < n_allowed[uidx]``.  State writes are identical to
+    tb_relay_bits on the expanded batch: every valid lane is its own
+    last occurrence.
+    """
+    num_slots = packed.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    scalar_lid = jnp.ndim(lids) == 0
+    lidc = lids if scalar_lid else jnp.clip(
+        lids, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    maxp = table.max_permits[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+    pre_ok = valid & (1 <= maxp)
+    u = jnp.where(pre_ok, v1 - TOKEN_FP_ONE, jnp.int64(-1))
+    avail = jnp.where(u >= 0, u // TOKEN_FP_ONE + 1, jnp.int64(0))
+    n_alw = jnp.minimum(avail, count)
+
+    any_inc = n_alw > 0
+    tokens_new = jnp.where(any_inc, v1 - n_alw * TOKEN_FP_ONE, rows[0])
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
+    widx = jnp.where(valid, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(
+        _tb_encode(tokens_new, last_new), mode="drop")
+    lim = jnp.int64(jnp.iinfo(out_dtype).max)
+    return packed_new, jnp.clip(n_alw, 0, lim).astype(out_dtype)
+
+
+def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
+                    out_dtype=jnp.uint8):
+    """Segment-digest sliding-window step (see tb_relay_counts).
+
+    The per-request decision ``rank < n_pass`` is exact: with unit
+    permits the Q2 post-increment re-check is implied — n_pass =
+    maxp - base - curr_e (when positive) and base >= 0, so any rank
+    below n_pass also satisfies curr_e + rank + 1 <= maxp.
+    """
+    num_slots = packed.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    scalar_lid = jnp.ndim(lids) == 0
+    lidc = lids if scalar_lid else jnp.clip(
+        lids, 0, table.max_permits.shape[0] - 1)
+    maxp = table.max_permits[lidc]
+    win = table.window_ms[lidc]
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    rem = now % win
+    base = (prev_e * (win - rem)) // win
+    u = jnp.where(valid, maxp - base - curr_e - 1, jnp.int64(-1))
+    n_pass = jnp.maximum(u + 1, 0)
+
+    tot = jnp.minimum(count, n_pass)
+    any_inc = tot > 0
+    curr_new = curr_e + tot
+    samew = rows[0] == curr_ws
+    cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
+    widx = jnp.where(valid, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(new_rows, mode="drop")
+    lim = jnp.int64(jnp.iinfo(out_dtype).max)
+    return packed_new, jnp.clip(n_pass, 0, lim).astype(out_dtype)
+
+
+def sw_relay_bits(packed, table, words, lids, now, *, rank_bits: int):
+    """Relay sliding-window counterpart of :func:`tb_relay_bits` (same
+    contract; decision math mirrors ops/flat.py:sw_flat_bits with
+    permits=None, including the Q1/Q2 increment-by-1 and
+    post-increment-check quirks)."""
+    num_slots = packed.shape[0]
+    slot, rank, last, valid = decode_words(words, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    scalar_lid = jnp.ndim(lids) == 0
+    lidc = lids if scalar_lid else jnp.clip(
+        lids, 0, table.max_permits.shape[0] - 1)
+    maxp = table.max_permits[lidc]
+    win = table.window_ms[lidc]
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    rem = now % win
+    base = (prev_e * (win - rem)) // win
+
+    # ops/flat.py:sw_flat_bits, permits=None: u = maxp - base - curr_e - 1;
+    # inc_j = rank_j <= u; prior increments at rank j are min(rank, n_pass);
+    # allowed additionally re-checks the post-increment count (quirk Q2).
+    u = jnp.where(valid, maxp - base - curr_e - 1, jnp.int64(-1))
+    n_pass = jnp.maximum(u + 1, 0)
+    inc = rank < n_pass
+    s_prior = jnp.minimum(rank, n_pass)
+    c_j = curr_e + s_prior
+    allowed = inc & (c_j + 1 <= maxp) & valid
+
+    seg_len = rank + 1
+    tot = jnp.minimum(seg_len, n_pass)
+    any_inc = tot > 0
+    curr_new = curr_e + tot
+    samew = rows[0] == curr_ws
+    cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
+
+    mask = valid & last
+    widx = jnp.where(mask, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(new_rows, mode="drop")
+    return packed_new, jnp.packbits(allowed)

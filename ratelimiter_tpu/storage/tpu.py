@@ -41,6 +41,31 @@ from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.memory import InMemoryStorage
 
 
+# Per-dispatch lane cap for the SORTED flat step (ops/flat.py): its
+# sort/associative-scan ops compile super-linearly on XLA:TPU
+# (bench/profile_compile.py), so dispatches are cut to this size and
+# pipelined instead.  The unit-permit relay step (ops/relay.py) has no
+# sort/scan and takes no cap.
+_FLAT_MAX_LANES = 1 << 19
+
+# Relay-path chunking: the first chunk probes the stream's duplicate
+# structure at 1M requests; later chunks grow toward a fixed wire budget
+# per dispatch (digest mode on skewed traffic runs ~0.3-1 B/request, so
+# chunks grow to 16M and the whole pass becomes a couple of dispatches;
+# uniform traffic stays near 2M).  Budget ~= the largest transfer that
+# still moves at full link speed (bench/profile_upload.py).
+_RELAY_CHUNK = 1 << 20
+_RELAY_CHUNK_MAX = 1 << 24
+_RELAY_WIRE_BUDGET = 8 << 20
+
+
+def _bucket_pow2(n: int, floor: int = 4096) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
 def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
 
@@ -335,6 +360,27 @@ class TpuBatchedStorage(RateLimitStorage):
         if oversize is not None:
             permits = np.where(oversize, 1, permits)  # lanes masked, see above
 
+        if (permits is None
+                and hasattr(index, "assign_batch_ints_uniques")
+                and self.engine.relay_usable()):
+            # Unit-permit relay path (ops/relay.py): the index hands the
+            # device the duplicate structure it computed while assigning
+            # slots, deleting the device-side sort/scan entirely.
+            rb = self.engine.rank_bits
+
+            def assign_uniques(start, chunk_n):
+                chunk = key_ids[start:start + chunk_n]
+                if multi_lid:
+                    return index.assign_batch_ints_multi_uniques(
+                        chunk, lid_arr[start:start + chunk_n], rb,
+                        pinned=self._batcher.pending_slots(algo))
+                return index.assign_batch_ints_uniques(
+                    chunk, lid, rb,
+                    pinned=self._batcher.pending_slots(algo))
+
+            return self._stream_relay(algo, lid, assign_uniques, len(key_ids),
+                                      lid_arr if multi_lid else None)
+
         def assign(start, chunk_n):
             chunk = key_ids[start:start + chunk_n]
             if multi_lid:
@@ -348,6 +394,106 @@ class TpuBatchedStorage(RateLimitStorage):
                                  oversize, batch, subbatches,
                                  lid_arr if multi_lid else None)
 
+    def _stream_relay(self, algo, lid, assign_uniques, n,
+                      lid_arr=None) -> np.ndarray:
+        """Relay streaming loop (unit permits): per chunk, one C call
+        assigns slots AND produces the duplicate structure — per-unique
+        (slot | segment count) words plus host-side (unique-index, rank)
+        per request (native/slot_index.cpp:assign_batch_uniques).  The
+        dispatch is chosen per chunk by measured traffic:
+
+        - **segment digest** (skewed traffic): upload one uint32 per
+          UNIQUE slot, device returns one allowed-count per unique, host
+          reconstructs per-request booleans as ``rank < n_allowed[uidx]``
+          (one numpy gather).  Bytes shrink by the duplicate factor —
+          4-10x on the Zipf/multi-tenant scenarios — and the device
+          gathers/scatters only unique rows.
+        - **per-request words** (uniform traffic, duplicate-poor): the
+          (slot|rank|last) words are reconstructed in numpy from the same
+          digest output and dispatched through the bit-mask relay step.
+
+        Both decide identically to the sorted flat path on the same
+        chunking (tests/test_relay.py).  Chunks are ``_RELAY_CHUNK``
+        requests and pipeline two-deep so fetches ride in the shadow of
+        the next chunk's host work + upload."""
+        multi_lid = lid_arr is not None
+        eng = self.engine
+        rb = eng.rank_bits
+        rank_mask = np.uint32((1 << rb) - 1)
+        cdt = eng.counts_dtype()
+        bits_dispatch = (eng.sw_relay_dispatch if algo == "sw"
+                         else eng.tb_relay_dispatch)
+        counts_dispatch = (eng.sw_relay_counts_dispatch if algo == "sw"
+                           else eng.tb_relay_counts_dispatch)
+        clear = (eng.sw_clear if algo == "sw" else eng.tb_clear)
+        out = np.empty(n, dtype=bool)
+        pending: list[tuple] = []
+
+        def drain(mode, handle, start, count, extra, t0):
+            arr = np.asarray(handle)  # the one blocking fetch
+            dt_us = (time.perf_counter() - t0) * 1e6
+            if mode == "bits":
+                got = np.unpackbits(arr)[:count].astype(bool)
+            else:  # digest: reconstruct from per-unique allowed counts
+                uidx, rank, u = extra
+                got = rank < arr[:u].astype(np.int32)[uidx]
+            out[start:start + count] = got
+            self._record_dispatch(algo, count, int(got.sum()), dt_us)
+
+        chunk = _RELAY_CHUNK
+        start = 0
+        while start < n:
+            cn = min(chunk, n - start)
+            uwords, uidx, rank, clears = assign_uniques(start, cn)
+            if len(clears):
+                clear(list(clears))
+            u = len(uwords)
+            l_chunk = lid_arr[start:start + cn] if multi_lid else None
+            # Per-request traffic: 4B word (+4B lid lane if multi) + bits
+            # back; digest traffic: 6B/unique (+4B if multi).  Pick the
+            # smaller wire cost.
+            digest = cdt is not None and (
+                (10 if multi_lid else 6) * u
+                <= ((8.2 if multi_lid else 4.2) * cn))
+            now = self._monotonic_now()
+            t0 = time.perf_counter()
+            if digest:
+                size = _bucket_pow2(u)
+                uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
+                lid_lane = lid if not multi_lid else _pad_tail(
+                    l_chunk[rank == 0], size, 0, np.int32)
+                counts = counts_dispatch(uw, lid_lane, now, cdt)
+                pending.append(
+                    ("digest", counts, start, cn, (uidx, rank, u), t0))
+            else:
+                slotf = uwords >> np.uint32(rb + 1)
+                cnt_cl = (uwords >> np.uint32(1)) & rank_mask
+                words = ((slotf[uidx] << np.uint32(rb + 1))
+                         | (np.minimum(rank.astype(np.uint32), rank_mask)
+                            << np.uint32(1))
+                         | (rank.astype(np.uint32) + 1 == cnt_cl[uidx]))
+                size = _bucket_pow2(cn)
+                words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
+                lid_lane = lid if not multi_lid else _pad_tail(
+                    l_chunk, size, 0, np.int32)
+                bits = bits_dispatch(words, lid_lane, now)
+                pending.append(("bits", bits, start, cn, None, t0))
+            if len(pending) > 1:
+                drain(*pending.pop(0))
+            # Grow the next chunk toward the wire budget at this chunk's
+            # measured bytes/request (skewed streams compact hard in
+            # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
+            # the fixed per-dispatch latency amortizes away).
+            wire_b = ((6 if not multi_lid else 10) * u if digest
+                      else (4.125 if not multi_lid else 8.125) * cn)
+            bpr = max(wire_b / cn, 1e-3)
+            chunk = int(min(max(_RELAY_WIRE_BUDGET / bpr, _RELAY_CHUNK),
+                            _RELAY_CHUNK_MAX))
+            start += cn
+        for item in pending:
+            drain(*item)
+        return out
+
     def _stream_flat(self, algo, lid, assign, n, permits, oversize,
                      batch, subbatches, lid_arr=None) -> np.ndarray:
         """Common flat-streaming loop: per super-batch, one host slot
@@ -355,9 +501,17 @@ class TpuBatchedStorage(RateLimitStorage):
         device dispatch (ops/flat.py — every request in a dispatch shares
         its timestamp, so the flat sorted batch decides identically to
         ``subbatches`` sequential scan steps), and a pipelined bitmask
-        fetch that overlaps the next super-batch's indexing + dispatch."""
+        fetch that overlaps the next super-batch's indexing + dispatch.
+
+        Dispatches are capped at ``_FLAT_MAX_LANES`` requests: the sorted
+        step's sort/scan ops have XLA:TPU compile times that grow
+        super-linearly with lane count (~30 s at 512K lanes, ~4 min at 2M,
+        unusable at 4M — bench/profile_compile.py), while throughput at
+        this size is already transfer-bound, so larger dispatches only buy
+        compile pain.  Semantically a cap is just a smaller super-batch:
+        each dispatch still carries its own monotonic timestamp."""
         multi_lid = lid_arr is not None
-        super_n = int(subbatches) * int(batch)
+        super_n = min(int(subbatches) * int(batch), _FLAT_MAX_LANES)
         dispatch = (self.engine.sw_flat_dispatch if algo == "sw"
                     else self.engine.tb_flat_dispatch)
         clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
@@ -444,6 +598,18 @@ class TpuBatchedStorage(RateLimitStorage):
         if oversize is not None:
             permits = np.where(oversize, 1, permits)
 
+        if (permits is None
+                and hasattr(index, "assign_batch_strs_uniques")
+                and self.engine.relay_usable()):
+            rb = self.engine.rank_bits
+
+            def assign_uniques(start, chunk_n):
+                return index.assign_batch_strs_uniques(
+                    list(keys[start:start + chunk_n]), lid, rb,
+                    pinned=self._batcher.pending_slots(algo))
+
+            return self._stream_relay(algo, lid, assign_uniques, len(keys))
+
         def assign(start, chunk_n):
             return index.assign_batch_strs(
                 list(keys[start:start + chunk_n]), lid,
@@ -469,7 +635,13 @@ class TpuBatchedStorage(RateLimitStorage):
             permits = np.where(oversize, 1, permits)  # lanes masked; the
             # oversized requests dispatch as padding (slot -1) below.
         n_sh, sps = eng.n_shards, eng.slots_per_shard
-        super_n = int(subbatches) * int(batch)
+        # Same per-dispatch lane cap as _stream_flat: the per-shard slice
+        # is what the sorted step compiles over, and _bucket rounds the
+        # busiest shard's count up to a power of two, so budget half the
+        # single-device lanes per shard to keep the bucketed b_loc at or
+        # under _FLAT_MAX_LANES even with hash imbalance.
+        super_n = min(int(subbatches) * int(batch),
+                      (_FLAT_MAX_LANES // 2) * n_sh)
         dispatch = (eng.sw_flat_sharded_dispatch if algo == "sw"
                     else eng.tb_flat_sharded_dispatch)
         clear = eng.sw_clear if algo == "sw" else eng.tb_clear

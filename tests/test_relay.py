@@ -1,0 +1,252 @@
+"""Differential tests for the relay steps (ops/relay.py) and the native
+index's duplicate-structure outputs (native/slot_index.cpp:
+assign_batch_words / assign_batch_uniques).
+
+The relay paths must decide exactly like the sorted flat step on the
+same batch and leave identical device state — that equivalence is what
+lets the stream path delete the device-side sort/scan.  The C++ words
+must match a straightforward Python reconstruction of ranks and last
+flags, including the clamp sentinel.
+"""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.engine import DeviceEngine
+from ratelimiter_tpu.engine.state import LimiterTable
+
+
+@pytest.fixture()
+def table():
+    t = LimiterTable()
+    t.register(RateLimitConfig(max_permits=5, window_ms=1000))          # 1 sw
+    t.register(RateLimitConfig(max_permits=10, window_ms=1000,
+                               refill_rate=5.0))                        # 2 tb
+    t.register(RateLimitConfig(max_permits=3, window_ms=500,
+                               refill_rate=2.0))                        # 3 tb
+    return t
+
+
+def _truth_structure(slots):
+    """(rank, uidx, unique slots in first-appearance order, counts)."""
+    seen, order, cnt = {}, [], {}
+    rank = np.empty(len(slots), dtype=np.int32)
+    uidx = np.empty(len(slots), dtype=np.int32)
+    for i, s in enumerate(slots):
+        if s not in seen:
+            seen[s] = len(order)
+            order.append(s)
+        r = cnt.get(s, 0)
+        cnt[s] = r + 1
+        rank[i] = r
+        uidx[i] = seen[s]
+    return rank, uidx, np.asarray(order), np.asarray(
+        [cnt[s] for s in order])
+
+
+def _make_words(slots, rank_bits):
+    rank, uidx, _, counts = _truth_structure(slots)
+    clamp = (1 << rank_bits) - 1
+    # True last occurrence (the C++ words path flags the actual last
+    # position regardless of clamping).
+    last = rank + 1 == counts[uidx]
+    return (np.asarray(slots, np.uint32) << np.uint32(rank_bits + 1)
+            | (np.minimum(rank, clamp).astype(np.uint32) << np.uint32(1))
+            | last.astype(np.uint32))
+
+
+def _make_uwords(slots, rank_bits):
+    _, _, order, counts = _truth_structure(slots)
+    clamp = (1 << rank_bits) - 1
+    return (order.astype(np.uint32) << np.uint32(rank_bits + 1)
+            | np.minimum(counts, clamp).astype(np.uint32) << np.uint32(1))
+
+
+def _flat(engine, algo, slots, lid, now):
+    fn = (engine.sw_flat_dispatch if algo == "sw"
+          else engine.tb_flat_dispatch)
+    return np.unpackbits(np.asarray(
+        fn(slots, np.int32(lid), None, now)))[: len(slots)].astype(bool)
+
+
+def _relay(engine, algo, slots, lid, now):
+    words = _make_words(slots, engine.rank_bits)
+    fn = (engine.sw_relay_dispatch if algo == "sw"
+          else engine.tb_relay_dispatch)
+    return np.unpackbits(np.asarray(
+        fn(words, np.int32(lid), now)))[: len(slots)].astype(bool)
+
+
+def _digest(engine, algo, slots, lid, now, out_dtype=np.uint8):
+    rank, uidx, order, _ = _truth_structure(slots)
+    uwords = _make_uwords(slots, engine.rank_bits)
+    fn = (engine.sw_relay_counts_dispatch if algo == "sw"
+          else engine.tb_relay_counts_dispatch)
+    counts = np.asarray(fn(uwords, np.int32(lid), now, out_dtype))
+    return rank < counts[: len(order)].astype(np.int32)[uidx]
+
+
+def _state(engine, algo):
+    return np.asarray(engine.sw_packed if algo == "sw"
+                      else engine.tb_packed)
+
+
+@pytest.mark.parametrize("algo,lid", [("sw", 1), ("tb", 2), ("tb", 3)])
+def test_relay_matches_flat(table, algo, lid):
+    """Duplicate-heavy random batches across window/refill boundaries:
+    relay bits and digest counts must reproduce the sorted flat step's
+    decisions bit-for-bit and leave identical state."""
+    rng = np.random.default_rng(11)
+    engines = [DeviceEngine(num_slots=64, table=table) for _ in range(3)]
+    for now in (1_000_000, 1_000_123, 1_000_750, 1_004_000):
+        slots = rng.integers(0, 9, 240).astype(np.int32)
+        a = _flat(engines[0], algo, slots, lid, now)
+        b = _relay(engines[1], algo, slots, lid, now)
+        c = _digest(engines[2], algo, slots, lid, now)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+        np.testing.assert_array_equal(
+            _state(engines[0], algo), _state(engines[1], algo))
+        np.testing.assert_array_equal(
+            _state(engines[0], algo), _state(engines[2], algo))
+
+
+@pytest.mark.parametrize("algo,lid", [("sw", 1), ("tb", 3)])
+def test_relay_clamped_ranks(table, algo, lid):
+    """One segment longer than the rank clamp: decisions and state must
+    still match the flat step.  The sentinel is deny-only ONLY when the
+    clamp exceeds max_permits (here clamp 7 > max_permits 5 and 3 —
+    exactly the precondition relay_usable() enforces)."""
+    import functools
+
+    import jax
+    from ratelimiter_tpu.ops import relay
+
+    rb = 3  # forced small clamp; engines would derive 24 at 64 slots
+    eng = DeviceEngine(num_slots=64, table=table)
+    slots = np.zeros(32, dtype=np.int32)  # one 32-long segment
+    now = 1_000_000
+    a = _flat(eng, algo, slots, lid, now)
+
+    bits_fn = jax.jit(functools.partial(
+        relay.sw_relay_bits if algo == "sw" else relay.tb_relay_bits,
+        rank_bits=rb))
+    counts_fn = jax.jit(functools.partial(
+        relay.sw_relay_counts if algo == "sw" else relay.tb_relay_counts,
+        rank_bits=rb))
+    state0 = (eng.sw_packed if algo == "sw" else eng.tb_packed) * 0
+    arrays = table.device_arrays
+
+    st_b, bits = bits_fn(state0, arrays, _make_words(slots, rb),
+                         np.int32(lid), now)
+    b = np.unpackbits(np.asarray(bits))[:32].astype(bool)
+    rank, uidx, order, _ = _truth_structure(slots)
+    st_c, counts = counts_fn(state0, arrays, _make_uwords(slots, rb),
+                             np.int32(lid), now)
+    c = rank < np.asarray(counts)[: len(order)].astype(np.int32)[uidx]
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+    truth_state = _state(eng, algo)
+    np.testing.assert_array_equal(truth_state[:1], np.asarray(st_b)[:1])
+    np.testing.assert_array_equal(truth_state[:1], np.asarray(st_c)[:1])
+
+
+def test_relay_usable_gate():
+    """A policy whose max_permits exceeds the clamp must disable relay."""
+    t = LimiterTable()
+    t.register(RateLimitConfig(max_permits=5, window_ms=1000))
+    eng = DeviceEngine(num_slots=1 << 20, table=t)  # rank_bits 10, clamp 1023
+    assert eng.relay_usable()
+    t.register(RateLimitConfig(max_permits=2000, window_ms=1000))
+    assert not eng.relay_usable()
+
+
+def test_native_words_and_uniques_match_truth():
+    """C++ duplicate structure == Python reconstruction, including clamp
+    and both key flavors."""
+    pytest.importorskip("ctypes")
+    from ratelimiter_tpu.engine.native_index import (
+        NativeSlotIndex, native_available)
+
+    if not native_available():
+        pytest.skip("native index unavailable")
+    rng = np.random.default_rng(5)
+    rb = 3
+    for flavor in ("int", "str", "multi"):
+        ix_w = NativeSlotIndex(256)
+        ix_u = NativeSlotIndex(256)
+        ix_ref = NativeSlotIndex(256)
+        keys = rng.integers(0, 17, 400)
+        if flavor == "int":
+            words, _ = ix_w.assign_batch_ints_words(keys, 1, rb)
+            uwords, uidx, rank, _ = ix_u.assign_batch_ints_uniques(keys, 1, rb)
+            slots, _ = ix_ref.assign_batch_ints(keys, 1)
+        elif flavor == "str":
+            skeys = [f"k{v}" for v in keys]
+            words, _ = ix_w.assign_batch_strs_words(skeys, 1, rb)
+            uwords, uidx, rank, _ = ix_u.assign_batch_strs_uniques(
+                skeys, 1, rb)
+            slots, _ = ix_ref.assign_batch_strs(skeys, 1)
+        else:
+            lids = rng.integers(1, 4, 400)
+            words, _ = ix_w.assign_batch_ints_multi_words(keys, lids, rb)
+            uwords, uidx, rank, _ = ix_u.assign_batch_ints_multi_uniques(
+                keys, lids, rb)
+            slots, _ = ix_ref.assign_batch_ints_multi(keys, lids)
+        np.testing.assert_array_equal(words, _make_words(slots, rb),
+                                      err_msg=flavor)
+        np.testing.assert_array_equal(uwords, _make_uwords(slots, rb),
+                                      err_msg=flavor)
+        t_rank, t_uidx, _, _ = _truth_structure(slots)
+        np.testing.assert_array_equal(rank, t_rank, err_msg=flavor)
+        np.testing.assert_array_equal(uidx, t_uidx, err_msg=flavor)
+
+
+@pytest.mark.parametrize("force_mode", ["digest", "bits"])
+def test_stream_relay_modes_match_batch_path(monkeypatch, force_mode):
+    """Storage-level: the relay stream (either mode) must decide exactly
+    like acquire_many_ids over the same chunks at the same timestamps."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    if force_mode == "bits":
+        # Disable digest election so the per-request reconstruction runs.
+        monkeypatch.setattr(
+            TpuBatchedStorage, "_stream_relay",
+            _forced_bits_stream(TpuBatchedStorage._stream_relay))
+    rng = np.random.default_rng(21)
+    now = [5_000_000]
+    st_a = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    st_b = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=6, window_ms=1000, refill_rate=4.0)
+    lid_a = st_a.register_limiter("tb", cfg)
+    lid_b = st_b.register_limiter("tb", cfg)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    for rep in range(4):
+        ids = rng.integers(0, 40, 700)
+        a = st_a.acquire_stream_ids("tb", lid_a, ids, None, batch=256,
+                                    subbatches=1)
+        res = np.empty(700, dtype=bool)
+        for i in range(0, 700, 256):
+            res[i:i + 256] = st_b.acquire_many_ids(
+                "tb", lid_b, ids[i:i + 256],
+                np.ones(len(ids[i:i + 256]), np.int64))["allowed"]
+        np.testing.assert_array_equal(a, res, err_msg=f"rep {rep}")
+        now[0] += 237
+    st_a.close()
+    st_b.close()
+
+
+def _forced_bits_stream(orig):
+    def wrapper(self, algo, lid, assign_uniques, n, lid_arr=None):
+        eng = self.engine
+        real = eng.counts_dtype
+
+        eng.counts_dtype = lambda: None  # digest never elected
+        try:
+            return orig(self, algo, lid, assign_uniques, n, lid_arr)
+        finally:
+            eng.counts_dtype = real
+    return wrapper
