@@ -54,9 +54,9 @@ struct Index {
   int64_t size = 0;
   int32_t lru_head = -1, lru_tail = -1;  // head = most recent
   uint64_t gen = 0;
-  // Scratch for the words path (assign_batch_words): per-slot duplicate
+  // Scratch for the relay path (assign_batch_uniques): per-slot duplicate
   // counters for the current batch, epoch-tagged so no per-batch reset is
-  // needed.  Allocated lazily on the first words call.
+  // needed.  Allocated lazily on the first uniques call.
   std::vector<uint64_t> batch_epoch;   // slot -> last batch generation seen
   std::vector<int32_t> batch_cnt;      // slot -> occurrences so far
   std::vector<int32_t> batch_last;     // slot -> position of last occurrence
@@ -249,60 +249,6 @@ inline void assign_batch(Index* ix, int64_t n, int32_t* out_slots,
   }
 }
 
-// Words variant: besides assigning slots, compute each request's
-// within-batch duplicate rank and last-occurrence flag, and pack
-// everything into one uint32 per request:
-//
-//   bit 0                 last-occurrence flag
-//   bits 1..rank_bits     rank, clamped to 2^rank_bits - 1 (sentinel:
-//                         the caller sizes rank_bits so the clamp value
-//                         exceeds every limiter's max_permits, making a
-//                         clamped rank an unconditional deny)
-//   bits rank_bits+1..31  slot id (the all-ones padding word decodes to
-//                         a slot >= num_slots on the device)
-//
-// A request that could not be assigned (all slots pinned, evicted = -2)
-// gets the padding word; the caller raises, matching assign_batch.
-// Per-slot scratch is epoch-tagged (one shared generation bump per
-// batch), so the extra cost is O(1) per request with no reset sweep.
-template <typename HashAt>
-inline void assign_batch_words(Index* ix, int64_t n, int32_t rank_bits,
-                               uint32_t* out_words, int32_t* out_evicted,
-                               HashAt&& hash_at) {
-  if (ix->batch_epoch.empty()) {
-    ix->batch_epoch.assign(ix->num_slots, 0);
-    ix->batch_cnt.assign(ix->num_slots, 0);
-    ix->batch_last.assign(ix->num_slots, -1);
-  }
-  if (static_cast<int64_t>(ix->slots_tmp.size()) < n)
-    ix->slots_tmp.resize(n);
-  int32_t* slots = ix->slots_tmp.data();
-  assign_batch(ix, n, slots, out_evicted, hash_at);
-  const uint64_t epoch = ix->gen;  // assign_batch bumped it for this batch
-  const uint32_t rank_max = (1u << rank_bits) - 1;
-  for (int64_t i = 0; i < n; i++) {
-    int32_t s = slots[i];
-    if (s < 0) {  // assignment failed (-2): padding word
-      out_words[i] = 0xFFFFFFFFu;
-      continue;
-    }
-    if (ix->batch_epoch[s] != epoch) {
-      ix->batch_epoch[s] = epoch;
-      ix->batch_cnt[s] = 0;
-    }
-    uint32_t rank = static_cast<uint32_t>(ix->batch_cnt[s]);
-    if (ix->batch_cnt[s] < INT32_MAX) ix->batch_cnt[s]++;
-    if (rank > rank_max) rank = rank_max;
-    ix->batch_last[s] = static_cast<int32_t>(i);
-    out_words[i] = (static_cast<uint32_t>(s) << (rank_bits + 1)) | (rank << 1);
-  }
-  for (int64_t i = 0; i < n; i++) {
-    int32_t s = slots[i];
-    if (s >= 0 && ix->batch_last[s] == static_cast<int32_t>(i))
-      out_words[i] |= 1u;
-  }
-}
-
 // Unique-compaction variant (the segment-digest path): one uint32 word
 // per UNIQUE slot of the batch — (slot << (rank_bits+1)) | (count << 1)
 // with count clamped like the rank — plus per-request (unique-index,
@@ -411,42 +357,6 @@ void rl_index_assign_bytes(void* h, const uint8_t* data, const int64_t* offsets,
                  hash_bytes(data + offsets[i], offsets[i + 1] - offsets[i],
                             lid_seed, h1, h2);
                });
-}
-
-// Words variants of the three batch-assign flavors (see
-// assign_batch_words above).  rank_bits must satisfy
-// 1 <= rank_bits <= 30 and num_slots < 2^(31 - rank_bits).
-void rl_index_assign_ints_words(void* h, const int64_t* keys, int64_t n,
-                                uint64_t lid_seed, int32_t rank_bits,
-                                uint32_t* out_words, int32_t* out_evicted) {
-  assign_batch_words(static_cast<Index*>(h), n, rank_bits, out_words,
-                     out_evicted,
-                     [&](int64_t i, uint64_t& h1, uint64_t& h2) {
-                       hash_int(keys[i], lid_seed, h1, h2);
-                     });
-}
-
-void rl_index_assign_ints_multi_words(void* h, const int64_t* keys,
-                                      const uint64_t* seeds, int64_t n,
-                                      int32_t rank_bits, uint32_t* out_words,
-                                      int32_t* out_evicted) {
-  assign_batch_words(static_cast<Index*>(h), n, rank_bits, out_words,
-                     out_evicted,
-                     [&](int64_t i, uint64_t& h1, uint64_t& h2) {
-                       hash_int(keys[i], seeds[i], h1, h2);
-                     });
-}
-
-void rl_index_assign_bytes_words(void* h, const uint8_t* data,
-                                 const int64_t* offsets, int64_t n,
-                                 uint64_t lid_seed, int32_t rank_bits,
-                                 uint32_t* out_words, int32_t* out_evicted) {
-  assign_batch_words(static_cast<Index*>(h), n, rank_bits, out_words,
-                     out_evicted,
-                     [&](int64_t i, uint64_t& h1, uint64_t& h2) {
-                       hash_bytes(data + offsets[i], offsets[i + 1] - offsets[i],
-                                  lid_seed, h1, h2);
-                     });
 }
 
 // Unique-compaction variants (see assign_batch_uniques above).

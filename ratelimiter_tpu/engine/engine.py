@@ -234,8 +234,11 @@ class DeviceEngine:
         else:
             lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
         if permits is not None:
-            permits = jnp.asarray(
-                np.ascontiguousarray(permits, dtype=np.int32))
+            # uint8 lanes (all permits <= 255) ship 4x fewer bytes; the
+            # step upcasts to i64 internally either way.
+            pdt = (np.uint8 if getattr(permits, "dtype", None) == np.uint8
+                   else np.int32)
+            permits = jnp.asarray(np.ascontiguousarray(permits, dtype=pdt))
         now = jnp.int64(now_ms)
         with self._lock:
             if algo == "sw":
@@ -254,12 +257,10 @@ class DeviceEngine:
     # is gather + elementwise + masked scatter + packbits (no sort/scan).
 
     def relay_usable(self) -> bool:
-        """Whether the word layout can carry this engine's traffic: the
-        rank clamp ceiling (2^rank_bits - 1, a deny sentinel) must exceed
-        every registered limiter's max_permits."""
-        return (self.rank_bits >= 1
-                and (1 << self.rank_bits) - 2
-                >= self.table.max_permits_registered)
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        return relay_ops.relay_usable(self.rank_bits,
+                                      self.table.max_permits_registered)
 
     def sw_relay_dispatch(self, words, lids, now_ms):
         return self._relay_dispatch("sw", words, lids, now_ms)
@@ -286,14 +287,9 @@ class DeviceEngine:
         return bits
 
     def counts_dtype(self):
-        """Smallest dtype that can carry per-unique allowed counts (None
-        if none fits — the per-request relay path has no such bound)."""
-        m = self.table.max_permits_registered
-        if m <= 255:
-            return np.uint8
-        if m <= 65535:
-            return np.uint16
-        return None
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        return relay_ops.counts_dtype(self.table.max_permits_registered)
 
     def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
         return self._relay_counts_dispatch("sw", uwords, lids, now_ms,

@@ -53,76 +53,73 @@ def _load_library():
                     if not os.path.exists(_LIB_PATH):
                         raise
             lib = ctypes.CDLL(_LIB_PATH)
+            _bind(lib)  # missing symbol (stale prebuilt .so) => fallback
         except Exception:  # noqa: BLE001 — any failure => Python fallback
             _lib_failed = True
             return None
-        lib.rl_index_new.restype = ctypes.c_void_p
-        lib.rl_index_new.argtypes = [ctypes.c_int64]
-        lib.rl_index_free.argtypes = [ctypes.c_void_p]
-        lib.rl_index_len.restype = ctypes.c_int64
-        lib.rl_index_len.argtypes = [ctypes.c_void_p]
-        lib.rl_index_assign_ints.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_ints_multi.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_ints_words.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_ints_multi_words.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_bytes_words.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_ints_uniques.restype = ctypes.c_int64
-        lib.rl_index_assign_ints_uniques.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_ints_multi_uniques.restype = ctypes.c_int64
-        lib.rl_index_assign_ints_multi_uniques.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_assign_bytes_uniques.restype = ctypes.c_int64
-        lib.rl_index_assign_bytes_uniques.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_get_bytes.restype = ctypes.c_int32
-        lib.rl_index_get_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
-        lib.rl_index_get_int.restype = ctypes.c_int32
-        lib.rl_index_get_int.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
-        lib.rl_index_remove_bytes.restype = ctypes.c_int32
-        lib.rl_index_remove_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
-        lib.rl_index_remove_int.restype = ctypes.c_int32
-        lib.rl_index_remove_int.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
-        lib.rl_index_pin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.rl_index_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.rl_index_dump.restype = ctypes.c_int64
-        lib.rl_index_dump.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        lib.rl_index_restore.restype = ctypes.c_int32
-        lib.rl_index_restore.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64]
-        lib.rl_index_lookup_fps.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p]
-        lib.rl_index_assign_fps.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+def _bind(lib) -> None:
+    """Declare the C ABI; raises AttributeError on a library that predates
+    any entry point (caller maps that to the Python-index fallback)."""
+    lib.rl_index_new.restype = ctypes.c_void_p
+    lib.rl_index_new.argtypes = [ctypes.c_int64]
+    lib.rl_index_free.argtypes = [ctypes.c_void_p]
+    lib.rl_index_len.restype = ctypes.c_int64
+    lib.rl_index_len.argtypes = [ctypes.c_void_p]
+    lib.rl_index_assign_ints.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_assign_ints_multi.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_assign_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_assign_ints_uniques.restype = ctypes.c_int64
+    lib.rl_index_assign_ints_uniques.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_assign_ints_multi_uniques.restype = ctypes.c_int64
+    lib.rl_index_assign_ints_multi_uniques.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_assign_bytes_uniques.restype = ctypes.c_int64
+    lib.rl_index_assign_bytes_uniques.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_get_bytes.restype = ctypes.c_int32
+    lib.rl_index_get_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
+    lib.rl_index_get_int.restype = ctypes.c_int32
+    lib.rl_index_get_int.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+    lib.rl_index_remove_bytes.restype = ctypes.c_int32
+    lib.rl_index_remove_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
+    lib.rl_index_remove_int.restype = ctypes.c_int32
+    lib.rl_index_remove_int.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+    lib.rl_index_pin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.rl_index_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.rl_index_dump.restype = ctypes.c_int64
+    lib.rl_index_dump.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_index_restore.restype = ctypes.c_int32
+    lib.rl_index_restore.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64]
+    lib.rl_index_lookup_fps.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p]
+    lib.rl_index_assign_fps.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p]
 
 
 def native_available() -> bool:
@@ -260,63 +257,12 @@ class NativeSlotIndex:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
-    # -- words interface (the relay streaming path; ops/relay.py) -------------
-    # One uint32 per request: slot | duplicate-rank | last-occurrence flag
-    # (layout in native/slot_index.cpp:assign_batch_words).  Evictions are
-    # reported exactly like the plain batch assigns.
-
-    def assign_batch_ints_words(self, keys: np.ndarray, lid: int,
-                                rank_bits: int,
-                                pinned: Optional[Set[int]] = None):
-        keys = np.ascontiguousarray(keys, dtype=np.int64)
-        n = len(keys)
-        out_words = np.empty(n, dtype=np.uint32)
-        out_ev = np.empty(n, dtype=np.int32)
-        with self._lock, self._pinned(pinned):
-            self._lib.rl_index_assign_ints_words(
-                self._h, keys.ctypes.data, n, int(lid), int(rank_bits),
-                out_words.ctypes.data, out_ev.ctypes.data)
-        if (out_ev == -2).any():
-            raise RuntimeError("slot capacity exhausted (all pinned)")
-        return out_words, out_ev[out_ev >= 0]
-
-    def assign_batch_ints_multi_words(self, keys: np.ndarray,
-                                      lids: np.ndarray, rank_bits: int,
-                                      pinned: Optional[Set[int]] = None):
-        keys = np.ascontiguousarray(keys, dtype=np.int64)
-        seeds = np.ascontiguousarray(lids, dtype=np.uint64)
-        n = len(keys)
-        out_words = np.empty(n, dtype=np.uint32)
-        out_ev = np.empty(n, dtype=np.int32)
-        with self._lock, self._pinned(pinned):
-            self._lib.rl_index_assign_ints_multi_words(
-                self._h, keys.ctypes.data, seeds.ctypes.data, n,
-                int(rank_bits), out_words.ctypes.data, out_ev.ctypes.data)
-        if (out_ev == -2).any():
-            raise RuntimeError("slot capacity exhausted (all pinned)")
-        return out_words, out_ev[out_ev >= 0]
-
-    def assign_batch_strs_words(self, keys, lid: int, rank_bits: int,
-                                pinned: Optional[Set[int]] = None):
-        encoded = [k.encode() if isinstance(k, str) else bytes(k)
-                   for k in keys]
-        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
-        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
-                           count=len(encoded))
-        offs = np.empty(len(keys) + 1, dtype=np.int64)
-        offs[0] = 0
-        np.cumsum(lens, out=offs[1:])
-        n = len(keys)
-        out_words = np.empty(n, dtype=np.uint32)
-        out_ev = np.empty(n, dtype=np.int32)
-        with self._lock, self._pinned(pinned):
-            self._lib.rl_index_assign_bytes_words(
-                self._h, packed.ctypes.data if len(packed) else 0,
-                offs.ctypes.data, n, int(lid), int(rank_bits),
-                out_words.ctypes.data, out_ev.ctypes.data)
-        if (out_ev == -2).any():
-            raise RuntimeError("slot capacity exhausted (all pinned)")
-        return out_words, out_ev[out_ev >= 0]
+    # -- uniques interface (the relay streaming path; ops/relay.py) -----------
+    # One uint32 per UNIQUE slot of the batch — (slot | clamped segment
+    # count) — plus per-request (unique-index, rank) scratch the caller
+    # keeps host-side (layout in native/slot_index.cpp:
+    # assign_batch_uniques).  Evictions are reported exactly like the
+    # plain batch assigns.
 
     def assign_batch_ints_uniques(self, keys: np.ndarray, lid: int,
                                   rank_bits: int,

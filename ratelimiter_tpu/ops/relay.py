@@ -59,6 +59,53 @@ from ratelimiter_tpu.ops.sliding_window import _rolled, _sw_decode, _sw_encode
 from ratelimiter_tpu.ops.token_bucket import _refilled, _tb_decode, _tb_encode
 
 
+def relay_usable(rank_bits: int, max_permits_registered: int) -> bool:
+    """Whether the word layout can carry the engine's traffic: the rank
+    clamp ceiling (2^rank_bits - 1, a deny sentinel) must exceed every
+    registered limiter's max_permits.  Shared by the single-device and
+    sharded engines so the invariant lives in one place."""
+    return (rank_bits >= 1
+            and (1 << rank_bits) - 2 >= max_permits_registered)
+
+
+def counts_dtype(max_permits_registered: int):
+    """Smallest numpy dtype that can carry per-unique allowed counts
+    (None if none fits — the per-request relay path has no such bound)."""
+    import numpy as np
+
+    if max_permits_registered <= 255:
+        return np.uint8
+    if max_permits_registered <= 65535:
+        return np.uint16
+    return None
+
+
+def wire_costs(multi_lid: bool):
+    """(bytes per unique in digest mode, bytes per request in words mode)
+    — the constants both stream loops use to elect a mode and to grow
+    chunks toward the wire budget.  Digest: 4B uword + 1-2B count back
+    (+4B lid lane when multi); words: 4B word + bits back (+4B lid)."""
+    return (10.0, 8.125) if multi_lid else (6.0, 4.125)
+
+
+def rebuild_words(uwords, uidx, rank, rank_bits: int):
+    """Per-request (slot | clamped rank | last) words from the digest
+    output — the words-mode wire format, built host-side in numpy.  For
+    an over-clamp segment the flagged lane is the one at rank clamp-1
+    rather than the true last; the device write saturates to the same
+    value either way (n_allowed = min(avail, seg_len) with avail below
+    the clamp)."""
+    import numpy as np
+
+    rank_mask = np.uint32((1 << rank_bits) - 1)
+    slotf = uwords >> np.uint32(rank_bits + 1)
+    cnt_cl = (uwords >> np.uint32(1)) & rank_mask
+    return ((slotf[uidx] << np.uint32(rank_bits + 1))
+            | (np.minimum(rank.astype(np.uint32), rank_mask)
+               << np.uint32(1))
+            | (rank.astype(np.uint32) + 1 == cnt_cl[uidx]))
+
+
 def decode_words(words, rank_bits: int, num_slots: int):
     """uint32[B] -> (slot i32[B], rank i64[B], last bool[B], valid bool[B]).
 

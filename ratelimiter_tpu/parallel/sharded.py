@@ -50,8 +50,8 @@ from ratelimiter_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 _MIN_BATCH = 256
 
 
-def _bucket(n: int) -> int:
-    size = _MIN_BATCH
+def _bucket(n: int, floor: int = _MIN_BATCH) -> int:
+    size = floor
     while size < n:
         size *= 2
     return size
@@ -247,6 +247,30 @@ def build_sharded_flat(mesh, flat_fn, lids_scalar: bool, has_permits: bool):
     )
 
 
+def build_sharded_relay(mesh, relay_fn, lids_scalar: bool):
+    """shard_map'd relay step (ops/relay.py — no sort/scan; the host
+    index supplies the duplicate structure).  Works for both flavors:
+    bits (words (n_shards, B) -> uint8 (n_shards, B/8)) and counts
+    (uwords (n_shards, U) -> out_dtype (n_shards, U)).
+
+    State stays (n_shards, S_local, L); each shard decides its slice with
+    LOCAL slot ids; zero cross-shard device traffic.
+    """
+    lid_spec = P() if lids_scalar else P(SHARD_AXIS)
+
+    def local_relay(state, table, words, lids, now):
+        st, out = relay_fn(state[0], table, words[0],
+                           lids if lids_scalar else lids[0], now)
+        return st[None], out[None]
+
+    return jax.shard_map(
+        local_relay,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+
+
 def build_sharded_peek(mesh, peek_fn):
     def local_peek(state, table, slots, lids, now):
         out = peek_fn(state[0], table, slots[0], lids[0], now)
@@ -409,6 +433,97 @@ class ShardedDeviceEngine:
             else:
                 self.tb_packed = state
         return bits
+
+    # -- relay dispatch (ops/relay.py, per shard) ------------------------------
+    # Word layout is per-SHARD: slot_bits covers slots_per_shard, so the
+    # rank field is wider than the single-device engine would get at the
+    # same total capacity.
+
+    @property
+    def slot_bits(self) -> int:
+        return max(int(self.slots_per_shard).bit_length(), 1)
+
+    @property
+    def rank_bits(self) -> int:
+        return 31 - self.slot_bits
+
+    def relay_usable(self) -> bool:
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        return relay_ops.relay_usable(self.rank_bits,
+                                      self.table.max_permits_registered)
+
+    def counts_dtype(self):
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        return relay_ops.counts_dtype(self.table.max_permits_registered)
+
+    def sw_relay_sharded_dispatch(self, words_sb, lids, now_ms):
+        return self._relay_dispatch("sw", "bits", words_sb, lids, now_ms,
+                                    None)
+
+    def tb_relay_sharded_dispatch(self, words_sb, lids, now_ms):
+        return self._relay_dispatch("tb", "bits", words_sb, lids, now_ms,
+                                    None)
+
+    def sw_relay_counts_sharded_dispatch(self, uwords_sb, lids, now_ms,
+                                         out_dtype):
+        return self._relay_dispatch("sw", "counts", uwords_sb, lids, now_ms,
+                                    out_dtype)
+
+    def tb_relay_counts_sharded_dispatch(self, uwords_sb, lids, now_ms,
+                                         out_dtype):
+        return self._relay_dispatch("tb", "counts", uwords_sb, lids, now_ms,
+                                    out_dtype)
+
+    def _relay_fn(self, algo, flavor, lids_scalar, out_dtype):
+        import functools
+
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        key = ("relay", algo, flavor, lids_scalar,
+               None if out_dtype is None else out_dtype().dtype.name)
+        fn = self._scan_fns.get(key)
+        if fn is None:
+            if flavor == "bits":
+                base = (relay_ops.sw_relay_bits if algo == "sw"
+                        else relay_ops.tb_relay_bits)
+                local = functools.partial(base, rank_bits=self.rank_bits)
+            else:
+                base = (relay_ops.sw_relay_counts if algo == "sw"
+                        else relay_ops.tb_relay_counts)
+                jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
+                local = functools.partial(base, rank_bits=self.rank_bits,
+                                          out_dtype=jdt)
+            fn = jax.jit(build_sharded_relay(self.mesh, local, lids_scalar),
+                         donate_argnums=0)
+            self._scan_fns[key] = fn
+        return fn
+
+    def _relay_dispatch(self, algo, flavor, words_sb, lids, now_ms,
+                        out_dtype):
+        """words_sb: uint32[n_shards, B_local] relay words with LOCAL slot
+        ids (0xFFFFFFFF padding); lids scalar or i32[n_shards, B_local].
+        Returns a lazy (n_shards, B/8) bits or (n_shards, B) counts
+        handle."""
+        words_sb = jnp.asarray(
+            np.ascontiguousarray(words_sb, dtype=np.uint32))
+        lids_scalar = np.ndim(lids) == 0
+        if lids_scalar:
+            lids = jnp.asarray(np.int32(lids))
+        else:
+            lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
+        now = jnp.int64(now_ms)
+        fn = self._relay_fn(algo, flavor, lids_scalar, out_dtype)
+        with self._lock:
+            state = self.sw_packed if algo == "sw" else self.tb_packed
+            state, out = fn(state, self.table.device_arrays,
+                            words_sb, lids, now)
+            if algo == "sw":
+                self.sw_packed = state
+            else:
+                self.tb_packed = state
+        return out
 
     def _scan_dispatch(self, algo, slots_skb, lids, permits_skb, now_k):
         """slots_skb: i32[n_shards, K, B_local] LOCAL slot ids (-1 padding);

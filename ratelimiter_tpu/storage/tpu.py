@@ -59,11 +59,10 @@ _RELAY_CHUNK_MAX = 1 << 24
 _RELAY_WIRE_BUDGET = 8 << 20
 
 
-def _bucket_pow2(n: int, floor: int = 4096) -> int:
-    size = floor
-    while size < n:
-        size *= 2
-    return size
+def _bucket_pow2(n: int) -> int:
+    from ratelimiter_tpu.parallel.sharded import _bucket
+
+    return _bucket(n, floor=4096)
 
 
 def _wall_clock_ms() -> int:
@@ -416,11 +415,13 @@ class TpuBatchedStorage(RateLimitStorage):
         chunking (tests/test_relay.py).  Chunks are ``_RELAY_CHUNK``
         requests and pipeline two-deep so fetches ride in the shadow of
         the next chunk's host work + upload."""
+        from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
+
         multi_lid = lid_arr is not None
         eng = self.engine
         rb = eng.rank_bits
-        rank_mask = np.uint32((1 << rb) - 1)
         cdt = eng.counts_dtype()
+        digest_bpu, words_bpr = wire_costs(multi_lid)
         bits_dispatch = (eng.sw_relay_dispatch if algo == "sw"
                          else eng.tb_relay_dispatch)
         counts_dispatch = (eng.sw_relay_counts_dispatch if algo == "sw"
@@ -449,12 +450,8 @@ class TpuBatchedStorage(RateLimitStorage):
                 clear(list(clears))
             u = len(uwords)
             l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            # Per-request traffic: 4B word (+4B lid lane if multi) + bits
-            # back; digest traffic: 6B/unique (+4B if multi).  Pick the
-            # smaller wire cost.
-            digest = cdt is not None and (
-                (10 if multi_lid else 6) * u
-                <= ((8.2 if multi_lid else 4.2) * cn))
+            # Pick the smaller wire cost (ops/relay.py:wire_costs).
+            digest = cdt is not None and digest_bpu * u <= words_bpr * cn
             now = self._monotonic_now()
             t0 = time.perf_counter()
             if digest:
@@ -466,12 +463,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 pending.append(
                     ("digest", counts, start, cn, (uidx, rank, u), t0))
             else:
-                slotf = uwords >> np.uint32(rb + 1)
-                cnt_cl = (uwords >> np.uint32(1)) & rank_mask
-                words = ((slotf[uidx] << np.uint32(rb + 1))
-                         | (np.minimum(rank.astype(np.uint32), rank_mask)
-                            << np.uint32(1))
-                         | (rank.astype(np.uint32) + 1 == cnt_cl[uidx]))
+                words = rebuild_words(uwords, uidx, rank, rb)
                 size = _bucket_pow2(cn)
                 words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
                 lid_lane = lid if not multi_lid else _pad_tail(
@@ -484,8 +476,7 @@ class TpuBatchedStorage(RateLimitStorage):
             # measured bytes/request (skewed streams compact hard in
             # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
             # the fixed per-dispatch latency amortizes away).
-            wire_b = ((6 if not multi_lid else 10) * u if digest
-                      else (4.125 if not multi_lid else 8.125) * cn)
+            wire_b = digest_bpu * u if digest else words_bpr * cn
             bpr = max(wire_b / cn, 1e-3)
             chunk = int(min(max(_RELAY_WIRE_BUDGET / bpr, _RELAY_CHUNK),
                             _RELAY_CHUNK_MAX))
@@ -515,6 +506,14 @@ class TpuBatchedStorage(RateLimitStorage):
         dispatch = (self.engine.sw_flat_dispatch if algo == "sw"
                     else self.engine.tb_flat_dispatch)
         clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
+        # When every permit in the stream fits a byte (the common case —
+        # permits above max_permits are pointless), the permits lane ships
+        # as uint8: 5 B/request on the wire instead of 8.  The device step
+        # upcasts, decisions unchanged.
+        p_dtype = np.int32
+        if (permits is not None and permits.size
+                and int(permits.min()) >= 0 and int(permits.max()) <= 255):
+            p_dtype = np.uint8
 
         out = np.empty(n, dtype=bool)
         # (start, count, bits, dispatch_t0) per in-flight super-batch
@@ -538,7 +537,7 @@ class TpuBatchedStorage(RateLimitStorage):
             lid_flat = lid if not multi_lid else _pad_tail(
                 lid_arr[start:start + cn], super_n, 0, np.int32)
             p_flat = None if permits is None else _pad_tail(
-                permits[start:start + cn], super_n, 1, np.int32)
+                permits[start:start + cn], super_n, 1, p_dtype)
             now = self._monotonic_now()
             t0 = time.perf_counter()
             bits = dispatch(slots, lid_flat, p_flat, now)
@@ -631,6 +630,12 @@ class TpuBatchedStorage(RateLimitStorage):
         from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
 
         eng = self.engine
+        if (permits is None and hasattr(eng, "relay_usable")
+                and eng.relay_usable()
+                and all(hasattr(s, "assign_batch_ints_uniques")
+                        for s in index._sub)):
+            return self._stream_relay_sharded(algo, lid, key_ids, index,
+                                              multi_lid, lid_arr)
         if oversize is not None:
             permits = np.where(oversize, 1, permits)  # lanes masked; the
             # oversized requests dispatch as padding (slot -1) below.
@@ -715,6 +720,148 @@ class TpuBatchedStorage(RateLimitStorage):
             pending.append((bits, start, cn, shard, cols, b_loc, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
+        for item in pending:
+            drain(*item)
+        return out
+
+    def _stream_relay_sharded(self, algo, lid, key_ids, index, multi_lid,
+                              lid_arr) -> np.ndarray:
+        """Sharded relay streaming (unit permits): per chunk, keys route to
+        shards host-side, each shard's C sub-index emits its duplicate
+        structure with LOCAL slot ids, and one shard_map'd relay dispatch
+        decides every shard's slice — digest mode (per-unique counts) on
+        skewed traffic, per-request words otherwise.  No device sort/scan
+        and zero cross-shard traffic; decisions identical to the
+        single-device relay on the same per-key request order."""
+        from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
+        from ratelimiter_tpu.parallel.sharded import (
+            _bucket,
+            shard_of_int_keys,
+        )
+
+        eng = self.engine
+        n_sh, sps = eng.n_shards, eng.slots_per_shard
+        rb = eng.rank_bits
+        cdt = eng.counts_dtype()
+        digest_bpu, words_bpr = wire_costs(multi_lid)
+        bits_dispatch = (eng.sw_relay_sharded_dispatch if algo == "sw"
+                         else eng.tb_relay_sharded_dispatch)
+        counts_dispatch = (eng.sw_relay_counts_sharded_dispatch
+                           if algo == "sw"
+                           else eng.tb_relay_counts_sharded_dispatch)
+        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+        n = len(key_ids)
+        out = np.empty(n, dtype=bool)
+        pending: list[tuple] = []
+
+        def drain(mode, handle, start, per_shard, t0):
+            arr = np.asarray(handle)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            cnt = alw = 0
+            if mode == "digest":
+                for s, (pos, uidx, rank, u) in enumerate(per_shard):
+                    if not len(pos):
+                        continue
+                    got = rank < arr[s, :u].astype(np.int32)[uidx]
+                    out[start + pos] = got
+                    cnt += len(pos)
+                    alw += int(got.sum())
+            else:
+                bits = np.unpackbits(arr, axis=1)
+                for s, (pos,) in enumerate(per_shard):
+                    if not len(pos):
+                        continue
+                    got = bits[s, :len(pos)].astype(bool)
+                    out[start + pos] = got
+                    cnt += len(pos)
+                    alw += int(got.sum())
+            self._record_dispatch(algo, cnt, alw, dt_us)
+
+        chunk = _RELAY_CHUNK
+        start = 0
+        while start < n:
+            cn = min(chunk, n - start)
+            kchunk = key_ids[start:start + cn]
+            shard = shard_of_int_keys(kchunk, n_sh)
+            l_chunk = lid_arr[start:start + cn] if multi_lid else None
+            pins_by_shard: dict = {}
+            for g in self._batcher.pending_slots(algo):
+                pins_by_shard.setdefault(g // sps, set()).add(g % sps)
+            results = []
+            clears: list = []
+            u_total = u_max = b_max = 0
+            for s in range(n_sh):
+                pos = np.where(shard == s)[0]
+                if not len(pos):
+                    results.append((pos, None, None, 0, None))
+                    continue
+                sub = index._sub[s]
+                if multi_lid:
+                    uw, uidx, rank, ev = sub.assign_batch_ints_multi_uniques(
+                        kchunk[pos], l_chunk[pos], rb,
+                        pinned=pins_by_shard.get(s))
+                else:
+                    uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
+                        kchunk[pos], lid, rb, pinned=pins_by_shard.get(s))
+                clears.extend(s * sps + int(e) for e in ev)
+                results.append((pos, uidx, rank, len(uw), uw))
+                u_total += len(uw)
+                u_max = max(u_max, len(uw))
+                b_max = max(b_max, len(pos))
+            if clears:
+                clear(clears)
+            digest = cdt is not None and (
+                digest_bpu * n_sh * _bucket(max(u_max, 1))
+                <= words_bpr * cn)
+            now = self._monotonic_now()
+            t0 = time.perf_counter()
+            if digest:
+                u_loc = _bucket(max(u_max, 1))
+                uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF, dtype=np.uint32)
+                lid_mat = None
+                if multi_lid:
+                    lid_mat = np.zeros((n_sh, u_loc), dtype=np.int32)
+                per_shard = []
+                for s, item in enumerate(results):
+                    pos = item[0]
+                    if not len(pos):
+                        per_shard.append((pos, None, None, 0))
+                        continue
+                    _, uidx, rank, u, uw = item
+                    uw_mat[s, :u] = uw
+                    if multi_lid:
+                        lid_mat[s, :u] = l_chunk[pos][rank == 0]
+                    per_shard.append((pos, uidx, rank, u))
+                counts = counts_dispatch(
+                    uw_mat, lid if not multi_lid else lid_mat, now, cdt)
+                pending.append(("digest", counts, start, per_shard, t0))
+            else:
+                b_loc = _bucket(max(b_max, 1))
+                w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF, dtype=np.uint32)
+                lid_mat = None
+                if multi_lid:
+                    lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                per_shard = []
+                for s, item in enumerate(results):
+                    pos = item[0]
+                    if not len(pos):
+                        per_shard.append((pos,))
+                        continue
+                    _, uidx, rank, u, uw = item
+                    w_mat[s, :len(pos)] = rebuild_words(uw, uidx, rank, rb)
+                    if multi_lid:
+                        lid_mat[s, :len(pos)] = l_chunk[pos]
+                    per_shard.append((pos,))
+                bits = bits_dispatch(
+                    w_mat, lid if not multi_lid else lid_mat, now)
+                pending.append(("bits", bits, start, per_shard, t0))
+            if len(pending) > 1:
+                drain(*pending.pop(0))
+            wire_b = digest_bpu * u_total if digest else words_bpr * cn
+            bpr = max(wire_b / cn, 1e-3)
+            chunk = int(min(max(_RELAY_WIRE_BUDGET / bpr, _RELAY_CHUNK),
+                            _RELAY_CHUNK_MAX))
+            start += cn
         for item in pending:
             drain(*item)
         return out

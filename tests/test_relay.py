@@ -162,10 +162,9 @@ def test_relay_usable_gate():
     assert not eng.relay_usable()
 
 
-def test_native_words_and_uniques_match_truth():
-    """C++ duplicate structure == Python reconstruction, including clamp
-    and both key flavors."""
-    pytest.importorskip("ctypes")
+def test_native_uniques_match_truth():
+    """C++ duplicate structure == Python reconstruction, including count
+    clamping, for all three key flavors."""
     from ratelimiter_tpu.engine.native_index import (
         NativeSlotIndex, native_available)
 
@@ -174,28 +173,22 @@ def test_native_words_and_uniques_match_truth():
     rng = np.random.default_rng(5)
     rb = 3
     for flavor in ("int", "str", "multi"):
-        ix_w = NativeSlotIndex(256)
         ix_u = NativeSlotIndex(256)
         ix_ref = NativeSlotIndex(256)
         keys = rng.integers(0, 17, 400)
         if flavor == "int":
-            words, _ = ix_w.assign_batch_ints_words(keys, 1, rb)
             uwords, uidx, rank, _ = ix_u.assign_batch_ints_uniques(keys, 1, rb)
             slots, _ = ix_ref.assign_batch_ints(keys, 1)
         elif flavor == "str":
             skeys = [f"k{v}" for v in keys]
-            words, _ = ix_w.assign_batch_strs_words(skeys, 1, rb)
             uwords, uidx, rank, _ = ix_u.assign_batch_strs_uniques(
                 skeys, 1, rb)
             slots, _ = ix_ref.assign_batch_strs(skeys, 1)
         else:
             lids = rng.integers(1, 4, 400)
-            words, _ = ix_w.assign_batch_ints_multi_words(keys, lids, rb)
             uwords, uidx, rank, _ = ix_u.assign_batch_ints_multi_uniques(
                 keys, lids, rb)
             slots, _ = ix_ref.assign_batch_ints_multi(keys, lids)
-        np.testing.assert_array_equal(words, _make_words(slots, rb),
-                                      err_msg=flavor)
         np.testing.assert_array_equal(uwords, _make_uwords(slots, rb),
                                       err_msg=flavor)
         t_rank, t_uidx, _, _ = _truth_structure(slots)
@@ -237,6 +230,50 @@ def test_stream_relay_modes_match_batch_path(monkeypatch, force_mode):
         now[0] += 237
     st_a.close()
     st_b.close()
+
+
+@pytest.mark.parametrize("force_mode", ["digest", "bits"])
+@pytest.mark.parametrize("multi_lid", [False, True])
+def test_sharded_relay_matches_single_device(monkeypatch, force_mode,
+                                             multi_lid):
+    """The sharded relay stream (8-device CPU mesh, either wire mode,
+    single- and multi-tenant) must decide exactly like the single-device
+    relay on the same stream at the same timestamps."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [7_000_000]
+    table_s, table_f = LimiterTable(), LimiterTable()
+    cfgs = [RateLimitConfig(max_permits=4 + i, window_ms=1000,
+                            refill_rate=3.0 + i) for i in range(3)]
+    lids_s = [table_s.register(c) for c in cfgs]
+    lids_f = [table_f.register(c) for c in cfgs]
+    eng = ShardedDeviceEngine(slots_per_shard=64, table=table_s)
+    st_s = TpuBatchedStorage(engine=eng, clock_ms=lambda: now[0])
+    st_f = TpuBatchedStorage(num_slots=1 << 12, table=table_f,
+                             clock_ms=lambda: now[0])
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    if force_mode == "bits":
+        for e in (eng, st_f.engine):
+            monkeypatch.setattr(type(e), "counts_dtype",
+                                lambda self: None, raising=True)
+    rng = np.random.default_rng(33)
+    for rep in range(3):
+        ids = rng.integers(0, 60, 500)
+        if multi_lid:
+            larr_s = np.asarray(lids_s)[rng.integers(0, 3, 500)]
+            larr_f = np.asarray(lids_f)[(larr_s - lids_s[0])]
+            a = st_s.acquire_stream_ids("tb", larr_s, ids, None)
+            b = st_f.acquire_stream_ids("tb", larr_f, ids, None)
+        else:
+            a = st_s.acquire_stream_ids("tb", lids_s[1], ids, None)
+            b = st_f.acquire_stream_ids("tb", lids_f[1], ids, None)
+        np.testing.assert_array_equal(a, b, err_msg=f"rep {rep}")
+        now[0] += 321
+    st_s.close()
+    st_f.close()
 
 
 def _forced_bits_stream(orig):
