@@ -35,6 +35,10 @@
 #include <cstring>
 #include <vector>
 
+#if defined(__linux__)
+#include <sys/mman.h>
+#endif
+
 namespace {
 
 struct Entry {
@@ -56,12 +60,46 @@ struct Index {
   uint64_t gen = 0;
   // Scratch for the relay path (assign_batch_uniques): per-slot duplicate
   // counters for the current batch, epoch-tagged so no per-batch reset is
-  // needed.  Allocated lazily on the first uniques call.
-  std::vector<uint64_t> batch_epoch;   // slot -> last batch generation seen
-  std::vector<int32_t> batch_cnt;      // slot -> occurrences so far
-  std::vector<int32_t> batch_last;     // slot -> position of last occurrence
+  // needed.  One 16-byte struct per slot (not parallel arrays) so the
+  // rank loop costs a single cache-line touch per request, which pass 2
+  // prefetches ahead from the already-resolved slot ids.  Allocated
+  // lazily on the first uniques call.
+  struct BatchScratch {
+    uint64_t epoch = 0;   // last batch generation seen
+    int32_t cnt = 0;      // occurrences so far this batch
+    int32_t uidx = -1;    // dense unique index this batch
+  };
+  std::vector<BatchScratch> batch;
   std::vector<int32_t> slots_tmp;      // request -> slot (pass-2 scratch)
+  // Within-batch front cache: repeat hits of a key inside one batch call
+  // (~94% of Zipf traffic) resolve from this L2-resident direct-mapped
+  // table instead of re-probing the DRAM hash table.  Safe because a hit
+  // is only honored when the line was verified under the CURRENT batch
+  // generation — and current-generation entries are eviction-protected,
+  // so the cached slot cannot have been reassigned mid-batch.
+  std::vector<uint64_t> fc_h1, fc_h2, fc_gen;
+  std::vector<int32_t> fc_slot;
 };
+
+const uint64_t kFrontCacheSize = 1 << 16;  // 64K lines, ~1.8 MB
+
+static void advise_huge(void* p, size_t bytes) {
+  // The probe is one random DRAM access per request; at 10M+ slots the
+  // table spans hundreds of MB and 4K-page TLB misses double its cost.
+  // Transparent huge pages are advisory — failure is fine.  madvise
+  // rejects non-page-aligned starts with EINVAL, and heap pointers are
+  // rarely page-aligned, so round the range inward first.
+#if defined(__linux__) && defined(MADV_HUGEPAGE)
+  const uintptr_t kPage = 4096;
+  uintptr_t start = (reinterpret_cast<uintptr_t>(p) + kPage - 1) & ~(kPage - 1);
+  uintptr_t end = (reinterpret_cast<uintptr_t>(p) + bytes) & ~(kPage - 1);
+  if (end > start && end - start >= (2u << 20))
+    madvise(reinterpret_cast<void*>(start), end - start, MADV_HUGEPAGE);
+#else
+  (void)p;
+  (void)bytes;
+#endif
+}
 
 inline void fnv_mix(uint64_t& h, uint64_t x) {
   h ^= x;
@@ -204,6 +242,13 @@ inline int64_t take_slot(Index* ix, int32_t* out_slot) {
 
 inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
                              int32_t* out_slot) {
+  const uint64_t fci = h1 & (kFrontCacheSize - 1);
+  if (!ix->fc_gen.empty() && ix->fc_gen[fci] == ix->gen &&
+      ix->fc_h1[fci] == h1 && ix->fc_h2[fci] == h2) {
+    // Repeat hit within this batch: already gen-stamped + LRU-touched.
+    *out_slot = ix->fc_slot[fci];
+    return -1;
+  }
   int32_t pos = find(ix, h1, h2);
   if (pos >= 0) {
     Entry& e = ix->table[pos];
@@ -215,6 +260,10 @@ inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
       e.gen = ix->gen;
       lru_touch(ix, pos);
     }
+    if (!ix->fc_gen.empty()) {
+      ix->fc_h1[fci] = h1; ix->fc_h2[fci] = h2;
+      ix->fc_slot[fci] = e.slot; ix->fc_gen[fci] = ix->gen;
+    }
     *out_slot = e.slot;
     return -1;
   }
@@ -222,6 +271,10 @@ inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
   int64_t evicted = take_slot(ix, &slot);
   if (evicted == -2) { *out_slot = -1; return -2; }
   pos = insert(ix, h1, h2, slot);
+  if (!ix->fc_gen.empty()) {
+    ix->fc_h1[fci] = h1; ix->fc_h2[fci] = h2;
+    ix->fc_slot[fci] = slot; ix->fc_gen[fci] = ix->gen;
+  }
   *out_slot = slot;
   return evicted;
 }
@@ -234,6 +287,12 @@ const int kChunk = 32;
 template <typename HashAt>
 inline void assign_batch(Index* ix, int64_t n, int32_t* out_slots,
                          int32_t* out_evicted, HashAt&& hash_at) {
+  if (ix->fc_gen.empty()) {  // batch paths only; scalar calls skip the fc
+    ix->fc_h1.assign(kFrontCacheSize, 0);
+    ix->fc_h2.assign(kFrontCacheSize, 0);
+    ix->fc_gen.assign(kFrontCacheSize, 0);
+    ix->fc_slot.assign(kFrontCacheSize, -1);
+  }
   ix->gen++;
   uint64_t h1s[kChunk], h2s[kChunk];
   for (int64_t base = 0; base < n; base += kChunk) {
@@ -261,10 +320,10 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
                                     uint32_t* out_uwords, int32_t* out_uidx,
                                     int32_t* out_rank, int32_t* out_evicted,
                                     HashAt&& hash_at) {
-  if (ix->batch_epoch.empty()) {
-    ix->batch_epoch.assign(ix->num_slots, 0);
-    ix->batch_cnt.assign(ix->num_slots, 0);
-    ix->batch_last.assign(ix->num_slots, -1);
+  if (ix->batch.empty()) {
+    ix->batch.assign(ix->num_slots, {});
+    advise_huge(ix->batch.data(),
+                ix->batch.size() * sizeof(Index::BatchScratch));
   }
   if (static_cast<int64_t>(ix->slots_tmp.size()) < n)
     ix->slots_tmp.resize(n);
@@ -272,31 +331,34 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
   assign_batch(ix, n, slots, out_evicted, hash_at);
   const uint64_t epoch = ix->gen;
   const uint32_t rank_max = (1u << rank_bits) - 1;
+  const int64_t pfd = 24;  // prefetch distance (requests)
+  Index::BatchScratch* scratch = ix->batch.data();
   int64_t u = 0;
-  // batch_last doubles as the slot -> dense-unique-index map this call.
   for (int64_t i = 0; i < n; i++) {
+    if (i + pfd < n && slots[i + pfd] >= 0)
+      __builtin_prefetch(&scratch[slots[i + pfd]], 1, 1);
     int32_t s = slots[i];
     if (s < 0) {  // assignment failed (-2): deny lane, not a unique
       out_uidx[i] = -1;
       out_rank[i] = 0;
       continue;
     }
-    if (ix->batch_epoch[s] != epoch) {
-      ix->batch_epoch[s] = epoch;
-      ix->batch_cnt[s] = 0;
-      ix->batch_last[s] = static_cast<int32_t>(u);
+    Index::BatchScratch& b = scratch[s];
+    if (b.epoch != epoch) {
+      b.epoch = epoch;
+      b.cnt = 0;
+      b.uidx = static_cast<int32_t>(u);
       out_uwords[u] = static_cast<uint32_t>(s) << (rank_bits + 1);
       u++;
     }
-    int32_t di = ix->batch_last[s];
-    int32_t rank = ix->batch_cnt[s];
-    if (ix->batch_cnt[s] < INT32_MAX) ix->batch_cnt[s]++;
-    out_uidx[i] = di;
+    int32_t rank = b.cnt;
+    if (b.cnt < INT32_MAX) b.cnt++;
+    out_uidx[i] = b.uidx;
     out_rank[i] = rank;
-    uint32_t cnt = static_cast<uint32_t>(ix->batch_cnt[s]);
+    uint32_t cnt = static_cast<uint32_t>(b.cnt);
     if (cnt > rank_max) cnt = rank_max;
-    out_uwords[di] =
-        (out_uwords[di] & ~((rank_max << 1) | 1u)) | (cnt << 1);
+    out_uwords[b.uidx] =
+        (out_uwords[b.uidx] & ~((rank_max << 1) | 1u)) | (cnt << 1);
   }
   return u;
 }
@@ -312,6 +374,7 @@ void* rl_index_new(int64_t num_slots) {
   while (cap < static_cast<uint64_t>(num_slots) * 2) cap <<= 1;
   ix->mask = cap - 1;
   ix->table.assign(cap, Entry{});
+  advise_huge(ix->table.data(), cap * sizeof(Entry));
   ix->entry_of_slot.assign(num_slots, -1);
   ix->pins.assign(num_slots, 0);
   ix->free_slots.reserve(num_slots);
@@ -526,6 +589,22 @@ void rl_index_assign_fps(void* h, const uint64_t* h1s, const uint64_t* h2s,
                  h1 = h1s[i];
                  h2 = h2s[i] | (h1 == 0 && h2s[i] == 0 ? 1 : 0);
                });
+}
+
+// Relay decision reconstruction: allowed[i] = rank[i] < counts[uidx[i]].
+// One fused pass instead of numpy's gather + astype + compare temporaries;
+// counts element width is 1 or 2 bytes (the device's u8/u16 output).
+void rl_relay_decide(const uint8_t* counts, int32_t counts_width,
+                     const int32_t* uidx, const int32_t* rank, int64_t n,
+                     uint8_t* out_allowed) {
+  if (counts_width == 1) {
+    for (int64_t i = 0; i < n; i++)
+      out_allowed[i] = rank[i] < static_cast<int32_t>(counts[uidx[i]]);
+  } else {
+    const uint16_t* c16 = reinterpret_cast<const uint16_t*>(counts);
+    for (int64_t i = 0; i < n; i++)
+      out_allowed[i] = rank[i] < static_cast<int32_t>(c16[uidx[i]]);
+  }
 }
 
 void rl_index_pin(void* h, int32_t slot) {

@@ -92,6 +92,11 @@ def _detach_index_arrays(index_dump: Dict, arrays: Dict) -> Dict:
                 for f in ("h1", "h2", "slots"):
                     arrays[f"idx_{algo}_s{j}_{f}"] = shard_p[f]
             p["array_ref"] = f"idx_{algo}"
+        elif p.get("kind") == "partitioned_native_fp":
+            for j, part_p in enumerate(p.pop("per_part")):
+                for f in ("h1", "h2", "slots"):
+                    arrays[f"idx_{algo}_p{j}_{f}"] = part_p[f]
+            p["array_ref"] = f"idx_{algo}"
         out["algos"][algo] = p
     return out
 
@@ -109,6 +114,10 @@ def _attach_index_arrays(meta_index: Dict, arrays: Dict) -> Dict:
             p["per_shard"] = [
                 {f: arrays[f"{ref}_s{j}_{f}"] for f in ("h1", "h2", "slots")}
                 for j in range(p["n_shards"])]
+        elif p.get("kind") == "partitioned_native_fp":
+            p["per_part"] = [
+                {f: arrays[f"{ref}_p{j}_{f}"] for f in ("h1", "h2", "slots")}
+                for j in range(p["n_parts"])]
         out["algos"][algo] = p
     return out
 
@@ -223,6 +232,23 @@ def export_keys(storage) -> Dict:
                 "kind": "fp",
                 "h1": payload["h1"],
                 "h2": payload["h2"],
+                "rows": (storage.engine.read_rows(algo, slots)
+                         if len(slots) else np.empty((0, 0), np.int32)),
+            }
+            continue
+        if payload.get("kind") == "partitioned_native_fp":
+            # Host-partitioned index: fingerprints are geometry-free once
+            # merged with their global slot ids (the partitioned dump is
+            # only partition-ADDRESSED, not partition-HASHED), so the
+            # export is the same flat 'fp' payload — importable into flat
+            # native targets; import into a partitioned target refuses
+            # (fingerprints cannot be re-routed).
+            index = storage._index[algo]
+            h1, h2, slots = index.dump_fp()
+            out["algos"][algo] = {
+                "kind": "fp",
+                "h1": h1,
+                "h2": h2,
                 "rows": (storage.engine.read_rows(algo, slots)
                          if len(slots) else np.empty((0, 0), np.int32)),
             }
@@ -387,6 +413,16 @@ def dump_slot_indexes(storage) -> Dict:
     for algo, index in storage._index.items():
         if hasattr(index, "_map"):
             out["algos"][algo] = {"kind": "flat", "entries": _dump_flat(index)}
+        elif hasattr(index, "_parts"):
+            # Host-parallel partitioned index: per-partition fingerprint
+            # dumps (local slots) + the routing-hash identity, since a
+            # restore under different routing would orphan every entry.
+            out["algos"][algo] = {
+                "kind": "partitioned_native_fp",
+                "part_hash": SHARD_HASH_VERSION,
+                "n_parts": index.n_parts,
+                "per_part": [_fp_payload(s) for s in index._parts],
+            }
         elif hasattr(index, "dump_fp"):
             payload = _fp_payload(index)
             payload["kind"] = "native_fp"
@@ -425,11 +461,34 @@ def restore_slot_indexes(storage, dump: Dict) -> None:
         index = storage._index[algo]
         kind = payload.get("kind")
         if kind == "native_fp":
+            if hasattr(index, "_parts"):
+                raise ValueError(
+                    "flat fingerprint checkpoint cannot restore into a "
+                    "host-partitioned index: fingerprints are one-way, so "
+                    "entries cannot be re-routed to their partitions "
+                    "(restore with host_parallel=0, or export/import per "
+                    "key)")
             if not hasattr(index, "restore_fp"):
                 raise ValueError(
                     "fingerprint checkpoint needs the native index "
                     "(restoring binary lacks it)")
             index.restore_fp(payload["h1"], payload["h2"], payload["slots"])
+            continue
+        if kind == "partitioned_native_fp":
+            if payload.get("part_hash") != SHARD_HASH_VERSION:
+                raise ValueError(
+                    f"checkpoint used partition hash "
+                    f"{payload.get('part_hash')!r}; this binary routes "
+                    f"with {SHARD_HASH_VERSION!r} — fingerprints cannot "
+                    "be re-partitioned (export/import per key instead)")
+            if (not hasattr(index, "_parts")
+                    or payload["n_parts"] != index.n_parts):
+                raise ValueError(
+                    "partitioned fingerprint checkpoint needs a "
+                    f"host-parallel index with {payload['n_parts']} "
+                    "partitions (restore with the same host_parallel)")
+            for sub, part_p in zip(index._parts, payload["per_part"]):
+                sub.restore_fp(part_p["h1"], part_p["h2"], part_p["slots"])
             continue
         if kind == "sharded_native_fp":
             if payload.get("shard_hash") != SHARD_HASH_VERSION:

@@ -120,10 +120,73 @@ def _bind(lib) -> None:
     lib.rl_index_assign_fps.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_relay_decide.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p]
 
 
 def native_available() -> bool:
     return _load_library() is not None
+
+
+def _pack_str_keys(keys):
+    """(packed bytes u8[:], offsets i64[n+1]) for a batch of string keys.
+
+    Fast path: one ``"\\x00".join().encode()`` pass (C speed) plus a
+    vectorized separator scan and one masked compaction — no per-key
+    Python encode loop.  Falls back to the per-key path when a key embeds
+    NUL or isn't a str.  Byte-identical packing either way (the hashes
+    must match every other entry path's)."""
+    n = len(keys)
+    if n == 0:
+        return np.empty(0, dtype=np.uint8), np.zeros(1, dtype=np.int64)
+    try:
+        joined = "\x00".join(keys).encode()
+    except TypeError:
+        joined = None
+    if joined is not None:
+        buf = np.frombuffer(joined, dtype=np.uint8)
+        seps = np.flatnonzero(buf == 0)
+        if len(seps) == n - 1:  # no embedded NULs
+            bounds = np.empty(n + 1, dtype=np.int64)
+            bounds[0] = -1
+            bounds[1:n] = seps
+            bounds[n] = len(buf)
+            lens = np.diff(bounds) - 1
+            offs = np.empty(n + 1, dtype=np.int64)
+            offs[0] = 0
+            np.cumsum(lens, out=offs[1:])
+            if n == 1:
+                return buf, offs
+            mask = np.ones(len(buf), dtype=bool)
+            mask[seps] = False
+            return buf[mask], offs
+    encoded = [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
+    packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
+                       count=n)
+    offs = np.empty(n + 1, dtype=np.int64)
+    offs[0] = 0
+    np.cumsum(lens, out=offs[1:])
+    return packed, offs
+
+
+def relay_decide(counts: np.ndarray, uidx: np.ndarray,
+                 rank: np.ndarray) -> np.ndarray:
+    """allowed[i] = rank[i] < counts[uidx[i]] — the digest-mode decision
+    reconstruction, fused into one C pass (numpy fallback off-native).
+    ``counts`` is the device's u8/u16 per-unique allowed counts."""
+    lib = _load_library()
+    if lib is None or counts.dtype.itemsize > 2:
+        return rank < counts.astype(np.int32)[uidx]
+    counts = np.ascontiguousarray(counts)
+    uidx = np.ascontiguousarray(uidx, dtype=np.int32)
+    rank = np.ascontiguousarray(rank, dtype=np.int32)
+    out = np.empty(len(uidx), dtype=np.uint8)
+    lib.rl_relay_decide(counts.ctypes.data, counts.dtype.itemsize,
+                        uidx.ctypes.data, rank.ctypes.data, len(uidx),
+                        out.ctypes.data)
+    return out.view(np.bool_)
 
 
 def _split_key(key: Hashable) -> Tuple[int, bytes | int]:
@@ -307,14 +370,7 @@ class NativeSlotIndex:
 
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
                                   pinned: Optional[Set[int]] = None):
-        encoded = [k.encode() if isinstance(k, str) else bytes(k)
-                   for k in keys]
-        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
-        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
-                           count=len(encoded))
-        offs = np.empty(len(keys) + 1, dtype=np.int64)
-        offs[0] = 0
-        np.cumsum(lens, out=offs[1:])
+        packed, offs = _pack_str_keys(keys)
         n = len(keys)
         uwords = np.empty(n, dtype=np.uint32)
         uidx = np.empty(n, dtype=np.int32)
@@ -392,13 +448,7 @@ class NativeSlotIndex:
     def assign_batch_strs(self, keys, lid: int,
                           pinned: Optional[Set[int]] = None):
         """Assign slots for a string key batch in one C call."""
-        encoded = [k.encode() if isinstance(k, str) else bytes(k) for k in keys]
-        packed = np.frombuffer(b"".join(encoded), dtype=np.uint8)
-        lens = np.fromiter((len(b) for b in encoded), dtype=np.int64,
-                           count=len(encoded))
-        offs = np.empty(len(keys) + 1, dtype=np.int64)
-        offs[0] = 0
-        np.cumsum(lens, out=offs[1:])
+        packed, offs = _pack_str_keys(keys)
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)

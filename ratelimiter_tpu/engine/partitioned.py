@@ -1,0 +1,275 @@
+"""Host-parallel slot index: T native sub-indexes, one worker thread each.
+
+The C hash probe is DRAM-latency-bound (~54 ns/request single-threaded —
+bench notes in ARCHITECTURE.md), which caps the host at ~18M assigns/s
+while the relay device step and the wire could go faster.  Partitioning
+the key space over T native sub-indexes (same splitmix64 routing as the
+device-sharded index) lets T ctypes calls run truly in parallel — the C
+calls release the GIL — so batch assignment scales with memory
+parallelism instead of serializing on one probe stream.
+
+Semantics: identical to ShardedSlotIndex's host side — eviction is
+per-partition LRU (a key's slot never migrates between partitions), and
+global slot id = partition * slots_per_part + local slot.  This is the
+same recency trade the device-sharded deployment already makes; the
+single-LRU NativeSlotIndex remains the default.
+
+Used by TpuBatchedStorage(host_parallel=T) on single-device engines; the
+sharded engine keeps its own per-shard routing (one partition per device
+shard).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Hashable, Optional, Set, Tuple
+
+import numpy as np
+
+from ratelimiter_tpu.engine.native_index import NativeSlotIndex
+
+
+def _part_of_int_keys(key_ids: np.ndarray, n_parts: int) -> np.ndarray:
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+    return shard_of_int_keys(key_ids, n_parts)
+
+
+def _part_of_key(key, n_parts: int) -> int:
+    from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+    return shard_of_key(key, n_parts)
+
+
+class PartitionedSlotIndex:
+    """Drop-in NativeSlotIndex with T-way host parallelism.
+
+    Exposes the same vectorized surface (assign_batch_ints[_multi],
+    assign_batch_strs, the *_uniques relay family) plus the scalar
+    SlotIndex contract.  Fingerprint dump/restore enumerates per
+    partition, so checkpoints carry the exact per-partition LRU orders.
+    """
+
+    def __init__(self, num_slots: int, n_parts: int = 4):
+        if num_slots % n_parts:
+            raise ValueError("num_slots must divide evenly by n_parts")
+        self.num_slots = int(num_slots)
+        self.n_parts = int(n_parts)
+        self.slots_per_part = self.num_slots // self.n_parts
+        self._parts = [NativeSlotIndex(self.slots_per_part)
+                       for _ in range(self.n_parts)]
+        self._pool = cf.ThreadPoolExecutor(
+            self.n_parts, thread_name_prefix="slotidx")
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # -- scalar interface ------------------------------------------------------
+    def _local_pins(self, pinned, part):
+        if not pinned:
+            return None
+        spp = self.slots_per_part
+        return {s % spp for s in pinned if s // spp == part}
+
+    def get(self, key: Hashable) -> Optional[int]:
+        p = _part_of_key(key, self.n_parts)
+        local = self._parts[p].get(key)
+        return None if local is None else p * self.slots_per_part + local
+
+    def assign(self, key: Hashable,
+               pinned: Optional[Set[int]] = None) -> Tuple[int, Optional[int]]:
+        p = _part_of_key(key, self.n_parts)
+        base = p * self.slots_per_part
+        local, evicted = self._parts[p].assign(
+            key, pinned=self._local_pins(pinned, p))
+        return base + local, None if evicted is None else base + evicted
+
+    def remove(self, key: Hashable) -> Optional[int]:
+        p = _part_of_key(key, self.n_parts)
+        local = self._parts[p].remove(key)
+        return None if local is None else p * self.slots_per_part + local
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self._parts)
+
+    # -- vectorized interface --------------------------------------------------
+    def _scatter_merge(self, n, parts_pos, results, kind, rank_bits=0):
+        """Merge per-partition outputs back to request order.
+
+        kind 'slots': results are (slots, ev) -> (slots i32[n], clears).
+        kind 'uniques': results are (uwords, uidx, rank, ev) -> global
+        (uwords concat with partition slot offsets folded into the slot
+        field, uidx i32[n] offset per partition, rank i32[n], clears).
+        """
+        spp = self.slots_per_part
+        if kind == "slots":
+            out = np.empty(n, dtype=np.int32)
+            clears: list = []
+            for p, (pos, res) in enumerate(zip(parts_pos, results)):
+                if res is None:
+                    continue
+                slots, ev = res
+                out[pos] = slots + p * spp
+                clears.extend(p * spp + int(e) for e in ev)
+            return out, clears
+        rb = rank_bits
+        uw_all, clears = [], []
+        uidx = np.empty(n, dtype=np.int32)
+        rank = np.empty(n, dtype=np.int32)
+        offset = 0
+        for p, (pos, res) in enumerate(zip(parts_pos, results)):
+            if res is None:
+                continue
+            uw, ui, rk, ev = res
+            # Fold the partition's global slot base into the word's slot
+            # field: slot rides in bits rank_bits+1.. so adding
+            # base << (rank_bits+1) re-addresses it globally.
+            uw_all.append(uw + np.uint32(p * spp << (rb + 1)))
+            uidx[pos] = ui + offset
+            rank[pos] = rk
+            offset += len(uw)
+            clears.extend(p * spp + int(e) for e in ev)
+        uwords = (np.concatenate(uw_all) if uw_all
+                  else np.empty(0, dtype=np.uint32))
+        return uwords, uidx, rank, clears
+
+    def _parallel(self, key_ids, pinned, run):
+        """Split a batch by partition, run per-partition C calls on the
+        pool (GIL released inside), return (parts_pos, results)."""
+        parts = _part_of_int_keys(key_ids, self.n_parts)
+        parts_pos = [np.where(parts == p)[0] for p in range(self.n_parts)]
+        futs = []
+        for p, pos in enumerate(parts_pos):
+            if not len(pos):
+                futs.append(None)
+                continue
+            futs.append(self._pool.submit(
+                run, p, pos, self._local_pins(pinned, p)))
+        return parts_pos, [None if f is None else f.result() for f in futs]
+
+    def assign_batch_ints(self, keys: np.ndarray, lid: int,
+                          pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+
+        def run(p, pos, pins):
+            return self._parts[p].assign_batch_ints(keys[pos], lid,
+                                                    pinned=pins)
+
+        parts_pos, results = self._parallel(keys, pinned, run)
+        slots, clears = self._scatter_merge(len(keys), parts_pos, results,
+                                            "slots")
+        return slots, np.asarray(clears, dtype=np.int32)
+
+    def assign_batch_ints_multi(self, keys: np.ndarray, lids: np.ndarray,
+                                pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        lids = np.ascontiguousarray(lids, dtype=np.uint64)
+
+        def run(p, pos, pins):
+            return self._parts[p].assign_batch_ints_multi(
+                keys[pos], lids[pos], pinned=pins)
+
+        parts_pos, results = self._parallel(keys, pinned, run)
+        slots, clears = self._scatter_merge(len(keys), parts_pos, results,
+                                            "slots")
+        return slots, np.asarray(clears, dtype=np.int32)
+
+    def assign_batch_ints_uniques(self, keys: np.ndarray, lid: int,
+                                  rank_bits: int,
+                                  pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+
+        def run(p, pos, pins):
+            return self._parts[p].assign_batch_ints_uniques(
+                keys[pos], lid, rank_bits, pinned=pins)
+
+        parts_pos, results = self._parallel(keys, pinned, run)
+        return self._scatter_merge(len(keys), parts_pos, results, "uniques",
+                                   rank_bits)
+
+    def assign_batch_ints_multi_uniques(self, keys: np.ndarray,
+                                        lids: np.ndarray, rank_bits: int,
+                                        pinned: Optional[Set[int]] = None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        lids = np.ascontiguousarray(lids, dtype=np.uint64)
+
+        def run(p, pos, pins):
+            return self._parts[p].assign_batch_ints_multi_uniques(
+                keys[pos], lids[pos], rank_bits, pinned=pins)
+
+        parts_pos, results = self._parallel(keys, pinned, run)
+        return self._scatter_merge(len(keys), parts_pos, results, "uniques",
+                                   rank_bits)
+
+    # Strings: partition routing needs per-key hashing host-side anyway,
+    # so the parallel win is smaller; route by the same shard_of_key the
+    # scalar path uses — INCLUDING the lid in the routed key, exactly as
+    # storage's scalar assign((lid, key)) does, so both paths agree on a
+    # key's partition — and still fan the C calls out.
+    def _parallel_strs(self, keys, lid, pinned, run):
+        parts = np.fromiter(
+            (_part_of_key((lid, k), self.n_parts) for k in keys),
+            dtype=np.int64, count=len(keys))
+        parts_pos = [np.where(parts == p)[0] for p in range(self.n_parts)]
+        futs = []
+        for p, pos in enumerate(parts_pos):
+            if not len(pos):
+                futs.append(None)
+                continue
+            futs.append(self._pool.submit(
+                run, p, [keys[i] for i in pos], self._local_pins(pinned, p)))
+        return parts_pos, [None if f is None else f.result() for f in futs]
+
+    def assign_batch_strs(self, keys, lid: int,
+                          pinned: Optional[Set[int]] = None):
+        def run(p, sub, pins):
+            return self._parts[p].assign_batch_strs(sub, lid, pinned=pins)
+
+        parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
+        slots, clears = self._scatter_merge(len(keys), parts_pos, results,
+                                            "slots")
+        return slots, np.asarray(clears, dtype=np.int32)
+
+    def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
+                                  pinned: Optional[Set[int]] = None):
+        def run(p, sub, pins):
+            return self._parts[p].assign_batch_strs_uniques(
+                sub, lid, rank_bits, pinned=pins)
+
+        parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
+        return self._scatter_merge(len(keys), parts_pos, results, "uniques",
+                                   rank_bits)
+
+    # -- fingerprint enumeration (checkpoint/restore) --------------------------
+    def dump_fp(self):
+        """Per-partition (h1, h2, local slots) stacked with partition slot
+        bases folded in; concatenation order is partition-major so
+        restore_fp can split it back exactly."""
+        h1s, h2s, slots = [], [], []
+        for p, part in enumerate(self._parts):
+            h1, h2, sl = part.dump_fp()
+            h1s.append(h1)
+            h2s.append(h2)
+            slots.append(sl + np.int32(p * self.slots_per_part))
+        return (np.concatenate(h1s) if h1s else np.empty(0, np.uint64),
+                np.concatenate(h2s) if h2s else np.empty(0, np.uint64),
+                np.concatenate(slots) if slots else np.empty(0, np.int32))
+
+    def restore_fp(self, h1: np.ndarray, h2: np.ndarray,
+                   slots: np.ndarray) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        part = slots // self.slots_per_part
+        for p, sub in enumerate(self._parts):
+            m = part == p
+            sub.restore_fp(h1[m], h2[m],
+                           slots[m] - np.int32(p * self.slots_per_part))
+
+    def lookup_fps(self, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+        # Fingerprints don't carry the partition; probe every partition
+        # (restore/rebalance path only — not on the hot path).
+        out = np.full(len(h1), -1, dtype=np.int32)
+        for p, sub in enumerate(self._parts):
+            local = sub.lookup_fps(h1, h2)
+            hit = (out == -1) & (local >= 0)
+            out[hit] = local[hit] + p * self.slots_per_part
+        return out

@@ -92,6 +92,7 @@ class TpuBatchedStorage(RateLimitStorage):
         table: LimiterTable | None = None,
         checkpointable: bool = False,
         meter_registry=None,
+        host_parallel: int = 0,
     ):
         self._clock_ms = clock_ms
         # The storage-latency histogram the reference documents but never
@@ -114,6 +115,41 @@ class TpuBatchedStorage(RateLimitStorage):
         # needed only for dumps that must re-hash keys in a different
         # geometry (cross-shard rebalance; engine/checkpoint.py).
         def make_index():
+            # host_parallel=T partitions the host index over T native
+            # sub-indexes with per-partition LRU (the trade the
+            # device-sharded index already makes) so batch assignment
+            # scales across cores instead of serializing on one DRAM
+            # probe stream.  Single-device engines only; checkpointable
+            # deployments keep the enumerable Python index.
+            if host_parallel > 1:
+                if checkpointable:
+                    raise ValueError(
+                        "host_parallel requires fingerprint checkpoints; "
+                        "it cannot combine with checkpointable=True "
+                        "(which needs the keyed Python index)")
+                if hasattr(self.engine, "n_shards"):
+                    raise ValueError(
+                        "host_parallel applies to single-device engines; "
+                        "the sharded engine already partitions the host "
+                        "index per device shard")
+                if self.engine.num_slots % host_parallel:
+                    raise ValueError(
+                        f"num_slots ({self.engine.num_slots}) must divide "
+                        f"evenly by host_parallel ({host_parallel})")
+                from ratelimiter_tpu.engine.native_index import (
+                    native_available,
+                )
+
+                if native_available():
+                    from ratelimiter_tpu.engine.partitioned import (
+                        PartitionedSlotIndex,
+                    )
+
+                    return PartitionedSlotIndex(self.engine.num_slots,
+                                                host_parallel)
+                raise RuntimeError(
+                    "host_parallel requires the native slot index "
+                    "(C++ build unavailable)")
             index = self.engine.make_slot_index()
             if not checkpointable:
                 return index
@@ -436,8 +472,10 @@ class TpuBatchedStorage(RateLimitStorage):
             if mode == "bits":
                 got = np.unpackbits(arr)[:count].astype(bool)
             else:  # digest: reconstruct from per-unique allowed counts
+                from ratelimiter_tpu.engine.native_index import relay_decide
+
                 uidx, rank, u = extra
-                got = rank < arr[:u].astype(np.int32)[uidx]
+                got = relay_decide(arr[:u], uidx, rank)
             out[start:start + count] = got
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
@@ -457,8 +495,16 @@ class TpuBatchedStorage(RateLimitStorage):
             if digest:
                 size = _bucket_pow2(u)
                 uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
-                lid_lane = lid if not multi_lid else _pad_tail(
-                    l_chunk[rank == 0], size, 0, np.int32)
+                if multi_lid:
+                    # Per-unique lids mapped through uidx (NOT positional:
+                    # a partitioned index merges uniques partition-major,
+                    # not in first-appearance order).
+                    first = rank == 0
+                    ulids = np.zeros(u, dtype=np.int32)
+                    ulids[uidx[first]] = l_chunk[first]
+                    lid_lane = _pad_tail(ulids, size, 0, np.int32)
+                else:
+                    lid_lane = lid
                 counts = counts_dispatch(uw, lid_lane, now, cdt)
                 pending.append(
                     ("digest", counts, start, cn, (uidx, rank, u), t0))
@@ -759,10 +805,12 @@ class TpuBatchedStorage(RateLimitStorage):
             dt_us = (time.perf_counter() - t0) * 1e6
             cnt = alw = 0
             if mode == "digest":
+                from ratelimiter_tpu.engine.native_index import relay_decide
+
                 for s, (pos, uidx, rank, u) in enumerate(per_shard):
                     if not len(pos):
                         continue
-                    got = rank < arr[s, :u].astype(np.int32)[uidx]
+                    got = relay_decide(arr[s, :u], uidx, rank)
                     out[start + pos] = got
                     cnt += len(pos)
                     alw += int(got.sum())
@@ -830,7 +878,10 @@ class TpuBatchedStorage(RateLimitStorage):
                     _, uidx, rank, u, uw = item
                     uw_mat[s, :u] = uw
                     if multi_lid:
-                        lid_mat[s, :u] = l_chunk[pos][rank == 0]
+                        first = rank == 0
+                        ulids = np.zeros(u, dtype=np.int32)
+                        ulids[uidx[first]] = l_chunk[pos][first]
+                        lid_mat[s, :u] = ulids
                     per_shard.append((pos, uidx, rank, u))
                 counts = counts_dispatch(
                     uw_mat, lid if not multi_lid else lid_mat, now, cdt)
@@ -999,6 +1050,9 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def close(self) -> None:
         self._batcher.close()
+        for index in self._index.values():
+            if hasattr(index, "close"):
+                index.close()
 
     # ------------------------------------------------------------------------
     def _assign_slot(self, algo: str, lid: int, key: str) -> int:

@@ -1,0 +1,155 @@
+"""Host-parallel partitioned slot index (engine/partitioned.py).
+
+Decision equivalence vs the single-LRU native index under ample
+capacity, the scalar/vector interface contract, and checkpoint
+round-trips with the geometry guards.
+"""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.native_index import native_available
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native index unavailable")
+
+
+def test_partitioned_stream_matches_plain():
+    now = [9_000_000]
+    st_p = TpuBatchedStorage(num_slots=1 << 12, host_parallel=4,
+                             clock_ms=lambda: now[0])
+    st_n = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=7, window_ms=1000, refill_rate=5.0)
+    lid_p = st_p.register_limiter("tb", cfg)
+    lid_n = st_n.register_limiter("tb", cfg)
+    from ratelimiter_tpu.engine.partitioned import PartitionedSlotIndex
+
+    assert isinstance(st_p._index["tb"], PartitionedSlotIndex)
+    rng = np.random.default_rng(8)
+    for rep in range(3):
+        ids = rng.integers(0, 200, 900)
+        a = st_p.acquire_stream_ids("tb", lid_p, ids, None)
+        b = st_n.acquire_stream_ids("tb", lid_n, ids, None)
+        np.testing.assert_array_equal(a, b, err_msg=f"rep {rep}")
+        now[0] += 411
+    st_p.close()
+    st_n.close()
+
+
+def test_partitioned_multi_lid_digest_matches_plain():
+    """Multi-tenant digest mode with a partitioned index: the per-unique
+    lid lane must be mapped through uidx (partition-major unique order),
+    not positionally."""
+    now = [9_500_000]
+    st_p = TpuBatchedStorage(num_slots=1 << 12, host_parallel=4,
+                             clock_ms=lambda: now[0])
+    st_n = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfgs = [RateLimitConfig(max_permits=3 + i, window_ms=1000,
+                            refill_rate=2.0 + i) for i in range(4)]
+    lids_p = np.asarray([st_p.register_limiter("tb", c) for c in cfgs])
+    lids_n = np.asarray([st_n.register_limiter("tb", c) for c in cfgs])
+    rng = np.random.default_rng(17)
+    for rep in range(3):
+        ids = rng.integers(0, 150, 800)
+        tl = rng.integers(0, 4, 800)
+        a = st_p.acquire_stream_ids("tb", lids_p[tl], ids, None)
+        b = st_n.acquire_stream_ids("tb", lids_n[tl], ids, None)
+        np.testing.assert_array_equal(a, b, err_msg=f"rep {rep}")
+        now[0] += 333
+    st_p.close()
+    st_n.close()
+
+
+def test_partitioned_scalar_and_batch_share_namespace():
+    from ratelimiter_tpu.engine.partitioned import PartitionedSlotIndex
+
+    ix = PartitionedSlotIndex(1 << 10, 4)
+    s1, _ = ix.assign((3, 42))
+    slots, _ = ix.assign_batch_ints(np.asarray([42, 42, 7]), 3)
+    assert slots[0] == s1 and slots[1] == s1 and slots[2] != s1
+    assert ix.get((3, 7)) == slots[2]
+    assert len(ix) == 2
+    assert ix.remove((3, 42)) == s1
+    assert ix.get((3, 42)) is None
+    uw, uidx, rank, _ = ix.assign_batch_ints_uniques(
+        np.asarray([7, 7, 42]), 3, 8)
+    assert len(uw) == 2
+    np.testing.assert_array_equal(rank, [0, 1, 0])
+    # Word slot fields must be the GLOBAL slots; uniques may merge in
+    # partition order, so map through uidx rather than positionally.
+    got_slots = (uw >> np.uint32(9)).astype(np.int64)
+    assert got_slots[uidx[0]] == ix.get((3, 7))
+    assert got_slots[uidx[2]] == ix.get((3, 42))
+    assert uidx[0] == uidx[1] != uidx[2]
+    ix.close()
+
+
+def test_partitioned_export_into_flat_native():
+    """export_keys from a host-partitioned storage produces the flat 'fp'
+    payload (global slots), importable into a flat native target that
+    then continues with identical decisions."""
+    now = [6_000_000]
+    st_p = TpuBatchedStorage(num_slots=1 << 10, host_parallel=2,
+                             clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=4, window_ms=1000, refill_rate=3.0)
+    lid = st_p.register_limiter("tb", cfg)
+    rng = np.random.default_rng(12)
+    ids = rng.integers(0, 120, 500)
+    st_p.acquire_stream_ids("tb", lid, ids, None)
+    dump = st_p.export_keys()
+    assert dump["algos"]["tb"]["kind"] == "fp"
+
+    st_f = TpuBatchedStorage(num_slots=1 << 11, clock_ms=lambda: now[0])
+    lid_f = st_f.register_limiter("tb", cfg)
+    assert lid_f == lid
+    st_f.import_keys(dump)
+    now[0] += 77
+    ids2 = rng.integers(0, 120, 500)
+    a = st_p.acquire_stream_ids("tb", lid, ids2, None)
+    b = st_f.acquire_stream_ids("tb", lid_f, ids2, None)
+    np.testing.assert_array_equal(a, b)
+    st_p.close()
+    st_f.close()
+
+
+def test_partitioned_checkpoint_round_trip(tmp_path):
+    now = [4_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 10, host_parallel=2,
+                           clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=5, window_ms=1000, refill_rate=2.0)
+    lid = st.register_limiter("tb", cfg)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 100, 400)
+    st.acquire_stream_ids("tb", lid, ids, None)
+    path = str(tmp_path / "ckpt")
+    st.save_checkpoint(path)
+
+    # Same-geometry restore continues identically to the original.
+    st2 = TpuBatchedStorage(num_slots=1 << 10, host_parallel=2,
+                            table=st.table, clock_ms=lambda: now[0])
+    st2.restore_checkpoint(path)
+    now[0] += 100
+    ids2 = rng.integers(0, 100, 400)
+    a = st.acquire_stream_ids("tb", lid, ids2, None)
+    b = st2.acquire_stream_ids("tb", lid, ids2, None)
+    np.testing.assert_array_equal(a, b)
+
+    # Geometry mismatches are refused, not silently orphaned.
+    st3 = TpuBatchedStorage(num_slots=1 << 10, host_parallel=4,
+                            table=st.table, clock_ms=lambda: now[0])
+    with pytest.raises(ValueError, match="partition"):
+        st3.restore_checkpoint(path)
+    st4 = TpuBatchedStorage(num_slots=1 << 10, table=st.table,
+                            clock_ms=lambda: now[0])
+    with pytest.raises(ValueError, match="partition"):
+        st4.restore_checkpoint(path)
+    # ...and a flat fingerprint dump cannot enter a partitioned index.
+    path2 = str(tmp_path / "ckpt_flat")
+    st4.acquire_stream_ids("tb", lid, ids, None)
+    st4.save_checkpoint(path2)
+    with pytest.raises(ValueError, match="host-partitioned"):
+        st2.restore_checkpoint(path2)
+    for s in (st, st2, st3, st4):
+        s.close()
