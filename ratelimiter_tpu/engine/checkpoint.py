@@ -315,21 +315,29 @@ def import_keys(storage, dump: Dict) -> None:
                 raise ValueError(
                     f"target storage is too small for the export ({new} "
                     f"new {algo} fingerprints, {free} free slots)")
-        elif hasattr(index, "_sub"):
+        elif hasattr(index, "_sub") or hasattr(index, "_parts"):
+            # Capacity is per shard/partition — a key's placement is fixed
+            # by hash, so a global count could pass while one bucket
+            # overflows mid-import, leaving a partial import.
             from ratelimiter_tpu.parallel.sharded import shard_of_key
 
-            new_per_shard = [0] * index.n_shards
+            subs = index._sub if hasattr(index, "_sub") else index._parts
+            per_sub_cap = (index.slots_per_shard if hasattr(index, "_sub")
+                           else index.slots_per_part)
+            new_per_sub = [0] * len(subs)
             for key, _ in entries:
                 key = tuple(key) if isinstance(key, list) else key
-                shard = shard_of_key(key, index.n_shards)
-                if index._sub[shard].get(key) is None:
-                    new_per_shard[shard] += 1
-            for shard, (sub, new) in enumerate(zip(index._sub, new_per_shard)):
-                free = index.slots_per_shard - len(sub)
+                bucket = shard_of_key(key, len(subs))
+                if subs[bucket].get(key) is None:
+                    new_per_sub[bucket] += 1
+            word = "shard" if hasattr(index, "_sub") else "partition"
+            for bucket, (sub, new) in enumerate(zip(subs, new_per_sub)):
+                free = per_sub_cap - len(sub)
                 if new > free:
                     raise ValueError(
-                        f"target shard {shard} is too small for the export "
-                        f"({new} new {algo} keys, {free} free slots)")
+                        f"target {word} {bucket} is too small for the "
+                        f"export ({new} new {algo} keys, {free} free "
+                        "slots)")
         else:
             new = sum(
                 1 for key, _ in entries

@@ -203,8 +203,10 @@ class DeviceEngine:
         else:
             lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
         if permits_kb is not None:
+            pdt = (np.uint8 if getattr(permits_kb, "dtype", None) == np.uint8
+                   else np.int32)
             permits_kb = jnp.asarray(
-                np.ascontiguousarray(permits_kb, dtype=np.int32))
+                np.ascontiguousarray(permits_kb, dtype=pdt))
         now_k = jnp.asarray(np.ascontiguousarray(now_k, dtype=np.int64))
         with self._lock:
             if algo == "sw":

@@ -255,14 +255,10 @@ class PartitionedSlotIndex:
                 np.concatenate(h2s) if h2s else np.empty(0, np.uint64),
                 np.concatenate(slots) if slots else np.empty(0, np.int32))
 
-    def restore_fp(self, h1: np.ndarray, h2: np.ndarray,
-                   slots: np.ndarray) -> None:
-        slots = np.ascontiguousarray(slots, dtype=np.int32)
-        part = slots // self.slots_per_part
-        for p, sub in enumerate(self._parts):
-            m = part == p
-            sub.restore_fp(h1[m], h2[m],
-                           slots[m] - np.int32(p * self.slots_per_part))
+    # NOTE: no restore_fp here on purpose — fingerprints don't carry their
+    # key's partition routing, so only the checkpoint path (which stores
+    # per-partition payloads) can restore; a flat fingerprint dump is
+    # rejected at the checkpoint layer (engine/checkpoint.py).
 
     def lookup_fps(self, h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
         # Fingerprints don't carry the partition; probe every partition

@@ -540,18 +540,34 @@ class TpuBatchedStorage(RateLimitStorage):
         ``subbatches`` sequential scan steps), and a pipelined bitmask
         fetch that overlaps the next super-batch's indexing + dispatch.
 
-        Dispatches are capped at ``_FLAT_MAX_LANES`` requests: the sorted
-        step's sort/scan ops have XLA:TPU compile times that grow
-        super-linearly with lane count (~30 s at 512K lanes, ~4 min at 2M,
-        unusable at 4M — bench/profile_compile.py), while throughput at
-        this size is already transfer-bound, so larger dispatches only buy
-        compile pain.  Semantically a cap is just a smaller super-batch:
-        each dispatch still carries its own monotonic timestamp."""
+        The sorted step's lane count is capped at ``_FLAT_MAX_LANES``:
+        its sort/scan ops have XLA:TPU compile times that grow
+        super-linearly with lane count (~30 s at 512K lanes, ~4 min at
+        2M, unusable at 4M — bench/profile_compile.py).  A super-batch
+        larger than the cap dispatches as ONE ``lax.scan`` of
+        cap-sized sub-batches instead (ops/packed.py) — same sorted step
+        compiled once at the cap, but a single dispatch + fetch round
+        trip per super-batch, which measures ~1.6x faster than chaining
+        capped flat dispatches on the dev tunnel."""
         multi_lid = lid_arr is not None
-        super_n = min(int(subbatches) * int(batch), _FLAT_MAX_LANES)
-        dispatch = (self.engine.sw_flat_dispatch if algo == "sw"
-                    else self.engine.tb_flat_dispatch)
-        clear = (self.engine.sw_clear if algo == "sw" else self.engine.tb_clear)
+        super_n = int(subbatches) * int(batch)
+        k_scan = 0
+        if super_n > _FLAT_MAX_LANES:
+            # Bounded by the stream length: a short stream must not pad
+            # up to the requested super-batch's worth of dead lanes.
+            k_scan = min(-(-super_n // _FLAT_MAX_LANES),
+                         max(-(-n // _FLAT_MAX_LANES), 1))
+            super_n = k_scan * _FLAT_MAX_LANES
+            if k_scan == 1:
+                k_scan = 0  # plain flat dispatch at the cap
+        eng = self.engine
+        if k_scan:
+            dispatch = (eng.sw_scan_dispatch if algo == "sw"
+                        else eng.tb_scan_dispatch)
+        else:
+            dispatch = (eng.sw_flat_dispatch if algo == "sw"
+                        else eng.tb_flat_dispatch)
+        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
         # When every permit in the stream fits a byte (the common case —
         # permits above max_permits are pointless), the permits lane ships
         # as uint8: 5 B/request on the wire instead of 8.  The device step
@@ -566,27 +582,44 @@ class TpuBatchedStorage(RateLimitStorage):
         pending: list[tuple[int, int, object, float]] = []
 
         def drain(handle, start, count, t0):
-            arr = np.asarray(handle)  # uint8[super_n//8] — the one blocking fetch
+            arr = np.asarray(handle)  # the one blocking fetch
             dt_us = (time.perf_counter() - t0) * 1e6
-            got = np.unpackbits(arr)[:count].astype(bool)
+            if k_scan:  # uint8[k, cap//8]
+                got = np.unpackbits(arr, axis=1).reshape(-1)[:count]
+                got = got.astype(bool)
+            else:  # uint8[super_n//8]
+                got = np.unpackbits(arr)[:count].astype(bool)
             out[start:start + count] = got
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
         for start in range(0, n, super_n):
             cn = min(super_n, n - start)
+            # The tail super-batch shrinks to its own sub-batch count so a
+            # partial chunk doesn't ship k_scan's worth of padding lanes.
+            k_i = (min(k_scan, -(-cn // _FLAT_MAX_LANES)) if k_scan else 0)
+            pad_n = k_i * _FLAT_MAX_LANES if k_i else super_n
             slots, clears = assign(start, cn)
             if len(clears):
                 clear(list(clears))
-            slots = _pad_tail(slots, super_n, -1, np.int32)
+            slots = _pad_tail(slots, pad_n, -1, np.int32)
             if oversize is not None:
                 slots[:cn][oversize[start:start + cn]] = -1  # force-deny
             lid_flat = lid if not multi_lid else _pad_tail(
-                lid_arr[start:start + cn], super_n, 0, np.int32)
+                lid_arr[start:start + cn], pad_n, 0, np.int32)
             p_flat = None if permits is None else _pad_tail(
-                permits[start:start + cn], super_n, 1, p_dtype)
+                permits[start:start + cn], pad_n, 1, p_dtype)
             now = self._monotonic_now()
             t0 = time.perf_counter()
-            bits = dispatch(slots, lid_flat, p_flat, now)
+            if k_i:
+                bits = dispatch(
+                    slots.reshape(k_i, _FLAT_MAX_LANES),
+                    lid_flat if not multi_lid
+                    else lid_flat.reshape(k_i, _FLAT_MAX_LANES),
+                    None if p_flat is None
+                    else p_flat.reshape(k_i, _FLAT_MAX_LANES),
+                    np.full(k_i, now, dtype=np.int64))
+            else:
+                bits = dispatch(slots, lid_flat, p_flat, now)
             pending.append((start, cn, bits, t0))
             if len(pending) > 1:
                 s0, c0, h0, pt0 = pending.pop(0)
