@@ -15,10 +15,11 @@ Arithmetic: int32 with saturating adds.  Exactness argument:
   2**TOKEN_FP_SHIFT (req = permits * 1000 * 2**s), so both sides can be
   right-shifted by s exactly (callers pass u' = (v1 - req) >> s and
   w' = req >> s = permits * 1000).  Within-segment sums can still overflow
-  int32 for pathological hot segments, so the scan saturates at SAT; since
-  SAT > any representable u', a saturated prefix correctly rejects.
-  min(a+b, SAT) is associative over non-negatives, so saturation commutes
-  with the scan.
+  int32 for pathological hot segments, so the scan saturates at SAT
+  (sized so 2*SAT fits int32 — the clamp runs after each add) while
+  thresholds clip to SAT-1; a saturated prefix therefore always compares
+  greater and correctly rejects.  min(a+b, SAT) is associative over
+  non-negatives, so saturation commutes with the scan.
 
 The kernel is gated: ``solve_threshold_recurrence_auto`` tries the Pallas
 path when enabled (RATELIMITER_PALLAS=1) and the platform supports it,
@@ -36,7 +37,28 @@ import jax.numpy as jnp
 
 from ratelimiter_tpu.ops import segments as _xla
 
-SAT = 1 << 30  # saturation ceiling (python int): above any legal threshold
+# Saturation ceiling: 2*SAT must fit int32 so two adjacent saturated
+# lanes can add without wrapping (the scan clamps AFTER the add), and
+# thresholds are clipped to SAT-1 so a saturated prefix always rejects.
+SAT = (1 << 30) - 1
+
+
+def _ensure_stack() -> None:
+    """Raise Python's recursion limit for kernel lowering.
+
+    Mosaic's jaxpr lowering recurses per equation and pltpu.roll's
+    tracing recurses with the shift amount, so the log-depth unroll
+    needs ~n/2 frames at the largest shift — ~16K at the 32K-lane
+    dispatch ceiling, far past the default 1000.  The raise is sticky
+    (process-global): lowering continues inside jit internals after this
+    frame returns, so a scoped save/restore cannot cover it.  CPython
+    3.12 keeps Python-to-Python calls off the C stack, so the depth is
+    safe on default 8 MB thread stacks.
+    """
+    import sys
+
+    if sys.getrecursionlimit() < 100000:
+        sys.setrecursionlimit(100000)
 
 
 def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
@@ -45,10 +67,13 @@ def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
     u, w: i32[1, n]; segfirst: i32[1, n] — index of each element's segment
     head; inc (out): i32[1, n].
     """
-    u = u_ref[0, :]
-    w = w_ref[0, :]
-    seg_first = segfirst_ref[0, :]
-    idx = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    # Everything stays (1, n): Mosaic's TPU lowering handles 2D slices,
+    # concats, and reductions, while rank-1 forms of the same ops hit
+    # NotImplemented/recursion walls (found empirically on v5e).
+    u = u_ref[...]
+    w = w_ref[...]
+    seg_first = segfirst_ref[...]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
 
     def seg_cumsum_excl(x):
         """Saturating segmented inclusive scan minus x (exclusive).
@@ -57,12 +82,25 @@ def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
         x over [max(seg_first[i], i - 2^k + 1), i]; values never leave the
         segment, so magnitudes stay segment-local.
         """
+        import numpy as np
+
+        from jax.experimental.pallas import tpu as pltpu
+
         v = x
         d = 1
         while d < n:  # static log2(n) unroll
-            shifted = jnp.concatenate([jnp.zeros((d,), jnp.int32), v[:-d]])
+            # Circular roll (a supported Mosaic primitive; concatenate
+            # recurses in lowering).  The wrap-around lanes land at
+            # idx < d, where idx - d < 0 <= seg_first masks them off.
+            # Literals must be explicit 32-bit under jax_enable_x64: a
+            # weak python int turns the shift into an i64 scalar
+            # (tpu.dynamic_rotate verification error) and an i64 `where`
+            # arm sends Mosaic's convert-element-type lowering into
+            # infinite recursion.
+            shifted = pltpu.roll(v, np.int32(d), 1)
             ok = (idx - d) >= seg_first
-            v = jnp.minimum(v + jnp.where(ok, shifted, 0), SAT)
+            v = jnp.minimum(v + jnp.where(ok, shifted, jnp.int32(0)),
+                            jnp.int32(SAT))
             d *= 2
         return v - x
 
@@ -72,31 +110,50 @@ def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
 
     def cond(carry):
         lo, hi, it = carry
-        return jnp.logical_and(jnp.any(lo != hi), it < n + 2)
+        # Reduce through i32: Mosaic only converts 32-bit reductions to
+        # scalars (a bool `any` trips a float64 path on TPU).
+        diff = jnp.max(jnp.abs(lo - hi))
+        return jnp.logical_and(diff > 0, it < n + 2)
 
     def body(carry):
         lo, hi, it = carry
         return step(hi), step(lo), it + 1
 
-    lo0 = jnp.zeros((n,), jnp.int32)
-    hi0 = jnp.ones((n,), jnp.int32)
+    lo0 = jnp.zeros((1, n), jnp.int32)
+    hi0 = jnp.ones((1, n), jnp.int32)
     lo, _, _ = jax.lax.while_loop(cond, body, (lo0, hi0, jnp.int32(0)))
-    inc_ref[0, :] = lo
+    inc_ref[...] = lo
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_solve(u32, w32, seg_first, interpret: bool = False):
-    """Run the Pallas solver on i32 inputs shaped [n]."""
+    """Run the Pallas solver on i32 inputs shaped [n].
+
+    Inputs are right-padded to a lane-aligned width (Mosaic mishandles
+    tiny/unaligned rank-2 shapes): padded lanes carry u = -1 (never
+    pass), and their seg_first is +inf-ish so the masked scan leaves
+    them inert; padding sits at the tail, so it can never feed a real
+    lane (the scan only looks backward).
+    """
     from jax.experimental import pallas as pl
 
+    _ensure_stack()
     n = u32.shape[0]
-    kernel = functools.partial(_solver_kernel, n=n)
+    n_pad = max(256, -(-n // 128) * 128)
+    if n_pad != n:
+        pad = n_pad - n
+        u32 = jnp.concatenate([u32, jnp.full((pad,), -1, jnp.int32)])
+        w32 = jnp.concatenate([w32, jnp.zeros((pad,), jnp.int32)])
+        seg_first = jnp.concatenate(
+            [seg_first, jnp.full((pad,), SAT, jnp.int32)])
+    kernel = functools.partial(_solver_kernel, n=n_pad)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
         interpret=interpret,
-    )(u32.reshape(1, n), w32.reshape(1, n), seg_first.reshape(1, n))
-    return out[0]
+    )(u32.reshape(1, n_pad), w32.reshape(1, n_pad),
+      seg_first.reshape(1, n_pad))
+    return out[0, :n]
 
 
 def seg_first_index(first: jnp.ndarray) -> jnp.ndarray:
@@ -111,15 +168,25 @@ def seg_first_index(first: jnp.ndarray) -> jnp.ndarray:
 # Auto dispatcher
 # ---------------------------------------------------------------------------
 
-_PALLAS_FLAG = os.environ.get("RATELIMITER_PALLAS", "0") == "1"
+_PALLAS_FLAG = os.environ.get("RATELIMITER_PALLAS", "1") == "1"
 # Interpret-mode override so the Pallas path can be exercised on CPU in tests.
 _PALLAS_INTERPRET = os.environ.get("RATELIMITER_PALLAS_INTERPRET", "0") == "1"
+# Single-launch lane ceiling: the log-depth unroll's temporaries grow with
+# lane count and the TPU compiler falls over past 32K lanes (measured on
+# v5e); larger batches take the XLA solver.  The micro-batcher's buckets
+# (<= max_batch 8192) and the synchronous acquire_many latency batches sit
+# comfortably under the ceiling — exactly the traffic the VMEM-resident
+# iteration helps.
+_PALLAS_MAX_LANES = 1 << 15
 _pallas_ok: bool | None = None
 
 
 def _pallas_supported() -> bool:
     global _pallas_ok
     if _pallas_ok is None:
+        if not (_PALLAS_INTERPRET or jax.default_backend() == "tpu"):
+            _pallas_ok = False
+            return False
         try:
             test = jnp.asarray([5, 5, -1], dtype=jnp.int32)
             w = jnp.ones(3, dtype=jnp.int32)
@@ -141,10 +208,13 @@ def solve_threshold_recurrence_auto(u, w, first, shift: int = 0):
     W <= u  <=>  W>>s <= floor(u/2**s) for W a multiple of 2**s).
     Sliding window uses shift=0.
     """
-    if _PALLAS_FLAG and _pallas_supported():
+    if (_PALLAS_FLAG and u.shape[0] <= _PALLAS_MAX_LANES
+            and _pallas_supported()):
         u_s = jnp.right_shift(u, shift) if shift else u
         w_s = jnp.right_shift(w, shift) if shift else w
-        u32 = jnp.clip(u_s, -1, SAT).astype(jnp.int32)
+        # Thresholds clip BELOW the saturation ceiling so a saturated
+        # prefix sum (== SAT) compares greater and correctly rejects.
+        u32 = jnp.clip(u_s, -1, SAT - 1).astype(jnp.int32)
         w32 = jnp.clip(w_s, 0, SAT).astype(jnp.int32)
         sf = seg_first_index(first)
         out = pallas_solve(u32, w32, sf, interpret=_PALLAS_INTERPRET)
