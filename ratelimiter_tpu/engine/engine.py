@@ -108,6 +108,17 @@ class DeviceEngine:
         self._relay_counts = {}  # (algo, out_dtype name) -> jitted step
         self._sw_peek = jax.jit(sw_peek_p)
         self._tb_peek = jax.jit(tb_peek_p)
+        # Settle the Pallas probes NOW, before any step kernel compiles:
+        # a probe firing lazily inside another program's lowering nests a
+        # second remote compile on toolchains that cannot serve one, and
+        # the resulting failure would stick as a permanent fallback.
+        # settle() honors each module's kill switch.
+        if jax.default_backend() == "tpu":
+            from ratelimiter_tpu.ops.pallas import block_scatter
+            from ratelimiter_tpu.ops.pallas import solver as pallas_solver
+
+            block_scatter.settle()
+            pallas_solver.settle()
         self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
         self._tb_reset = jax.jit(tb_reset_p, donate_argnums=0)
 

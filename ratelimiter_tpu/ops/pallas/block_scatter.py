@@ -1,11 +1,11 @@
 """Pallas TPU dense block-scatter for sorted-unique row updates.
 
-XLA's generic scatter on TPU costs ~45 ns/index (~179 ms to write 4M rows
-of a 1M-slot table — bench/profile_step.py), far above the HBM-bandwidth
-floor for the same bytes.  But the streaming step's scatter has structure
-XLA cannot exploit: the batch is sorted by slot and carries at most one
-surviving write per slot (the segment-last row of each sorted duplicate
-run).  That makes the scatter expressible as a DENSE sweep:
+XLA's generic scatter on TPU costs ~45 ns/index, far above the
+HBM-bandwidth floor for the same bytes.  But the sorted step's scatter
+has structure XLA cannot exploit: the batch is sorted by slot and
+carries at most one surviving write per slot (the segment-last row of
+each sorted duplicate run).  That makes the scatter expressible as a
+DENSE sweep:
 
     for each aligned block of T consecutive state rows:
         the updates touching it sit in a contiguous window of the
@@ -15,21 +15,29 @@ run).  That makes the scatter expressible as a DENSE sweep:
 Pipeline:
 1. Compact: one payload-carrying ``lax.sort`` moves masked-out lanes to
    the tail (key = slot for live updates, S sentinel otherwise), leaving
-   live updates sorted by slot and unique.
-2. Window map: ``searchsorted`` of the T-aligned block boundaries over the
-   compacted keys, divided down to block granularity — per state block i a
-   scalar sigma[i] such that update-blocks [sigma[i], sigma[i]+1] cover
-   every update for block i (<= T updates, any exact window start spans at
-   most two aligned T-blocks).
-3. One ``pallas_call`` over the S/T state blocks: the update windows are
-   pulled through VMEM by BlockSpec index_maps reading sigma (scalar
-   prefetch — DMA double-buffering comes free from the grid pipeline);
-   per row the matching update (if any) is selected by compare-and-sum
-   over the window, which is exact because slots are unique.
+   live updates sorted by slot and unique; the update array is then
+   TRANSPOSED (XLA-side) so the kernel reads (row-vector slots,
+   lane-major rows) — rank-2 friendly shapes for Mosaic.
+2. Window map: ``searchsorted`` of the T-aligned block boundaries over
+   the compacted keys, divided down to block granularity — per state
+   block i a scalar sigma[i] such that update-blocks [sigma[i],
+   sigma[i]+1] cover every update for block i (<= T updates; any exact
+   window start spans at most two aligned T-blocks).
+3. One ``pallas_call`` over the S/T state blocks: per window the kernel
+   builds the (T, T) match matrix t_slot == w_slot and SELECTS each
+   row's matching update by two exact f32 matmuls over the update's
+   16-bit halves (at most one match per row, so every dot-product has
+   at most one nonzero term — exact in f32 regardless of magnitude).
+   Slots are unique and the two windows are disjoint, so summing the
+   per-window selections composes them.
 
-HBM traffic: read S + 2B rows, write S rows — bandwidth-bound instead of
-per-index-bound.  The state output aliases the state input (in-place in
-HBM, composing with the caller's donated buffers).
+HBM traffic: read S + 2B rows, write S rows — bandwidth-bound instead
+of per-index-bound.  The state output aliases the state input (in-place
+in HBM, composing with the caller's donated buffers).
+
+Mosaic survival rules baked in (learned on v5e, see also
+ops/pallas/solver.py): rank-2 everything, no 1-D slices/gathers,
+explicit 32-bit literals under jax_enable_x64.
 """
 
 from __future__ import annotations
@@ -42,46 +50,69 @@ import jax.numpy as jnp
 import numpy as np
 
 T = 256          # state rows per block; S must divide by this
-_CHUNK = 128     # window columns folded per VPU select-sum pass
 
 _FLAG = os.environ.get("RATELIMITER_BLOCK_SCATTER", "1") == "1"
 _INTERPRET = os.environ.get("RATELIMITER_BLOCK_SCATTER_INTERPRET", "0") == "1"
 _probe_ok: bool | None = None
 
 
-def _kernel(sigma_ref, state_ref, upd_a_ref, upd_b_ref, out_ref, *, lanes):
-    del sigma_ref, lanes  # sigma is consumed by the index_maps
-    block = state_ref[...]                       # (T, lanes)
-    win = jnp.concatenate([upd_a_ref[...], upd_b_ref[...]], axis=0)
-    w_slot = win[:, 0]                           # (2T,) compacted slot keys
-    w_rows = win[:, 1:]                          # (2T, lanes)
-    t_slot = T * pl.program_id(0) + jax.lax.broadcasted_iota(
-        jnp.int32, (T,), 0)
+def _select_window(eq_f, rows_ref):
+    """Per-target-row selected update values for one window.
 
-    acc = jnp.zeros(block.shape, dtype=jnp.int32)
-    anym = jnp.zeros((T,), dtype=jnp.bool_)
-    for c in range(0, 2 * T, _CHUNK):
-        eq = w_slot[None, c:c + _CHUNK] == t_slot[:, None]   # (T, CHUNK)
-        anym = anym | eq.any(axis=1)
-        # Unique slots => at most one hit per row: select-sum is exact.
-        acc = acc + jnp.sum(
-            eq[:, :, None].astype(jnp.int32) * w_rows[None, c:c + _CHUNK, :],
-            axis=1, dtype=jnp.int32)
-    out_ref[...] = jnp.where(anym[:, None], acc, block)
+    eq_f: f32[T, T] 0/1 match matrix (at most one 1 per row).
+    rows_ref: i32[lanes, T] window rows, lane-major.
+    Returns (vals u32[T, lanes] — zeros where unmatched, hits f32-exact
+    via 16-bit halves; match f32[T, 1] row match counts).
+    """
+    rows = rows_ref[...]
+    # 16-bit halves in SIGNED i32 arithmetic (Mosaic crashes on
+    # uint32 casts/bitcasts): both halves land in [0, 65535], exact in
+    # f32; the left-shift recombine wraps into the sign bit, which is
+    # exactly the original bit pattern.
+    lo = (rows & jnp.int32(0xFFFF)).astype(jnp.float32)
+    hi = ((rows >> jnp.int32(16)) & jnp.int32(0xFFFF)).astype(jnp.float32)
+    dn = (((1,), (1,)), ((), ()))  # contract window axis of both
+    # HIGHEST precision: the TPU's default bf16 matmul passes would
+    # round the 16-bit halves; the 3-pass f32 mode keeps them exact.
+    lo_s = jax.lax.dot_general(eq_f, lo, dn,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+    hi_s = jax.lax.dot_general(eq_f, hi, dn,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+    match = jnp.sum(eq_f, axis=1, keepdims=True)
+    vals = ((hi_s.astype(jnp.int32) << jnp.int32(16))
+            | lo_s.astype(jnp.int32))
+    return vals, match
 
 
-try:  # import guarded so CPU-only environments can still load the module
+def _kernel(sigma_ref, state_ref, sl_a_ref, sl_b_ref, rw_a_ref, rw_b_ref,
+            out_ref, *, lanes):
+    del lanes  # shapes carry it
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-except Exception:  # noqa: BLE001
-    pl = None
-    pltpu = None
+
+    block = state_ref[...]                       # (T, lanes)
+    t_slot = (jnp.int32(T) * pl.program_id(0)
+              + jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0))
+    eq_a = (sl_a_ref[...] == t_slot).astype(jnp.float32)   # (T, T)
+    eq_b = (sl_b_ref[...] == t_slot).astype(jnp.float32)
+    va, ma = _select_window(eq_a, rw_a_ref)
+    vb, mb = _select_window(eq_b, rw_b_ref)
+    # Windows are disjoint and slots unique: at most one nonzero term.
+    vals = va | vb
+    anym = (ma + mb) > 0.0
+    out_ref[...] = jnp.where(anym, vals, block)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _block_scatter(state, upd, sigma, interpret: bool = False):
-    """state (S, L) i32; upd (B, 1+L) i32 lane0=compacted slot key;
-    sigma (S/T,) i32 aligned window starts (units of T)."""
+def _block_scatter(state, upd_slots, upd_rows_t, sigma,
+                   interpret: bool = False):
+    """state (S, L) i32; upd_slots (1, B) i32 compacted sorted keys;
+    upd_rows_t (L, B) i32 lane-major rows; sigma (S/T,) i32 aligned
+    window starts (units of T)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     s_rows, lanes = state.shape
     grid = s_rows // T
     kernel = functools.partial(_kernel, lanes=lanes)
@@ -90,8 +121,10 @@ def _block_scatter(state, upd, sigma, interpret: bool = False):
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((T, lanes), lambda i, sig: (i, 0)),
-            pl.BlockSpec((T, 1 + lanes), lambda i, sig: (sig[i], 0)),
-            pl.BlockSpec((T, 1 + lanes), lambda i, sig: (sig[i] + 1, 0)),
+            pl.BlockSpec((1, T), lambda i, sig: (0, sig[i])),
+            pl.BlockSpec((1, T), lambda i, sig: (0, sig[i] + 1)),
+            pl.BlockSpec((lanes, T), lambda i, sig: (0, sig[i])),
+            pl.BlockSpec((lanes, T), lambda i, sig: (0, sig[i] + 1)),
         ],
         out_specs=pl.BlockSpec((T, lanes), lambda i, sig: (i, 0)),
     )
@@ -101,7 +134,7 @@ def _block_scatter(state, upd, sigma, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct(state.shape, state.dtype),
         input_output_aliases={1: 0},  # state buffer updated in place
         interpret=interpret,
-    )(sigma, state, upd, upd)
+    )(sigma, state, upd_slots, upd_slots, upd_rows_t, upd_rows_t)
 
 
 def scatter_rows(state, sorted_slots, write_mask, rows,
@@ -115,20 +148,31 @@ def scatter_rows(state, sorted_slots, write_mask, rows,
         interpret = _INTERPRET
     s_rows, lanes = state.shape
     n = sorted_slots.shape[0]
-    key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
-    ops = jax.lax.sort(
-        (key,) + tuple(rows[:, j] for j in range(lanes)), num_keys=1)
-    upd = jnp.stack(ops, axis=1)                 # (B, 1+L), live-first
-    bounds = jnp.arange(s_rows // T, dtype=jnp.int32) * T
-    starts = jnp.searchsorted(ops[0], bounds).astype(jnp.int32)
-    sigma = jnp.clip(starts // T, 0, n // T - 2)
-    return _block_scatter(state, upd, sigma, interpret=interpret)
+    # Trace with 64-bit disabled: every value here is explicit int32, but
+    # under jax_enable_x64 the grid/BlockSpec index plumbing emits i64
+    # index arithmetic that crashes the TPU compiler outright (any
+    # grid-ful pallas_call does, even a block copy — found on v5e).
+    with jax.enable_x64(False):
+        key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
+        ops = jax.lax.sort(
+            (key,) + tuple(rows[:, j] for j in range(lanes)), num_keys=1)
+        upd_slots = ops[0].reshape(1, n)
+        upd_rows_t = jnp.stack(ops[1:], axis=0)  # (L, B), lane-major
+        bounds = jnp.arange(s_rows // T, dtype=jnp.int32) * T
+        starts = jnp.searchsorted(ops[0], bounds).astype(jnp.int32)
+        sigma = jnp.clip(starts // T, 0, n // T - 2)
+        return _block_scatter(state, upd_slots, upd_rows_t, sigma,
+                              interpret=interpret)
 
 
 def supported(state_shape, batch: int) -> bool:
     """Static geometry gate: aligned table, window-coverable batch."""
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+    except Exception:  # noqa: BLE001
+        return False
     s_rows = state_shape[0]
-    return (pl is not None and s_rows % T == 0 and s_rows // T >= 1
+    return (s_rows % T == 0 and s_rows // T >= 1
             and batch >= 2 * T and batch % T == 0)
 
 
@@ -141,7 +185,7 @@ def _probe() -> bool:
             s = jnp.asarray(rng.integers(0, 1 << 30, (2 * T, 3), np.int32))
             slots = np.sort(rng.choice(2 * T, size=2 * T, replace=True))
             mask = np.r_[np.diff(slots) != 0, True]
-            rows = rng.integers(0, 1 << 30, (2 * T, 3), np.int32)
+            rows = rng.integers(-(1 << 30), 1 << 30, (2 * T, 3), np.int32)
             got = np.asarray(scatter_rows(
                 s, jnp.asarray(slots.astype(np.int32)), jnp.asarray(mask),
                 jnp.asarray(rows), interpret=_INTERPRET))
@@ -151,6 +195,19 @@ def _probe() -> bool:
         except Exception:  # noqa: BLE001 — any lowering failure => fallback
             _probe_ok = False
     return _probe_ok
+
+
+def settle() -> bool:
+    """Resolve the support probe eagerly (engine init calls this before
+    any step kernel compiles — a probe firing lazily inside another
+    program's lowering would nest remote compiles).  Respects the
+    RATELIMITER_BLOCK_SCATTER kill switch: disabled means no Pallas
+    compile at all."""
+    if not _FLAG:
+        return False
+    if not (_INTERPRET or jax.default_backend() == "tpu"):
+        return False
+    return _probe()
 
 
 def enabled(state_shape, batch: int) -> bool:

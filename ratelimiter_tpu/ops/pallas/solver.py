@@ -57,6 +57,12 @@ def _ensure_stack() -> None:
     """
     import sys
 
+    if sys.version_info < (3, 12):
+        # Pre-3.12 CPython keeps Python calls on the C stack: a 100K
+        # limit could convert a clean RecursionError (-> XLA fallback
+        # via the probe) into a segfault.  Leave the default; the probe
+        # will fail and the XLA solver serves instead.
+        return
     if sys.getrecursionlimit() < 100000:
         sys.setrecursionlimit(100000)
 
@@ -76,33 +82,39 @@ def _solver_kernel(u_ref, w_ref, segfirst_ref, inc_ref, *, n: int):
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
 
     def seg_cumsum_excl(x):
-        """Saturating segmented inclusive scan minus x (exclusive).
+        """Saturating segmented EXCLUSIVE scan.
 
-        Masked Hillis-Steele: after step k, v[i] holds the (saturated) sum of
-        x over [max(seg_first[i], i - 2^k + 1), i]; values never leave the
-        segment, so magnitudes stay segment-local.
+        The exclusive sum is computed directly — shift x down one lane
+        within its segment, then run the masked Hillis-Steele inclusive
+        scan over the shifted values — so saturation clamps the
+        exclusive prefix itself.  (Deriving it as inclusive-minus-own
+        would UNDERestimate clamped prefixes by the element's own
+        weight, admitting requests a saturated prefix must reject.)
+        Values never leave the segment, so magnitudes stay
+        segment-local.
         """
         import numpy as np
 
         from jax.experimental.pallas import tpu as pltpu
 
-        v = x
+        # Circular roll (a supported Mosaic primitive; concatenate
+        # recurses in lowering).  The wrap-around lanes land at
+        # idx < d, where idx - d < 0 <= seg_first masks them off.
+        # Literals must be explicit 32-bit under jax_enable_x64: a
+        # weak python int turns the shift into an i64 scalar
+        # (tpu.dynamic_rotate verification error) and an i64 `where`
+        # arm sends Mosaic's convert-element-type lowering into
+        # infinite recursion.
+        prev_ok = (idx - 1) >= seg_first
+        v = jnp.where(prev_ok, pltpu.roll(x, np.int32(1), 1), jnp.int32(0))
         d = 1
         while d < n:  # static log2(n) unroll
-            # Circular roll (a supported Mosaic primitive; concatenate
-            # recurses in lowering).  The wrap-around lanes land at
-            # idx < d, where idx - d < 0 <= seg_first masks them off.
-            # Literals must be explicit 32-bit under jax_enable_x64: a
-            # weak python int turns the shift into an i64 scalar
-            # (tpu.dynamic_rotate verification error) and an i64 `where`
-            # arm sends Mosaic's convert-element-type lowering into
-            # infinite recursion.
             shifted = pltpu.roll(v, np.int32(d), 1)
             ok = (idx - d) >= seg_first
             v = jnp.minimum(v + jnp.where(ok, shifted, jnp.int32(0)),
                             jnp.int32(SAT))
             d *= 2
-        return v - x
+        return v
 
     def step(x):
         s = seg_cumsum_excl(jnp.minimum(w * x, SAT))
@@ -172,12 +184,11 @@ _PALLAS_FLAG = os.environ.get("RATELIMITER_PALLAS", "1") == "1"
 # Interpret-mode override so the Pallas path can be exercised on CPU in tests.
 _PALLAS_INTERPRET = os.environ.get("RATELIMITER_PALLAS_INTERPRET", "0") == "1"
 # Single-launch lane ceiling: the log-depth unroll's temporaries grow with
-# lane count and the TPU compiler falls over past 32K lanes (measured on
-# v5e); larger batches take the XLA solver.  The micro-batcher's buckets
-# (<= max_batch 8192) and the synchronous acquire_many latency batches sit
-# comfortably under the ceiling — exactly the traffic the VMEM-resident
-# iteration helps.
-_PALLAS_MAX_LANES = 1 << 15
+# lane count and the TPU compiler falls over past 16K lanes (measured on
+# v5e with the exclusive-scan kernel); larger batches take the XLA solver.
+# The micro-batcher's buckets (<= max_batch 8192) sit comfortably under
+# the ceiling — exactly the traffic the VMEM-resident iteration helps.
+_PALLAS_MAX_LANES = 1 << 14
 _pallas_ok: bool | None = None
 
 
@@ -196,6 +207,17 @@ def _pallas_supported() -> bool:
         except Exception:  # noqa: BLE001 — any lowering failure => fallback
             _pallas_ok = False
     return _pallas_ok
+
+
+def settle() -> bool:
+    """Resolve the support probe eagerly (engine init calls this before
+    any step kernel compiles — a probe firing lazily inside another
+    program's lowering would nest remote compiles).  Respects the
+    RATELIMITER_PALLAS kill switch: disabled means no Pallas compile at
+    all."""
+    if not _PALLAS_FLAG:
+        return False
+    return _pallas_supported()
 
 
 def solve_threshold_recurrence_auto(u, w, first, shift: int = 0):

@@ -62,3 +62,16 @@ def test_pallas_saturation_correct():
     xla, pal = run_both(slots, u, w)
     np.testing.assert_array_equal(xla, pal)
     assert pal[:3].sum() == 3 and pal[3:].sum() == 0
+
+
+def test_pallas_saturated_exclusive_prefix_rejects():
+    # Regression: when the INCLUSIVE prefix clamps at SAT, deriving the
+    # exclusive prefix as inclusive-minus-own would underestimate it by
+    # the element's own (large) weight and wrongly admit.  The exclusive
+    # scan must saturate directly.
+    slots = np.zeros(3, dtype=np.int32)
+    w = np.array([2 ** 29, 6 * 10 ** 8, 1], dtype=np.int64)
+    u = np.array([2 ** 29, 5 * 10 ** 8, 0], dtype=np.int64)
+    xla, pal = run_both(slots, u, w)
+    np.testing.assert_array_equal(xla, pal)
+    np.testing.assert_array_equal(pal, [1, 0, 0])
