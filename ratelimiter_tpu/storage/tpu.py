@@ -49,14 +49,18 @@ from ratelimiter_tpu.storage.memory import InMemoryStorage
 _FLAT_MAX_LANES = 1 << 19
 
 # Relay-path chunking: the first chunk probes the stream's duplicate
-# structure at 1M requests; later chunks grow toward a fixed wire budget
-# per dispatch (digest mode on skewed traffic runs ~0.3-1 B/request, so
-# chunks grow to 16M and the whole pass becomes a couple of dispatches;
-# uniform traffic stays near 2M).  Budget ~= the largest transfer that
-# still moves at full link speed (bench/profile_upload.py).
-_RELAY_CHUNK = 1 << 20
+# structure at the floor size; later chunks size themselves to a
+# per-dispatch wire budget at the measured bytes/request of their mode.
+# Digest chunks grow until the whole pass is a couple of dispatches
+# (dedup improves superlinearly with chunk size); per-request-words
+# chunks sit at the ~4 MB transfer sweet spot (bench/profile_upload.py:
+# mid-size transfers move at better per-byte rates than 16 MB
+# monoliths) — the 512K floor keeps that budget binding even at
+# multi-lid's 8.125 B/request.
+_RELAY_CHUNK = 1 << 19
 _RELAY_CHUNK_MAX = 1 << 24
-_RELAY_WIRE_BUDGET = 8 << 20
+_RELAY_WIRE_BUDGET_DIGEST = 16 << 20
+_RELAY_WIRE_BUDGET_WORDS = 4 << 20
 
 
 def _bucket_pow2(n: int) -> int:
@@ -524,7 +528,9 @@ class TpuBatchedStorage(RateLimitStorage):
             # the fixed per-dispatch latency amortizes away).
             wire_b = digest_bpu * u if digest else words_bpr * cn
             bpr = max(wire_b / cn, 1e-3)
-            chunk = int(min(max(_RELAY_WIRE_BUDGET / bpr, _RELAY_CHUNK),
+            budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
+                      else _RELAY_WIRE_BUDGET_WORDS)
+            chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
                             _RELAY_CHUNK_MAX))
             start += cn
         for item in pending:
@@ -943,7 +949,9 @@ class TpuBatchedStorage(RateLimitStorage):
                 drain(*pending.pop(0))
             wire_b = digest_bpu * u_total if digest else words_bpr * cn
             bpr = max(wire_b / cn, 1e-3)
-            chunk = int(min(max(_RELAY_WIRE_BUDGET / bpr, _RELAY_CHUNK),
+            budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
+                      else _RELAY_WIRE_BUDGET_WORDS)
+            chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
                             _RELAY_CHUNK_MAX))
             start += cn
         for item in pending:
