@@ -28,6 +28,7 @@ import jax
 
 from ratelimiter_tpu.engine.state import SWState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
+from ratelimiter_tpu.ops.scatter import scatter_rows_sorted
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -167,11 +168,11 @@ def sw_step_p(
     samew = ws0 == curr_ws
     cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
 
-    n_slots = packed.shape[0]
-    widx = jnp.where(lastm, sc, n_slots)  # out-of-range -> dropped
     curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
     new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
-    packed_new = packed.at[widx].set(new_rows, mode="drop")
+    # Sorted batch, one surviving write per slot: the shared scatter takes
+    # the Pallas dense block-scatter when the geometry allows.
+    packed_new = scatter_rows_sorted(packed, s, lastm, new_rows)
 
     out = SWOut(
         allowed=unsort(allowed & valid, inv),

@@ -24,6 +24,7 @@ import jax
 from ratelimiter_tpu.core.config import TOKEN_FP_ONE, TOKEN_FP_SHIFT
 from ratelimiter_tpu.engine.state import TBState, TableArrays
 from ratelimiter_tpu.ops.pallas.solver import solve_threshold_recurrence_auto
+from ratelimiter_tpu.ops.scatter import scatter_rows_sorted
 from ratelimiter_tpu.ops.segments import (
     first_occurrence,
     last_occurrence,
@@ -144,10 +145,10 @@ def tb_step_p(
     # skew for clocks that start exactly at 0.
     last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
 
-    n_slots = packed.shape[0]
-    widx = jnp.where(lastm, sc, n_slots)
-    packed_new = packed.at[widx].set(
-        _tb_encode(tokens_new, last_new), mode="drop")
+    # Sorted batch, one surviving write per slot: the shared scatter takes
+    # the Pallas dense block-scatter when the geometry allows.
+    packed_new = scatter_rows_sorted(
+        packed, s, lastm, _tb_encode(tokens_new, last_new))
 
     out = TBOut(
         allowed=unsort(allowed & valid, inv),

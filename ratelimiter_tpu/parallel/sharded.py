@@ -335,6 +335,16 @@ class ShardedDeviceEngine:
         self.sw_packed = zeros(6)
         self.tb_packed = zeros(4)
 
+        # Settle the Pallas probes before any shard_map step compiles
+        # (same reason as DeviceEngine: a probe firing lazily inside
+        # another program's lowering nests a remote compile some
+        # toolchains cannot serve, sticking as a permanent fallback).
+        if jax.default_backend() == "tpu":
+            from ratelimiter_tpu.ops.pallas import block_scatter
+            from ratelimiter_tpu.ops.pallas import solver as pallas_solver
+
+            block_scatter.settle()
+            pallas_solver.settle()
         self._sw_step = jax.jit(build_sharded_sw_step(self.mesh), donate_argnums=0)
         self._tb_step = jax.jit(build_sharded_tb_step(self.mesh), donate_argnums=0)
         self._sw_peek = jax.jit(build_sharded_peek(self.mesh, sw_peek_p))
