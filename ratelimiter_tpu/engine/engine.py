@@ -112,13 +112,9 @@ class DeviceEngine:
         # a probe firing lazily inside another program's lowering nests a
         # second remote compile on toolchains that cannot serve one, and
         # the resulting failure would stick as a permanent fallback.
-        # settle() honors each module's kill switch.
-        if jax.default_backend() == "tpu":
-            from ratelimiter_tpu.ops.pallas import block_scatter
-            from ratelimiter_tpu.ops.pallas import solver as pallas_solver
+        from ratelimiter_tpu.ops import pallas as pallas_kernels
 
-            block_scatter.settle()
-            pallas_solver.settle()
+        pallas_kernels.settle_all()
         self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
         self._tb_reset = jax.jit(tb_reset_p, donate_argnums=0)
 

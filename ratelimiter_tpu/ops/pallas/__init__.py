@@ -1,0 +1,23 @@
+"""Pallas TPU kernels (probe-gated, XLA fallbacks, decisions identical)."""
+
+
+def settle_all() -> None:
+    """Resolve every kernel's support probe eagerly.
+
+    Engines call this at init, before any step kernel compiles: a probe
+    firing lazily inside another program's lowering nests a remote
+    compile some toolchains cannot serve, and the resulting failure
+    would stick as a permanent silent fallback.  Each module's settle()
+    honors its own kill switch, and both no-op off-TPU (the interpret
+    overrides still probe lazily by design — interpret lowering nests
+    fine).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return
+    from ratelimiter_tpu.ops.pallas import block_scatter
+    from ratelimiter_tpu.ops.pallas import solver
+
+    block_scatter.settle()
+    solver.settle()

@@ -2,9 +2,10 @@
 
 The host slot index already walks every request of a batch in arrival
 order to assign slots, so it can ALSO hand the device each request's
-within-batch duplicate rank and a last-occurrence flag for free
-(native/slot_index.cpp:assign_batch_words — O(1) extra work per request,
-epoch-tagged per-slot scratch).  With unit permits the whole threshold
+within-batch duplicate rank and each unique slot's segment count for
+free (native/slot_index.cpp:assign_batch_uniques — O(1) extra work per
+request, epoch-tagged per-slot scratch; :func:`rebuild_words` turns
+that digest output into the per-request word stream when needed).  With unit permits the whole threshold
 recurrence of the sorted step (ops/flat.py) has a closed form in that
 rank: within a segment every request carries the same weight and
 threshold, so request j passes iff ``rank_j < avail`` and the slot's

@@ -339,12 +339,9 @@ class ShardedDeviceEngine:
         # (same reason as DeviceEngine: a probe firing lazily inside
         # another program's lowering nests a remote compile some
         # toolchains cannot serve, sticking as a permanent fallback).
-        if jax.default_backend() == "tpu":
-            from ratelimiter_tpu.ops.pallas import block_scatter
-            from ratelimiter_tpu.ops.pallas import solver as pallas_solver
+        from ratelimiter_tpu.ops import pallas as pallas_kernels
 
-            block_scatter.settle()
-            pallas_solver.settle()
+        pallas_kernels.settle_all()
         self._sw_step = jax.jit(build_sharded_sw_step(self.mesh), donate_argnums=0)
         self._tb_step = jax.jit(build_sharded_tb_step(self.mesh), donate_argnums=0)
         self._sw_peek = jax.jit(build_sharded_peek(self.mesh, sw_peek_p))

@@ -1,6 +1,6 @@
 """Differential tests for the relay steps (ops/relay.py) and the native
-index's duplicate-structure outputs (native/slot_index.cpp:
-assign_batch_words / assign_batch_uniques).
+index's duplicate-structure outputs
+(native/slot_index.cpp:assign_batch_uniques).
 
 The relay paths must decide exactly like the sorted flat step on the
 same batch and leave identical device state — that equivalence is what
@@ -230,6 +230,46 @@ def test_stream_relay_modes_match_batch_path(monkeypatch, force_mode):
         now[0] += 237
     st_a.close()
     st_b.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_stream_relay_soak_vs_oracle(algo):
+    """Randomized multi-pass soak: the relay stream (mode elected per
+    chunk) against the executable oracle, with duplicate-heavy traffic,
+    window rolls, refills, and resets between passes."""
+    import random
+
+    from ratelimiter_tpu.semantics import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [3_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+        oracle = SlidingWindowOracle(cfg)
+    else:
+        cfg = RateLimitConfig(max_permits=8, window_ms=1500,
+                              refill_rate=5.0)
+        oracle = TokenBucketOracle(cfg)
+    lid = st.register_limiter(algo, cfg)
+    rng = np.random.default_rng(77)
+    pyrng = random.Random(77)
+    for step in range(12):
+        now[0] += pyrng.randrange(0, 900)
+        ids = rng.integers(0, 30, 400)
+        got = st.acquire_stream_ids(algo, lid, ids, None)
+        for j, k in enumerate(ids):
+            want = oracle.try_acquire(f"id:{k}", 1, now[0]).allowed
+            assert got[j] == want, (algo, step, j)
+        if pyrng.random() < 0.3:
+            k = int(pyrng.choice(list(ids)))
+            st.reset_key(algo, lid, k)  # int user key, same namespace
+            oracle.reset(f"id:{k}", now[0])
+    st.close()
 
 
 @pytest.mark.parametrize("force_mode", ["digest", "bits"])
