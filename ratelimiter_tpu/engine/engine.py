@@ -106,6 +106,13 @@ class DeviceEngine:
         self._tb_relay = jax.jit(functools.partial(
             tb_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
         self._relay_counts = {}  # (algo, out_dtype name) -> jitted step
+        # Resident tenant-id map per algo (ops/relay.py:*_relay_counts_
+        # resident): one slot = one (limiter, key), so a slot's lid is
+        # immutable while assigned; the digest-multi path uploads only
+        # the deltas and reads policies from this array.
+        self.sw_lid_map = jnp.zeros(self.num_slots, dtype=jnp.int32)
+        self.tb_lid_map = jnp.zeros(self.num_slots, dtype=jnp.int32)
+        self._relay_resident = {}  # (algo, out_dtype name) -> jitted step
         self._sw_peek = jax.jit(sw_peek_p)
         self._tb_peek = jax.jit(tb_peek_p)
         # Settle the Pallas probes NOW, before any step kernel compiles:
@@ -307,6 +314,55 @@ class DeviceEngine:
     def tb_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
         return self._relay_counts_dispatch("tb", uwords, lids, now_ms,
                                            out_dtype)
+
+    def sw_relay_counts_resident_dispatch(self, uwords, delta_slots,
+                                          delta_lids, now_ms, out_dtype):
+        return self._relay_resident_dispatch("sw", uwords, delta_slots,
+                                             delta_lids, now_ms, out_dtype)
+
+    def tb_relay_counts_resident_dispatch(self, uwords, delta_slots,
+                                          delta_lids, now_ms, out_dtype):
+        return self._relay_resident_dispatch("tb", uwords, delta_slots,
+                                             delta_lids, now_ms, out_dtype)
+
+    def _relay_resident_dispatch(self, algo, uwords, delta_slots, delta_lids,
+                                 now_ms, out_dtype):
+        """Digest dispatch with device-resident lids: uwords uint32[U];
+        delta (slot, lid) i32 pairs for slots whose lid the device doesn't
+        know yet (padding slot = -1).  Returns the lazy counts handle."""
+        from ratelimiter_tpu.ops.relay import (
+            sw_relay_counts_resident,
+            tb_relay_counts_resident,
+        )
+
+        jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
+        key = (algo, out_dtype().dtype.name)
+        fn = self._relay_resident.get(key)
+        if fn is None:
+            base = (sw_relay_counts_resident if algo == "sw"
+                    else tb_relay_counts_resident)
+            fn = jax.jit(functools.partial(
+                base, rank_bits=self.rank_bits, out_dtype=jdt),
+                donate_argnums=(0, 1))
+            self._relay_resident[key] = fn
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        delta_slots = jnp.asarray(
+            np.ascontiguousarray(delta_slots, dtype=np.int32))
+        delta_lids = jnp.asarray(
+            np.ascontiguousarray(delta_lids, dtype=np.int32))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, self.sw_lid_map, counts = fn(
+                    self.sw_packed, self.sw_lid_map,
+                    self.table.device_arrays, uwords, delta_slots,
+                    delta_lids, now)
+            else:
+                self.tb_packed, self.tb_lid_map, counts = fn(
+                    self.tb_packed, self.tb_lid_map,
+                    self.table.device_arrays, uwords, delta_slots,
+                    delta_lids, now)
+        return counts
 
     def _relay_counts_dispatch(self, algo, uwords, lids, now_ms, out_dtype):
         """uwords uint32[U] (slot | clamped count; padding 0xFFFFFFFF);

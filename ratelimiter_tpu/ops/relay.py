@@ -81,12 +81,16 @@ def counts_dtype(max_permits_registered: int):
     return None
 
 
-def wire_costs(multi_lid: bool):
+def wire_costs(multi_lid: bool, resident_lids: bool = False):
     """(bytes per unique in digest mode, bytes per request in words mode)
     — the constants both stream loops use to elect a mode and to grow
-    chunks toward the wire budget.  Digest: 4B uword + 1-2B count back
-    (+4B lid lane when multi); words: 4B word + bits back (+4B lid)."""
-    return (10.0, 8.125) if multi_lid else (6.0, 4.125)
+    chunks toward the wire budget.  Digest: 4B uword + 1-2B count back,
+    plus a 4B per-unique lid lane for multi-tenant callers that don't
+    keep lids device-resident (the single-device loop does — its deltas
+    are charged separately; the sharded loop ships the lane).  Words
+    mode: 4B word + bits back (+4B lid lane when multi)."""
+    digest = 6.0 if (not multi_lid or resident_lids) else 10.0
+    return digest, (8.125 if multi_lid else 4.125)
 
 
 def rebuild_words(uwords, uidx, rank, rank_bits: int):
@@ -241,6 +245,46 @@ def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
     packed_new = packed.at[widx].set(new_rows, mode="drop")
     lim = jnp.int64(jnp.iinfo(out_dtype).max)
     return packed_new, jnp.clip(n_pass, 0, lim).astype(out_dtype)
+
+
+def tb_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
+                             delta_lids, now, *, rank_bits: int,
+                             out_dtype=jnp.uint8):
+    """Digest step with the tenant ids RESIDENT on device.
+
+    One slot is one (limiter, key) pair, so a slot's lid is immutable
+    while assigned — the host uploads (slot, lid) pairs only for slots
+    whose lid the device doesn't know yet (fresh assignments and
+    post-eviction reuse), and the step folds that delta into ``lid_map``
+    before deciding.  Steady-state multi-tenant wire cost drops from
+    10 B/unique to ~5 (no per-unique lid lane).
+    """
+    lid_map = lid_map.at[jnp.where(delta_slots >= 0, delta_slots,
+                                   lid_map.shape[0])].set(
+        delta_lids, mode="drop")
+    num_slots = packed.shape[0]
+    slot, _, _, valid = decode_words(uwords, rank_bits, num_slots)
+    lids = lid_map[jnp.where(valid, slot, 0)]
+    packed_new, counts = tb_relay_counts(
+        packed, table, uwords, lids, now, rank_bits=rank_bits,
+        out_dtype=out_dtype)
+    return packed_new, lid_map, counts
+
+
+def sw_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
+                             delta_lids, now, *, rank_bits: int,
+                             out_dtype=jnp.uint8):
+    """Sliding-window counterpart of :func:`tb_relay_counts_resident`."""
+    lid_map = lid_map.at[jnp.where(delta_slots >= 0, delta_slots,
+                                   lid_map.shape[0])].set(
+        delta_lids, mode="drop")
+    num_slots = packed.shape[0]
+    slot, _, _, valid = decode_words(uwords, rank_bits, num_slots)
+    lids = lid_map[jnp.where(valid, slot, 0)]
+    packed_new, counts = sw_relay_counts(
+        packed, table, uwords, lids, now, rank_bits=rank_bits,
+        out_dtype=out_dtype)
+    return packed_new, lid_map, counts
 
 
 def sw_relay_bits(packed, table, words, lids, now, *, rank_bits: int):

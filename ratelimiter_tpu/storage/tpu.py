@@ -171,6 +171,13 @@ class TpuBatchedStorage(RateLimitStorage):
             return index
 
         self._index = {"sw": make_index(), "tb": make_index()}
+        # Host mirror of which slots' lids the device lid map knows
+        # (per algo, allocated on first digest-multi stream).
+        self._lid_known: Dict[str, np.ndarray] = {}
+        # Serializes _lid_known reads/marks + their dispatch against
+        # _clear_slots (clear-wins: an eviction concurrent with a mark
+        # must leave known=False so the lid is re-uploaded).
+        self._lid_lock = threading.Lock()
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
         from ratelimiter_tpu.utils.tracing import DecisionTrace
 
@@ -221,8 +228,8 @@ class TpuBatchedStorage(RateLimitStorage):
                 "tb": _drainer("tb", self.engine.tb_acquire_drain),
             },
             clear={
-                "sw": self.engine.sw_clear,
-                "tb": self.engine.tb_clear,
+                "sw": lambda slots: self._clear_slots("sw", slots),
+                "tb": lambda slots: self._clear_slots("tb", slots),
             },
             max_batch=max_batch,
             max_delay_ms=max_delay_ms,
@@ -461,12 +468,14 @@ class TpuBatchedStorage(RateLimitStorage):
         eng = self.engine
         rb = eng.rank_bits
         cdt = eng.counts_dtype()
-        digest_bpu, words_bpr = wire_costs(multi_lid)
+        digest_bpu, words_bpr = wire_costs(multi_lid,
+                                           resident_lids=True)
         bits_dispatch = (eng.sw_relay_dispatch if algo == "sw"
                          else eng.tb_relay_dispatch)
         counts_dispatch = (eng.sw_relay_counts_dispatch if algo == "sw"
                            else eng.tb_relay_counts_dispatch)
-        clear = (eng.sw_clear if algo == "sw" else eng.tb_clear)
+        def clear(slots):
+            self._clear_slots(algo, slots)
         out = np.empty(n, dtype=bool)
         pending: list[tuple] = []
 
@@ -492,24 +501,59 @@ class TpuBatchedStorage(RateLimitStorage):
                 clear(list(clears))
             u = len(uwords)
             l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            # Pick the smaller wire cost (ops/relay.py:wire_costs).
-            digest = cdt is not None and digest_bpu * u <= words_bpr * cn
+            # Mode election on the REAL wire cost: for multi-tenant
+            # digest the per-unique cost is the resident steady state
+            # PLUS this chunk's actual (slot, lid) delta uploads, so a
+            # churn-heavy stream whose uniques are mostly fresh falls
+            # back to words mode instead of paying 14 B/request.
+            fresh = None
+            n_delta = 0
+            if cdt is not None and multi_lid:
+                known = self._lid_known.setdefault(
+                    algo, np.zeros(eng.num_slots, dtype=bool))
+                uslots = (uwords >> np.uint32(rb + 1)).astype(np.int64)
+                with self._lid_lock:
+                    fresh = ~known[uslots]
+                n_delta = int(fresh.sum())
+            digest = cdt is not None and (
+                digest_bpu * u + 8 * n_delta <= words_bpr * cn)
             now = self._monotonic_now()
             t0 = time.perf_counter()
             if digest:
                 size = _bucket_pow2(u)
                 uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
                 if multi_lid:
-                    # Per-unique lids mapped through uidx (NOT positional:
-                    # a partitioned index merges uniques partition-major,
-                    # not in first-appearance order).
+                    # Tenant ids live RESIDENT on device (a slot's lid is
+                    # immutable while assigned): upload only the (slot,
+                    # lid) pairs the device doesn't know yet — fresh
+                    # assignments and post-eviction reuse, tracked in
+                    # _lid_known and invalidated by _clear_slots.  Per-
+                    # unique lids map through uidx (NOT positional: a
+                    # partitioned index merges uniques partition-major).
+                    from ratelimiter_tpu.parallel.sharded import _bucket
+
                     first = rank == 0
                     ulids = np.zeros(u, dtype=np.int32)
                     ulids[uidx[first]] = l_chunk[first]
-                    lid_lane = _pad_tail(ulids, size, 0, np.int32)
+                    # Re-read fresh, mark, and dispatch under the lock
+                    # shared with _clear_slots: an eviction racing the
+                    # mark must win (forcing a later re-upload), never
+                    # lose to a stale known=True.
+                    with self._lid_lock:
+                        fresh = ~known[uslots]
+                        n_delta = int(fresh.sum())
+                        dsize = _bucket(max(n_delta, 1), floor=256)
+                        d_slots = _pad_tail(uslots[fresh], dsize, -1,
+                                            np.int32)
+                        d_lids = _pad_tail(ulids[fresh], dsize, 0,
+                                           np.int32)
+                        known[uslots[fresh]] = True
+                        resident = (eng.sw_relay_counts_resident_dispatch
+                                    if algo == "sw"
+                                    else eng.tb_relay_counts_resident_dispatch)
+                        counts = resident(uw, d_slots, d_lids, now, cdt)
                 else:
-                    lid_lane = lid
-                counts = counts_dispatch(uw, lid_lane, now, cdt)
+                    counts = counts_dispatch(uw, lid, now, cdt)
                 pending.append(
                     ("digest", counts, start, cn, (uidx, rank, u), t0))
             else:
@@ -526,7 +570,8 @@ class TpuBatchedStorage(RateLimitStorage):
             # measured bytes/request (skewed streams compact hard in
             # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
             # the fixed per-dispatch latency amortizes away).
-            wire_b = digest_bpu * u if digest else words_bpr * cn
+            wire_b = (digest_bpu * u + 8 * n_delta if digest
+                      else words_bpr * cn)
             bpr = max(wire_b / cn, 1e-3)
             budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
                       else _RELAY_WIRE_BUDGET_WORDS)
@@ -573,7 +618,8 @@ class TpuBatchedStorage(RateLimitStorage):
         else:
             dispatch = (eng.sw_flat_dispatch if algo == "sw"
                         else eng.tb_flat_dispatch)
-        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+        def clear(slots):
+            self._clear_slots(algo, slots)
         # When every permit in the stream fits a byte (the common case —
         # permits above max_permits are pointless), the permits lane ships
         # as uint8: 5 B/request on the wire instead of 8.  The device step
@@ -734,7 +780,8 @@ class TpuBatchedStorage(RateLimitStorage):
                       (_FLAT_MAX_LANES // 2) * n_sh)
         dispatch = (eng.sw_flat_sharded_dispatch if algo == "sw"
                     else eng.tb_flat_sharded_dispatch)
-        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+        def clear(slots):
+            self._clear_slots(algo, slots)
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
         pending: list = []
@@ -834,7 +881,8 @@ class TpuBatchedStorage(RateLimitStorage):
         counts_dispatch = (eng.sw_relay_counts_sharded_dispatch
                            if algo == "sw"
                            else eng.tb_relay_counts_sharded_dispatch)
-        clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+        def clear(slots):
+            self._clear_slots(algo, slots)
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
         pending: list[tuple] = []
@@ -1000,14 +1048,27 @@ class TpuBatchedStorage(RateLimitStorage):
         slot = index.get((lid, key))
         if slot is None:
             return
-        if algo == "sw":
-            self.engine.sw_clear([slot])
-        else:
-            self.engine.tb_clear([slot])
+        self._clear_slots(algo, [slot])
         index.remove((lid, key))
 
     def flush(self) -> None:
         self._batcher.flush()
+
+    def _clear_slots(self, algo: str, slots) -> None:
+        """Single choke point for zeroing evicted/reset slots.
+
+        Besides the device-state clear, it invalidates the host's record
+        of which slots' tenant ids the device lid map knows — a cleared
+        slot can be reassigned to a different (lid, key), so its resident
+        lid must be re-uploaded on next digest use."""
+        if not len(slots):
+            return
+        with self._lid_lock:
+            (self.engine.sw_clear if algo == "sw"
+             else self.engine.tb_clear)(list(slots))
+            known = self._lid_known.get(algo)
+            if known is not None:
+                known[np.asarray(slots, dtype=np.int64)] = False
 
     def _record_dispatch(self, algo: str, n: int, allowed: int,
                          dt_us: float) -> None:
@@ -1034,6 +1095,9 @@ class TpuBatchedStorage(RateLimitStorage):
         self._batcher.flush()
         ckpt.restore_engine_state(self.engine, data)
         ckpt.restore_slot_indexes(self, data["meta"]["index"])
+        # The device lid map is not checkpointed: forget what the device
+        # "knows" so the next digest-multi dispatch re-uploads lids.
+        self._lid_known.clear()
 
     def export_keys(self) -> Dict:
         """Geometry-free export of all live per-key state (the rebalance
@@ -1050,6 +1114,7 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._batcher.flush()
         ckpt.import_keys(self, dump)
+        self._lid_known.clear()  # imported slots carry unknown lids
 
     # ------------------------------------------------------------------------
     # Legacy 10-method contract (host-side, embedded InMemoryStorage)

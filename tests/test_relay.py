@@ -272,6 +272,50 @@ def test_stream_relay_soak_vs_oracle(algo):
     st.close()
 
 
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_resident_lid_map_survives_eviction_churn(monkeypatch, algo):
+    """Multi-tenant digest with device-resident lids: a slot evicted and
+    reassigned to a key of a DIFFERENT tenant must get its new lid
+    re-uploaded (tracked by _lid_known, invalidated via _clear_slots) —
+    decisions must match the chunked batch path exactly throughout."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [2_000_000]
+    # Tiny slot table so the stream constantly evicts and reassigns.
+    st_a = TpuBatchedStorage(num_slots=32, clock_ms=lambda: now[0])
+    st_b = TpuBatchedStorage(num_slots=32, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfgs = [RateLimitConfig(max_permits=3 + i, window_ms=1000,
+                                enable_local_cache=False) for i in range(3)]
+    else:
+        cfgs = [RateLimitConfig(max_permits=3 + i, window_ms=1000,
+                                refill_rate=2.0 + i) for i in range(3)]
+    lids_a = np.asarray([st_a.register_limiter(algo, c) for c in cfgs])
+    lids_b = np.asarray([st_b.register_limiter(algo, c) for c in cfgs])
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 64)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 64)
+    rng = np.random.default_rng(5)
+    for rep in range(6):
+        # 24 live (lid,key) pairs per rep, window sliding by 8 each rep:
+        # old pairs evict (32-slot table) and their slots get reassigned
+        # to pairs of OTHER tenants across reps — the lid re-upload path.
+        pairs = rng.integers(rep * 8, rep * 8 + 24, 256)
+        ids = pairs
+        tl = pairs % 3
+        a = st_a.acquire_stream_ids(algo, lids_a[tl], ids, None)
+        res = np.empty(256, dtype=bool)
+        for i in range(0, 256, 64):
+            chunk_lids = lids_b[tl[i:i + 64]]
+            got = st_b.acquire_stream_ids(
+                algo, chunk_lids, ids[i:i + 64], np.ones(64, np.int64))
+            res[i:i + 64] = got
+        np.testing.assert_array_equal(a, res, err_msg=f"rep {rep}")
+        now[0] += 173
+    st_a.close()
+    st_b.close()
+
+
 @pytest.mark.parametrize("force_mode", ["digest", "bits"])
 @pytest.mark.parametrize("multi_lid", [False, True])
 def test_sharded_relay_matches_single_device(monkeypatch, force_mode,
