@@ -617,4 +617,25 @@ void rl_index_unpin(void* h, int32_t slot) {
   if (slot >= 0 && slot < ix->num_slots && ix->pins[slot] > 0) ix->pins[slot]--;
 }
 
+// Batch pin/unpin (refcounted, duplicates fine): streams hold these from
+// slot assignment until their device dispatch is enqueued, so concurrent
+// scalar traffic can never evict-and-clear a slot that an in-preparation
+// batch is about to write (the reverse direction — queued micro-batcher
+// slots vs stream assigns — is covered by the per-call pinned set).
+void rl_index_pin_batch(void* h, const int32_t* slots, int64_t n) {
+  Index* ix = static_cast<Index*>(h);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = slots[i];
+    if (s >= 0 && s < ix->num_slots) ix->pins[s]++;
+  }
+}
+
+void rl_index_unpin_batch(void* h, const int32_t* slots, int64_t n) {
+  Index* ix = static_cast<Index*>(h);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = slots[i];
+    if (s >= 0 && s < ix->num_slots && ix->pins[s] > 0) ix->pins[s]--;
+  }
+}
+
 }  // extern "C"

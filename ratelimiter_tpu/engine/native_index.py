@@ -107,6 +107,10 @@ def _bind(lib) -> None:
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
     lib.rl_index_pin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.rl_index_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.rl_index_pin_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.rl_index_unpin_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
     lib.rl_index_dump.restype = ctypes.c_int64
     lib.rl_index_dump.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
@@ -247,7 +251,8 @@ class NativeSlotIndex:
         return None if slot < 0 else slot
 
     def assign(
-        self, key: Hashable, pinned: Optional[Set[int]] = None
+        self, key: Hashable, pinned: Optional[Set[int]] = None,
+        hold_pin: bool = False
     ) -> Tuple[int, Optional[int]]:
         seed, user = _split_key(key)
         out_slot = np.empty(1, dtype=np.int32)
@@ -266,6 +271,8 @@ class NativeSlotIndex:
                     self._h, data.ctypes.data if len(user) else 0,
                     offs.ctypes.data, 1, seed,
                     out_slot.ctypes.data, out_ev.ctypes.data)
+            if hold_pin and out_slot[0] >= 0:
+                self._lib.rl_index_pin(self._h, int(out_slot[0]))
         if out_ev[0] == -2:
             raise RuntimeError("all slots pinned; increase num_slots or flush")
         evicted = int(out_ev[0]) if out_ev[0] >= 0 else None
@@ -286,10 +293,14 @@ class NativeSlotIndex:
 
     # -- vectorized interface -------------------------------------------------
     def assign_batch_ints(self, keys: np.ndarray, lid: int,
-                          pinned: Optional[Set[int]] = None):
+                          pinned: Optional[Set[int]] = None,
+                          hold_pins: bool = False):
         """Assign slots for an int64 key batch in one C call.
         ``pinned`` slots (queued async requests) are never evicted.
-        Returns (slots i32[n], evictions i32[k])."""
+        ``hold_pins`` pins the returned slots ATOMICALLY with the
+        assignment (same lock hold) — the caller must ``unpin_batch``
+        them once its dispatch is enqueued.  Returns (slots i32[n],
+        evictions i32[k])."""
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         n = len(keys)
         out_slots = np.empty(n, dtype=np.int32)
@@ -298,12 +309,16 @@ class NativeSlotIndex:
             self._lib.rl_index_assign_ints(
                 self._h, keys.ctypes.data, n, int(lid),
                 out_slots.ctypes.data, out_ev.ctypes.data)
+            if hold_pins:
+                self._lib.rl_index_pin_batch(
+                    self._h, out_slots.ctypes.data, n)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
     def assign_batch_ints_multi(self, keys: np.ndarray, lids: np.ndarray,
-                                pinned: Optional[Set[int]] = None):
+                                pinned: Optional[Set[int]] = None,
+                                hold_pins: bool = False):
         """Assign slots for an int64 key batch with per-request limiter ids
         in one C call.  Same key namespace as per-lid assign_batch_ints —
         (lid, key) maps to the same slot whichever path touches it first."""
@@ -316,9 +331,27 @@ class NativeSlotIndex:
             self._lib.rl_index_assign_ints_multi(
                 self._h, keys.ctypes.data, seeds.ctypes.data, n,
                 out_slots.ctypes.data, out_ev.ctypes.data)
+            if hold_pins:
+                self._lib.rl_index_pin_batch(
+                    self._h, out_slots.ctypes.data, n)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
+
+    # -- held pins (streams: assign -> dispatch-enqueue window) ---------------
+    def pin_batch(self, slots: np.ndarray) -> None:
+        """Refcounted pins (duplicates fine) held across a dispatch-prep
+        window so concurrent assigns can't evict these slots."""
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        with self._lock:
+            self._lib.rl_index_pin_batch(self._h, slots.ctypes.data,
+                                         len(slots))
+
+    def unpin_batch(self, slots: np.ndarray) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        with self._lock:
+            self._lib.rl_index_unpin_batch(self._h, slots.ctypes.data,
+                                           len(slots))
 
     # -- uniques interface (the relay streaming path; ops/relay.py) -----------
     # One uint32 per UNIQUE slot of the batch — (slot | clamped segment
@@ -329,7 +362,8 @@ class NativeSlotIndex:
 
     def assign_batch_ints_uniques(self, keys: np.ndarray, lid: int,
                                   rank_bits: int,
-                                  pinned: Optional[Set[int]] = None):
+                                  pinned: Optional[Set[int]] = None,
+                                  hold_pins: bool = False):
         """Unique-compaction assign (segment-digest path): returns
         (uwords uint32[u], uidx i32[n], rank i32[n], evictions).  uwords
         carries (slot | clamped-count) per unique in first-appearance
@@ -345,13 +379,19 @@ class NativeSlotIndex:
                 self._h, keys.ctypes.data, n, int(lid), int(rank_bits),
                 uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
                 out_ev.ctypes.data)
+            if hold_pins:
+                uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
+                    np.int32)
+                self._lib.rl_index_pin_batch(
+                    self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
     def assign_batch_ints_multi_uniques(self, keys: np.ndarray,
                                         lids: np.ndarray, rank_bits: int,
-                                        pinned: Optional[Set[int]] = None):
+                                        pinned: Optional[Set[int]] = None,
+                                        hold_pins: bool = False):
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         seeds = np.ascontiguousarray(lids, dtype=np.uint64)
         n = len(keys)
@@ -364,12 +404,18 @@ class NativeSlotIndex:
                 self._h, keys.ctypes.data, seeds.ctypes.data, n,
                 int(rank_bits), uwords.ctypes.data, uidx.ctypes.data,
                 rank.ctypes.data, out_ev.ctypes.data)
+            if hold_pins:
+                uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
+                    np.int32)
+                self._lib.rl_index_pin_batch(
+                    self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
-                                  pinned: Optional[Set[int]] = None):
+                                  pinned: Optional[Set[int]] = None,
+                                  hold_pins: bool = False):
         packed, offs = _pack_str_keys(keys)
         n = len(keys)
         uwords = np.empty(n, dtype=np.uint32)
@@ -382,6 +428,11 @@ class NativeSlotIndex:
                 offs.ctypes.data, n, int(lid), int(rank_bits),
                 uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
                 out_ev.ctypes.data)
+            if hold_pins:
+                uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
+                    np.int32)
+                self._lib.rl_index_pin_batch(
+                    self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
@@ -446,7 +497,8 @@ class NativeSlotIndex:
         return out_slots, out_ev[out_ev >= 0]
 
     def assign_batch_strs(self, keys, lid: int,
-                          pinned: Optional[Set[int]] = None):
+                          pinned: Optional[Set[int]] = None,
+                          hold_pins: bool = False):
         """Assign slots for a string key batch in one C call."""
         packed, offs = _pack_str_keys(keys)
         n = len(keys)
@@ -457,6 +509,9 @@ class NativeSlotIndex:
                 self._h, packed.ctypes.data if len(packed) else 0,
                 offs.ctypes.data, n, int(lid),
                 out_slots.ctypes.data, out_ev.ctypes.data)
+            if hold_pins:
+                self._lib.rl_index_pin_batch(
+                    self._h, out_slots.ctypes.data, n)
         if (out_ev == -2).any():
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]

@@ -77,11 +77,12 @@ class PartitionedSlotIndex:
         return None if local is None else p * self.slots_per_part + local
 
     def assign(self, key: Hashable,
-               pinned: Optional[Set[int]] = None) -> Tuple[int, Optional[int]]:
+               pinned: Optional[Set[int]] = None,
+               hold_pin: bool = False) -> Tuple[int, Optional[int]]:
         p = _part_of_key(key, self.n_parts)
         base = p * self.slots_per_part
         local, evicted = self._parts[p].assign(
-            key, pinned=self._local_pins(pinned, p))
+            key, pinned=self._local_pins(pinned, p), hold_pin=hold_pin)
         return base + local, None if evicted is None else base + evicted
 
     def remove(self, key: Hashable) -> Optional[int]:
@@ -148,12 +149,13 @@ class PartitionedSlotIndex:
         return parts_pos, [None if f is None else f.result() for f in futs]
 
     def assign_batch_ints(self, keys: np.ndarray, lid: int,
-                          pinned: Optional[Set[int]] = None):
+                          pinned: Optional[Set[int]] = None,
+                          hold_pins: bool = False):
         keys = np.ascontiguousarray(keys, dtype=np.int64)
 
         def run(p, pos, pins):
-            return self._parts[p].assign_batch_ints(keys[pos], lid,
-                                                    pinned=pins)
+            return self._parts[p].assign_batch_ints(
+                keys[pos], lid, pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel(keys, pinned, run)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
@@ -161,13 +163,14 @@ class PartitionedSlotIndex:
         return slots, np.asarray(clears, dtype=np.int32)
 
     def assign_batch_ints_multi(self, keys: np.ndarray, lids: np.ndarray,
-                                pinned: Optional[Set[int]] = None):
+                                pinned: Optional[Set[int]] = None,
+                                hold_pins: bool = False):
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         lids = np.ascontiguousarray(lids, dtype=np.uint64)
 
         def run(p, pos, pins):
             return self._parts[p].assign_batch_ints_multi(
-                keys[pos], lids[pos], pinned=pins)
+                keys[pos], lids[pos], pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel(keys, pinned, run)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
@@ -176,12 +179,14 @@ class PartitionedSlotIndex:
 
     def assign_batch_ints_uniques(self, keys: np.ndarray, lid: int,
                                   rank_bits: int,
-                                  pinned: Optional[Set[int]] = None):
+                                  pinned: Optional[Set[int]] = None,
+                                  hold_pins: bool = False):
         keys = np.ascontiguousarray(keys, dtype=np.int64)
 
         def run(p, pos, pins):
             return self._parts[p].assign_batch_ints_uniques(
-                keys[pos], lid, rank_bits, pinned=pins)
+                keys[pos], lid, rank_bits, pinned=pins,
+                hold_pins=hold_pins)
 
         parts_pos, results = self._parallel(keys, pinned, run)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
@@ -189,13 +194,15 @@ class PartitionedSlotIndex:
 
     def assign_batch_ints_multi_uniques(self, keys: np.ndarray,
                                         lids: np.ndarray, rank_bits: int,
-                                        pinned: Optional[Set[int]] = None):
+                                        pinned: Optional[Set[int]] = None,
+                                        hold_pins: bool = False):
         keys = np.ascontiguousarray(keys, dtype=np.int64)
         lids = np.ascontiguousarray(lids, dtype=np.uint64)
 
         def run(p, pos, pins):
             return self._parts[p].assign_batch_ints_multi_uniques(
-                keys[pos], lids[pos], rank_bits, pinned=pins)
+                keys[pos], lids[pos], rank_bits, pinned=pins,
+                hold_pins=hold_pins)
 
         parts_pos, results = self._parallel(keys, pinned, run)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
@@ -221,9 +228,11 @@ class PartitionedSlotIndex:
         return parts_pos, [None if f is None else f.result() for f in futs]
 
     def assign_batch_strs(self, keys, lid: int,
-                          pinned: Optional[Set[int]] = None):
+                          pinned: Optional[Set[int]] = None,
+                          hold_pins: bool = False):
         def run(p, sub, pins):
-            return self._parts[p].assign_batch_strs(sub, lid, pinned=pins)
+            return self._parts[p].assign_batch_strs(
+                sub, lid, pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
@@ -231,10 +240,11 @@ class PartitionedSlotIndex:
         return slots, np.asarray(clears, dtype=np.int32)
 
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
-                                  pinned: Optional[Set[int]] = None):
+                                  pinned: Optional[Set[int]] = None,
+                                  hold_pins: bool = False):
         def run(p, sub, pins):
             return self._parts[p].assign_batch_strs_uniques(
-                sub, lid, rank_bits, pinned=pins)
+                sub, lid, rank_bits, pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
@@ -254,6 +264,22 @@ class PartitionedSlotIndex:
         return (np.concatenate(h1s) if h1s else np.empty(0, np.uint64),
                 np.concatenate(h2s) if h2s else np.empty(0, np.uint64),
                 np.concatenate(slots) if slots else np.empty(0, np.int32))
+
+    def pin_batch(self, slots) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        part = slots // self.slots_per_part
+        for q, sub in enumerate(self._parts):
+            m = part == q
+            if m.any():
+                sub.pin_batch(slots[m] - np.int32(q * self.slots_per_part))
+
+    def unpin_batch(self, slots) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        part = slots // self.slots_per_part
+        for q, sub in enumerate(self._parts):
+            m = part == q
+            if m.any():
+                sub.unpin_batch(slots[m] - np.int32(q * self.slots_per_part))
 
     # NOTE: no restore_fp here on purpose — fingerprints don't carry their
     # key's partition routing, so only the checkpoint path (which stores

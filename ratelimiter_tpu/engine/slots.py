@@ -32,7 +32,9 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Hashable, Optional, Set, Tuple
+from typing import Dict, Hashable, Optional, Set, Tuple
+
+import numpy as np
 
 
 class SlotIndex:
@@ -45,6 +47,8 @@ class SlotIndex:
         self._lock = threading.Lock()
         self._map: "OrderedDict[Hashable, int]" = OrderedDict()  # key -> slot, LRU order
         self._free = list(range(self.num_slots - 1, -1, -1))
+        # Refcounted held pins (streams: assign -> dispatch-enqueue window).
+        self._pins: Dict[int, int] = {}
 
     def get(self, key: Hashable) -> Optional[int]:
         """Slot for key, or None; refreshes recency."""
@@ -55,7 +59,8 @@ class SlotIndex:
             return slot
 
     def assign(
-        self, key: Hashable, pinned: Optional[Set[int]] = None
+        self, key: Hashable, pinned: Optional[Set[int]] = None,
+        hold_pin: bool = False
     ) -> Tuple[int, Optional[int]]:
         """Slot for key, allocating (and possibly evicting) if absent.
 
@@ -63,23 +68,49 @@ class SlotIndex:
         LRU victim was displaced — its device state must be cleared before
         this slot's next use.  Raises RuntimeError if every slot is pinned.
         """
+        def held(slot):
+            if hold_pin:
+                self._pins[slot] = self._pins.get(slot, 0) + 1
+            return slot
+
         with self._lock:
             slot = self._map.get(key)
             if slot is not None:
                 self._map.move_to_end(key)
-                return slot, None
+                return held(slot), None
             if self._free:
                 slot = self._free.pop()
                 self._map[key] = slot
-                return slot, None
+                return held(slot), None
             # Evict the least-recently-used non-pinned key.
             for victim_key, victim_slot in self._map.items():
                 if pinned and victim_slot in pinned:
                     continue
+                if self._pins.get(victim_slot):
+                    continue
                 del self._map[victim_key]
                 self._map[key] = victim_slot
-                return victim_slot, victim_slot
+                return held(victim_slot), victim_slot
             raise RuntimeError("all slots pinned; increase num_slots or flush")
+
+    def pin_batch(self, slots) -> None:
+        """Refcounted pins (duplicates fine) held across a dispatch-prep
+        window so concurrent assigns can't evict these slots."""
+        with self._lock:
+            for s in np.asarray(slots):
+                s = int(s)
+                if 0 <= s < self.num_slots:
+                    self._pins[s] = self._pins.get(s, 0) + 1
+
+    def unpin_batch(self, slots) -> None:
+        with self._lock:
+            for s in np.asarray(slots):
+                s = int(s)
+                c = self._pins.get(s, 0)
+                if c <= 1:
+                    self._pins.pop(s, None)
+                else:
+                    self._pins[s] = c - 1
 
     def remove(self, key: Hashable) -> Optional[int]:
         """Drop a key (admin reset); returns its slot (caller clears it)."""

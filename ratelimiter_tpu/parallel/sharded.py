@@ -115,7 +115,7 @@ class ShardedSlotIndex:
         local = self._sub[shard].get(key)
         return None if local is None else shard * self.slots_per_shard + local
 
-    def assign(self, key, pinned=None):
+    def assign(self, key, pinned=None, hold_pin=False):
         shard = shard_of_key(key, self.n_shards)
         local_pinned = None
         if pinned:
@@ -124,7 +124,8 @@ class ShardedSlotIndex:
                 for s in pinned
                 if s // self.slots_per_shard == shard
             }
-        local, evicted = self._sub[shard].assign(key, pinned=local_pinned)
+        local, evicted = self._sub[shard].assign(key, pinned=local_pinned,
+                                                 hold_pin=hold_pin)
         base = shard * self.slots_per_shard
         return base + local, None if evicted is None else base + evicted
 
@@ -135,6 +136,22 @@ class ShardedSlotIndex:
 
     def __len__(self):
         return sum(len(s) for s in self._sub)
+
+    def pin_batch(self, slots) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        shard = slots // self.slots_per_shard
+        for q, sub in enumerate(self._sub):
+            m = shard == q
+            if m.any() and hasattr(sub, "pin_batch"):
+                sub.pin_batch(slots[m] - np.int32(q * self.slots_per_shard))
+
+    def unpin_batch(self, slots) -> None:
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        shard = slots // self.slots_per_shard
+        for q, sub in enumerate(self._sub):
+            m = shard == q
+            if m.any() and hasattr(sub, "unpin_batch"):
+                sub.unpin_batch(slots[m] - np.int32(q * self.slots_per_shard))
 
 
 # ---------------------------------------------------------------------------

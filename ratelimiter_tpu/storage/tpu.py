@@ -27,6 +27,7 @@ zeroed in the dispatch stream ahead of their reuse.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Dict, List, Sequence, Tuple
@@ -174,10 +175,12 @@ class TpuBatchedStorage(RateLimitStorage):
         # Host mirror of which slots' lids the device lid map knows
         # (per algo, allocated on first digest-multi stream).
         self._lid_known: Dict[str, np.ndarray] = {}
-        # Serializes _lid_known reads/marks + their dispatch against
-        # _clear_slots (clear-wins: an eviction concurrent with a mark
-        # must leave known=False so the lid is re-uploaded).
-        self._lid_lock = threading.Lock()
+        # Per-algo locks serializing _lid_known reads/marks + their
+        # dispatch against _clear_slots (clear-wins: an eviction
+        # concurrent with a mark must leave known=False so the lid is
+        # re-uploaded).  Per algo so sw and tb clears never serialize
+        # against each other.
+        self._lid_locks = {"sw": threading.Lock(), "tb": threading.Lock()}
         self._host = InMemoryStorage(clock_ms=clock_ms)  # legacy-contract ops
         from ratelimiter_tpu.utils.tracing import DecisionTrace
 
@@ -251,8 +254,12 @@ class TpuBatchedStorage(RateLimitStorage):
     def acquire(self, algo: str, lid: int, key: str, permits: int) -> dict:
         """Single decision through the micro-batcher (blocks until the batch
         containing this request lands; bounded by max_delay_ms)."""
-        slot = self._assign_slot(algo, lid, key)
-        return self._batcher.submit(algo, slot, lid, permits).result()
+        slot = self._assign_slot(algo, lid, key, hold_pin=True)
+        # The pin (taken atomically inside the assign) holds until the
+        # submit registers the slot in pending_slots.
+        with self._pins_released(self._index[algo], [slot]):
+            fut = self._batcher.submit(algo, slot, lid, permits)
+        return fut.result()
 
     def acquire_many(
         self, algo: str, lid_per_req: Sequence[int], keys: Sequence[str],
@@ -268,20 +275,25 @@ class TpuBatchedStorage(RateLimitStorage):
             # slots of requests queued since the flush are pin-protected.
             self._batcher.flush()
             slots, clears = index.assign_batch_strs(
-                list(keys), lid0, pinned=self._batcher.pending_slots(algo))
-            return self._batcher.dispatch_direct(
-                algo, slots, list(lid_per_req), list(permits), list(clears))
+                list(keys), lid0, pinned=self._batcher.pending_slots(algo),
+                hold_pins=True)
+            with self._pins_released(index, slots):
+                return self._batcher.dispatch_direct(
+                    algo, slots, list(lid_per_req), list(permits),
+                    list(clears))
         pinned = self._batcher.pending_slots(algo)
         slots: List[int] = []
         clears: List[int] = []
         for lid, key in zip(lid_per_req, keys):
-            slot, evicted = index.assign((lid, key), pinned=pinned)
+            slot, evicted = index.assign((lid, key), pinned=pinned,
+                                         hold_pin=True)
             if evicted is not None:
                 clears.append(evicted)
             pinned.add(slot)
             slots.append(slot)
-        return self._batcher.dispatch_direct(
-            algo, slots, list(lid_per_req), list(permits), clears)
+        with self._pins_released(index, slots):
+            return self._batcher.dispatch_direct(
+                algo, slots, list(lid_per_req), list(permits), clears)
 
     def acquire_many_ids(
         self, algo: str, lid: int, key_ids: np.ndarray, permits: np.ndarray,
@@ -296,21 +308,24 @@ class TpuBatchedStorage(RateLimitStorage):
             self._batcher.flush()
             slots, clears = index.assign_batch_ints(
                 np.ascontiguousarray(key_ids, dtype=np.int64), lid,
-                pinned=self._batcher.pending_slots(algo))
+                pinned=self._batcher.pending_slots(algo), hold_pins=True)
             clears = list(clears)
         else:
             pinned = self._batcher.pending_slots(algo)
             slots = []
             clears = []
             for k in np.asarray(key_ids):
-                slot, evicted = index.assign((lid, int(k)), pinned=pinned)
+                slot, evicted = index.assign((lid, int(k)), pinned=pinned,
+                                             hold_pin=True)
                 if evicted is not None:
                     clears.append(evicted)
                 pinned.add(slot)
                 slots.append(slot)
             slots = np.asarray(slots, dtype=np.int32)
         lids = np.full(len(slots), lid, dtype=np.int32)
-        return self._batcher.dispatch_direct(algo, slots, lids, permits, clears)
+        with self._pins_released(index, slots):
+            return self._batcher.dispatch_direct(algo, slots, lids, permits,
+                                                 clears)
 
     def acquire_stream_ids(
         self,
@@ -419,10 +434,12 @@ class TpuBatchedStorage(RateLimitStorage):
                 if multi_lid:
                     return index.assign_batch_ints_multi_uniques(
                         chunk, lid_arr[start:start + chunk_n], rb,
-                        pinned=self._batcher.pending_slots(algo))
+                        pinned=self._batcher.pending_slots(algo),
+                        hold_pins=True)
                 return index.assign_batch_ints_uniques(
                     chunk, lid, rb,
-                    pinned=self._batcher.pending_slots(algo))
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
 
             return self._stream_relay(algo, lid, assign_uniques, len(key_ids),
                                       lid_arr if multi_lid else None)
@@ -432,9 +449,11 @@ class TpuBatchedStorage(RateLimitStorage):
             if multi_lid:
                 return index.assign_batch_ints_multi(
                     chunk, lid_arr[start:start + chunk_n],
-                    pinned=self._batcher.pending_slots(algo))
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
             return index.assign_batch_ints(
-                chunk, lid, pinned=self._batcher.pending_slots(algo))
+                chunk, lid, pinned=self._batcher.pending_slots(algo),
+                hold_pins=True)
 
         return self._stream_flat(algo, lid, assign, len(key_ids), permits,
                                  oversize, batch, subbatches,
@@ -497,73 +516,80 @@ class TpuBatchedStorage(RateLimitStorage):
         while start < n:
             cn = min(chunk, n - start)
             uwords, uidx, rank, clears = assign_uniques(start, cn)
-            if len(clears):
-                clear(list(clears))
             u = len(uwords)
-            l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            # Mode election on the REAL wire cost: for multi-tenant
-            # digest the per-unique cost is the resident steady state
-            # PLUS this chunk's actual (slot, lid) delta uploads, so a
-            # churn-heavy stream whose uniques are mostly fresh falls
-            # back to words mode instead of paying 14 B/request.
-            fresh = None
-            n_delta = 0
-            if cdt is not None and multi_lid:
-                known = self._lid_known.setdefault(
-                    algo, np.zeros(eng.num_slots, dtype=bool))
-                uslots = (uwords >> np.uint32(rb + 1)).astype(np.int64)
-                with self._lid_lock:
-                    fresh = ~known[uslots]
-                n_delta = int(fresh.sum())
-            digest = cdt is not None and (
-                digest_bpu * u + 8 * n_delta <= words_bpr * cn)
-            now = self._monotonic_now()
-            t0 = time.perf_counter()
-            if digest:
-                size = _bucket_pow2(u)
-                uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
-                if multi_lid:
-                    # Tenant ids live RESIDENT on device (a slot's lid is
-                    # immutable while assigned): upload only the (slot,
-                    # lid) pairs the device doesn't know yet — fresh
-                    # assignments and post-eviction reuse, tracked in
-                    # _lid_known and invalidated by _clear_slots.  Per-
-                    # unique lids map through uidx (NOT positional: a
-                    # partitioned index merges uniques partition-major).
-                    from ratelimiter_tpu.parallel.sharded import _bucket
-
-                    first = rank == 0
-                    ulids = np.zeros(u, dtype=np.int32)
-                    ulids[uidx[first]] = l_chunk[first]
-                    # Re-read fresh, mark, and dispatch under the lock
-                    # shared with _clear_slots: an eviction racing the
-                    # mark must win (forcing a later re-upload), never
-                    # lose to a stale known=True.
-                    with self._lid_lock:
+            uslots_all = (uwords >> np.uint32(rb + 1)).astype(np.int32)
+            with self._pins_released(self._index[algo], uslots_all):
+                if len(clears):
+                    clear(list(clears))
+                l_chunk = (lid_arr[start:start + cn] if multi_lid
+                           else None)
+                # Mode election on the REAL wire cost: for multi-tenant
+                # digest the per-unique cost is the resident steady state
+                # PLUS this chunk's actual (slot, lid) delta uploads, so a
+                # churn-heavy stream whose uniques are mostly fresh falls
+                # back to words mode instead of paying 14 B/request.
+                fresh = None
+                n_delta = 0
+                if cdt is not None and multi_lid:
+                    with self._lid_locks[algo]:
+                        known = self._lid_known.setdefault(
+                            algo, np.zeros(eng.num_slots, dtype=bool))
+                        uslots = uslots_all.astype(np.int64)
                         fresh = ~known[uslots]
-                        n_delta = int(fresh.sum())
-                        dsize = _bucket(max(n_delta, 1), floor=256)
-                        d_slots = _pad_tail(uslots[fresh], dsize, -1,
-                                            np.int32)
-                        d_lids = _pad_tail(ulids[fresh], dsize, 0,
-                                           np.int32)
-                        known[uslots[fresh]] = True
-                        resident = (eng.sw_relay_counts_resident_dispatch
-                                    if algo == "sw"
-                                    else eng.tb_relay_counts_resident_dispatch)
-                        counts = resident(uw, d_slots, d_lids, now, cdt)
+                    from ratelimiter_tpu.parallel.sharded import _bucket as _bkt
+                    n_delta = _bkt(max(int(fresh.sum()), 1), floor=8)
+                digest = cdt is not None and (
+                    digest_bpu * u + 8 * n_delta <= words_bpr * cn)
+                now = self._monotonic_now()
+                t0 = time.perf_counter()
+                if digest:
+                    size = _bucket_pow2(u)
+                    uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
+                    if multi_lid:
+                        # Tenant ids live RESIDENT on device (a slot's lid is
+                        # immutable while assigned): upload only the (slot,
+                        # lid) pairs the device doesn't know yet — fresh
+                        # assignments and post-eviction reuse, tracked in
+                        # _lid_known and invalidated by _clear_slots.  Per-
+                        # unique lids map through uidx (NOT positional: a
+                        # partitioned index merges uniques partition-major).
+                        from ratelimiter_tpu.parallel.sharded import _bucket
+
+                        first = rank == 0
+                        ulids = np.zeros(u, dtype=np.int32)
+                        ulids[uidx[first]] = l_chunk[first]
+                        # Re-read fresh, mark, and dispatch under the lock
+                        # shared with _clear_slots: an eviction racing the
+                        # mark must win (forcing a later re-upload), never
+                        # lose to a stale known=True.
+                        with self._lid_locks[algo]:
+                            fresh = ~known[uslots]
+                            n_delta = int(fresh.sum())
+                            dsize = _bucket(max(n_delta, 1), floor=8)
+                            d_slots = _pad_tail(uslots[fresh], dsize, -1,
+                                                np.int32)
+                            d_lids = _pad_tail(ulids[fresh], dsize, 0,
+                                               np.int32)
+                            resident = (eng.sw_relay_counts_resident_dispatch
+                                        if algo == "sw"
+                                        else eng.tb_relay_counts_resident_dispatch)
+                            counts = resident(uw, d_slots, d_lids, now, cdt)
+                            # Mark AFTER the dispatch: a raise must not
+                            # leave slots "known" with no lid uploaded.
+                            known[uslots[fresh]] = True
+                            n_delta = dsize  # charge the padded lane
+                    else:
+                        counts = counts_dispatch(uw, lid, now, cdt)
+                    pending.append(
+                        ("digest", counts, start, cn, (uidx, rank, u), t0))
                 else:
-                    counts = counts_dispatch(uw, lid, now, cdt)
-                pending.append(
-                    ("digest", counts, start, cn, (uidx, rank, u), t0))
-            else:
-                words = rebuild_words(uwords, uidx, rank, rb)
-                size = _bucket_pow2(cn)
-                words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
-                lid_lane = lid if not multi_lid else _pad_tail(
-                    l_chunk, size, 0, np.int32)
-                bits = bits_dispatch(words, lid_lane, now)
-                pending.append(("bits", bits, start, cn, None, t0))
+                    words = rebuild_words(uwords, uidx, rank, rb)
+                    size = _bucket_pow2(cn)
+                    words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
+                    lid_lane = lid if not multi_lid else _pad_tail(
+                        l_chunk, size, 0, np.int32)
+                    bits = bits_dispatch(words, lid_lane, now)
+                    pending.append(("bits", bits, start, cn, None, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
             # Grow the next chunk toward the wire budget at this chunk's
@@ -651,27 +677,29 @@ class TpuBatchedStorage(RateLimitStorage):
             k_i = (min(k_scan, -(-cn // _FLAT_MAX_LANES)) if k_scan else 0)
             pad_n = k_i * _FLAT_MAX_LANES if k_i else super_n
             slots, clears = assign(start, cn)
-            if len(clears):
-                clear(list(clears))
-            slots = _pad_tail(slots, pad_n, -1, np.int32)
-            if oversize is not None:
-                slots[:cn][oversize[start:start + cn]] = -1  # force-deny
-            lid_flat = lid if not multi_lid else _pad_tail(
-                lid_arr[start:start + cn], pad_n, 0, np.int32)
-            p_flat = None if permits is None else _pad_tail(
-                permits[start:start + cn], pad_n, 1, p_dtype)
-            now = self._monotonic_now()
-            t0 = time.perf_counter()
-            if k_i:
-                bits = dispatch(
-                    slots.reshape(k_i, _FLAT_MAX_LANES),
-                    lid_flat if not multi_lid
-                    else lid_flat.reshape(k_i, _FLAT_MAX_LANES),
-                    None if p_flat is None
-                    else p_flat.reshape(k_i, _FLAT_MAX_LANES),
-                    np.full(k_i, now, dtype=np.int64))
-            else:
-                bits = dispatch(slots, lid_flat, p_flat, now)
+            raw_slots = slots
+            with self._pins_released(self._index[algo], raw_slots):
+                if len(clears):
+                    clear(list(clears))
+                slots = _pad_tail(slots, pad_n, -1, np.int32)
+                if oversize is not None:
+                    slots[:cn][oversize[start:start + cn]] = -1  # deny
+                lid_flat = lid if not multi_lid else _pad_tail(
+                    lid_arr[start:start + cn], pad_n, 0, np.int32)
+                p_flat = None if permits is None else _pad_tail(
+                    permits[start:start + cn], pad_n, 1, p_dtype)
+                now = self._monotonic_now()
+                t0 = time.perf_counter()
+                if k_i:
+                    bits = dispatch(
+                        slots.reshape(k_i, _FLAT_MAX_LANES),
+                        lid_flat if not multi_lid
+                        else lid_flat.reshape(k_i, _FLAT_MAX_LANES),
+                        None if p_flat is None
+                        else p_flat.reshape(k_i, _FLAT_MAX_LANES),
+                        np.full(k_i, now, dtype=np.int64))
+                else:
+                    bits = dispatch(slots, lid_flat, p_flat, now)
             pending.append((start, cn, bits, t0))
             if len(pending) > 1:
                 s0, c0, h0, pt0 = pending.pop(0)
@@ -736,14 +764,15 @@ class TpuBatchedStorage(RateLimitStorage):
             def assign_uniques(start, chunk_n):
                 return index.assign_batch_strs_uniques(
                     list(keys[start:start + chunk_n]), lid, rb,
-                    pinned=self._batcher.pending_slots(algo))
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
 
             return self._stream_relay(algo, lid, assign_uniques, len(keys))
 
         def assign(start, chunk_n):
             return index.assign_batch_strs(
                 list(keys[start:start + chunk_n]), lid,
-                pinned=self._batcher.pending_slots(algo))
+                pinned=self._batcher.pending_slots(algo), hold_pins=True)
 
         return self._stream_flat(algo, lid, assign, len(keys), permits,
                                  oversize, batch, subbatches)
@@ -813,9 +842,11 @@ class TpuBatchedStorage(RateLimitStorage):
                 sub = index._sub[s]
                 if multi_lid:
                     sl, ev = sub.assign_batch_ints_multi(
-                        chunk[m], l_chunk[m], pinned=pins)
+                        chunk[m], l_chunk[m], pinned=pins, hold_pins=True)
                 else:
-                    sl, ev = sub.assign_batch_ints(chunk[m], lid, pinned=pins)
+                    sl, ev = sub.assign_batch_ints(chunk[m], lid,
+                                                   pinned=pins,
+                                                   hold_pins=True)
                 local[m] = sl
                 clears.extend(s * sps + int(e) for e in ev)
             if clears:
@@ -848,7 +879,9 @@ class TpuBatchedStorage(RateLimitStorage):
                 p_sb = p_mat
             now = self._monotonic_now()
             t0 = time.perf_counter()
-            bits = dispatch(slots_mat, lid_sb, p_sb, now)
+            with self._pins_released(index,
+                                     shard.astype(np.int64) * sps + local):
+                bits = dispatch(slots_mat, lid_sb, p_sb, now)
             pending.append((bits, start, cn, shard, cols, b_loc, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
@@ -924,6 +957,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 pins_by_shard.setdefault(g // sps, set()).add(g % sps)
             results = []
             clears: list = []
+            pin_glob: list = []
             u_total = u_max = b_max = 0
             for s in range(n_sh):
                 pos = np.where(shard == s)[0]
@@ -934,12 +968,15 @@ class TpuBatchedStorage(RateLimitStorage):
                 if multi_lid:
                     uw, uidx, rank, ev = sub.assign_batch_ints_multi_uniques(
                         kchunk[pos], l_chunk[pos], rb,
-                        pinned=pins_by_shard.get(s))
+                        pinned=pins_by_shard.get(s), hold_pins=True)
                 else:
                     uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
-                        kchunk[pos], lid, rb, pinned=pins_by_shard.get(s))
+                        kchunk[pos], lid, rb, pinned=pins_by_shard.get(s),
+                        hold_pins=True)
                 clears.extend(s * sps + int(e) for e in ev)
                 results.append((pos, uidx, rank, len(uw), uw))
+                pin_glob.append(
+                    ((uw >> np.uint32(rb + 1)).astype(np.int64) + s * sps))
                 u_total += len(uw)
                 u_max = max(u_max, len(uw))
                 b_max = max(b_max, len(pos))
@@ -950,6 +987,8 @@ class TpuBatchedStorage(RateLimitStorage):
                 <= words_bpr * cn)
             now = self._monotonic_now()
             t0 = time.perf_counter()
+            pins = (np.concatenate(pin_glob) if pin_glob
+                    else np.empty(0, dtype=np.int64))
             if digest:
                 u_loc = _bucket(max(u_max, 1))
                 uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF, dtype=np.uint32)
@@ -970,8 +1009,9 @@ class TpuBatchedStorage(RateLimitStorage):
                         ulids[uidx[first]] = l_chunk[pos][first]
                         lid_mat[s, :u] = ulids
                     per_shard.append((pos, uidx, rank, u))
-                counts = counts_dispatch(
-                    uw_mat, lid if not multi_lid else lid_mat, now, cdt)
+                with self._pins_released(index, pins):
+                    counts = counts_dispatch(
+                        uw_mat, lid if not multi_lid else lid_mat, now, cdt)
                 pending.append(("digest", counts, start, per_shard, t0))
             else:
                 b_loc = _bucket(max(b_max, 1))
@@ -990,8 +1030,9 @@ class TpuBatchedStorage(RateLimitStorage):
                     if multi_lid:
                         lid_mat[s, :len(pos)] = l_chunk[pos]
                     per_shard.append((pos,))
-                bits = bits_dispatch(
-                    w_mat, lid if not multi_lid else lid_mat, now)
+                with self._pins_released(index, pins):
+                    bits = bits_dispatch(
+                        w_mat, lid if not multi_lid else lid_mat, now)
                 pending.append(("bits", bits, start, per_shard, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
@@ -1054,6 +1095,25 @@ class TpuBatchedStorage(RateLimitStorage):
     def flush(self) -> None:
         self._batcher.flush()
 
+    @contextlib.contextmanager
+    def _pins_released(self, index, slots):
+        """Release pins taken ATOMICALLY inside an assign
+        (``hold_pins=True``) once the enclosed dispatch is enqueued.
+
+        The pins close an eviction race: without them, concurrent scalar
+        traffic under eviction pressure could reassign-and-clear a slot
+        BETWEEN the batch's slot assignment and its dispatch entering
+        the device stream, making the batch write stale state into
+        another key's slot.  Pinning after the assign returned would
+        leave the same gap, which is why the indexes pin under the same
+        lock hold as the assignment.  (Dispatches serialize in program
+        order, so anything cleared AFTER the enqueue stays correct.)"""
+        try:
+            yield
+        finally:
+            if hasattr(index, "unpin_batch") and len(slots):
+                index.unpin_batch(slots)
+
     def _clear_slots(self, algo: str, slots) -> None:
         """Single choke point for zeroing evicted/reset slots.
 
@@ -1063,7 +1123,13 @@ class TpuBatchedStorage(RateLimitStorage):
         lid must be re-uploaded on next digest use."""
         if not len(slots):
             return
-        with self._lid_lock:
+        if self._lid_known.get(algo) is None:
+            # No resident-lid tracking for this algo: nothing to
+            # invalidate, so don't serialize against digest dispatches.
+            (self.engine.sw_clear if algo == "sw"
+             else self.engine.tb_clear)(list(slots))
+            return
+        with self._lid_locks[algo]:
             (self.engine.sw_clear if algo == "sw"
              else self.engine.tb_clear)(list(slots))
             known = self._lid_known.get(algo)
@@ -1161,10 +1227,12 @@ class TpuBatchedStorage(RateLimitStorage):
                 index.close()
 
     # ------------------------------------------------------------------------
-    def _assign_slot(self, algo: str, lid: int, key: str) -> int:
+    def _assign_slot(self, algo: str, lid: int, key: str,
+                     hold_pin: bool = False) -> int:
         index = self._index[algo]
         pinned = self._batcher.pending_slots(algo)
-        slot, evicted = index.assign((lid, key), pinned=pinned)
+        slot, evicted = index.assign((lid, key), pinned=pinned,
+                                     hold_pin=hold_pin)
         if evicted is not None:
             self._batcher.add_clear(algo, evicted)
         return slot

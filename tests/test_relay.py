@@ -371,3 +371,28 @@ def _forced_bits_stream(orig):
         finally:
             eng.counts_dtype = real
     return wrapper
+
+
+def test_held_pins_block_concurrent_eviction():
+    """The assign->dispatch window contract: pinned slots must survive a
+    concurrent assign's eviction pressure (the concurrent assign either
+    finds other victims or refuses), for the native and Python indexes."""
+    from ratelimiter_tpu.engine.native_index import (
+        NativeSlotIndex, native_available)
+    from ratelimiter_tpu.engine.slots import SlotIndex
+
+    indexes = [SlotIndex(4)]
+    if native_available():
+        indexes.append(NativeSlotIndex(4))
+    for ix in indexes:
+        slots = [ix.assign((1, k))[0] for k in range(4)]  # full table
+        ix.pin_batch(np.asarray(slots[:3], dtype=np.int32))
+        # Only the unpinned slot may be evicted.
+        s, ev = ix.assign((1, 99))
+        assert ev == slots[3] and s == slots[3], (type(ix).__name__, s, ev)
+        ix.pin_batch(np.asarray([s], dtype=np.int32))
+        with pytest.raises(RuntimeError):
+            ix.assign((1, 100))  # everything pinned now
+        ix.unpin_batch(np.asarray(slots[:3] + [s], dtype=np.int32))
+        s2, ev2 = ix.assign((1, 100))  # unpinned again: eviction works
+        assert ev2 is not None
