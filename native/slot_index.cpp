@@ -55,6 +55,13 @@ struct Index {
   std::vector<int32_t> entry_of_slot;  // slot -> table position (-1 if free)
   std::vector<int32_t> free_slots;
   std::vector<uint32_t> pins;          // slot -> pin refcount
+  // Slots removed (admin reset) while their pin refcount was nonzero:
+  // freeing them immediately would let a new key take the slot before the
+  // pinned dispatch enqueues, receiving its stale write.  They are flagged
+  // here and surface on the dirty list at last unpin; reassignment reports
+  // them as their own eviction so the caller re-clears device state first.
+  std::vector<uint8_t> deferred;       // slot -> removed-while-pinned flag
+  std::vector<int32_t> dirty_free;     // unpinned deferred slots (need clear)
   int64_t size = 0;
   int32_t lru_head = -1, lru_tail = -1;  // head = most recent
   uint64_t gen = 0;
@@ -222,6 +229,21 @@ inline int64_t take_slot(Index* ix, int32_t* out_slot) {
     ix->free_slots.pop_back();
     return -1;
   }
+  // Dirty free slots (removed while pinned, since unpinned) may carry a
+  // stale write from the formerly-pinned dispatch: hand them out as their
+  // own "eviction" so the caller zeroes the device state before reuse.
+  // A dirty slot can have been RE-pinned since it was listed (a queued
+  // micro-batch request pinned via the per-call set) — skip those, exactly
+  // as the LRU eviction scan below does.  The list is tiny (admin resets
+  // racing streams), so the scan is O(few).
+  for (size_t i = ix->dirty_free.size(); i-- > 0;) {
+    int32_t slot = ix->dirty_free[i];
+    if (ix->pins[slot] == 0) {
+      ix->dirty_free.erase(ix->dirty_free.begin() + i);
+      *out_slot = slot;
+      return slot;
+    }
+  }
   // Evict from LRU tail, skipping pinned and current-generation entries.
   int32_t pos = ix->lru_tail;
   while (pos >= 0) {
@@ -377,6 +399,7 @@ void* rl_index_new(int64_t num_slots) {
   advise_huge(ix->table.data(), cap * sizeof(Entry));
   ix->entry_of_slot.assign(num_slots, -1);
   ix->pins.assign(num_slots, 0);
+  ix->deferred.assign(num_slots, 0);
   ix->free_slots.reserve(num_slots);
   for (int64_t s = num_slots - 1; s >= 0; s--)
     ix->free_slots.push_back(static_cast<int32_t>(s));
@@ -480,8 +503,23 @@ int32_t rl_index_get_bytes(void* h, const uint8_t* data, int64_t len,
 }
 
 // Remove a key; returns its slot (caller must clear device state BEFORE the
-// slot can be reused) or -1.  The slot returns to the free list immediately,
-// matching the Python index contract.
+// slot can be reused) or -1.  A slot with a live pin refcount (a stream's
+// assign->dispatch window) is NOT freed here — that would let a new key take
+// it before the pinned dispatch enqueues its write.  It is deferred and
+// surfaces on the dirty list at last unpin (see take_slot).
+static int32_t remove_at(Index* ix, int32_t pos) {
+  int32_t slot = ix->table[pos].slot;
+  lru_unlink(ix, pos);
+  ix->entry_of_slot[slot] = -1;
+  erase_at(ix, static_cast<uint64_t>(pos));
+  ix->size--;
+  if (ix->pins[slot] > 0)
+    ix->deferred[slot] = 1;
+  else
+    ix->free_slots.push_back(slot);
+  return slot;
+}
+
 int32_t rl_index_remove_bytes(void* h, const uint8_t* data, int64_t len,
                               uint64_t lid_seed) {
   Index* ix = static_cast<Index*>(h);
@@ -489,13 +527,7 @@ int32_t rl_index_remove_bytes(void* h, const uint8_t* data, int64_t len,
   hash_bytes(data, len, lid_seed, h1, h2);
   int32_t pos = find(ix, h1, h2);
   if (pos < 0) return -1;
-  int32_t slot = ix->table[pos].slot;
-  lru_unlink(ix, pos);
-  ix->entry_of_slot[slot] = -1;
-  erase_at(ix, static_cast<uint64_t>(pos));
-  ix->size--;
-  ix->free_slots.push_back(slot);
-  return slot;
+  return remove_at(ix, pos);
 }
 
 int32_t rl_index_remove_int(void* h, int64_t key, uint64_t lid_seed) {
@@ -504,13 +536,7 @@ int32_t rl_index_remove_int(void* h, int64_t key, uint64_t lid_seed) {
   hash_int(key, lid_seed, h1, h2);
   int32_t pos = find(ix, h1, h2);
   if (pos < 0) return -1;
-  int32_t slot = ix->table[pos].slot;
-  lru_unlink(ix, pos);
-  ix->entry_of_slot[slot] = -1;
-  erase_at(ix, static_cast<uint64_t>(pos));
-  ix->size--;
-  ix->free_slots.push_back(slot);
-  return slot;
+  return remove_at(ix, pos);
 }
 
 // -- enumeration / restore (checkpointing at native speed) -------------------
@@ -540,11 +566,21 @@ int64_t rl_index_dump(void* h, uint64_t* out_h1, uint64_t* out_h2,
 static void reset_empty(Index* ix) {
   std::fill(ix->table.begin(), ix->table.end(), Entry{});
   std::fill(ix->entry_of_slot.begin(), ix->entry_of_slot.end(), -1);
+  std::fill(ix->deferred.begin(), ix->deferred.end(), 0);
+  ix->dirty_free.clear();
   ix->size = 0;
   ix->lru_head = ix->lru_tail = -1;
   ix->free_slots.clear();
-  for (int64_t s = ix->num_slots - 1; s >= 0; s--)
-    ix->free_slots.push_back(static_cast<int32_t>(s));
+  // Pin refcounts survive a clear/restore (they belong to in-flight
+  // dispatch windows, not to the mapping): a still-pinned slot must not
+  // reach the clean free list — defer it so it surfaces on the dirty
+  // list (=> cleared before reuse) at last unpin.
+  for (int64_t s = ix->num_slots - 1; s >= 0; s--) {
+    if (ix->pins[s] > 0)
+      ix->deferred[s] = 1;
+    else
+      ix->free_slots.push_back(static_cast<int32_t>(s));
+  }
 }
 
 int32_t rl_index_restore(void* h, const uint64_t* h1s, const uint64_t* h2s,
@@ -564,9 +600,18 @@ int32_t rl_index_restore(void* h, const uint64_t* h1s, const uint64_t* h2s,
     }
     insert(ix, h1, h2, slot);
   }
-  for (int64_t s = ix->num_slots - 1; s >= 0; s--)
-    if (ix->entry_of_slot[s] < 0)
+  for (int64_t s = ix->num_slots - 1; s >= 0; s--) {
+    if (ix->entry_of_slot[s] >= 0) {
+      // Slot re-mapped by the restore: it must NOT surface on the dirty
+      // free list at last unpin (two keys would share it).
+      ix->deferred[s] = 0;
+      continue;
+    }
+    if (ix->pins[s] > 0)  // in-flight dispatch window: see reset_empty
+      ix->deferred[s] = 1;
+    else
       ix->free_slots.push_back(static_cast<int32_t>(s));
+  }
   return 0;
 }
 
@@ -612,9 +657,18 @@ void rl_index_pin(void* h, int32_t slot) {
   if (slot >= 0 && slot < ix->num_slots) ix->pins[slot]++;
 }
 
+// Last unpin of a removed-while-pinned slot frees it onto the dirty list
+// (take_slot reports dirty slots as their own eviction => cleared on reuse).
+static inline void unpin_one(Index* ix, int32_t slot) {
+  if (slot < 0 || slot >= ix->num_slots || ix->pins[slot] == 0) return;
+  if (--ix->pins[slot] == 0 && ix->deferred[slot]) {
+    ix->deferred[slot] = 0;
+    ix->dirty_free.push_back(slot);
+  }
+}
+
 void rl_index_unpin(void* h, int32_t slot) {
-  Index* ix = static_cast<Index*>(h);
-  if (slot >= 0 && slot < ix->num_slots && ix->pins[slot] > 0) ix->pins[slot]--;
+  unpin_one(static_cast<Index*>(h), slot);
 }
 
 // Batch pin/unpin (refcounted, duplicates fine): streams hold these from
@@ -632,10 +686,7 @@ void rl_index_pin_batch(void* h, const int32_t* slots, int64_t n) {
 
 void rl_index_unpin_batch(void* h, const int32_t* slots, int64_t n) {
   Index* ix = static_cast<Index*>(h);
-  for (int64_t i = 0; i < n; i++) {
-    int32_t s = slots[i];
-    if (s >= 0 && s < ix->num_slots && ix->pins[s] > 0) ix->pins[s]--;
-  }
+  for (int64_t i = 0; i < n; i++) unpin_one(ix, slots[i]);
 }
 
 }  // extern "C"

@@ -49,9 +49,19 @@ def _load_library():
                     subprocess.run(
                         ["make", "-C", os.path.abspath(_NATIVE_DIR)],
                         check=True, capture_output=True, timeout=120)
-                except Exception:  # noqa: BLE001
+                except Exception as exc:  # noqa: BLE001
                     if not os.path.exists(_LIB_PATH):
                         raise
+                    # A symbol-complete but semantically outdated library
+                    # would load silently otherwise; give operators a signal
+                    # that the binary predates the source.
+                    import warnings
+
+                    warnings.warn(
+                        f"native slot index rebuild failed ({exc!r}); "
+                        f"loading possibly STALE {_LIB_PATH} — rebuild "
+                        "with `make -C native` to match the source",
+                        RuntimeWarning, stacklevel=2)
             lib = ctypes.CDLL(_LIB_PATH)
             _bind(lib)  # missing symbol (stale prebuilt .so) => fallback
         except Exception:  # noqa: BLE001 — any failure => Python fallback
@@ -309,10 +319,13 @@ class NativeSlotIndex:
             self._lib.rl_index_assign_ints(
                 self._h, keys.ctypes.data, n, int(lid),
                 out_slots.ctypes.data, out_ev.ctypes.data)
-            if hold_pins:
+            # Pin only on full success: the caller raises on -2 and never
+            # dispatches, so pinning the successful lanes would leak.
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
@@ -331,10 +344,11 @@ class NativeSlotIndex:
             self._lib.rl_index_assign_ints_multi(
                 self._h, keys.ctypes.data, seeds.ctypes.data, n,
                 out_slots.ctypes.data, out_ev.ctypes.data)
-            if hold_pins:
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]
 
@@ -379,12 +393,13 @@ class NativeSlotIndex:
                 self._h, keys.ctypes.data, n, int(lid), int(rank_bits),
                 uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
                 out_ev.ctypes.data)
-            if hold_pins:
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
                 uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
                     np.int32)
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
@@ -404,12 +419,13 @@ class NativeSlotIndex:
                 self._h, keys.ctypes.data, seeds.ctypes.data, n,
                 int(rank_bits), uwords.ctypes.data, uidx.ctypes.data,
                 rank.ctypes.data, out_ev.ctypes.data)
-            if hold_pins:
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
                 uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
                     np.int32)
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
@@ -428,12 +444,13 @@ class NativeSlotIndex:
                 offs.ctypes.data, n, int(lid), int(rank_bits),
                 uwords.ctypes.data, uidx.ctypes.data, rank.ctypes.data,
                 out_ev.ctypes.data)
-            if hold_pins:
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
                 uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
                     np.int32)
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
@@ -509,9 +526,10 @@ class NativeSlotIndex:
                 self._h, packed.ctypes.data if len(packed) else 0,
                 offs.ctypes.data, n, int(lid),
                 out_slots.ctypes.data, out_ev.ctypes.data)
-            if hold_pins:
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
-        if (out_ev == -2).any():
+        if failed:
             raise RuntimeError("slot capacity exhausted (all pinned)")
         return out_slots, out_ev[out_ev >= 0]

@@ -134,9 +134,33 @@ class PartitionedSlotIndex:
                   else np.empty(0, dtype=np.uint32))
         return uwords, uidx, rank, clears
 
-    def _parallel(self, key_ids, pinned, run):
+    def _collect(self, futs, unpin_of):
+        """Gather per-partition futures; if any partition raised, release
+        the pins the SUCCESSFUL partitions took (their results never reach
+        the caller, so nothing else could unpin them) and re-raise."""
+        results, err = [], None
+        for f in futs:
+            if f is None:
+                results.append(None)
+                continue
+            try:
+                results.append(f.result())
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                err = err if err is not None else exc
+                results.append(None)
+        if err is not None:
+            if unpin_of is not None:
+                for p, res in enumerate(results):
+                    if res is not None:
+                        self._parts[p].unpin_batch(unpin_of(res))
+            raise err
+        return results
+
+    def _parallel(self, key_ids, pinned, run, unpin_of=None):
         """Split a batch by partition, run per-partition C calls on the
-        pool (GIL released inside), return (parts_pos, results)."""
+        pool (GIL released inside), return (parts_pos, results).
+        ``unpin_of(result) -> local slots`` must be given when the run
+        holds pins, so a partial failure releases them."""
         parts = _part_of_int_keys(key_ids, self.n_parts)
         parts_pos = [np.where(parts == p)[0] for p in range(self.n_parts)]
         futs = []
@@ -146,7 +170,7 @@ class PartitionedSlotIndex:
                 continue
             futs.append(self._pool.submit(
                 run, p, pos, self._local_pins(pinned, p)))
-        return parts_pos, [None if f is None else f.result() for f in futs]
+        return parts_pos, self._collect(futs, unpin_of)
 
     def assign_batch_ints(self, keys: np.ndarray, lid: int,
                           pinned: Optional[Set[int]] = None,
@@ -157,7 +181,9 @@ class PartitionedSlotIndex:
             return self._parts[p].assign_batch_ints(
                 keys[pos], lid, pinned=pins, hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel(keys, pinned, run)
+        parts_pos, results = self._parallel(
+            keys, pinned, run,
+            unpin_of=(lambda res: res[0]) if hold_pins else None)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
                                             "slots")
         return slots, np.asarray(clears, dtype=np.int32)
@@ -172,7 +198,9 @@ class PartitionedSlotIndex:
             return self._parts[p].assign_batch_ints_multi(
                 keys[pos], lids[pos], pinned=pins, hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel(keys, pinned, run)
+        parts_pos, results = self._parallel(
+            keys, pinned, run,
+            unpin_of=(lambda res: res[0]) if hold_pins else None)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
                                             "slots")
         return slots, np.asarray(clears, dtype=np.int32)
@@ -188,7 +216,9 @@ class PartitionedSlotIndex:
                 keys[pos], lid, rank_bits, pinned=pins,
                 hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel(keys, pinned, run)
+        parts_pos, results = self._parallel(
+            keys, pinned, run,
+            unpin_of=(lambda res: (res[0] >> np.uint32(rank_bits + 1)).astype(np.int32)) if hold_pins else None)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
                                    rank_bits)
 
@@ -204,7 +234,9 @@ class PartitionedSlotIndex:
                 keys[pos], lids[pos], rank_bits, pinned=pins,
                 hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel(keys, pinned, run)
+        parts_pos, results = self._parallel(
+            keys, pinned, run,
+            unpin_of=(lambda res: (res[0] >> np.uint32(rank_bits + 1)).astype(np.int32)) if hold_pins else None)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
                                    rank_bits)
 
@@ -213,7 +245,7 @@ class PartitionedSlotIndex:
     # scalar path uses — INCLUDING the lid in the routed key, exactly as
     # storage's scalar assign((lid, key)) does, so both paths agree on a
     # key's partition — and still fan the C calls out.
-    def _parallel_strs(self, keys, lid, pinned, run):
+    def _parallel_strs(self, keys, lid, pinned, run, unpin_of=None):
         parts = np.fromiter(
             (_part_of_key((lid, k), self.n_parts) for k in keys),
             dtype=np.int64, count=len(keys))
@@ -225,7 +257,7 @@ class PartitionedSlotIndex:
                 continue
             futs.append(self._pool.submit(
                 run, p, [keys[i] for i in pos], self._local_pins(pinned, p)))
-        return parts_pos, [None if f is None else f.result() for f in futs]
+        return parts_pos, self._collect(futs, unpin_of)
 
     def assign_batch_strs(self, keys, lid: int,
                           pinned: Optional[Set[int]] = None,
@@ -234,7 +266,9 @@ class PartitionedSlotIndex:
             return self._parts[p].assign_batch_strs(
                 sub, lid, pinned=pins, hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
+        parts_pos, results = self._parallel_strs(
+            keys, lid, pinned, run,
+            unpin_of=(lambda res: res[0]) if hold_pins else None)
         slots, clears = self._scatter_merge(len(keys), parts_pos, results,
                                             "slots")
         return slots, np.asarray(clears, dtype=np.int32)
@@ -246,7 +280,10 @@ class PartitionedSlotIndex:
             return self._parts[p].assign_batch_strs_uniques(
                 sub, lid, rank_bits, pinned=pins, hold_pins=hold_pins)
 
-        parts_pos, results = self._parallel_strs(keys, lid, pinned, run)
+        parts_pos, results = self._parallel_strs(
+            keys, lid, pinned, run,
+            unpin_of=(lambda res: (res[0] >> np.uint32(rank_bits + 1))
+                      .astype(np.int32)) if hold_pins else None)
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
                                    rank_bits)
 

@@ -49,6 +49,11 @@ class SlotIndex:
         self._free = list(range(self.num_slots - 1, -1, -1))
         # Refcounted held pins (streams: assign -> dispatch-enqueue window).
         self._pins: Dict[int, int] = {}
+        # Slots removed (admin reset) while pinned: freed on last unpin via
+        # the dirty list, and reported as their own eviction when reassigned
+        # so the caller re-clears the (possibly stale) device state first.
+        self._deferred: Set[int] = set()
+        self._dirty: list = []
 
     def get(self, key: Hashable) -> Optional[int]:
         """Slot for key, or None; refreshes recency."""
@@ -82,6 +87,19 @@ class SlotIndex:
                 slot = self._free.pop()
                 self._map[key] = slot
                 return held(slot), None
+            # Removed-while-pinned slots, since unpinned: may carry a stale
+            # write from the formerly-pinned dispatch — reported as their
+            # own eviction so the caller clears them before reuse.  A dirty
+            # slot can have been RE-pinned since it was listed (a queued
+            # request via the per-call pinned set): skip those, exactly as
+            # the LRU eviction scan below does.
+            for i in range(len(self._dirty) - 1, -1, -1):
+                slot = self._dirty[i]
+                if self._pins.get(slot) or (pinned and slot in pinned):
+                    continue
+                del self._dirty[i]
+                self._map[key] = slot
+                return held(slot), slot
             # Evict the least-recently-used non-pinned key.
             for victim_key, victim_slot in self._map.items():
                 if pinned and victim_slot in pinned:
@@ -109,15 +127,25 @@ class SlotIndex:
                 c = self._pins.get(s, 0)
                 if c <= 1:
                     self._pins.pop(s, None)
+                    if c == 1 and s in self._deferred:
+                        self._deferred.discard(s)
+                        self._dirty.append(s)
                 else:
                     self._pins[s] = c - 1
 
     def remove(self, key: Hashable) -> Optional[int]:
-        """Drop a key (admin reset); returns its slot (caller clears it)."""
+        """Drop a key (admin reset); returns its slot (caller clears it).
+
+        A slot with a live pin refcount (a stream's assign->dispatch window)
+        is not freed immediately — it joins the dirty list at last unpin so
+        a new key can never receive the pinned dispatch's stale write."""
         with self._lock:
             slot = self._map.pop(key, None)
             if slot is not None:
-                self._free.append(slot)
+                if self._pins.get(slot):
+                    self._deferred.add(slot)
+                else:
+                    self._free.append(slot)
             return slot
 
     def __len__(self) -> int:

@@ -284,16 +284,21 @@ class TpuBatchedStorage(RateLimitStorage):
         pinned = self._batcher.pending_slots(algo)
         slots: List[int] = []
         clears: List[int] = []
-        for lid, key in zip(lid_per_req, keys):
-            slot, evicted = index.assign((lid, key), pinned=pinned,
-                                         hold_pin=True)
-            if evicted is not None:
-                clears.append(evicted)
-            pinned.add(slot)
-            slots.append(slot)
-        with self._pins_released(index, slots):
+        # try/finally from the FIRST assign: a mid-loop raise ("all slots
+        # pinned") must release the pins earlier iterations took.
+        try:
+            for lid, key in zip(lid_per_req, keys):
+                slot, evicted = index.assign((lid, key), pinned=pinned,
+                                             hold_pin=True)
+                if evicted is not None:
+                    clears.append(evicted)
+                pinned.add(slot)
+                slots.append(slot)
             return self._batcher.dispatch_direct(
                 algo, slots, list(lid_per_req), list(permits), clears)
+        finally:
+            self._unpin_held(
+                index, [np.asarray(slots, dtype=np.int32)] if slots else [])
 
     def acquire_many_ids(
         self, algo: str, lid: int, key_ids: np.ndarray, permits: np.ndarray,
@@ -314,14 +319,26 @@ class TpuBatchedStorage(RateLimitStorage):
             pinned = self._batcher.pending_slots(algo)
             slots = []
             clears = []
-            for k in np.asarray(key_ids):
-                slot, evicted = index.assign((lid, int(k)), pinned=pinned,
-                                             hold_pin=True)
-                if evicted is not None:
-                    clears.append(evicted)
-                pinned.add(slot)
-                slots.append(slot)
-            slots = np.asarray(slots, dtype=np.int32)
+            # try/finally from the FIRST assign (see acquire_many): a
+            # mid-loop raise must release earlier iterations' pins.
+            try:
+                for k in np.asarray(key_ids):
+                    slot, evicted = index.assign((lid, int(k)),
+                                                 pinned=pinned,
+                                                 hold_pin=True)
+                    if evicted is not None:
+                        clears.append(evicted)
+                    pinned.add(slot)
+                    slots.append(slot)
+                slots = np.asarray(slots, dtype=np.int32)
+                lids = np.full(len(slots), lid, dtype=np.int32)
+                return self._batcher.dispatch_direct(algo, slots, lids,
+                                                     permits, clears)
+            finally:
+                self._unpin_held(
+                    index,
+                    [np.asarray(slots, dtype=np.int32)] if len(slots)
+                    else [])
         lids = np.full(len(slots), lid, dtype=np.int32)
         with self._pins_released(index, slots):
             return self._batcher.dispatch_direct(algo, slots, lids, permits,
@@ -834,54 +851,63 @@ class TpuBatchedStorage(RateLimitStorage):
             for g in self._batcher.pending_slots(algo):
                 pins_by_shard.setdefault(g // sps, set()).add(g % sps)
             l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            for s in range(n_sh):
-                m = shard == s
-                if not m.any():
-                    continue
-                pins = pins_by_shard.get(s)
-                sub = index._sub[s]
-                if multi_lid:
-                    sl, ev = sub.assign_batch_ints_multi(
-                        chunk[m], l_chunk[m], pinned=pins, hold_pins=True)
-                else:
-                    sl, ev = sub.assign_batch_ints(chunk[m], lid,
-                                                   pinned=pins,
-                                                   hold_pins=True)
-                local[m] = sl
-                clears.extend(s * sps + int(e) for e in ev)
-            if clears:
-                clear(clears)
-            # Column of each request within its shard row (arrival order —
-            # the stable per-slot segment order the flat step sorts by).
-            order = np.argsort(shard, kind="stable")
-            counts = np.bincount(shard, minlength=n_sh)
-            offs = np.zeros(n_sh + 1, dtype=np.int64)
-            np.cumsum(counts, out=offs[1:])
-            cols = np.empty(cn, dtype=np.int64)
-            cols[order] = np.arange(cn) - offs[shard[order]]
-            from ratelimiter_tpu.parallel.sharded import _bucket
+            # Pins accumulate per shard as the loop assigns; the finally
+            # releases whatever was taken even if a later shard's assign,
+            # the clears dispatch, or the matrix packing raises (a leaked
+            # pin would make its slot permanently unevictable).
+            held: list = []
+            try:
+                for s in range(n_sh):
+                    m = shard == s
+                    if not m.any():
+                        continue
+                    pins = pins_by_shard.get(s)
+                    sub = index._sub[s]
+                    if multi_lid:
+                        sl, ev = sub.assign_batch_ints_multi(
+                            chunk[m], l_chunk[m], pinned=pins,
+                            hold_pins=True)
+                    else:
+                        sl, ev = sub.assign_batch_ints(chunk[m], lid,
+                                                       pinned=pins,
+                                                       hold_pins=True)
+                    local[m] = sl
+                    held.append(s * sps + sl.astype(np.int64))
+                    clears.extend(s * sps + int(e) for e in ev)
+                if clears:
+                    clear(clears)
+                # Column of each request within its shard row (arrival order
+                # — the stable per-slot segment order the flat step sorts
+                # by).
+                order = np.argsort(shard, kind="stable")
+                counts = np.bincount(shard, minlength=n_sh)
+                offs = np.zeros(n_sh + 1, dtype=np.int64)
+                np.cumsum(counts, out=offs[1:])
+                cols = np.empty(cn, dtype=np.int64)
+                cols[order] = np.arange(cn) - offs[shard[order]]
+                from ratelimiter_tpu.parallel.sharded import _bucket
 
-            b_loc = _bucket(int(counts.max(initial=1)))
-            slots_mat = np.full((n_sh, b_loc), -1, dtype=np.int32)
-            slots_mat[shard, cols] = local
-            if oversize is not None:
-                ov = oversize[start:start + cn]
-                slots_mat[shard[ov], cols[ov]] = -1  # force-deny
-            lid_sb = lid
-            if multi_lid:
-                lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
-                lid_mat[shard, cols] = l_chunk
-                lid_sb = lid_mat
-            p_sb = None
-            if permits is not None:
-                p_mat = np.ones((n_sh, b_loc), dtype=np.int32)
-                p_mat[shard, cols] = permits[start:start + cn]
-                p_sb = p_mat
-            now = self._monotonic_now()
-            t0 = time.perf_counter()
-            with self._pins_released(index,
-                                     shard.astype(np.int64) * sps + local):
+                b_loc = _bucket(int(counts.max(initial=1)))
+                slots_mat = np.full((n_sh, b_loc), -1, dtype=np.int32)
+                slots_mat[shard, cols] = local
+                if oversize is not None:
+                    ov = oversize[start:start + cn]
+                    slots_mat[shard[ov], cols[ov]] = -1  # force-deny
+                lid_sb = lid
+                if multi_lid:
+                    lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                    lid_mat[shard, cols] = l_chunk
+                    lid_sb = lid_mat
+                p_sb = None
+                if permits is not None:
+                    p_mat = np.ones((n_sh, b_loc), dtype=np.int32)
+                    p_mat[shard, cols] = permits[start:start + cn]
+                    p_sb = p_mat
+                now = self._monotonic_now()
+                t0 = time.perf_counter()
                 bits = dispatch(slots_mat, lid_sb, p_sb, now)
+            finally:
+                self._unpin_held(index, held)
             pending.append((bits, start, cn, shard, cols, b_loc, t0))
             if len(pending) > 1:
                 drain(*pending.pop(0))
@@ -959,81 +985,88 @@ class TpuBatchedStorage(RateLimitStorage):
             clears: list = []
             pin_glob: list = []
             u_total = u_max = b_max = 0
-            for s in range(n_sh):
-                pos = np.where(shard == s)[0]
-                if not len(pos):
-                    results.append((pos, None, None, 0, None))
-                    continue
-                sub = index._sub[s]
-                if multi_lid:
-                    uw, uidx, rank, ev = sub.assign_batch_ints_multi_uniques(
-                        kchunk[pos], l_chunk[pos], rb,
-                        pinned=pins_by_shard.get(s), hold_pins=True)
-                else:
-                    uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
-                        kchunk[pos], lid, rb, pinned=pins_by_shard.get(s),
-                        hold_pins=True)
-                clears.extend(s * sps + int(e) for e in ev)
-                results.append((pos, uidx, rank, len(uw), uw))
-                pin_glob.append(
-                    ((uw >> np.uint32(rb + 1)).astype(np.int64) + s * sps))
-                u_total += len(uw)
-                u_max = max(u_max, len(uw))
-                b_max = max(b_max, len(pos))
-            if clears:
-                clear(clears)
-            digest = cdt is not None and (
-                digest_bpu * n_sh * _bucket(max(u_max, 1))
-                <= words_bpr * cn)
-            now = self._monotonic_now()
-            t0 = time.perf_counter()
-            pins = (np.concatenate(pin_glob) if pin_glob
-                    else np.empty(0, dtype=np.int64))
-            if digest:
-                u_loc = _bucket(max(u_max, 1))
-                uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF, dtype=np.uint32)
-                lid_mat = None
-                if multi_lid:
-                    lid_mat = np.zeros((n_sh, u_loc), dtype=np.int32)
-                per_shard = []
-                for s, item in enumerate(results):
-                    pos = item[0]
+            # Pins accumulate per shard as the loop assigns; the finally
+            # releases them even if a later shard's assign, the clears
+            # dispatch, the mode election, or the matrix packing raises.
+            try:
+                for s in range(n_sh):
+                    pos = np.where(shard == s)[0]
                     if not len(pos):
-                        per_shard.append((pos, None, None, 0))
+                        results.append((pos, None, None, 0, None))
                         continue
-                    _, uidx, rank, u, uw = item
-                    uw_mat[s, :u] = uw
+                    sub = index._sub[s]
                     if multi_lid:
-                        first = rank == 0
-                        ulids = np.zeros(u, dtype=np.int32)
-                        ulids[uidx[first]] = l_chunk[pos][first]
-                        lid_mat[s, :u] = ulids
-                    per_shard.append((pos, uidx, rank, u))
-                with self._pins_released(index, pins):
+                        uw, uidx, rank, ev = \
+                            sub.assign_batch_ints_multi_uniques(
+                                kchunk[pos], l_chunk[pos], rb,
+                                pinned=pins_by_shard.get(s), hold_pins=True)
+                    else:
+                        uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
+                            kchunk[pos], lid, rb,
+                            pinned=pins_by_shard.get(s), hold_pins=True)
+                    clears.extend(s * sps + int(e) for e in ev)
+                    results.append((pos, uidx, rank, len(uw), uw))
+                    pin_glob.append(
+                        ((uw >> np.uint32(rb + 1)).astype(np.int64)
+                         + s * sps))
+                    u_total += len(uw)
+                    u_max = max(u_max, len(uw))
+                    b_max = max(b_max, len(pos))
+                if clears:
+                    clear(clears)
+                digest = cdt is not None and (
+                    digest_bpu * n_sh * _bucket(max(u_max, 1))
+                    <= words_bpr * cn)
+                now = self._monotonic_now()
+                t0 = time.perf_counter()
+                if digest:
+                    u_loc = _bucket(max(u_max, 1))
+                    uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF,
+                                     dtype=np.uint32)
+                    lid_mat = None
+                    if multi_lid:
+                        lid_mat = np.zeros((n_sh, u_loc), dtype=np.int32)
+                    per_shard = []
+                    for s, item in enumerate(results):
+                        pos = item[0]
+                        if not len(pos):
+                            per_shard.append((pos, None, None, 0))
+                            continue
+                        _, uidx, rank, u, uw = item
+                        uw_mat[s, :u] = uw
+                        if multi_lid:
+                            first = rank == 0
+                            ulids = np.zeros(u, dtype=np.int32)
+                            ulids[uidx[first]] = l_chunk[pos][first]
+                            lid_mat[s, :u] = ulids
+                        per_shard.append((pos, uidx, rank, u))
                     counts = counts_dispatch(
                         uw_mat, lid if not multi_lid else lid_mat, now, cdt)
-                pending.append(("digest", counts, start, per_shard, t0))
-            else:
-                b_loc = _bucket(max(b_max, 1))
-                w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF, dtype=np.uint32)
-                lid_mat = None
-                if multi_lid:
-                    lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
-                per_shard = []
-                for s, item in enumerate(results):
-                    pos = item[0]
-                    if not len(pos):
-                        per_shard.append((pos,))
-                        continue
-                    _, uidx, rank, u, uw = item
-                    w_mat[s, :len(pos)] = rebuild_words(uw, uidx, rank, rb)
+                    pending.append(("digest", counts, start, per_shard, t0))
+                else:
+                    b_loc = _bucket(max(b_max, 1))
+                    w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF,
+                                    dtype=np.uint32)
+                    lid_mat = None
                     if multi_lid:
-                        lid_mat[s, :len(pos)] = l_chunk[pos]
-                    per_shard.append((pos,))
-                with self._pins_released(index, pins):
+                        lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                    per_shard = []
+                    for s, item in enumerate(results):
+                        pos = item[0]
+                        if not len(pos):
+                            per_shard.append((pos,))
+                            continue
+                        _, uidx, rank, u, uw = item
+                        w_mat[s, :len(pos)] = rebuild_words(uw, uidx, rank,
+                                                            rb)
+                        if multi_lid:
+                            lid_mat[s, :len(pos)] = l_chunk[pos]
+                        per_shard.append((pos,))
                     bits = bits_dispatch(
                         w_mat, lid if not multi_lid else lid_mat, now)
-                pending.append(("bits", bits, start, per_shard, t0))
+                    pending.append(("bits", bits, start, per_shard, t0))
+            finally:
+                self._unpin_held(index, pin_glob)
             if len(pending) > 1:
                 drain(*pending.pop(0))
             wire_b = digest_bpu * u_total if digest else words_bpr * cn
@@ -1094,6 +1127,14 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def flush(self) -> None:
         self._batcher.flush()
+
+    @staticmethod
+    def _unpin_held(index, held) -> None:
+        """Release pins accumulated as a list of slot arrays — the finally
+        half of :meth:`_pins_released` for loops that take pins shard by
+        shard and must release whatever was taken on any exception path."""
+        if held and hasattr(index, "unpin_batch"):
+            index.unpin_batch(np.concatenate(held))
 
     @contextlib.contextmanager
     def _pins_released(self, index, slots):

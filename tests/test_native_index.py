@@ -207,3 +207,99 @@ def test_batch_recency_is_first_occurrence_granular():
     _, evicted = ix.assign((1, 9))
     assert evicted == slots[0]
     assert ix.get((1, 8)) is not None
+
+
+@pytest.mark.parametrize("kind", ["native", "python"])
+def test_remove_while_pinned_defers_free(kind):
+    """ADVICE r2: an admin remove() racing a stream's assign->dispatch pin
+    window must NOT hand the slot to a new key until the pin drops — and
+    the reassignment must report the slot as its own eviction so the
+    (possibly stale) device state is cleared before reuse."""
+    if kind == "native":
+        ix = make_native(2)
+    else:
+        from ratelimiter_tpu.engine.slots import SlotIndex
+
+        ix = SlotIndex(2)
+    s_a, _ = ix.assign((0, 1), hold_pin=True)  # stream holds the pin
+    s_b, _ = ix.assign((0, 2))
+    assert ix.remove((0, 1)) == s_a  # admin reset while pinned
+    # Capacity is 2: key 3 must NOT receive the pinned slot s_a.
+    s_c, ev_c = ix.assign((0, 3))
+    assert s_c != s_a
+    assert ev_c == s_b  # LRU eviction of the only unpinned entry
+    # Pin drops (dispatch enqueued): the slot becomes reusable, but its
+    # next assignment reports it as its own eviction (clear before use).
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))
+    s_d, ev_d = ix.assign((0, 4))
+    assert s_d == s_a and ev_d == s_a
+
+
+@pytest.mark.parametrize("kind", ["native", "python"])
+def test_remove_while_pinned_all_pinned_raises(kind):
+    """With every slot pinned (one via remove-deferral), a new key's
+    assignment must fail loudly, not hand out a pinned slot."""
+    if kind == "native":
+        ix = make_native(1)
+    else:
+        from ratelimiter_tpu.engine.slots import SlotIndex
+
+        ix = SlotIndex(1)
+    s_a, _ = ix.assign((0, 1), hold_pin=True)
+    ix.remove((0, 1))
+    with pytest.raises(RuntimeError):
+        ix.assign((0, 2))
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))
+    s_b, ev_b = ix.assign((0, 2))
+    assert s_b == s_a and ev_b == s_a
+
+
+def test_dirty_slot_repinned_is_skipped():
+    """A dirty slot that was RE-pinned after listing (queued micro-batch
+    request) must not be handed out until that pin also drops."""
+    ix = make_native(2)
+    s_a, _ = ix.assign((0, 1), hold_pin=True)
+    s_b, _ = ix.assign((0, 2))
+    ix.remove((0, 1))
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))  # s_a now dirty
+    ix.pin_batch(np.asarray([s_a], dtype=np.int32))    # re-pinned
+    s_c, ev_c = ix.assign((0, 3))
+    assert s_c == s_b and ev_c == s_b  # LRU eviction, not the dirty slot
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))
+    s_d, ev_d = ix.assign((0, 4))
+    assert s_d == s_a and ev_d == s_a  # dirty handout clears first
+
+
+def test_restore_defers_pinned_unmapped_slot():
+    """restore_fp with a live pin on a slot absent from the dump: the slot
+    must not reach the clean free list — it surfaces dirty at last unpin."""
+    ix = make_native(2)
+    s_a, _ = ix.assign((0, 1), hold_pin=True)  # pinned by an in-flight window
+    s_b, _ = ix.assign((0, 2))
+    h1, h2, slots = ix.dump_fp()
+    keep = slots != s_a  # dump without the pinned slot's entry
+    ix.restore_fp(h1[keep], h2[keep], slots[keep])
+    # Only key 2 is mapped; the pinned slot must not be assigned clean.
+    s_c, ev_c = ix.assign((0, 3))
+    assert s_c != s_a
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))
+    s_d, ev_d = ix.assign((0, 4))
+    assert s_d == s_a and ev_d == s_a  # dirty: cleared before reuse
+
+
+def test_restore_remaps_pinned_slot_cleanly():
+    """restore_fp where the pinned slot IS in the dump: the mapping wins —
+    the slot must never surface on the dirty list at unpin (two keys would
+    share it)."""
+    ix = make_native(2)
+    s_a, _ = ix.assign((0, 1), hold_pin=True)
+    ix.assign((0, 2))
+    h1, h2, slots = ix.dump_fp()
+    ix.restore_fp(h1, h2, slots)  # s_a re-mapped to key 1
+    ix.unpin_batch(np.asarray([s_a], dtype=np.int32))
+    assert ix.get((0, 1)) == s_a
+    # Capacity full: a new key's assignment must EVICT (clearing state),
+    # never receive s_a as a "free" slot while key 1 still maps to it.
+    s_c, ev_c = ix.assign((0, 9))
+    assert ev_c is not None and ev_c == s_c
+    assert len(ix) == 2

@@ -153,3 +153,35 @@ def test_partitioned_checkpoint_round_trip(tmp_path):
         st2.restore_checkpoint(path2)
     for s in (st, st2, st3, st4):
         s.close()
+
+
+def test_partial_failure_releases_sibling_pins():
+    """One partition exhausting capacity mid-batch must release the pins
+    the other (successful) partitions took — their results never reach
+    the caller, so nothing else could unpin them."""
+    import numpy as np
+    import pytest
+
+    from ratelimiter_tpu.engine.partitioned import (
+        PartitionedSlotIndex,
+        _part_of_int_keys,
+    )
+
+    ix = PartitionedSlotIndex(4, n_parts=2)  # 2 slots per partition
+    keys = np.arange(64, dtype=np.int64)
+    part = _part_of_int_keys(keys, 2)
+    p0 = keys[part == 0]
+    p1 = keys[part == 1]
+    # Fill partition 0 and pin both its slots (as in-flight windows).
+    for k in p0[:2]:
+        ix.assign((0, int(k)), hold_pin=True)
+    # Mixed batch: a fresh partition-0 key must fail (-2, all pinned),
+    # while partition-1 keys succeed and get pinned.
+    batch = np.asarray([int(p0[2]), int(p1[0]), int(p1[1])], dtype=np.int64)
+    with pytest.raises(RuntimeError):
+        ix.assign_batch_ints(batch, lid=0, hold_pins=True)
+    # Partition 1's pins must be gone: both its slots evictable again.
+    s1, ev1 = ix.assign((0, int(p1[2])))
+    s2, ev2 = ix.assign((0, int(p1[3])))
+    assert {s1, s2} == {2, 3}  # both partition-1 slots reachable
+    ix.close()
