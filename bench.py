@@ -2,21 +2,38 @@
 
 Headline: end-to-end rate-limit decisions/sec on a 1M-key token-bucket
 Zipf(1.1) stream (BASELINE.json config #2) — integer keys in, allow/deny
-out, through the native slot index + the pipelined scan-bits device path on
-one chip.  vs_baseline compares against the reference's published 80,192
+out, through the native slot index + the pipelined relay/digest device path
+on one chip.  vs_baseline compares against the reference's published 80,192
 req/s (README single-key sliding-window, local cache on, M1 + Redis —
 BASELINE.md).
 
+Robustness discipline (VERDICT r2 #1 — the driver's recorded number must
+match the code's ability):
+
+- Every stream scenario runs a FULL untimed warmup pass first.  The relay
+  chunk-growth schedule is deterministic in the key stream, so the warmup
+  visits every chunk shape the timed passes will visit — no mid-timing
+  XLA compiles (r2's prime suspect for the 5x driver/builder swing).
+- Timed passes record a per-pass phase breakdown (assign_s / host_s /
+  fetch_s / wire_bytes / chunks) from the storage's stream instrumentation
+  plus the number and seconds of backend compiles that fired inside the
+  timed region — so BENCH_DETAIL explains where the seconds went.
+- If the pass walls spread wider than 1.6x, the link is re-probed and ONE
+  extra pass runs; everything (both probes, all passes) is recorded.
+
 Detailed results for all scenarios land in BENCH_DETAIL.json:
   1. single-key sliding window, 10 threads, through the micro-batcher
-     (latency percentiles — the reference's headline scenario; per-request
-     latency here is dominated by the host<->device tunnel RTT of this
-     environment, ~110 ms per fetch — see the "tunnel" note in the detail)
+     (tunnel-RTT-bound here; a CPU-device in-process run of the same code
+     is recorded as sw_single_key_threaded_local — the RTT<<TTL regime
+     the reference actually operates in)
   2. 1M-key token bucket, Zipf(1.1)      [headline, streaming path]
   3. 10M-key sliding window, uniform     (streaming path)
-  4. 100K-tenant multi-config mix        (fused engine path, mixed lids)
+  4. 100K-tenant multi-config mix        (churn pass and resident-lid
+     steady-state passes, reported separately)
   5. burst batch-acquire tryAcquire(key, n in [1,100]) over 1M keys
-     (streaming path with per-request permits)
+  plus: a latency-SLO section (per-request percentiles + RTT
+  decomposition against the <=1 ms target) and a Pallas A/B subprocess
+  pair recording what the kernels buy on this link.
 
 Scale knobs: BENCH_SCALE=small|full (default full on TPU, small elsewhere).
 A persistent XLA compilation cache (.jax_cache) makes repeat runs cheap.
@@ -26,10 +43,13 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def log(msg: str) -> None:
@@ -41,13 +61,33 @@ def main() -> None:
 
     from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
 
-    enable_compile_cache(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
 
     platform = jax.devices()[0].platform
     scale = os.environ.get("BENCH_SCALE") or ("full" if platform == "tpu" else "small")
     small = scale == "small"
     log(f"bench: platform={platform} scale={scale}")
+
+    # -- compile accounting: every backend compile that fires inside a timed
+    # region is a measurement hazard; count them so the detail can prove a
+    # pass was (or was not) compile-contaminated.
+    compile_events: list = []
+
+    def _on_event(name, secs, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            compile_events.append(secs)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+    class _compiles:
+        def __enter__(self):
+            self._n0 = len(compile_events)
+            return self
+
+        def __exit__(self, *a):
+            evs = compile_events[self._n0:]
+            self.n = len(evs)
+            self.secs = round(float(sum(evs)), 3)
 
     def link_probe():
         """Upload bandwidth + round-trip floor of the host<->device link,
@@ -84,7 +124,6 @@ def main() -> None:
         TokenBucketRateLimiter,
     )
     from ratelimiter_tpu.bench.harness import (
-        bench_end_to_end,
         bench_end_to_end_stream,
         bench_threaded,
         uniform_stream,
@@ -104,26 +143,91 @@ def main() -> None:
         detail["link"] = detail_link
     t_start = time.time()
 
+    # Which Pallas kernels are LIVE vs silently fallen back (VERDICT r2 #6:
+    # the axis must be falsifiable from the artifacts).  settle() is the
+    # same cached probe the engines consult, so this records exactly what
+    # the scenario dispatches will use.
+    from ratelimiter_tpu.ops.pallas import block_scatter, solver
+
+    detail["pallas"] = {
+        "flag": os.environ.get("RATELIMITER_PALLAS", "1"),
+        "solver_live": bool(solver.settle()),
+        "block_scatter_live": bool(block_scatter.settle()),
+    }
+    log(f"pallas: solver_live={detail['pallas']['solver_live']} "
+        f"block_scatter_live={detail['pallas']['block_scatter_live']}")
+
     # Streaming shape: K sub-batches of B per device dispatch.
     B = (1 << 12) if small else (1 << 19)
     K = 4 if small else 8
     super_n = B * K
 
-    def run_stream(lim, key_ids, permits, reps):
-        """Compile once on the first super-batch, then time `reps` passes."""
-        lim.try_acquire_stream_ids(key_ids[:super_n], permits if permits is None
-                                   else permits[:super_n], batch=B, subbatches=K)
-        n = len(key_ids)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            allowed = lim.try_acquire_stream_ids(key_ids, permits,
-                                                 batch=B, subbatches=K)
-        wall = time.perf_counter() - t0
-        return {
-            "mode": "stream_ids", "decisions": n * reps, "wall_s": wall,
-            "decisions_per_sec": n * reps / wall, "batch": B, "subbatches": K,
-            "allowed_last_pass": int(allowed.sum()),
+    def _agg_stats(stats):
+        """Collapse per-chunk records into one phase breakdown."""
+        if not stats:
+            return None
+        agg = {
+            "chunks": len(stats),
+            "assign_s": round(sum(r.get("assign_s", 0) for r in stats), 4),
+            "host_s": round(sum(r.get("host_s", 0) for r in stats), 4),
+            "fetch_s": round(sum(r.get("fetch_s", 0) for r in stats), 4),
+            "max_fetch_s": round(max((r.get("fetch_s", 0) for r in stats),
+                                     default=0.0), 4),
+            "wire_bytes": int(sum(r.get("wire_bytes", 0) for r in stats)),
         }
+        modes: dict = {}
+        for r in stats:
+            m = r.get("mode", "?")
+            modes[m] = modes.get(m, 0) + 1
+        agg["modes"] = modes
+        return agg
+
+    def run_stream(go, key_ids, permits, reps, storage, warmed=False):
+        """Full untimed warmup pass (visits every chunk shape the growth
+        schedule reaches), then ``reps`` timed passes with per-pass phase
+        breakdowns; re-probes the link and retries once if the pass walls
+        spread wider than 1.6x."""
+        n = len(key_ids)
+        res = {"mode": "stream_ids", "batch": B, "subbatches": K,
+               "decisions_per_pass": n}
+        if not warmed:
+            with _compiles() as cw:
+                go(key_ids, permits)
+            res["warmup"] = {"n_compiles": cw.n, "compile_s": cw.secs}
+        passes = []
+
+        def timed_pass():
+            storage.stream_stats = stats = []
+            with _compiles() as c:
+                t0 = time.perf_counter()
+                allowed = go(key_ids, permits)
+                wall = time.perf_counter() - t0
+            storage.stream_stats = None
+            rec = {"wall_s": round(wall, 4),
+                   "decisions_per_sec": round(n / wall, 1),
+                   "n_compiles": c.n, "compile_s": c.secs,
+                   "phase": _agg_stats(stats)}
+            passes.append(rec)
+            return allowed
+
+        for _ in range(reps):
+            allowed = timed_pass()
+        walls = [p["wall_s"] for p in passes]
+        if platform == "tpu" and max(walls) > 1.6 * min(walls):
+            # A pass was degraded by something outside the code (link
+            # hiccup / noisy neighbor): record a fresh probe + one retry.
+            res["relink"] = link_probe()
+            allowed = timed_pass()
+        total_wall = sum(p["wall_s"] for p in passes)
+        res.update({
+            "decisions": n * len(passes), "wall_s": round(total_wall, 4),
+            "decisions_per_sec": n * len(passes) / total_wall,
+            "best_pass_decisions_per_sec": max(
+                p["decisions_per_sec"] for p in passes),
+            "passes": passes,
+            "allowed_last_pass": int(allowed.sum()),
+        })
+        return res
 
     # -- scenario 2 (headline): 1M-key token bucket, Zipf(1.1) ---------------
     num_keys = 20_000 if small else 1_000_000
@@ -136,10 +240,14 @@ def main() -> None:
 
     key_ids = zipf_stream(rng, num_keys, n_requests)
     with device_profile(profile_dir):
-        res = run_stream(tb_limiter, key_ids, None, reps=2 if small else 3)
+        res = run_stream(
+            lambda ids, p: tb_limiter.try_acquire_stream_ids(
+                ids, p, batch=B, subbatches=K),
+            key_ids, None, 2 if small else 3, storage)
     detail["tb_1m_zipf_stream_ids"] = res
     headline = res["decisions_per_sec"]
-    log(f"  stream (int keys): {headline:,.0f} decisions/s")
+    log(f"  stream (int keys): {headline:,.0f} decisions/s "
+        f"(best pass {res['best_pass_decisions_per_sec']:,.0f})")
 
     # String-key end-to-end (Python key handling included; streamed).
     n_str = min(n_requests, 50_000 if small else 2_000_000)
@@ -166,7 +274,8 @@ def main() -> None:
     # tunnel, never true on a local-attached TPU), every cache expiry
     # chains a full round trip and the scenario measures the LINK, not
     # the engine — the reference's regime (0.8 ms Redis RTT << TTL)
-    # reproduces only with local attachment.
+    # reproduces only with local attachment (see
+    # sw_single_key_threaded_local for that regime measured in-process).
     t0 = time.perf_counter()
     for _ in range(3):
         sw_limiter.try_acquire("rtt-probe-key")
@@ -174,13 +283,61 @@ def main() -> None:
         (time.perf_counter() - t0) / 3 * 1000, 1)
     res["note"] = ("per-request latency includes the host<->device tunnel "
                    "RTT of this environment on cache misses; see "
-                   "device_round_trip_ms — when it exceeds the cache TTL "
-                   "the throughput number measures the link, not the "
-                   "engine")
+                   "device_round_trip_ms and sw_single_key_threaded_local")
     detail["sw_single_key_threaded"] = res
     log(f"  {res['decisions_per_sec']:,.0f} req/s; "
         f"p99 {res['request_latency']['p99_us']:.0f} us")
+
+    # -- latency-SLO section: per-request percentiles + decomposition --------
+    # The <=1 ms p99 target (BASELINE.md) is a LOCAL-attachment claim; this
+    # section records the tunnel numbers alongside the pieces that compose
+    # them (batcher flush delay, device RTT) so the production claim is
+    # checkable: p99_local ~= max_delay_ms + device step + PCIe RTT.
+    log("latency SLO: 16 threads, distinct keys, percentiles + decomposition...")
+    res = bench_threaded(
+        sw_limiter,
+        keys_per_thread=lambda t: [f"slo-user-{t}-{i}" for i in range(64)],
+        n_threads=16,
+        requests_per_thread=100 if small else 400,
+    )
+    res["decomposition"] = {
+        "batcher_max_delay_ms": 0.3,
+        "device_round_trip_ms": detail["sw_single_key_threaded"][
+            "device_round_trip_ms"],
+        "target_p99_ms_local": 1.0,
+        "note": ("tunnel RTT dominates every percentile here; on local "
+                 "attachment the same path's bound is max_delay + one "
+                 "device step + PCIe round trip — see "
+                 "sw_single_key_threaded_local for the measured "
+                 "zero-RTT regime"),
+    }
+    detail["latency_slo_threaded"] = res
+    log(f"  p50 {res['request_latency']['p50_us']:.0f} us, "
+        f"p99 {res['request_latency']['p99_us']:.0f} us over "
+        f"{res['request_latency']['n_samples']} requests")
     storage.close()
+
+    # -- scenario 1-local: same code, CPU device in-process (RTT ~ 0) --------
+    # The reference's operating regime is RTT << cache TTL; the tunnel
+    # inverts that.  A subprocess pins jax to the in-process CPU device and
+    # reruns scenario 1 — same limiter, same batcher, zero tunnel.
+    log("scenario 1-local: single-key SW, CPU device in-process...")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench",
+                                          "local_single_key.py")],
+            capture_output=True, timeout=600, text=True, cwd=_REPO)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"rc={proc.returncode} stderr={proc.stderr[-500:]!r}")
+        detail["sw_single_key_threaded_local"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+        r = detail["sw_single_key_threaded_local"]
+        log(f"  local: {r['decisions_per_sec']:,.0f} req/s; "
+            f"p99 {r['request_latency']['p99_us']:.0f} us")
+    except Exception as exc:  # noqa: BLE001 — aux section must not kill bench
+        detail["sw_single_key_threaded_local"] = {"error": str(exc)}
+        log(f"  local single-key failed: {exc}")
 
     # -- scenario 3: 10M-key sliding window, uniform (streaming) -------------
     num_keys3 = 50_000 if small else 10_000_000
@@ -192,16 +349,25 @@ def main() -> None:
         RateLimitConfig(max_permits=100, window_ms=60_000,
                         enable_local_cache=False),
         MeterRegistry())
-    res = run_stream(sw3, uniform_stream(rng, num_keys3, n3), None,
-                     reps=2 if small else 3)
+    res = run_stream(
+        lambda ids, p: sw3.try_acquire_stream_ids(ids, p, batch=B,
+                                                  subbatches=K),
+        uniform_stream(rng, num_keys3, n3), None, 2 if small else 3,
+        storage3)
     detail["sw_10m_uniform_stream"] = res
     log(f"  stream: {res['decisions_per_sec']:,.0f} decisions/s")
     storage3.close()
 
     # -- scenario 4: 100K-tenant multi-config mix (multi-lid stream) ---------
+    # Measured in TWO phases (VERDICT r2 #4): a CHURN pass where every lid
+    # is a first touch (the warmup fills the slot space with a disjoint
+    # key population, so the timed churn pass pays full eviction + lid
+    # delta-upload cost at warm compile shapes), then STEADY-STATE passes
+    # where the lids are device-resident and the digest wire cost drops to
+    # ~5-6 B/unique.
     n_tenants = 1000 if small else 100_000
     n4 = super_n * (2 if small else 3)
-    log(f"scenario 4: {n_tenants}-tenant mix (stream)...")
+    log(f"scenario 4: {n_tenants}-tenant mix (churn + steady stream)...")
     table = LimiterTable(capacity=n_tenants + 2)
     lids = np.asarray(
         [table.register(RateLimitConfig(
@@ -214,18 +380,40 @@ def main() -> None:
     # ~8 user keys per tenant, per-request tenant policy.
     keys4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
     lids4 = lids[tenant_of_req]
-    storage4.acquire_stream_ids("tb", lids4[:super_n], keys4[:super_n],
-                                batch=B, subbatches=K)
-    t0_all = time.perf_counter()
-    allowed4 = storage4.acquire_stream_ids("tb", lids4, keys4,
-                                           batch=B, subbatches=K)
-    wall = time.perf_counter() - t0_all
-    detail["multi_tenant_100k_stream"] = {
-        "mode": "stream_ids_multi", "decisions": n4, "wall_s": wall,
-        "decisions_per_sec": n4 / wall, "tenants": n_tenants,
-        "allowed": int(allowed4.sum()),
+    # Warmup on a DISJOINT key population: compiles every chunk shape and
+    # fills the slot space so the churn pass below is 100% first-touch.
+    with _compiles() as cw:
+        storage4.acquire_stream_ids("tb", lids4, keys4 + (n_tenants * 8),
+                                    batch=B, subbatches=K)
+    storage4.stream_stats = churn_stats = []
+    with _compiles() as cc:
+        t0 = time.perf_counter()
+        allowed_churn = storage4.acquire_stream_ids("tb", lids4, keys4,
+                                                    batch=B, subbatches=K)
+        churn_wall = time.perf_counter() - t0
+    storage4.stream_stats = None
+    detail["multi_tenant_100k_churn"] = {
+        "mode": "stream_ids_multi_first_touch", "decisions": n4,
+        "wall_s": round(churn_wall, 4),
+        "decisions_per_sec": round(n4 / churn_wall, 1),
+        "tenants": n_tenants, "allowed": int(allowed_churn.sum()),
+        "n_compiles": cc.n, "compile_s": cc.secs,
+        "warmup": {"n_compiles": cw.n, "compile_s": cw.secs},
+        "phase": _agg_stats(churn_stats),
     }
-    log(f"  stream: {n4 / wall:,.0f} decisions/s")
+    log(f"  churn (first touch): {n4 / churn_wall:,.0f} decisions/s")
+    # run_stream's own untimed warmup doubles as the first steady pass:
+    # the zero-delta resident-lid dispatch is a NEW compile shape after a
+    # churn pass (delta lanes shrink to the floor bucket), and it must
+    # settle before the timed steady passes.
+    res = run_stream(
+        lambda ids, p: storage4.acquire_stream_ids("tb", lids4, ids,
+                                                   batch=B, subbatches=K),
+        keys4, None, 2 if small else 3, storage4)
+    res["mode"] = "stream_ids_multi_steady"
+    res["tenants"] = n_tenants
+    detail["multi_tenant_100k_stream"] = res
+    log(f"  steady state: {res['decisions_per_sec']:,.0f} decisions/s")
     storage4.close()
 
     # -- scenario 5: burst batch-acquire over 1M keys (streaming) ------------
@@ -239,10 +427,44 @@ def main() -> None:
         MeterRegistry())
     key5 = uniform_stream(rng, num_keys5, n5)
     perms5 = rng.integers(1, 101, size=n5).astype(np.int64)
-    res = run_stream(tb5, key5, perms5, reps=2)
+    res = run_stream(
+        lambda ids, p: tb5.try_acquire_stream_ids(ids, p, batch=B,
+                                                  subbatches=K),
+        key5, perms5, 2, storage5)
     detail["tb_burst_batch_stream"] = res
     log(f"  stream: {res['decisions_per_sec']:,.0f} decisions/s")
     storage5.close()
+
+    # -- Pallas A/B (subprocess pair): what the kernels buy on this link -----
+    # The solver serves micro-batcher-sized dispatches (<= 16K lanes); the
+    # A/B drives that path with the flag on/off.  RATELIMITER_PALLAS is
+    # read at import, hence subprocesses.
+    if platform == "tpu" and not small:
+        log("pallas A/B (micro-batch path, subprocess pair)...")
+        ab = {}
+        for flag in ("1", "0"):
+            try:
+                env = dict(os.environ, RATELIMITER_PALLAS=flag,
+                           RATELIMITER_BLOCK_SCATTER=flag)
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(_REPO, "bench",
+                                                  "pallas_ab.py")],
+                    capture_output=True, timeout=600, text=True, cwd=_REPO,
+                    env=env)
+                if proc.returncode != 0 or not proc.stdout.strip():
+                    raise RuntimeError(
+                        f"rc={proc.returncode} stderr={proc.stderr[-400:]!r}")
+                ab["pallas_on" if flag == "1" else "pallas_off"] = (
+                    json.loads(proc.stdout.strip().splitlines()[-1]))
+            except Exception as exc:  # noqa: BLE001
+                ab["pallas_on" if flag == "1" else "pallas_off"] = {
+                    "error": str(exc)}
+        detail["pallas_ab"] = ab
+        on = ab.get("pallas_on", {}).get("decisions_per_sec")
+        off = ab.get("pallas_off", {}).get("decisions_per_sec")
+        if on and off:
+            log(f"  pallas on: {on:,.0f}/s, off: {off:,.0f}/s "
+                f"(x{on / off:.2f})")
 
     # -- sharded scaling (virtual CPU mesh, subprocess) ----------------------
     # The multi-chip sharding machinery measured 1 -> 8 shards; a separate
@@ -250,13 +472,10 @@ def main() -> None:
     # work (this process owns the TPU).
     log("sharded scaling (8-device virtual CPU mesh, subprocess)...")
     try:
-        import subprocess
-
         proc = subprocess.run(
-            [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "bench", "sharded_scaling.py")],
-            capture_output=True, timeout=600, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            [sys.executable, os.path.join(_REPO, "bench",
+                                          "sharded_scaling.py")],
+            capture_output=True, timeout=600, text=True, cwd=_REPO)
         if proc.returncode != 0 or not proc.stdout.strip():
             raise RuntimeError(
                 f"rc={proc.returncode} stderr={proc.stderr[-500:]!r}")
@@ -271,7 +490,7 @@ def main() -> None:
 
     detail["total_bench_seconds"] = time.time() - t_start
 
-    with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"), "w") as fh:
+    with open(os.path.join(_REPO, "BENCH_DETAIL.json"), "w") as fh:
         json.dump(detail, fh, indent=2)
 
     baseline = 80_192.0  # reference README throughput (BASELINE.md)

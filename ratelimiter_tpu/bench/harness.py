@@ -35,6 +35,9 @@ def _pcts(lat_us: np.ndarray) -> Dict[str, float]:
     def pct(p):
         return float(lat[min(len(lat) - 1, int(p * len(lat)))])
     return {
+        # n_samples makes degenerate upper percentiles visible (p95 == p99
+        # means the tail is one sample, not a plateau).
+        "n_samples": int(len(lat)),
         "mean_us": float(lat.mean()),
         "p50_us": pct(0.50),
         "p95_us": pct(0.95),
