@@ -106,6 +106,10 @@ class DeviceEngine:
         self._tb_relay = jax.jit(functools.partial(
             tb_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
         self._relay_counts = {}  # (algo, out_dtype name) -> jitted step
+        self._relay_weighted = {}  # (algo, r_steps) -> jitted weighted step
+        # Largest per-request permits the weighted relay carries (uint8
+        # CSR permits lane); larger permits take the sorted flat path.
+        self.weighted_permit_cap = 255
         # Resident tenant-id map per algo (ops/relay.py:*_relay_counts_
         # resident): one slot = one (limiter, key), so a slot's lid is
         # immutable while assigned; the digest-multi path uploads only
@@ -306,6 +310,54 @@ class DeviceEngine:
         from ratelimiter_tpu.ops import relay as relay_ops
 
         return relay_ops.counts_dtype(self.table.max_permits_registered)
+
+    # -- weighted relay dispatch (ops/relay.py:*_relay_weighted) ---------------
+    def sw_weighted_dispatch(self, uwords, perms_rank, roff, lid,
+                             now_ms, r_steps):
+        return self._weighted_dispatch("sw", uwords, perms_rank, roff,
+                                       lid, now_ms, r_steps)
+
+    def tb_weighted_dispatch(self, uwords, perms_rank, roff, lid,
+                             now_ms, r_steps):
+        return self._weighted_dispatch("tb", uwords, perms_rank, roff,
+                                       lid, now_ms, r_steps)
+
+    def _weighted_dispatch(self, algo, uwords, perms_rank, roff, lid,
+                           now_ms, r_steps):
+        """uwords uint32[U] (slot | count; padding 0xFFFFFFFF; segments
+        in count-descending order), perms_rank uint8[N+U] rank-major
+        compacted permits, roff i32[R] per-rank offsets; returns the
+        lazy uint8[r_steps, U/8] decision-bit handle (bit [r, j] = r-th
+        request of count-sorted segment j)."""
+        from ratelimiter_tpu.ops.relay import (
+            sw_relay_weighted,
+            tb_relay_weighted,
+        )
+
+        key = (algo, int(r_steps))
+        fn = self._relay_weighted.get(key)
+        if fn is None:
+            base = sw_relay_weighted if algo == "sw" else tb_relay_weighted
+            fn = jax.jit(functools.partial(
+                base, rank_bits=self.rank_bits, r_steps=int(r_steps)),
+                donate_argnums=0)
+            self._relay_weighted[key] = fn
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        perms_rank = jnp.asarray(
+            np.ascontiguousarray(perms_rank, dtype=np.uint8))
+        roff = jnp.asarray(np.ascontiguousarray(roff, dtype=np.int32))
+        lid = jnp.asarray(np.int32(lid))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, bits = fn(
+                    self.sw_packed, self.table.device_arrays, uwords,
+                    perms_rank, roff, lid, now)
+            else:
+                self.tb_packed, bits = fn(
+                    self.tb_packed, self.table.device_arrays, uwords,
+                    perms_rank, roff, lid, now)
+        return bits
 
     def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
         return self._relay_counts_dispatch("sw", uwords, lids, now_ms,

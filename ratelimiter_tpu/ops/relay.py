@@ -53,6 +53,7 @@ TokenBucketRateLimiter.java:38-68.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ratelimiter_tpu.core.config import TOKEN_FP_ONE
@@ -285,6 +286,132 @@ def sw_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
         packed, table, uwords, lids, now, rank_bits=rank_bits,
         out_dtype=out_dtype)
     return packed_new, lid_map, counts
+
+
+def _weighted_step_w(perms_rank, roff, r, count, u_b):
+    """Permits of the r-th request of every segment (0 where r >= count).
+
+    The host sorts a chunk's segments by occurrence count DESCENDING and
+    ships permits rank-major compacted: all rank-0 permits (in segment
+    order), then all rank-1 permits, ...  With that ordering the
+    segments active at rank r are a PREFIX of the lane, so each step's
+    permits are one contiguous ``dynamic_slice`` at ``roff[r]`` — no
+    gathers, no rank-matrix padding, exactly 1 B/request on the wire.
+    """
+    w = jax.lax.dynamic_slice(perms_rank, (roff[r],),
+                              (u_b,)).astype(jnp.int64)
+    return jnp.where(r < count, w, jnp.int64(0))
+
+
+def tb_relay_weighted(packed, table, uwords, perms_rank, roff, lid, now, *,
+                      rank_bits: int, r_steps: int):
+    """Weighted-permit relay token-bucket step — no sort, no solver.
+
+    uwords uint32[U] carries (slot | segment count) per unique exactly as
+    the digest path (padding 0xFFFFFFFF), in COUNT-DESCENDING segment
+    order; ``perms_rank`` uint8[N+U] is the chunk's permits rank-major
+    compacted (see :func:`_weighted_step_w`); ``roff`` i32[R] the
+    per-rank offsets.  A ``lax.scan`` over the ``r_steps`` rank steps
+    runs the exact skip recurrence of ops/flat.py:tb_flat_bits (denied
+    requests consume nothing) with a U-wide elementwise body — nothing
+    here has the super-linear XLA:TPU compile cost of
+    sort/associative_scan, so chunks grow to the wire budget like the
+    unit-permit relay.
+
+    ``lid`` is a 0-d i32 (single-tenant streams; multi-lid weighted
+    streams take the flat path).  Returns (new_packed, packed decision
+    bits in the same compact rank-major layout as perms_rank — bit
+    [roff[r] + j] decides the r-th request of the j-th count-sorted
+    segment, ~1 bit/request); the host reconstructs arrival order via
+    its (uidx, rank) scratch and the sort permutation.  Decisions are
+    bit-identical to tb_flat_bits on the same chunking
+    (tests/test_relay.py).
+    """
+    num_slots = packed.shape[0]
+    u_b = uwords.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    cap = table.cap_fp[lid]
+    rate = table.rate_fp[lid]
+    maxp = table.max_permits[lid]
+    ttl2 = table.ttl2_ms[lid]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+
+    def body(carry, r):
+        consumed, buf = carry
+        w = _weighted_step_w(perms_rank, roff, r, count, u_b)
+        w_fp = w * TOKEN_FP_ONE
+        ok = (valid & (w >= 1) & (w <= maxp)
+              & (consumed + w_fp <= v1))
+        # Decisions go back in the SAME compact rank-major layout the
+        # permits came in: ascending-r block writes, each fixing the
+        # previous write's padding tail (see _weighted_step_w).
+        buf = jax.lax.dynamic_update_slice(
+            buf, ok.astype(jnp.uint8), (roff[r],))
+        return (consumed + jnp.where(ok, w_fp, 0), buf), None
+
+    (consumed, buf), _ = jax.lax.scan(
+        body,
+        (jnp.zeros_like(v1),
+         jnp.zeros(perms_rank.shape[0], dtype=jnp.uint8)),
+        jnp.arange(r_steps, dtype=jnp.int64))
+    any_inc = consumed > 0
+    tokens_new = jnp.where(any_inc, v1 - consumed, rows[0])
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
+    widx = jnp.where(valid & any_inc, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(
+        _tb_encode(tokens_new, last_new), mode="drop")
+    return packed_new, jnp.packbits(buf)
+
+
+def sw_relay_weighted(packed, table, uwords, perms_rank, roff, lid, now, *,
+                      rank_bits: int, r_steps: int):
+    """Weighted-permit relay sliding-window step (see tb_relay_weighted).
+
+    The recurrence state is the count of prior INCREMENTS m (quirk Q1:
+    weighted requests check count+permits but increment by 1); the
+    emitted decision additionally re-checks the post-increment count
+    (quirk Q2), exactly as ops/flat.py:sw_flat_bits.
+    """
+    num_slots = packed.shape[0]
+    u_b = uwords.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    maxp = table.max_permits[lid]
+    win = table.window_ms[lid]
+    rem = now % win
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    base = (prev_e * (win - rem)) // win
+
+    def body(carry, r):
+        m, buf = carry
+        w = _weighted_step_w(perms_rank, roff, r, count, u_b)
+        t = maxp - base - curr_e - w
+        inc = valid & (w >= 1) & (m <= t)
+        allowed = inc & (curr_e + m + 1 <= maxp)
+        buf = jax.lax.dynamic_update_slice(
+            buf, allowed.astype(jnp.uint8), (roff[r],))
+        return (m + inc, buf), None
+
+    (m_fin, buf), _ = jax.lax.scan(
+        body,
+        (jnp.zeros_like(curr_e),
+         jnp.zeros(perms_rank.shape[0], dtype=jnp.uint8)),
+        jnp.arange(r_steps, dtype=jnp.int64))
+    any_inc = m_fin > 0
+    curr_new = curr_e + m_fin
+    samew = rows[0] == curr_ws
+    cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    widx = jnp.where(valid, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(
+        _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e),
+        mode="drop")
+    return packed_new, jnp.packbits(buf)
 
 
 def sw_relay_bits(packed, table, words, lids, now, *, rank_bits: int):

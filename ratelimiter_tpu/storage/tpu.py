@@ -71,11 +71,32 @@ _RELAY_WIRE_BUDGET_WORDS = 4 << 20
 # instead of reaching the ~6 B/unique resident steady state.
 _DELTA_AMORT = 4
 
+# Weighted relay wire budget: the rank-major layout has no sort/scan
+# compile ceiling and ~1.5-4 B/request wire cost, so chunks amortize
+# best when the whole pass is a handful of dispatches.
+_RELAY_WIRE_BUDGET_WEIGHTED = 48 << 20
+
+# Weighted relay: longest rank-major permit matrix the scan step accepts.
+# A chunk whose deepest segment exceeds this (heavy duplication — Zipf
+# bursts) dispatches through the sorted flat step instead; duplicate-poor
+# weighted traffic (the burst batch-acquire scenario) stays on the relay.
+_WREL_MAX_R = 64
+
 
 def _bucket_pow2(n: int) -> int:
     from ratelimiter_tpu.parallel.sharded import _bucket
 
     return _bucket(n, floor=4096)
+
+
+def _bucket_fine(n: int, floor: int = 4096) -> int:
+    """Quarter-pow2 bucketing: next multiple of pow2/4 — at most 4 compile
+    shapes per octave instead of 1, for ~12% worst-case padding instead
+    of ~100% (used where a lane's bytes dominate the wire)."""
+    if n <= floor:
+        return floor
+    step = 1 << (int(n - 1).bit_length() - 2)
+    return -(-n // step) * step
 
 
 def _wall_clock_ms() -> int:
@@ -452,6 +473,22 @@ class TpuBatchedStorage(RateLimitStorage):
         if oversize is not None:
             permits = np.where(oversize, 1, permits)  # lanes masked, see above
 
+        if (permits is not None and not multi_lid and oversize is None
+                and hasattr(index, "assign_batch_ints_uniques")
+                and permits.size
+                and int(permits.min()) >= 1
+                and int(permits.max()) <= self.engine.weighted_permit_cap):
+            # Weighted-permit relay (ops/relay.py:*_relay_weighted): the
+            # index's duplicate structure splits segments into closed-form
+            # singles and a short rank-major scan — no device sort, no
+            # solver, chunks grow to the wire budget.  Requests with
+            # permits < 1 or above the word capacity keep the flat path's
+            # semantics and routing.
+            return self._stream_weighted(algo, lid, key_ids,
+                                         np.ascontiguousarray(
+                                             permits, dtype=np.int64),
+                                         index)
+
         if (permits is None
                 and hasattr(index, "assign_batch_ints_uniques")
                 and self.engine.relay_usable()):
@@ -650,6 +687,148 @@ class TpuBatchedStorage(RateLimitStorage):
                       else _RELAY_WIRE_BUDGET_WORDS)
             chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
                             _RELAY_CHUNK_MAX))
+            start += cn
+        for item in pending:
+            drain(*item)
+        return out
+
+    def _stream_weighted(self, algo, lid, key_ids, permits,
+                         index) -> np.ndarray:
+        """Weighted-permit relay streaming loop.
+
+        Per chunk, one C call assigns slots and hands back the duplicate
+        structure (uidx, rank); the host sorts segments by occurrence
+        count DESCENDING and lays the permits out rank-major compacted
+        (all rank-0 permits, then rank-1, ... — 1 B/request with zero
+        padding waste, plus 4 B/unique of words), so each rank step's
+        active segments are a PREFIX and the device reads its permits
+        with one contiguous ``dynamic_slice``.  A short ``lax.scan``
+        over rank steps then runs the exact skip recurrence of the
+        sorted flat step.  No sort, no solver, no super-linear compile
+        shapes, so chunks grow to the wire budget and pipeline two-deep
+        exactly like the unit-permit relay.  A chunk whose deepest
+        segment exceeds ``_WREL_MAX_R`` (heavy duplication — the scan
+        would be long and mostly masked) falls back to sorted flat
+        dispatches for that chunk.  Decisions are bit-identical to
+        ``_stream_flat`` on the same chunking (tests/test_relay.py)."""
+        eng = self.engine
+        rb = eng.rank_bits
+        dispatch = (eng.sw_weighted_dispatch if algo == "sw"
+                    else eng.tb_weighted_dispatch)
+        flat_dispatch = (eng.sw_flat_dispatch if algo == "sw"
+                         else eng.tb_flat_dispatch)
+        # The CSR mask needs true counts; the word count field clamps at
+        # (1 << rank_bits) - 1, so deeper chunks must fall back.
+        r_cap = min(_WREL_MAX_R, (1 << rb) - 1)
+        n = len(key_ids)
+        out = np.empty(n, dtype=bool)
+        pending: list[tuple] = []
+
+        def drain(kind, handle, start, count, extra, t0, rec):
+            tf0 = time.perf_counter()
+            if kind == "weighted":
+                flat_bits = np.unpackbits(np.asarray(handle))
+                if rec is not None:
+                    rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+                pos = extra  # roff[rank] + spos per request
+                got = flat_bits[pos].astype(bool)
+            else:  # flat-fallback slice
+                arr = np.asarray(handle)
+                if rec is not None:
+                    rec["fetch_s"] = round(
+                        rec.get("fetch_s", 0)
+                        + (time.perf_counter() - tf0), 6)
+                got = np.unpackbits(arr)[:count].astype(bool)
+            out[start:start + count] = got
+            dt_us = (time.perf_counter() - t0) * 1e6
+            self._record_dispatch(algo, count, int(got.sum()), dt_us)
+
+        chunk = _RELAY_CHUNK
+        start = 0
+        while start < n:
+            cn = min(chunk, n - start)
+            t_a0 = time.perf_counter()
+            uwords, uidx, rank, clears = index.assign_batch_ints_uniques(
+                key_ids[start:start + cn], lid, rb,
+                pinned=self._batcher.pending_slots(algo), hold_pins=True)
+            t_assign = time.perf_counter() - t_a0
+            u = len(uwords)
+            uslots = (uwords >> np.uint32(rb + 1)).astype(np.int32)
+            p_chunk = permits[start:start + cn]
+            rec = None
+            if self.stream_stats is not None:
+                rec = {"path": "relay_w", "n": int(cn), "u": int(u),
+                       "assign_s": round(t_assign, 6)}
+                self.stream_stats.append(rec)
+            with self._pins_released(index, uslots):
+                if len(clears):
+                    self._clear_slots(algo, list(clears))
+                r_max = int(rank.max()) + 1 if cn else 1
+                now = self._monotonic_now()
+                t0 = time.perf_counter()
+                if r_max <= r_cap:
+                    # Count-descending rank-major layout: segments sorted
+                    # by occurrence count so each rank step's active set
+                    # is a prefix — permits ship compacted (1 B/request,
+                    # zero padding) and the device reads each step with
+                    # one contiguous dynamic_slice (ops/relay.py:
+                    # _weighted_step_w).
+                    counts = np.bincount(uidx, minlength=u)
+                    order = np.argsort(-counts, kind="stable")
+                    spos = np.empty(max(u, 1), dtype=np.int64)
+                    spos[order] = np.arange(u, dtype=np.int64)
+                    r_b = 2
+                    while r_b < r_max:
+                        r_b *= 2
+                    # k_r = number of segments with count > r; roff is its
+                    # exclusive prefix sum (rank-major block offsets).
+                    hist = np.bincount(counts, minlength=r_b + 1)
+                    k_r = u - np.cumsum(hist[:r_b])
+                    roff = np.zeros(r_b, dtype=np.int64)
+                    np.cumsum(k_r[:-1], out=roff[1:])
+                    u_b = _bucket_fine(max(u, 1))
+                    uw_pad = _pad_tail(uwords[order], u_b, 0xFFFFFFFF,
+                                       np.uint32)
+                    pos = roff[rank] + spos[uidx]
+                    perms_rank = np.zeros(_bucket_fine(cn) + u_b,
+                                          dtype=np.uint8)
+                    perms_rank[pos] = p_chunk
+                    handle = dispatch(uw_pad, perms_rank, roff, lid, now,
+                                      r_b)
+                    pending.append(("weighted", handle, start, cn,
+                                    pos, t0, rec))
+                    wire_b = (4 * u_b + len(perms_rank)
+                              + len(perms_rank) // 8)
+                    if rec is not None:
+                        rec["mode"] = "weighted"
+                        rec["wire_bytes"] = int(wire_b)
+                else:
+                    # Heavy duplication: sorted flat dispatches for this
+                    # chunk (<= _FLAT_MAX_LANES lanes each, as the sort
+                    # compile ceiling requires).
+                    slots_req = uslots[uidx]
+                    for off in range(0, cn, _FLAT_MAX_LANES):
+                        sl = min(_FLAT_MAX_LANES, cn - off)
+                        size = _bucket_pow2(sl)
+                        s_pad = _pad_tail(slots_req[off:off + sl], size,
+                                          -1, np.int32)
+                        p_pad = _pad_tail(p_chunk[off:off + sl], size, 1,
+                                          np.uint8)
+                        bits = flat_dispatch(s_pad, lid, p_pad, now)
+                        pending.append(("flat", bits, start + off, sl,
+                                        None, t0, rec))
+                    wire_b = 5.0 * cn
+                    if rec is not None:
+                        rec["mode"] = "flat_fb"
+                        rec["wire_bytes"] = int(wire_b)
+            if rec is not None:
+                rec["host_s"] = round(
+                    time.perf_counter() - t_a0 - t_assign, 6)
+            while len(pending) > 1:
+                drain(*pending.pop(0))
+            bpr = max(wire_b / cn, 1e-3)
+            chunk = int(min(max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
+                                _RELAY_CHUNK), _RELAY_CHUNK_MAX))
             start += cn
         for item in pending:
             drain(*item)

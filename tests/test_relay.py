@@ -396,3 +396,138 @@ def test_held_pins_block_concurrent_eviction():
         ix.unpin_batch(np.asarray(slots[:3] + [s], dtype=np.int32))
         s2, ev2 = ix.assign((1, 100))  # unpinned again: eviction works
         assert ev2 is not None
+
+
+# ---------------------------------------------------------------------------
+# Weighted-permit relay (ops/relay.py:*_relay_weighted)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_stream_weighted_matches_batch_path(monkeypatch, algo):
+    """The weighted relay stream must decide exactly like acquire_many_ids
+    over the same chunks at the same timestamps — including mixed
+    single/multi segments and the skip recurrence."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = np.random.default_rng(31)
+    now = [5_000_000]
+    st_a = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    st_b = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+    else:
+        cfg = RateLimitConfig(max_permits=9, window_ms=1000,
+                              refill_rate=4.0)
+    lid_a = st_a.register_limiter(algo, cfg)
+    lid_b = st_b.register_limiter(algo, cfg)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    for rep in range(4):
+        ids = rng.integers(0, 40, 768)
+        perms = rng.integers(1, 11, 768).astype(np.int64)
+        a = st_a.acquire_stream_ids(algo, lid_a, ids, perms)
+        res = np.empty(768, dtype=bool)
+        for i in range(0, 768, 256):
+            res[i:i + 256] = st_b.acquire_many_ids(
+                algo, lid_b, ids[i:i + 256],
+                perms[i:i + 256])["allowed"]
+        np.testing.assert_array_equal(a, res, err_msg=f"rep {rep}")
+        now[0] += 431
+    st_a.close()
+    st_b.close()
+
+
+def test_stream_weighted_skip_semantics():
+    """A denied large request consumes nothing — a later smaller request
+    of the SAME key in the SAME chunk can still pass (the reference's
+    Lua semantics; a prefix-sum closed form would get this wrong)."""
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [9_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=10, window_ms=1000, refill_rate=1.0)
+    lid = st.register_limiter("tb", cfg)
+    ids = np.asarray([7, 7, 7], dtype=np.int64)
+    perms = np.asarray([8, 5, 2], dtype=np.int64)
+    got = st.acquire_stream_ids("tb", lid, ids, perms)
+    np.testing.assert_array_equal(got, [True, False, True])
+    st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_stream_weighted_fallback_deep_segments(monkeypatch, algo):
+    """A chunk whose deepest segment exceeds _WREL_MAX_R must take the
+    sorted-flat fallback and still match the batch path exactly."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    monkeypatch.setattr(tpu_mod, "_WREL_MAX_R", 4)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    rng = np.random.default_rng(41)
+    now = [6_000_000]
+    st_a = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    st_b = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=7, window_ms=1000,
+                              enable_local_cache=False)
+    else:
+        cfg = RateLimitConfig(max_permits=12, window_ms=1000,
+                              refill_rate=6.0)
+    lid_a = st_a.register_limiter(algo, cfg)
+    lid_b = st_b.register_limiter(algo, cfg)
+    # Hot key: ~1/3 of traffic -> segments far deeper than the forced cap.
+    ids = np.where(rng.random(384) < 0.34, 3,
+                   rng.integers(0, 30, 384)).astype(np.int64)
+    perms = rng.integers(1, 9, 384).astype(np.int64)
+    a = st_a.acquire_stream_ids(algo, lid_a, ids, perms)
+    res = np.empty(384, dtype=bool)
+    for i in range(0, 384, 128):
+        res[i:i + 128] = st_b.acquire_many_ids(
+            algo, lid_b, ids[i:i + 128], perms[i:i + 128])["allowed"]
+    np.testing.assert_array_equal(a, res)
+    st_a.close()
+    st_b.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_stream_weighted_soak_vs_oracle(algo):
+    """Randomized weighted soak against the executable oracle: mixed
+    permits, duplicate-heavy traffic, rolls/refills, resets."""
+    import random
+
+    from ratelimiter_tpu.semantics import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [3_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+        oracle = SlidingWindowOracle(cfg)
+    else:
+        cfg = RateLimitConfig(max_permits=8, window_ms=1500,
+                              refill_rate=5.0)
+        oracle = TokenBucketOracle(cfg)
+    lid = st.register_limiter(algo, cfg)
+    rng = np.random.default_rng(87)
+    pyrng = random.Random(87)
+    for step in range(12):
+        now[0] += pyrng.randrange(0, 900)
+        ids = rng.integers(0, 30, 400)
+        perms = rng.integers(1, 7, 400).astype(np.int64)
+        got = st.acquire_stream_ids(algo, lid, ids, perms)
+        for j, k in enumerate(ids):
+            want = oracle.try_acquire(f"id:{k}", int(perms[j]),
+                                      now[0]).allowed
+            assert got[j] == want, (algo, step, j)
+        if pyrng.random() < 0.3:
+            k = int(pyrng.choice(list(ids)))
+            st.reset_key(algo, lid, k)
+            oracle.reset(f"id:{k}", now[0])
+    st.close()
