@@ -77,18 +77,26 @@ struct Index {
     int32_t uidx = -1;    // dense unique index this batch
   };
   std::vector<BatchScratch> batch;
-  std::vector<int32_t> slots_tmp;      // request -> slot (pass-2 scratch)
+  std::vector<int32_t> ucnt;           // dense per-unique occurrence counts
   // Within-batch front cache: repeat hits of a key inside one batch call
-  // (~94% of Zipf traffic) resolve from this L2-resident direct-mapped
+  // (most of Zipf traffic) resolve from this cache-resident direct-mapped
   // table instead of re-probing the DRAM hash table.  Safe because a hit
   // is only honored when the line was verified under the CURRENT batch
   // generation — and current-generation entries are eviction-protected,
-  // so the cached slot cannot have been reassigned mid-batch.
-  std::vector<uint64_t> fc_h1, fc_h2, fc_gen;
-  std::vector<int32_t> fc_slot;
+  // so the cached slot cannot have been reassigned mid-batch.  One
+  // 32-byte struct per line (not parallel arrays): a hit touches ONE
+  // cache line, and the line carries the batch-dense unique index so the
+  // fused uniques walk never touches the slot-indexed scratch on hits.
+  struct FcLine {
+    uint64_t h1 = 0, h2 = 0;
+    uint64_t gen = 0;
+    int32_t slot = -1;
+    int32_t uidx = -1;
+  };
+  std::vector<FcLine> fc;
 };
 
-const uint64_t kFrontCacheSize = 1 << 16;  // 64K lines, ~1.8 MB
+const uint64_t kFrontCacheSize = 1 << 17;  // 128K lines, 4 MB
 
 static void advise_huge(void* p, size_t bytes) {
   // The probe is one random DRAM access per request; at 10M+ slots the
@@ -262,15 +270,10 @@ inline int64_t take_slot(Index* ix, int32_t* out_slot) {
   return -2;
 }
 
-inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
-                             int32_t* out_slot) {
-  const uint64_t fci = h1 & (kFrontCacheSize - 1);
-  if (!ix->fc_gen.empty() && ix->fc_gen[fci] == ix->gen &&
-      ix->fc_h1[fci] == h1 && ix->fc_h2[fci] == h2) {
-    // Repeat hit within this batch: already gen-stamped + LRU-touched.
-    *out_slot = ix->fc_slot[fci];
-    return -1;
-  }
+// Probe-or-insert WITHOUT front-cache handling (callers manage the fc
+// line themselves; the fused uniques walk writes it with the unique id).
+inline int64_t probe_or_insert(Index* ix, uint64_t h1, uint64_t h2,
+                               int32_t* out_slot) {
   int32_t pos = find(ix, h1, h2);
   if (pos >= 0) {
     Entry& e = ix->table[pos];
@@ -282,22 +285,34 @@ inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
       e.gen = ix->gen;
       lru_touch(ix, pos);
     }
-    if (!ix->fc_gen.empty()) {
-      ix->fc_h1[fci] = h1; ix->fc_h2[fci] = h2;
-      ix->fc_slot[fci] = e.slot; ix->fc_gen[fci] = ix->gen;
-    }
     *out_slot = e.slot;
     return -1;
   }
   int32_t slot;
   int64_t evicted = take_slot(ix, &slot);
   if (evicted == -2) { *out_slot = -1; return -2; }
-  pos = insert(ix, h1, h2, slot);
-  if (!ix->fc_gen.empty()) {
-    ix->fc_h1[fci] = h1; ix->fc_h2[fci] = h2;
-    ix->fc_slot[fci] = slot; ix->fc_gen[fci] = ix->gen;
-  }
+  insert(ix, h1, h2, slot);
   *out_slot = slot;
+  return evicted;
+}
+
+inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
+                             int32_t* out_slot) {
+  const uint64_t fci = h1 & (kFrontCacheSize - 1);
+  if (!ix->fc.empty()) {
+    Index::FcLine& L = ix->fc[fci];
+    if (L.gen == ix->gen && L.h1 == h1 && L.h2 == h2) {
+      // Repeat hit within this batch: already gen-stamped + LRU-touched.
+      *out_slot = L.slot;
+      return -1;
+    }
+  }
+  int64_t evicted = probe_or_insert(ix, h1, h2, out_slot);
+  if (evicted != -2 && !ix->fc.empty()) {
+    Index::FcLine& L = ix->fc[fci];
+    L.h1 = h1; L.h2 = h2; L.gen = ix->gen;
+    L.slot = *out_slot; L.uidx = -1;
+  }
   return evicted;
 }
 
@@ -306,21 +321,24 @@ inline int64_t assign_hashed(Index* ix, uint64_t h1, uint64_t h2,
 // DRAM-latency-bound, so home buckets are prefetched a chunk ahead.
 const int kChunk = 32;
 
+inline void ensure_fc(Index* ix) {
+  if (ix->fc.empty()) {  // batch paths only; scalar calls skip the fc
+    ix->fc.assign(kFrontCacheSize, Index::FcLine{});
+    advise_huge(ix->fc.data(), ix->fc.size() * sizeof(Index::FcLine));
+  }
+}
+
 template <typename HashAt>
 inline void assign_batch(Index* ix, int64_t n, int32_t* out_slots,
                          int32_t* out_evicted, HashAt&& hash_at) {
-  if (ix->fc_gen.empty()) {  // batch paths only; scalar calls skip the fc
-    ix->fc_h1.assign(kFrontCacheSize, 0);
-    ix->fc_h2.assign(kFrontCacheSize, 0);
-    ix->fc_gen.assign(kFrontCacheSize, 0);
-    ix->fc_slot.assign(kFrontCacheSize, -1);
-  }
+  ensure_fc(ix);
   ix->gen++;
   uint64_t h1s[kChunk], h2s[kChunk];
   for (int64_t base = 0; base < n; base += kChunk) {
     int64_t m = n - base < kChunk ? n - base : kChunk;
     for (int64_t j = 0; j < m; j++) {
       hash_at(base + j, h1s[j], h2s[j]);
+      __builtin_prefetch(&ix->fc[h1s[j] & (kFrontCacheSize - 1)], 1, 3);
       __builtin_prefetch(&ix->table[h1s[j] & ix->mask], 1, 1);
     }
     for (int64_t j = 0; j < m; j++) {
@@ -337,6 +355,13 @@ inline void assign_batch(Index* ix, int64_t n, int32_t* out_slots,
 // decisions from the device's per-unique allowed counts.  On skewed
 // traffic this cuts host->device bytes by the duplicate factor.
 // Returns the number of uniques (first-appearance order).
+// FUSED probe + duplicate-structure walk: one pass over the requests.
+// Front-cache hits (the bulk of skewed traffic) touch ONE fc cache line
+// and one dense-ucnt cell — the slot-indexed scratch (tens of MB, a DRAM
+// touch per request in the old two-pass layout) is consulted only on fc
+// misses.  Within a chunk, requests are staged hits-then-misses; a key's
+// requests always land in the SAME stage (the fc line is stable across a
+// chunk's check loop), so per-segment rank order stays arrival order.
 template <typename HashAt>
 inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
                                     uint32_t* out_uwords, int32_t* out_uidx,
@@ -347,40 +372,73 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
     advise_huge(ix->batch.data(),
                 ix->batch.size() * sizeof(Index::BatchScratch));
   }
-  if (static_cast<int64_t>(ix->slots_tmp.size()) < n)
-    ix->slots_tmp.resize(n);
-  int32_t* slots = ix->slots_tmp.data();
-  assign_batch(ix, n, slots, out_evicted, hash_at);
+  if (static_cast<int64_t>(ix->ucnt.size()) < n) ix->ucnt.resize(n);
+  ensure_fc(ix);
+  ix->gen++;
   const uint64_t epoch = ix->gen;
   const uint32_t rank_max = (1u << rank_bits) - 1;
-  const int64_t pfd = 24;  // prefetch distance (requests)
   Index::BatchScratch* scratch = ix->batch.data();
+  Index::FcLine* fc = ix->fc.data();
+  int32_t* ucnt = ix->ucnt.data();
   int64_t u = 0;
-  for (int64_t i = 0; i < n; i++) {
-    if (i + pfd < n && slots[i + pfd] >= 0)
-      __builtin_prefetch(&scratch[slots[i + pfd]], 1, 1);
-    int32_t s = slots[i];
-    if (s < 0) {  // assignment failed (-2): deny lane, not a unique
-      out_uidx[i] = -1;
-      out_rank[i] = 0;
-      continue;
+  uint64_t h1s[kChunk], h2s[kChunk];
+  int64_t misses[kChunk];
+  for (int64_t base = 0; base < n; base += kChunk) {
+    int64_t m = n - base < kChunk ? n - base : kChunk;
+    for (int64_t j = 0; j < m; j++) {
+      hash_at(base + j, h1s[j], h2s[j]);
+      __builtin_prefetch(&fc[h1s[j] & (kFrontCacheSize - 1)], 1, 3);
     }
-    Index::BatchScratch& b = scratch[s];
-    if (b.epoch != epoch) {
-      b.epoch = epoch;
-      b.cnt = 0;
-      b.uidx = static_cast<int32_t>(u);
-      out_uwords[u] = static_cast<uint32_t>(s) << (rank_bits + 1);
-      u++;
+    // Stage 1: fc hits resolve immediately; misses queue with their
+    // table bucket prefetched (the DRAM latency overlaps the rest of
+    // the chunk instead of stalling per request).
+    int64_t nm = 0;
+    for (int64_t j = 0; j < m; j++) {
+      const int64_t i = base + j;
+      Index::FcLine& L = fc[h1s[j] & (kFrontCacheSize - 1)];
+      if (L.gen == epoch && L.h1 == h1s[j] && L.h2 == h2s[j]) {
+        out_evicted[i] = -1;
+        out_uidx[i] = L.uidx;
+        out_rank[i] = ucnt[L.uidx]++;
+        continue;
+      }
+      __builtin_prefetch(&ix->table[h1s[j] & ix->mask], 1, 1);
+      misses[nm++] = j;
     }
-    int32_t rank = b.cnt;
-    if (b.cnt < INT32_MAX) b.cnt++;
-    out_uidx[i] = b.uidx;
-    out_rank[i] = rank;
-    uint32_t cnt = static_cast<uint32_t>(b.cnt);
+    // Stage 2: misses probe/insert the main table in arrival order.
+    for (int64_t k = 0; k < nm; k++) {
+      const int64_t j = misses[k];
+      const int64_t i = base + j;
+      int32_t slot;
+      int64_t ev = probe_or_insert(ix, h1s[j], h2s[j], &slot);
+      out_evicted[i] = static_cast<int32_t>(ev);
+      if (ev == -2) {  // assignment failed: deny lane, not a unique
+        out_uidx[i] = -1;
+        out_rank[i] = 0;
+        continue;
+      }
+      Index::BatchScratch& b = scratch[slot];
+      int32_t ui;
+      if (b.epoch != epoch) {
+        b.epoch = epoch;
+        ui = b.uidx = static_cast<int32_t>(u);
+        out_uwords[u] = static_cast<uint32_t>(slot) << (rank_bits + 1);
+        ucnt[u] = 0;
+        u++;
+      } else {
+        ui = b.uidx;
+      }
+      Index::FcLine& L = fc[h1s[j] & (kFrontCacheSize - 1)];
+      L.h1 = h1s[j]; L.h2 = h2s[j]; L.gen = epoch;
+      L.slot = slot; L.uidx = ui;
+      out_uidx[i] = ui;
+      out_rank[i] = ucnt[ui]++;
+    }
+  }
+  for (int64_t j = 0; j < u; j++) {
+    uint32_t cnt = static_cast<uint32_t>(ucnt[j]);
     if (cnt > rank_max) cnt = rank_max;
-    out_uwords[b.uidx] =
-        (out_uwords[b.uidx] & ~((rank_max << 1) | 1u)) | (cnt << 1);
+    out_uwords[j] |= cnt << 1;
   }
   return u;
 }

@@ -59,6 +59,10 @@ _FLAT_MAX_LANES = 1 << 19
 # monoliths) — the 512K floor keeps that budget binding even at
 # multi-lid's 8.125 B/request.
 _RELAY_CHUNK = 1 << 19
+# Chunks grow to 16M: Zipf dedup improves superlinearly with chunk size
+# (u/cn drops), so two giant digest chunks beat five pipelined 4M ones
+# even though the pipeline overlap is worse — measured both ways on the
+# dev tunnel (ROUND_NOTES.md r3).
 _RELAY_CHUNK_MAX = 1 << 24
 _RELAY_WIRE_BUDGET_DIGEST = 16 << 20
 _RELAY_WIRE_BUDGET_WORDS = 4 << 20
@@ -95,7 +99,7 @@ def _bucket_fine(n: int, floor: int = 4096) -> int:
     of ~100% (used where a lane's bytes dominate the wire)."""
     if n <= floor:
         return floor
-    step = 1 << (int(n - 1).bit_length() - 2)
+    step = 1 << (int(n - 1).bit_length() - 3)
     return -(-n // step) * step
 
 
@@ -547,8 +551,8 @@ class TpuBatchedStorage(RateLimitStorage):
 
         Both decide identically to the sorted flat path on the same
         chunking (tests/test_relay.py).  Chunks are ``_RELAY_CHUNK``
-        requests and pipeline two-deep so fetches ride in the shadow of
-        the next chunk's host work + upload."""
+        requests (growing to the wire budget) and pipeline three-deep so
+        fetches ride in the shadow of later chunks' host work + upload."""
         from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
 
         multi_lid = lid_arr is not None
@@ -680,7 +684,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 rec["wire_bytes"] = int(wire_b)
                 rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
                                       6)
-            if len(pending) > 1:
+            while len(pending) > 2:
                 drain(*pending.pop(0))
             bpr = max(wire_b / cn, 1e-3)
             budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
@@ -705,8 +709,9 @@ class TpuBatchedStorage(RateLimitStorage):
         with one contiguous ``dynamic_slice``.  A short ``lax.scan``
         over rank steps then runs the exact skip recurrence of the
         sorted flat step.  No sort, no solver, no super-linear compile
-        shapes, so chunks grow to the wire budget and pipeline two-deep
-        exactly like the unit-permit relay.  A chunk whose deepest
+        shapes, so chunks grow to the wire budget and pipeline
+        three-deep exactly like the unit-permit relay.  A chunk whose
+        deepest
         segment exceeds ``_WREL_MAX_R`` (heavy duplication — the scan
         would be long and mostly masked) falls back to sorted flat
         dispatches for that chunk.  Decisions are bit-identical to
@@ -772,8 +777,11 @@ class TpuBatchedStorage(RateLimitStorage):
                     # is a prefix — permits ship compacted (1 B/request,
                     # zero padding) and the device reads each step with
                     # one contiguous dynamic_slice (ops/relay.py:
-                    # _weighted_step_w).
-                    counts = np.bincount(uidx, minlength=u)
+                    # _weighted_step_w).  Counts come straight from the
+                    # words' count field — unclamped here, since the true
+                    # r_max (from the rank scratch) fit under r_cap.
+                    counts = ((uwords >> np.uint32(1))
+                              & np.uint32((1 << rb) - 1)).astype(np.int64)
                     order = np.argsort(-counts, kind="stable")
                     spos = np.empty(max(u, 1), dtype=np.int64)
                     spos[order] = np.arange(u, dtype=np.int64)
@@ -824,7 +832,7 @@ class TpuBatchedStorage(RateLimitStorage):
             if rec is not None:
                 rec["host_s"] = round(
                     time.perf_counter() - t_a0 - t_assign, 6)
-            while len(pending) > 1:
+            while len(pending) > 2:
                 drain(*pending.pop(0))
             bpr = max(wire_b / cn, 1e-3)
             chunk = int(min(max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
