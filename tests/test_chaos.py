@@ -223,3 +223,217 @@ def test_stream_ops_pass_through_retry_unreplayed():
     out = st.acquire("tb", lid, "k", 1)
     assert out["allowed"]
     st.close()
+
+
+# ---------------------------------------------------------------------------
+# Mid-stream fault injection (VERDICT r2 #8): a dispatch or fetch dying
+# inside a stream must release held pins, keep the lid bookkeeping
+# conservative, and leave the storage fully usable.  The contract on
+# partial results is RAISE — callers never see a partial `out`.
+# ---------------------------------------------------------------------------
+
+def _fail_after(fn, n, exc=RuntimeError("injected dispatch failure")):
+    """Wrap an engine dispatch: exactly the (n+1)-th call raises; all
+    later calls pass through (so post-failure recovery can be driven)."""
+    calls = {"n": 0}
+
+    def wrapped(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == n + 1:
+            raise exc
+        return fn(*a, **kw)
+
+    return wrapped
+
+
+class _PoisonFetch:
+    """A dispatch handle whose fetch (np.asarray) raises."""
+
+    def __array__(self, *a, **kw):
+        raise RuntimeError("injected fetch failure")
+
+
+def _assert_no_pin_leak(storage, algo, n_slots):
+    """Every slot must be evictable again: assigning a full table's worth
+    of fresh keys raises iff a pin leaked (pinned slots are skipped by
+    eviction, so one leak leaves the last fresh key victimless)."""
+    index = storage._index[algo]
+    fresh = np.arange(10_000_000, 10_000_000 + n_slots, dtype=np.int64)
+    slots, _ = index.assign_batch_ints(fresh, 0)
+    assert len(set(slots.tolist())) == n_slots
+
+
+@pytest.mark.parametrize("mode", ["unit", "weighted"])
+def test_stream_dispatch_failure_releases_pins(monkeypatch, mode):
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    n_slots = 64
+    st = TpuBatchedStorage(num_slots=n_slots)
+    lid = st.register_limiter("tb", RateLimitConfig(
+        max_permits=50, window_ms=60_000, refill_rate=5.0))
+    eng = st.engine
+    if mode == "unit":
+        monkeypatch.setattr(
+            eng, "tb_relay_counts_dispatch",
+            _fail_after(eng.tb_relay_counts_dispatch, 1))
+        monkeypatch.setattr(
+            eng, "tb_relay_dispatch",
+            _fail_after(eng.tb_relay_dispatch, 1))
+        perms = None
+    else:
+        monkeypatch.setattr(
+            eng, "tb_weighted_dispatch",
+            _fail_after(eng.tb_weighted_dispatch, 1))
+        perms = np.random.default_rng(1).integers(1, 9, 512).astype(np.int64)
+    ids = np.random.default_rng(0).integers(0, 48, 512)
+    with pytest.raises(RuntimeError, match="injected"):
+        st.acquire_stream_ids("tb", lid, ids, perms)
+    _assert_no_pin_leak(st, "tb", n_slots)
+    st.close()
+
+
+def test_stream_drain_failure_releases_pins(monkeypatch):
+    """A fetch (drain) dying mid-pipeline: pins were already released at
+    dispatch-enqueue, the exception propagates, storage stays usable."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    n_slots = 64
+    st = TpuBatchedStorage(num_slots=n_slots)
+    lid = st.register_limiter("tb", RateLimitConfig(
+        max_permits=50, window_ms=60_000, refill_rate=5.0))
+    eng = st.engine
+    real = eng.tb_relay_counts_dispatch
+    calls = {"n": 0}
+
+    def poison_second(*a, **kw):
+        calls["n"] += 1
+        h = real(*a, **kw)
+        return _PoisonFetch() if calls["n"] == 2 else h
+
+    monkeypatch.setattr(eng, "tb_relay_counts_dispatch", poison_second)
+    monkeypatch.setattr(eng, "tb_relay_dispatch", poison_second)
+    ids = np.random.default_rng(0).integers(0, 48, 512)
+    with pytest.raises(RuntimeError, match="injected fetch"):
+        st.acquire_stream_ids("tb", lid, ids, None)
+    _assert_no_pin_leak(st, "tb", n_slots)
+    # Fully usable afterward: a clean stream pass decides everything.
+    out = st.acquire_stream_ids("tb", lid, ids, None)
+    assert out.shape == (512,)
+    st.close()
+
+
+def test_multi_lid_stream_failure_keeps_state_consistent(monkeypatch):
+    """Multi-tenant digest stream dying on chunk 2: the chunks that DID
+    dispatch persist (like the reference crashing after a Redis write),
+    the failed chunk leaves no partial marks, and a rerun produces
+    exactly the decisions a fresh storage makes after the same prefix."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+    from ratelimiter_tpu.engine.state import LimiterTable
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    now = [7_000_000]
+
+    def build():
+        table = LimiterTable()
+        lids = [table.register(RateLimitConfig(
+            max_permits=5 + i, window_ms=60_000, refill_rate=2.0 + i))
+            for i in range(4)]
+        st = TpuBatchedStorage(
+            engine=DeviceEngine(num_slots=256, table=table),
+            clock_ms=lambda: now[0])
+        return st, np.asarray(lids, dtype=np.int64)
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 60, 384)
+    lid_arr = rng.integers(0, 4, 384)
+
+    st_a, lids_a = build()
+    eng = st_a.engine
+    for name in ("tb_relay_counts_resident_dispatch", "tb_relay_dispatch"):
+        monkeypatch.setattr(eng, name, _fail_after(getattr(eng, name), 1))
+    with pytest.raises(RuntimeError, match="injected"):
+        st_a.acquire_stream_ids("tb", lids_a[lid_arr], ids, None)
+    # Rerun the whole stream on the survivor.
+    got = st_a.acquire_stream_ids("tb", lids_a[lid_arr], ids, None)
+
+    # Fresh storage: apply the prefix that succeeded in A, then the rerun.
+    st_b, lids_b = build()
+    st_b.acquire_stream_ids("tb", lids_b[lid_arr[:128]], ids[:128], None)
+    want = st_b.acquire_stream_ids("tb", lids_b[lid_arr], ids, None)
+    np.testing.assert_array_equal(got, want)
+    st_a.close()
+    st_b.close()
+
+
+def test_interleaved_scalar_and_stream_traffic():
+    """Concurrent try_acquire traffic while stream calls run on the SAME
+    storage (VERDICT r2 #9): no deadlock, and the per-key allow total
+    across BOTH paths never exceeds the policy budget."""
+    import threading
+
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    now = [9_000_000]  # frozen clock: no refill during the test
+    st = TpuBatchedStorage(num_slots=1 << 10, clock_ms=lambda: now[0])
+    results = {}
+    budgets = {}
+    for algo, cfg in (
+        ("tb", RateLimitConfig(max_permits=7, window_ms=600_000,
+                               refill_rate=0.001)),
+        ("sw", RateLimitConfig(max_permits=7, window_ms=600_000,
+                               enable_local_cache=False)),
+    ):
+        lid = st.register_limiter(algo, cfg)
+        budgets[algo] = cfg.max_permits
+        rng = np.random.default_rng(11)
+        scalar_allowed = []
+        errs = []
+
+        def scalar_worker(algo=algo, lid=lid):
+            r = np.random.default_rng(threading.get_ident() % 1000)
+            try:
+                for i in range(60):
+                    key = f"user-{int(r.integers(0, 40))}"
+                    res = st.acquire(algo, lid, key, 1)
+                    scalar_allowed.append((key, bool(res["allowed"])))
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=scalar_worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        stream_out = []
+        ids = rng.integers(0, 40, 2000)
+        for _ in range(3):
+            stream_out.append(
+                (ids.copy(),
+                 st.acquire_stream_ids(algo, lid, ids, None)))
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "deadlock: scalar worker stuck"
+        assert not errs, errs
+        results[algo] = (scalar_allowed, stream_out)
+
+    st.flush()
+    for algo, (scalar_allowed, stream_out) in results.items():
+        per_key: dict = {}
+        for key, ok in scalar_allowed:
+            per_key[key] = per_key.get(key, 0) + int(ok)
+        for ids, out in stream_out:
+            for k, ok in zip(ids, out):
+                # int stream keys share the scalar string namespace only
+                # if spelled identically; scalar used 'user-N', stream
+                # used raw ints -> distinct keys, tracked separately.
+                per_key[int(k)] = per_key.get(int(k), 0) + int(ok)
+        over = {k: v for k, v in per_key.items() if v > budgets[algo]}
+        assert not over, (algo, over)
+    st.close()
