@@ -710,6 +710,35 @@ void rl_relay_decide(const uint8_t* counts, int32_t counts_width,
   }
 }
 
+// Shard routing for the sharded stream paths: one pass hashes every key
+// with the splitmix64 finalizer (bit-identical to
+// parallel/sharded.py:shard_of_int_keys) and counts per shard; a second
+// pass emits the STABLE counting-sort order, so each shard's requests
+// become one contiguous slice in arrival order.  Replaces a numpy
+// hash (6 vector passes) + O(n log n) argsort on the chunk hot path.
+void rl_shard_route(const int64_t* keys, int64_t n, int32_t n_shards,
+                    int32_t* out_shard, int64_t* out_order,
+                    int64_t* out_counts) {
+  for (int32_t s = 0; s < n_shards; s++) out_counts[s] = 0;
+  const uint64_t ns = static_cast<uint64_t>(n_shards);
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t x = static_cast<uint64_t>(keys[i]) + 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    x = x ^ (x >> 31);
+    int32_t s = static_cast<int32_t>(x % ns);
+    out_shard[i] = s;
+    out_counts[s]++;
+  }
+  std::vector<int64_t> off(n_shards);
+  int64_t acc = 0;
+  for (int32_t s = 0; s < n_shards; s++) {
+    off[s] = acc;
+    acc += out_counts[s];
+  }
+  for (int64_t i = 0; i < n; i++) out_order[off[out_shard[i]]++] = i;
+}
+
 void rl_index_pin(void* h, int32_t slot) {
   Index* ix = static_cast<Index*>(h);
   if (slot >= 0 && slot < ix->num_slots) ix->pins[slot]++;

@@ -137,6 +137,9 @@ def _bind(lib) -> None:
     lib.rl_relay_decide.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_int64, ctypes.c_void_p]
+    lib.rl_shard_route.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p]
 
 
 def native_available() -> bool:
@@ -201,6 +204,25 @@ def relay_decide(counts: np.ndarray, uidx: np.ndarray,
                         uidx.ctypes.data, rank.ctypes.data, len(uidx),
                         out.ctypes.data)
     return out.view(np.bool_)
+
+
+def shard_route(key_ids: np.ndarray, n_shards: int):
+    """(shard i32[n], stable order i64[n], counts i64[n_shards]) for an
+    int64 key batch — one C pass of splitmix hash + counting sort,
+    bit-identical to shard_of_int_keys + stable argsort.  None when the
+    native library is unavailable (callers fall back to numpy)."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+    n = len(key_ids)
+    shard = np.empty(n, dtype=np.int32)
+    order = np.empty(n, dtype=np.int64)
+    counts = np.empty(n_shards, dtype=np.int64)
+    lib.rl_shard_route(key_ids.ctypes.data, n, int(n_shards),
+                       shard.ctypes.data, order.ctypes.data,
+                       counts.ctypes.data)
+    return shard, order, counts
 
 
 def _split_key(key: Hashable) -> Tuple[int, bytes | int]:

@@ -107,6 +107,21 @@ def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+def _route_chunk(key_ids: np.ndarray, n_shards: int):
+    """(shard, stable order, per-shard counts) — C fast path with a
+    bit-identical numpy fallback."""
+    from ratelimiter_tpu.engine.native_index import shard_route
+
+    r = shard_route(key_ids, n_shards)
+    if r is not None:
+        return r
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+    shard = shard_of_int_keys(key_ids, n_shards)
+    order = np.argsort(shard, kind="stable")
+    return shard, order, np.bincount(shard, minlength=n_shards)
+
+
 def _pad_tail(arr: np.ndarray, size: int, fill, dtype) -> np.ndarray:
     """Contiguous cast + right-pad with ``fill`` up to ``size``."""
     arr = np.ascontiguousarray(arr, dtype=dtype)
@@ -1074,49 +1089,65 @@ class TpuBatchedStorage(RateLimitStorage):
             out[start:start + cnt] = got
             self._record_dispatch(algo, cnt, int(got.sum()), dt_us)
 
+        pool = self._shard_pool(n_sh)
         for start in range(0, n, super_n):
             chunk = key_ids[start:start + super_n]
             cn = len(chunk)
-            shard = shard_of_int_keys(chunk, n_sh)
-            # Per-shard slot assignment (one C call each), chunk order kept.
-            local = np.empty(cn, dtype=np.int32)
             clears: list = []
             pins_by_shard: dict = {}
             for g in self._batcher.pending_slots(algo):
                 pins_by_shard.setdefault(g // sps, set()).add(g % sps)
             l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            # Pins accumulate per shard as the loop assigns; the finally
-            # releases whatever was taken even if a later shard's assign,
-            # the clears dispatch, or the matrix packing raises (a leaked
+            # One routing pass (see _stream_relay_sharded); per-shard C
+            # calls run on the pool against contiguous slices.
+            shard, order, counts = _route_chunk(chunk, n_sh)
+            offs = np.zeros(n_sh + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            kst = chunk[order]
+            l_st = l_chunk[order] if multi_lid else None
+
+            def assign_shard(s):
+                lo, hi = int(offs[s]), int(offs[s + 1])
+                if lo == hi:
+                    return None
+                sub = index._sub[s]
+                if multi_lid:
+                    return sub.assign_batch_ints_multi(
+                        kst[lo:hi], l_st[lo:hi],
+                        pinned=pins_by_shard.get(s), hold_pins=True)
+                return sub.assign_batch_ints(
+                    kst[lo:hi], lid, pinned=pins_by_shard.get(s),
+                    hold_pins=True)
+
+            # Pins of successful shards accumulate in held as results are
+            # collected; the finally releases them on ANY raise (a leaked
             # pin would make its slot permanently unevictable).
+            local_sorted = np.empty(cn, dtype=np.int32)
             held: list = []
             try:
-                for s in range(n_sh):
-                    m = shard == s
-                    if not m.any():
+                futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
+                err = None
+                for s, f in enumerate(futs):
+                    try:
+                        r = f.result()
+                    except Exception as exc:  # noqa: BLE001
+                        err = err if err is not None else exc
                         continue
-                    pins = pins_by_shard.get(s)
-                    sub = index._sub[s]
-                    if multi_lid:
-                        sl, ev = sub.assign_batch_ints_multi(
-                            chunk[m], l_chunk[m], pinned=pins,
-                            hold_pins=True)
-                    else:
-                        sl, ev = sub.assign_batch_ints(chunk[m], lid,
-                                                       pinned=pins,
-                                                       hold_pins=True)
-                    local[m] = sl
+                    if r is None:
+                        continue
+                    sl, ev = r
+                    local_sorted[offs[s]:offs[s + 1]] = sl
                     held.append(s * sps + sl.astype(np.int64))
                     clears.extend(s * sps + int(e) for e in ev)
+                if err is not None:
+                    raise err
                 if clears:
                     clear(clears)
-                # Column of each request within its shard row (arrival order
-                # — the stable per-slot segment order the flat step sorts
-                # by).
-                order = np.argsort(shard, kind="stable")
-                counts = np.bincount(shard, minlength=n_sh)
-                offs = np.zeros(n_sh + 1, dtype=np.int64)
-                np.cumsum(counts, out=offs[1:])
+                local = np.empty(cn, dtype=np.int32)
+                local[order] = local_sorted
+                # Column of each request within its shard row (arrival
+                # order — the stable per-slot segment order the flat step
+                # sorts by).
                 cols = np.empty(cn, dtype=np.int64)
                 cols[order] = np.arange(cn) - offs[shard[order]]
                 from ratelimiter_tpu.parallel.sharded import _bucket
@@ -1210,34 +1241,59 @@ class TpuBatchedStorage(RateLimitStorage):
         while start < n:
             cn = min(chunk, n - start)
             kchunk = key_ids[start:start + cn]
-            shard = shard_of_int_keys(kchunk, n_sh)
             l_chunk = lid_arr[start:start + cn] if multi_lid else None
             pins_by_shard: dict = {}
             for g in self._batcher.pending_slots(algo):
                 pins_by_shard.setdefault(g // sps, set()).add(g % sps)
+            # One routing pass turns each shard's requests into a
+            # contiguous slice (still in arrival order): the C helper
+            # hashes + counting-sorts in O(n) (numpy fallback: splitmix
+            # hash + stable argsort, bit-identical); per-shard C calls
+            # then run on the pool — parallel probe walks on multi-core
+            # hosts, no O(n) mask scan per shard.
+            shard, order, scnt = _route_chunk(kchunk, n_sh)
+            soffs = np.zeros(n_sh + 1, dtype=np.int64)
+            np.cumsum(scnt, out=soffs[1:])
+            kst = kchunk[order]
+            l_st = l_chunk[order] if multi_lid else None
+            pool = self._shard_pool(n_sh)
+
+            def assign_shard(s):
+                lo, hi = int(soffs[s]), int(soffs[s + 1])
+                if lo == hi:
+                    return None
+                sub = index._sub[s]
+                if multi_lid:
+                    return sub.assign_batch_ints_multi_uniques(
+                        kst[lo:hi], l_st[lo:hi], rb,
+                        pinned=pins_by_shard.get(s), hold_pins=True)
+                return sub.assign_batch_ints_uniques(
+                    kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
+                    hold_pins=True)
+
             results = []
             clears: list = []
             pin_glob: list = []
             u_total = u_max = b_max = 0
-            # Pins accumulate per shard as the loop assigns; the finally
-            # releases them even if a later shard's assign, the clears
-            # dispatch, the mode election, or the matrix packing raises.
+            # Pins of successful shards accumulate in pin_glob as results
+            # are collected; the finally releases them on ANY raise —
+            # including a partial assignment failure, whose successful
+            # siblings' results never reach a caller.
             try:
-                for s in range(n_sh):
-                    pos = np.where(shard == s)[0]
-                    if not len(pos):
+                futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
+                err = None
+                for s, f in enumerate(futs):
+                    pos = order[soffs[s]:soffs[s + 1]]
+                    try:
+                        r = f.result()
+                    except Exception as exc:  # noqa: BLE001
+                        err = err if err is not None else exc
                         results.append((pos, None, None, 0, None))
                         continue
-                    sub = index._sub[s]
-                    if multi_lid:
-                        uw, uidx, rank, ev = \
-                            sub.assign_batch_ints_multi_uniques(
-                                kchunk[pos], l_chunk[pos], rb,
-                                pinned=pins_by_shard.get(s), hold_pins=True)
-                    else:
-                        uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
-                            kchunk[pos], lid, rb,
-                            pinned=pins_by_shard.get(s), hold_pins=True)
+                    if r is None:
+                        results.append((pos, None, None, 0, None))
+                        continue
+                    uw, uidx, rank, ev = r
                     clears.extend(s * sps + int(e) for e in ev)
                     results.append((pos, uidx, rank, len(uw), uw))
                     pin_glob.append(
@@ -1246,6 +1302,8 @@ class TpuBatchedStorage(RateLimitStorage):
                     u_total += len(uw)
                     u_max = max(u_max, len(uw))
                     b_max = max(b_max, len(pos))
+                if err is not None:
+                    raise err
                 if clears:
                     clear(clears)
                 digest = cdt is not None and (
@@ -1497,9 +1555,25 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def close(self) -> None:
         self._batcher.close()
+        pool = getattr(self, "_shard_pool_obj", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
         for index in self._index.values():
             if hasattr(index, "close"):
                 index.close()
+
+    def _shard_pool(self, n_sh: int):
+        """Thread pool for per-shard C index calls (lazily created): the
+        calls release the GIL, so on multi-core hosts the shards' probe
+        walks run truly in parallel (single-core hosts lose nothing)."""
+        pool = getattr(self, "_shard_pool_obj", None)
+        if pool is None:
+            import concurrent.futures as cf
+
+            pool = cf.ThreadPoolExecutor(n_sh,
+                                         thread_name_prefix="shardidx")
+            self._shard_pool_obj = pool
+        return pool
 
     # ------------------------------------------------------------------------
     def _assign_slot(self, algo: str, lid: int, key: str,

@@ -119,3 +119,26 @@ def test_full_stack_on_sharded_engine_vs_oracle():
         assert sw.get_available_permits(k) == osw.get_available_permits(k, clock.t)
         assert tb.get_available_permits(k) == otb.get_available_permits(k, clock.t)
     storage.close()
+
+
+def test_native_shard_route_matches_numpy():
+    """The C routing pass must be bit-identical to shard_of_int_keys +
+    stable argsort (scalar and stream paths must agree on shards)."""
+    import numpy as np
+    import pytest
+
+    from ratelimiter_tpu.engine.native_index import shard_route
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+    if shard_route(np.asarray([1], dtype=np.int64), 2) is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    for n_sh in (1, 2, 3, 8):
+        keys = rng.integers(-(1 << 62), 1 << 62, 5000)
+        shard, order, counts = shard_route(keys, n_sh)
+        want = shard_of_int_keys(keys, n_sh)
+        np.testing.assert_array_equal(shard, want)
+        np.testing.assert_array_equal(order,
+                                      np.argsort(want, kind="stable"))
+        np.testing.assert_array_equal(counts,
+                                      np.bincount(want, minlength=n_sh))
