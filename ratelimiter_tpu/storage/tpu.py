@@ -53,11 +53,11 @@ _FLAT_MAX_LANES = 1 << 19
 # structure at the floor size; later chunks size themselves to a
 # per-dispatch wire budget at the measured bytes/request of their mode.
 # Digest chunks grow until the whole pass is a couple of dispatches
-# (dedup improves superlinearly with chunk size); per-request-words
-# chunks sit at the ~4 MB transfer sweet spot (bench/profile_upload.py:
-# mid-size transfers move at better per-byte rates than 16 MB
-# monoliths) — the 512K floor keeps that budget binding even at
-# multi-lid's 8.125 B/request.
+# (dedup improves superlinearly with chunk size).  Per-request-words
+# chunks use the same 16 MB budget: fewer dispatches = fewer ~100 ms
+# round trips, and large transfers measured as fast per byte as 4 MB
+# ones in r3 (the r2 "4 MB sweet spot" did not reproduce; scenario 3
+# runs ~15% faster at 16 MB).
 _RELAY_CHUNK = 1 << 19
 # Chunks grow to 16M: Zipf dedup improves superlinearly with chunk size
 # (u/cn drops), so two giant digest chunks beat five pipelined 4M ones
@@ -65,7 +65,7 @@ _RELAY_CHUNK = 1 << 19
 # dev tunnel (ROUND_NOTES.md r3).
 _RELAY_CHUNK_MAX = 1 << 24
 _RELAY_WIRE_BUDGET_DIGEST = 16 << 20
-_RELAY_WIRE_BUDGET_WORDS = 4 << 20
+_RELAY_WIRE_BUDGET_WORDS = 16 << 20
 
 # Mode-election amortization for the resident-lid delta upload: a (slot,
 # lid) pair is paid ONCE and then serves every later digest chunk that
@@ -503,10 +503,17 @@ class TpuBatchedStorage(RateLimitStorage):
             # solver, chunks grow to the wire budget.  Requests with
             # permits < 1 or above the word capacity keep the flat path's
             # semantics and routing.
-            return self._stream_weighted(algo, lid, key_ids,
-                                         np.ascontiguousarray(
-                                             permits, dtype=np.int64),
-                                         index)
+            rb = self.engine.rank_bits
+
+            def assign_uniques_w(start, chunk_n):
+                return index.assign_batch_ints_uniques(
+                    key_ids[start:start + chunk_n], lid, rb,
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
+
+            return self._stream_weighted(
+                algo, lid, assign_uniques_w, len(key_ids),
+                np.ascontiguousarray(permits, dtype=np.int64), index)
 
         if (permits is None
                 and hasattr(index, "assign_batch_ints_uniques")
@@ -711,8 +718,8 @@ class TpuBatchedStorage(RateLimitStorage):
             drain(*item)
         return out
 
-    def _stream_weighted(self, algo, lid, key_ids, permits,
-                         index) -> np.ndarray:
+    def _stream_weighted(self, algo, lid, assign_uniques, n, permits,
+                          index) -> np.ndarray:
         """Weighted-permit relay streaming loop.
 
         Per chunk, one C call assigns slots and hands back the duplicate
@@ -740,7 +747,6 @@ class TpuBatchedStorage(RateLimitStorage):
         # The CSR mask needs true counts; the word count field clamps at
         # (1 << rank_bits) - 1, so deeper chunks must fall back.
         r_cap = min(_WREL_MAX_R, (1 << rb) - 1)
-        n = len(key_ids)
         out = np.empty(n, dtype=bool)
         pending: list[tuple] = []
 
@@ -768,9 +774,7 @@ class TpuBatchedStorage(RateLimitStorage):
         while start < n:
             cn = min(chunk, n - start)
             t_a0 = time.perf_counter()
-            uwords, uidx, rank, clears = index.assign_batch_ints_uniques(
-                key_ids[start:start + cn], lid, rb,
-                pinned=self._batcher.pending_slots(algo), hold_pins=True)
+            uwords, uidx, rank, clears = assign_uniques(start, cn)
             t_assign = time.perf_counter() - t_a0
             u = len(uwords)
             uslots = (uwords >> np.uint32(rb + 1)).astype(np.int32)
@@ -1021,6 +1025,25 @@ class TpuBatchedStorage(RateLimitStorage):
         self._batcher.flush()
         if oversize is not None:
             permits = np.where(oversize, 1, permits)
+
+        if (permits is not None and oversize is None
+                and hasattr(index, "assign_batch_strs_uniques")
+                and permits.size
+                and int(permits.min()) >= 1
+                and int(permits.max()) <= self.engine.weighted_permit_cap):
+            # Weighted relay for string keys — same loop as the int path,
+            # only the assign closure differs (see acquire_stream_ids).
+            rb = self.engine.rank_bits
+
+            def assign_uniques_w(start, chunk_n):
+                return index.assign_batch_strs_uniques(
+                    list(keys[start:start + chunk_n]), lid, rb,
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
+
+            return self._stream_weighted(
+                algo, lid, assign_uniques_w, len(keys),
+                np.ascontiguousarray(permits, dtype=np.int64), index)
 
         if (permits is None
                 and hasattr(index, "assign_batch_strs_uniques")

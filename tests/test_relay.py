@@ -531,3 +531,40 @@ def test_stream_weighted_soak_vs_oracle(algo):
             st.reset_key(algo, lid, k)
             oracle.reset(f"id:{k}", now[0])
     st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_stream_weighted_strs_matches_batch_path(monkeypatch, algo):
+    """String-key weighted streams run the same weighted relay loop; the
+    decisions must match acquire_many on identical chunks."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = np.random.default_rng(53)
+    now = [4_000_000]
+    st_a = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    st_b = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+    else:
+        cfg = RateLimitConfig(max_permits=9, window_ms=1000,
+                              refill_rate=4.0)
+    lid_a = st_a.register_limiter(algo, cfg)
+    lid_b = st_b.register_limiter(algo, cfg)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    for rep in range(3):
+        keys = [f"u{int(k)}" for k in rng.integers(0, 35, 512)]
+        perms = rng.integers(1, 11, 512).astype(np.int64)
+        a = st_a.acquire_stream_strs(algo, lid_a, keys, perms)
+        res = np.empty(512, dtype=bool)
+        for i in range(0, 512, 256):
+            got = st_b.acquire_many(
+                algo, [lid_b] * 256, keys[i:i + 256],
+                list(perms[i:i + 256]))
+            res[i:i + 256] = got["allowed"]
+        np.testing.assert_array_equal(a, res, err_msg=f"rep {rep}")
+        now[0] += 433
+    st_a.close()
+    st_b.close()
