@@ -610,110 +610,128 @@ class TpuBatchedStorage(RateLimitStorage):
 
         chunk = _RELAY_CHUNK
         start = 0
-        while start < n:
-            cn = min(chunk, n - start)
-            t_a0 = time.perf_counter()
-            uwords, uidx, rank, clears = assign_uniques(start, cn)
-            t_assign = time.perf_counter() - t_a0
-            u = len(uwords)
-            rec = None
-            if self.stream_stats is not None:
-                rec = {"path": "relay", "n": int(cn), "u": int(u),
-                       "assign_s": round(t_assign, 6)}
-                self.stream_stats.append(rec)
-            uslots_all = (uwords >> np.uint32(rb + 1)).astype(np.int32)
-            with self._pins_released(self._index[algo], uslots_all):
-                if len(clears):
-                    clear(list(clears))
-                l_chunk = (lid_arr[start:start + cn] if multi_lid
-                           else None)
-                # Mode election: steady-state digest cost per unique plus
-                # this chunk's (slot, lid) delta uploads charged at
-                # 1/_DELTA_AMORT (they are an investment — once resident,
-                # every later chunk reads the lid from the device map).
-                fresh = None
-                n_delta = 0
-                if cdt is not None and multi_lid:
-                    with self._lid_locks[algo]:
-                        known = self._lid_known.setdefault(
-                            algo, np.zeros(eng.num_slots, dtype=bool))
-                        uslots = uslots_all.astype(np.int64)
-                        fresh = ~known[uslots]
-                    from ratelimiter_tpu.parallel.sharded import _bucket as _bkt
-                    n_delta = _bkt(max(int(fresh.sum()), 1), floor=8)
-                digest = cdt is not None and (
-                    digest_bpu * u + 8 * n_delta / _DELTA_AMORT
-                    <= words_bpr * cn)
-                now = self._monotonic_now()
-                t0 = time.perf_counter()
-                if digest:
-                    size = _bucket_pow2(u)
-                    uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
-                    if multi_lid:
-                        # Tenant ids live RESIDENT on device (a slot's lid is
-                        # immutable while assigned): upload only the (slot,
-                        # lid) pairs the device doesn't know yet — fresh
-                        # assignments and post-eviction reuse, tracked in
-                        # _lid_known and invalidated by _clear_slots.  Per-
-                        # unique lids map through uidx (NOT positional: a
-                        # partitioned index merges uniques partition-major).
-                        from ratelimiter_tpu.parallel.sharded import _bucket
-
-                        first = rank == 0
-                        ulids = np.zeros(u, dtype=np.int32)
-                        ulids[uidx[first]] = l_chunk[first]
-                        # Re-read fresh, mark, and dispatch under the lock
-                        # shared with _clear_slots: an eviction racing the
-                        # mark must win (forcing a later re-upload), never
-                        # lose to a stale known=True.
-                        with self._lid_locks[algo]:
-                            fresh = ~known[uslots]
-                            n_delta = int(fresh.sum())
-                            dsize = _bucket(max(n_delta, 1), floor=8)
-                            d_slots = _pad_tail(uslots[fresh], dsize, -1,
-                                                np.int32)
-                            d_lids = _pad_tail(ulids[fresh], dsize, 0,
-                                               np.int32)
-                            resident = (eng.sw_relay_counts_resident_dispatch
-                                        if algo == "sw"
-                                        else eng.tb_relay_counts_resident_dispatch)
-                            counts = resident(uw, d_slots, d_lids, now, cdt)
-                            # Mark AFTER the dispatch: a raise must not
-                            # leave slots "known" with no lid uploaded.
-                            known[uslots[fresh]] = True
-                            n_delta = dsize  # charge the padded lane
-                    else:
-                        counts = counts_dispatch(uw, lid, now, cdt)
-                    pending.append(
-                        ("digest", counts, start, cn, (uidx, rank, u), t0,
-                         rec))
+        fut = None  # prefetched next-chunk assignment (holds pins)
+        try:
+            while start < n:
+                cn = min(chunk, n - start)
+                t_a0 = time.perf_counter()
+                if fut is not None:
+                    uwords, uidx, rank, clears = fut.result()
+                    fut = None
                 else:
-                    words = rebuild_words(uwords, uidx, rank, rb)
-                    size = _bucket_pow2(cn)
-                    words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
-                    lid_lane = lid if not multi_lid else _pad_tail(
-                        l_chunk, size, 0, np.int32)
-                    bits = bits_dispatch(words, lid_lane, now)
-                    pending.append(("bits", bits, start, cn, None, t0, rec))
-            # Grow the next chunk toward the wire budget at this chunk's
-            # measured bytes/request (skewed streams compact hard in
-            # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
-            # the fixed per-dispatch latency amortizes away).
-            wire_b = (digest_bpu * u + 8 * n_delta if digest
-                      else words_bpr * cn)
-            if rec is not None:
-                rec["mode"] = "digest" if digest else "bits"
-                rec["wire_bytes"] = int(wire_b)
-                rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
-                                      6)
-            while len(pending) > 2:
-                drain(*pending.pop(0))
-            bpr = max(wire_b / cn, 1e-3)
-            budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
-                      else _RELAY_WIRE_BUDGET_WORDS)
-            chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
-                            _RELAY_CHUNK_MAX))
-            start += cn
+                    uwords, uidx, rank, clears = assign_uniques(start, cn)
+                t_assign = time.perf_counter() - t_a0
+                u = len(uwords)
+                rec = None
+                if self.stream_stats is not None:
+                    rec = {"path": "relay", "n": int(cn), "u": int(u),
+                           "assign_s": round(t_assign, 6)}
+                    self.stream_stats.append(rec)
+                uslots_all = (uwords >> np.uint32(rb + 1)).astype(np.int32)
+                with self._pins_released(self._index[algo], uslots_all):
+                    if len(clears):
+                        clear(list(clears))
+                    l_chunk = (lid_arr[start:start + cn] if multi_lid
+                               else None)
+                    # Mode election: steady-state digest cost per unique plus
+                    # this chunk's (slot, lid) delta uploads charged at
+                    # 1/_DELTA_AMORT (they are an investment — once resident,
+                    # every later chunk reads the lid from the device map).
+                    fresh = None
+                    n_delta = 0
+                    if cdt is not None and multi_lid:
+                        with self._lid_locks[algo]:
+                            known = self._lid_known.setdefault(
+                                algo, np.zeros(eng.num_slots, dtype=bool))
+                            uslots = uslots_all.astype(np.int64)
+                            fresh = ~known[uslots]
+                        from ratelimiter_tpu.parallel.sharded import _bucket as _bkt
+                        n_delta = _bkt(max(int(fresh.sum()), 1), floor=8)
+                    digest = cdt is not None and (
+                        digest_bpu * u + 8 * n_delta / _DELTA_AMORT
+                        <= words_bpr * cn)
+                    now = self._monotonic_now()
+                    t0 = time.perf_counter()
+                    if digest:
+                        size = _bucket_pow2(u)
+                        uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
+                        if multi_lid:
+                            # Tenant ids live RESIDENT on device (a slot's lid is
+                            # immutable while assigned): upload only the (slot,
+                            # lid) pairs the device doesn't know yet — fresh
+                            # assignments and post-eviction reuse, tracked in
+                            # _lid_known and invalidated by _clear_slots.  Per-
+                            # unique lids map through uidx (NOT positional: a
+                            # partitioned index merges uniques partition-major).
+                            from ratelimiter_tpu.parallel.sharded import _bucket
+
+                            first = rank == 0
+                            ulids = np.zeros(u, dtype=np.int32)
+                            ulids[uidx[first]] = l_chunk[first]
+                            # Re-read fresh, mark, and dispatch under the lock
+                            # shared with _clear_slots: an eviction racing the
+                            # mark must win (forcing a later re-upload), never
+                            # lose to a stale known=True.
+                            with self._lid_locks[algo]:
+                                fresh = ~known[uslots]
+                                n_delta = int(fresh.sum())
+                                dsize = _bucket(max(n_delta, 1), floor=8)
+                                d_slots = _pad_tail(uslots[fresh], dsize, -1,
+                                                    np.int32)
+                                d_lids = _pad_tail(ulids[fresh], dsize, 0,
+                                                   np.int32)
+                                resident = (eng.sw_relay_counts_resident_dispatch
+                                            if algo == "sw"
+                                            else eng.tb_relay_counts_resident_dispatch)
+                                counts = resident(uw, d_slots, d_lids, now, cdt)
+                                # Mark AFTER the dispatch: a raise must not
+                                # leave slots "known" with no lid uploaded.
+                                known[uslots[fresh]] = True
+                                n_delta = dsize  # charge the padded lane
+                        else:
+                            counts = counts_dispatch(uw, lid, now, cdt)
+                        pending.append(
+                            ("digest", counts, start, cn, (uidx, rank, u), t0,
+                             rec))
+                    else:
+                        words = rebuild_words(uwords, uidx, rank, rb)
+                        size = _bucket_pow2(cn)
+                        words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
+                        lid_lane = lid if not multi_lid else _pad_tail(
+                            l_chunk, size, 0, np.int32)
+                        bits = bits_dispatch(words, lid_lane, now)
+                        pending.append(("bits", bits, start, cn, None, t0, rec))
+                # Grow the next chunk toward the wire budget at this chunk's
+                # measured bytes/request (skewed streams compact hard in
+                # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
+                # the fixed per-dispatch latency amortizes away).
+                wire_b = (digest_bpu * u + 8 * n_delta if digest
+                          else words_bpr * cn)
+                if rec is not None:
+                    rec["mode"] = "digest" if digest else "bits"
+                    rec["wire_bytes"] = int(wire_b)
+                    rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
+                                          6)
+                bpr = max(wire_b / cn, 1e-3)
+                budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
+                          else _RELAY_WIRE_BUDGET_WORDS)
+                chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
+                                _RELAY_CHUNK_MAX))
+                start += cn
+                if start < n:
+                    # Prefetch the next chunk's assignment on the worker: it
+                    # runs (GIL-free C walk) while the drains below block in
+                    # their (GIL-free) device fetches.
+                    fut = self._assign_pool().submit(
+                        assign_uniques, start, min(chunk, n - start))
+                while len(pending) > 2:
+                    drain(*pending.pop(0))
+        finally:
+            if fut is not None:
+                self._abort_prefetch(
+                    algo, self._index[algo], fut,
+                    lambda res: (res[0] >> np.uint32(rb + 1)).astype(
+                        np.int32))
         for item in pending:
             drain(*item)
         return out
@@ -771,92 +789,108 @@ class TpuBatchedStorage(RateLimitStorage):
 
         chunk = _RELAY_CHUNK
         start = 0
-        while start < n:
-            cn = min(chunk, n - start)
-            t_a0 = time.perf_counter()
-            uwords, uidx, rank, clears = assign_uniques(start, cn)
-            t_assign = time.perf_counter() - t_a0
-            u = len(uwords)
-            uslots = (uwords >> np.uint32(rb + 1)).astype(np.int32)
-            p_chunk = permits[start:start + cn]
-            rec = None
-            if self.stream_stats is not None:
-                rec = {"path": "relay_w", "n": int(cn), "u": int(u),
-                       "assign_s": round(t_assign, 6)}
-                self.stream_stats.append(rec)
-            with self._pins_released(index, uslots):
-                if len(clears):
-                    self._clear_slots(algo, list(clears))
-                r_max = int(rank.max()) + 1 if cn else 1
-                now = self._monotonic_now()
-                t0 = time.perf_counter()
-                if r_max <= r_cap:
-                    # Count-descending rank-major layout: segments sorted
-                    # by occurrence count so each rank step's active set
-                    # is a prefix — permits ship compacted (1 B/request,
-                    # zero padding) and the device reads each step with
-                    # one contiguous dynamic_slice (ops/relay.py:
-                    # _weighted_step_w).  Counts come straight from the
-                    # words' count field — unclamped here, since the true
-                    # r_max (from the rank scratch) fit under r_cap.
-                    counts = ((uwords >> np.uint32(1))
-                              & np.uint32((1 << rb) - 1)).astype(np.int64)
-                    order = np.argsort(-counts, kind="stable")
-                    spos = np.empty(max(u, 1), dtype=np.int64)
-                    spos[order] = np.arange(u, dtype=np.int64)
-                    r_b = 2
-                    while r_b < r_max:
-                        r_b *= 2
-                    # k_r = number of segments with count > r; roff is its
-                    # exclusive prefix sum (rank-major block offsets).
-                    hist = np.bincount(counts, minlength=r_b + 1)
-                    k_r = u - np.cumsum(hist[:r_b])
-                    roff = np.zeros(r_b, dtype=np.int64)
-                    np.cumsum(k_r[:-1], out=roff[1:])
-                    u_b = _bucket_fine(max(u, 1))
-                    uw_pad = _pad_tail(uwords[order], u_b, 0xFFFFFFFF,
-                                       np.uint32)
-                    pos = roff[rank] + spos[uidx]
-                    perms_rank = np.zeros(_bucket_fine(cn) + u_b,
-                                          dtype=np.uint8)
-                    perms_rank[pos] = p_chunk
-                    handle = dispatch(uw_pad, perms_rank, roff, lid, now,
-                                      r_b)
-                    pending.append(("weighted", handle, start, cn,
-                                    pos, t0, rec))
-                    wire_b = (4 * u_b + len(perms_rank)
-                              + len(perms_rank) // 8)
-                    if rec is not None:
-                        rec["mode"] = "weighted"
-                        rec["wire_bytes"] = int(wire_b)
+        fut = None  # prefetched next-chunk assignment (holds pins)
+        try:
+            while start < n:
+                cn = min(chunk, n - start)
+                t_a0 = time.perf_counter()
+                if fut is not None:
+                    uwords, uidx, rank, clears = fut.result()
+                    fut = None
                 else:
-                    # Heavy duplication: sorted flat dispatches for this
-                    # chunk (<= _FLAT_MAX_LANES lanes each, as the sort
-                    # compile ceiling requires).
-                    slots_req = uslots[uidx]
-                    for off in range(0, cn, _FLAT_MAX_LANES):
-                        sl = min(_FLAT_MAX_LANES, cn - off)
-                        size = _bucket_pow2(sl)
-                        s_pad = _pad_tail(slots_req[off:off + sl], size,
-                                          -1, np.int32)
-                        p_pad = _pad_tail(p_chunk[off:off + sl], size, 1,
-                                          np.uint8)
-                        bits = flat_dispatch(s_pad, lid, p_pad, now)
-                        pending.append(("flat", bits, start + off, sl,
-                                        None, t0, rec))
-                    wire_b = 5.0 * cn
-                    if rec is not None:
-                        rec["mode"] = "flat_fb"
-                        rec["wire_bytes"] = int(wire_b)
-            if rec is not None:
-                rec["host_s"] = round(
-                    time.perf_counter() - t_a0 - t_assign, 6)
-            while len(pending) > 2:
-                drain(*pending.pop(0))
-            bpr = max(wire_b / cn, 1e-3)
-            chunk = int(min(max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
-                                _RELAY_CHUNK), _RELAY_CHUNK_MAX))
-            start += cn
+                    uwords, uidx, rank, clears = assign_uniques(start, cn)
+                t_assign = time.perf_counter() - t_a0
+                u = len(uwords)
+                uslots = (uwords >> np.uint32(rb + 1)).astype(np.int32)
+                p_chunk = permits[start:start + cn]
+                rec = None
+                if self.stream_stats is not None:
+                    rec = {"path": "relay_w", "n": int(cn), "u": int(u),
+                           "assign_s": round(t_assign, 6)}
+                    self.stream_stats.append(rec)
+                with self._pins_released(index, uslots):
+                    if len(clears):
+                        self._clear_slots(algo, list(clears))
+                    r_max = int(rank.max()) + 1 if cn else 1
+                    now = self._monotonic_now()
+                    t0 = time.perf_counter()
+                    if r_max <= r_cap:
+                        # Count-descending rank-major layout: segments sorted
+                        # by occurrence count so each rank step's active set
+                        # is a prefix — permits ship compacted (1 B/request,
+                        # zero padding) and the device reads each step with
+                        # one contiguous dynamic_slice (ops/relay.py:
+                        # _weighted_step_w).  Counts come straight from the
+                        # words' count field — unclamped here, since the true
+                        # r_max (from the rank scratch) fit under r_cap.
+                        counts = ((uwords >> np.uint32(1))
+                                  & np.uint32((1 << rb) - 1)).astype(np.int64)
+                        order = np.argsort(-counts, kind="stable")
+                        spos = np.empty(max(u, 1), dtype=np.int64)
+                        spos[order] = np.arange(u, dtype=np.int64)
+                        r_b = 2
+                        while r_b < r_max:
+                            r_b *= 2
+                        # k_r = number of segments with count > r; roff is its
+                        # exclusive prefix sum (rank-major block offsets).
+                        hist = np.bincount(counts, minlength=r_b + 1)
+                        k_r = u - np.cumsum(hist[:r_b])
+                        roff = np.zeros(r_b, dtype=np.int64)
+                        np.cumsum(k_r[:-1], out=roff[1:])
+                        u_b = _bucket_fine(max(u, 1))
+                        uw_pad = _pad_tail(uwords[order], u_b, 0xFFFFFFFF,
+                                           np.uint32)
+                        pos = roff[rank] + spos[uidx]
+                        perms_rank = np.zeros(_bucket_fine(cn) + u_b,
+                                              dtype=np.uint8)
+                        perms_rank[pos] = p_chunk
+                        handle = dispatch(uw_pad, perms_rank, roff, lid, now,
+                                          r_b)
+                        pending.append(("weighted", handle, start, cn,
+                                        pos, t0, rec))
+                        wire_b = (4 * u_b + len(perms_rank)
+                                  + len(perms_rank) // 8)
+                        if rec is not None:
+                            rec["mode"] = "weighted"
+                            rec["wire_bytes"] = int(wire_b)
+                    else:
+                        # Heavy duplication: sorted flat dispatches for this
+                        # chunk (<= _FLAT_MAX_LANES lanes each, as the sort
+                        # compile ceiling requires).
+                        slots_req = uslots[uidx]
+                        for off in range(0, cn, _FLAT_MAX_LANES):
+                            sl = min(_FLAT_MAX_LANES, cn - off)
+                            size = _bucket_pow2(sl)
+                            s_pad = _pad_tail(slots_req[off:off + sl], size,
+                                              -1, np.int32)
+                            p_pad = _pad_tail(p_chunk[off:off + sl], size, 1,
+                                              np.uint8)
+                            bits = flat_dispatch(s_pad, lid, p_pad, now)
+                            pending.append(("flat", bits, start + off, sl,
+                                            None, t0, rec))
+                        wire_b = 5.0 * cn
+                        if rec is not None:
+                            rec["mode"] = "flat_fb"
+                            rec["wire_bytes"] = int(wire_b)
+                if rec is not None:
+                    rec["host_s"] = round(
+                        time.perf_counter() - t_a0 - t_assign, 6)
+                bpr = max(wire_b / cn, 1e-3)
+                chunk = int(min(max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
+                                    _RELAY_CHUNK), _RELAY_CHUNK_MAX))
+                start += cn
+                if start < n:
+                    # Prefetch the next chunk's assignment (see _stream_relay).
+                    fut = self._assign_pool().submit(
+                        assign_uniques, start, min(chunk, n - start))
+                while len(pending) > 2:
+                    drain(*pending.pop(0))
+        finally:
+            if fut is not None:
+                self._abort_prefetch(
+                    algo, index, fut,
+                    lambda res: (res[0] >> np.uint32(rb + 1)).astype(
+                        np.int32))
         for item in pending:
             drain(*item)
         return out
@@ -926,54 +960,71 @@ class TpuBatchedStorage(RateLimitStorage):
             out[start:start + count] = got
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
 
-        for start in range(0, n, super_n):
-            cn = min(super_n, n - start)
-            # The tail super-batch shrinks to its own sub-batch count so a
-            # partial chunk doesn't ship k_scan's worth of padding lanes.
-            k_i = (min(k_scan, -(-cn // _FLAT_MAX_LANES)) if k_scan else 0)
-            pad_n = k_i * _FLAT_MAX_LANES if k_i else super_n
-            t_a0 = time.perf_counter()
-            slots, clears = assign(start, cn)
-            t_assign = time.perf_counter() - t_a0
-            rec = None
-            if self.stream_stats is not None:
-                lanes = 4 + (np.dtype(p_dtype).itemsize
-                             if permits is not None else 0) + (
-                    4 if multi_lid else 0)
-                rec = {"path": "flat", "mode": "scan" if k_i else "flat",
-                       "n": int(cn), "assign_s": round(t_assign, 6),
-                       "wire_bytes": int(pad_n * lanes)}
-                self.stream_stats.append(rec)
-            raw_slots = slots
-            with self._pins_released(self._index[algo], raw_slots):
-                if len(clears):
-                    clear(list(clears))
-                slots = _pad_tail(slots, pad_n, -1, np.int32)
-                if oversize is not None:
-                    slots[:cn][oversize[start:start + cn]] = -1  # deny
-                lid_flat = lid if not multi_lid else _pad_tail(
-                    lid_arr[start:start + cn], pad_n, 0, np.int32)
-                p_flat = None if permits is None else _pad_tail(
-                    permits[start:start + cn], pad_n, 1, p_dtype)
-                now = self._monotonic_now()
-                t0 = time.perf_counter()
-                if k_i:
-                    bits = dispatch(
-                        slots.reshape(k_i, _FLAT_MAX_LANES),
-                        lid_flat if not multi_lid
-                        else lid_flat.reshape(k_i, _FLAT_MAX_LANES),
-                        None if p_flat is None
-                        else p_flat.reshape(k_i, _FLAT_MAX_LANES),
-                        np.full(k_i, now, dtype=np.int64))
+        fut = None  # prefetched next-chunk assignment (holds pins)
+        try:
+            for start in range(0, n, super_n):
+                cn = min(super_n, n - start)
+                # The tail super-batch shrinks to its own sub-batch count so a
+                # partial chunk doesn't ship k_scan's worth of padding lanes.
+                k_i = (min(k_scan, -(-cn // _FLAT_MAX_LANES)) if k_scan else 0)
+                pad_n = k_i * _FLAT_MAX_LANES if k_i else super_n
+                t_a0 = time.perf_counter()
+                if fut is not None:
+                    slots, clears = fut.result()
+                    fut = None
                 else:
-                    bits = dispatch(slots, lid_flat, p_flat, now)
-            if rec is not None:
-                rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
-                                      6)
-            pending.append((start, cn, bits, t0, rec))
-            if len(pending) > 1:
-                s0, c0, h0, pt0, r0 = pending.pop(0)
-                drain(h0, s0, c0, pt0, r0)
+                    slots, clears = assign(start, cn)
+                t_assign = time.perf_counter() - t_a0
+                rec = None
+                if self.stream_stats is not None:
+                    lanes = 4 + (np.dtype(p_dtype).itemsize
+                                 if permits is not None else 0) + (
+                        4 if multi_lid else 0)
+                    rec = {"path": "flat", "mode": "scan" if k_i else "flat",
+                           "n": int(cn), "assign_s": round(t_assign, 6),
+                           "wire_bytes": int(pad_n * lanes)}
+                    self.stream_stats.append(rec)
+                raw_slots = slots
+                with self._pins_released(self._index[algo], raw_slots):
+                    if len(clears):
+                        clear(list(clears))
+                    slots = _pad_tail(slots, pad_n, -1, np.int32)
+                    if oversize is not None:
+                        slots[:cn][oversize[start:start + cn]] = -1  # deny
+                    lid_flat = lid if not multi_lid else _pad_tail(
+                        lid_arr[start:start + cn], pad_n, 0, np.int32)
+                    p_flat = None if permits is None else _pad_tail(
+                        permits[start:start + cn], pad_n, 1, p_dtype)
+                    now = self._monotonic_now()
+                    t0 = time.perf_counter()
+                    if k_i:
+                        bits = dispatch(
+                            slots.reshape(k_i, _FLAT_MAX_LANES),
+                            lid_flat if not multi_lid
+                            else lid_flat.reshape(k_i, _FLAT_MAX_LANES),
+                            None if p_flat is None
+                            else p_flat.reshape(k_i, _FLAT_MAX_LANES),
+                            np.full(k_i, now, dtype=np.int64))
+                    else:
+                        bits = dispatch(slots, lid_flat, p_flat, now)
+                if rec is not None:
+                    rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
+                                          6)
+                pending.append((start, cn, bits, t0, rec))
+                nxt = start + super_n
+                if nxt < n:
+                    # Prefetch the next super-batch's assignment (see
+                    # _stream_relay).
+                    fut = self._assign_pool().submit(
+                        assign, nxt, min(super_n, n - nxt))
+                if len(pending) > 1:
+                    s0, c0, h0, pt0, r0 = pending.pop(0)
+                    drain(h0, s0, c0, pt0, r0)
+        finally:
+            if fut is not None:
+                self._abort_prefetch(
+                    algo, self._index[algo], fut,
+                    lambda res: np.asarray(res[0], dtype=np.int32))
         for s0, c0, h0, pt0, r0 in pending:
             drain(h0, s0, c0, pt0, r0)
         return out
@@ -1578,12 +1629,47 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def close(self) -> None:
         self._batcher.close()
-        pool = getattr(self, "_shard_pool_obj", None)
-        if pool is not None:
-            pool.shutdown(wait=False)
+        for attr in ("_shard_pool_obj", "_assign_pool_obj"):
+            pool = getattr(self, attr, None)
+            if pool is not None:
+                pool.shutdown(wait=False)
         for index in self._index.values():
             if hasattr(index, "close"):
                 index.close()
+
+    def _abort_prefetch(self, algo, index, fut, slots_of) -> None:
+        """Consume an ORPHANED prefetched assignment (an exception escaped
+        before the main loop took it): the index already applied it — its
+        evicted slots map to new keys and must be cleared on device
+        before any reuse, exactly as the in-loop path clears them — and
+        its held pins must be released.  ``slots_of(result)`` extracts
+        the pinned slot array from the assign result (whose last element
+        is always the clears list)."""
+        try:
+            res = fut.result()
+        except Exception:  # noqa: BLE001 — failed assign holds nothing
+            return
+        try:
+            clears = res[-1]
+            if len(clears):
+                self._clear_slots(algo, list(clears))
+        finally:
+            slots = slots_of(res)
+            if slots is not None and len(slots):
+                self._unpin_held(index, [slots])
+
+    def _assign_pool(self):
+        """One-worker pool that prefetches the NEXT chunk's slot
+        assignment while the main thread blocks in a device fetch (the
+        fetch wait releases the GIL and the C walk releases it too, so
+        on any host the assign rides in the fetch shadow)."""
+        pool = getattr(self, "_assign_pool_obj", None)
+        if pool is None:
+            import concurrent.futures as cf
+
+            pool = cf.ThreadPoolExecutor(1, thread_name_prefix="assignpf")
+            self._assign_pool_obj = pool
+        return pool
 
     def _shard_pool(self, n_sh: int):
         """Thread pool for per-shard C index calls (lazily created): the

@@ -437,3 +437,46 @@ def test_interleaved_scalar_and_stream_traffic():
         over = {k: v for k, v in per_key.items() if v > budgets[algo]}
         assert not over, (algo, over)
     st.close()
+
+
+def test_stream_failure_with_prefetched_assign_clears_evictions(monkeypatch):
+    """An exception escaping while a PREFETCHED next-chunk assignment is
+    outstanding must still clear that assignment's evicted slots (their
+    index entries already point at new keys) and release its pins."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    n_slots = 64
+    now = [8_000_000]
+    st = TpuBatchedStorage(num_slots=n_slots, clock_ms=lambda: now[0])
+    lid = st.register_limiter("tb", RateLimitConfig(
+        max_permits=3, window_ms=60_000, refill_rate=0.001))
+    eng = st.engine
+    # Chunk 1 dispatch fails AFTER chunk 2's assignment was prefetched
+    # (the prefetch is submitted before the drains run, and dispatch of
+    # chunk 1 precedes it — so fail the SECOND dispatch: chunk 2's).
+    # Only the digest dispatch is wrapped: the failing stream's chunks
+    # (40 uniques / 128 requests) deterministically elect digest mode,
+    # while the recovery stream below (uniform uniques) elects words.
+    monkeypatch.setattr(eng, "tb_relay_counts_dispatch",
+                        _fail_after(eng.tb_relay_counts_dispatch, 1))
+    rng = np.random.default_rng(9)
+    # 4 chunks of 128; each chunk's 40 uniques fit the 64-slot table but
+    # later chunks evict earlier chunks' keys — so the PREFETCHED
+    # assignment that is outstanding when chunk 2's dispatch dies has
+    # performed evictions that only the abort path can clear.
+    ids = np.concatenate([rng.integers(c * 40, c * 40 + 40, 128)
+                          for c in range(4)]).astype(np.int64)
+    with pytest.raises(RuntimeError, match="injected"):
+        st.acquire_stream_ids("tb", lid, ids, None)
+    _assert_no_pin_leak(st, "tb", n_slots)
+    # Every key must see a clean budget for its slot: burn each key once
+    # under a frozen clock; a slot with stale (unclear) state would have
+    # less than the full budget.
+    fresh = np.arange(20_000_000, 20_000_000 + n_slots, dtype=np.int64)
+    for _ in range(3):
+        out = st.acquire_stream_ids("tb", lid, fresh, None)
+        assert bool(out.all()), "stale device state survived the abort"
+    st.close()
