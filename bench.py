@@ -219,11 +219,16 @@ def main() -> None:
             res["relink"] = link_probe()
             allowed = timed_pass()
         total_wall = sum(p["wall_s"] for p in passes)
+        rates = sorted(p["decisions_per_sec"] for p in passes)
         res.update({
             "decisions": n * len(passes), "wall_s": round(total_wall, 4),
             "decisions_per_sec": n * len(passes) / total_wall,
-            "best_pass_decisions_per_sec": max(
-                p["decisions_per_sec"] for p in passes),
+            # The median pass is robust to single multi-second link
+            # stalls (observed: a 65 s zero-compile fetch on an
+            # otherwise-normal run); the aggregate and every pass stay
+            # recorded alongside.
+            "median_pass_decisions_per_sec": rates[len(rates) // 2],
+            "best_pass_decisions_per_sec": rates[-1],
             "passes": passes,
             "allowed_last_pass": int(allowed.sum()),
         })
@@ -245,9 +250,12 @@ def main() -> None:
                 ids, p, batch=B, subbatches=K),
             key_ids, None, 2 if small else 3, storage)
     detail["tb_1m_zipf_stream_ids"] = res
-    headline = res["decisions_per_sec"]
-    log(f"  stream (int keys): {headline:,.0f} decisions/s "
-        f"(best pass {res['best_pass_decisions_per_sec']:,.0f})")
+    # Median pass: robust to single link stalls; every pass + the
+    # aggregate are in BENCH_DETAIL with their phase breakdowns.
+    headline = res["median_pass_decisions_per_sec"]
+    log(f"  stream (int keys): {headline:,.0f} decisions/s median pass "
+        f"(aggregate {res['decisions_per_sec']:,.0f}, best "
+        f"{res['best_pass_decisions_per_sec']:,.0f})")
 
     # String-key end-to-end (Python key handling included; streamed).
     n_str = min(n_requests, 50_000 if small else 2_000_000)
@@ -494,11 +502,12 @@ def main() -> None:
         json.dump(detail, fh, indent=2)
 
     baseline = 80_192.0  # reference README throughput (BASELINE.md)
-    # Honest labeling: the headline is the int-key STREAM rate; the
-    # string-key end-to-end number lives in BENCH_DETAIL.json under
-    # tb_1m_zipf_end_to_end_strs.
+    # Honest labeling: the headline is the MEDIAN timed pass of the
+    # int-key stream (robust to single tunnel stalls; aggregate + every
+    # pass recorded in BENCH_DETAIL); the string-key end-to-end number
+    # lives under tb_1m_zipf_end_to_end_strs.
     print(json.dumps({
-        "metric": "tb_1m_keys_zipf_stream_decisions_per_sec",
+        "metric": "tb_1m_keys_zipf_stream_decisions_per_sec_median_pass",
         "value": round(float(headline), 1),
         "unit": "decisions/s",
         "vs_baseline": round(float(headline) / baseline, 2),
