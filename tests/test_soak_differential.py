@@ -161,3 +161,76 @@ def test_monotonic_stamp_guards_clock_regression():
     # zero the state, and wrongly admit.
     assert not limiter.try_acquire("u")
     storage.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_grand_soak_all_paths_with_reset_and_checkpoint(seed, tmp_path):
+    """The widest interleave: scalar, int batch, unit stream, WEIGHTED
+    stream (single-lid), string stream, admin reset, and a mid-soak
+    checkpoint save/restore cycle — one storage, one oracle, decisions
+    bit-identical throughout."""
+    import numpy as np
+
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    rng = random.Random(900 + seed)
+    nrng = np.random.default_rng(900 + seed)
+    win = 1500
+    cfg = RateLimitConfig(max_permits=9, window_ms=win, refill_rate=6.0)
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=256,
+                                clock_ms=lambda: clock["t"])
+    lid = storage.register_limiter("tb", cfg)
+    oracle = TokenBucketOracle(cfg)
+    n_keys = 8
+    ckpt = str(tmp_path / f"soak{seed}.ckpt")
+
+    for step in range(50):
+        clock["t"] += biased_dt(rng, win)
+        now = clock["t"]
+        mode = rng.randrange(6)
+        n = rng.randrange(1, 12)
+        key_ids = nrng.integers(0, n_keys, n)
+        perms = nrng.integers(1, 6, n).astype(np.int64)
+        if mode == 0:
+            # Scalar path with RAW int keys: shares the int bucket family
+            # with the batch/stream paths below.
+            got = [storage.acquire("tb", lid, int(k), int(p))["allowed"]
+                   for k, p in zip(key_ids, perms)]
+            okeys = [f"int:{k}" for k in key_ids]
+        elif mode == 1:
+            got = storage.acquire_many_ids(
+                "tb", lid, key_ids, perms)["allowed"]
+            okeys = [f"int:{k}" for k in key_ids]
+        elif mode == 2:
+            got = storage.acquire_stream_ids(
+                "tb", lid, key_ids, None, batch=16, subbatches=1)
+            perms = np.ones(n, dtype=np.int64)
+            okeys = [f"int:{k}" for k in key_ids]
+        elif mode == 3:  # weighted relay stream
+            got = storage.acquire_stream_ids(
+                "tb", lid, key_ids, perms, batch=16, subbatches=1)
+            okeys = [f"int:{k}" for k in key_ids]
+        elif mode == 4:  # weighted STRING stream, its own bucket family
+            keys = [f"s:{int(k)}" for k in key_ids]
+            got = storage.acquire_stream_strs("tb", lid, keys, perms)
+            okeys = keys
+        else:
+            got = storage.acquire_stream_strs(
+                "tb", lid, [f"s:{int(k)}" for k in key_ids], None)
+            perms = np.ones(n, dtype=np.int64)
+            okeys = [f"s:{k}" for k in key_ids]
+        for j in range(n):
+            d = oracle.try_acquire(okeys[j], int(perms[j]), now)
+            assert bool(got[j]) == d.allowed, (seed, step, j, mode)
+        r = rng.random()
+        if r < 0.15:
+            k = rng.randrange(n_keys)
+            fam = rng.choice(["int", "s"])
+            key = k if fam == "int" else f"s:{k}"
+            storage.reset_key("tb", lid, key)
+            oracle.reset(f"{fam}:{k}" if fam == "int" else key, now)
+        elif r < 0.25:
+            storage.save_checkpoint(ckpt)
+            storage.restore_checkpoint(ckpt)
+    storage.close()
