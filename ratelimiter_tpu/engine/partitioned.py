@@ -160,9 +160,23 @@ class PartitionedSlotIndex:
         """Split a batch by partition, run per-partition C calls on the
         pool (GIL released inside), return (parts_pos, results).
         ``unpin_of(result) -> local slots`` must be given when the run
-        holds pins, so a partial failure releases them."""
-        parts = _part_of_int_keys(key_ids, self.n_parts)
-        parts_pos = [np.where(parts == p)[0] for p in range(self.n_parts)]
+        holds pins, so a partial failure releases them.  Routing is one
+        native pass (hash + stable counting sort) when available, so
+        each partition's positions are a contiguous slice of one order
+        array instead of T O(n) mask scans."""
+        from ratelimiter_tpu.engine.native_index import shard_route
+
+        r = shard_route(key_ids, self.n_parts)
+        if r is not None:
+            _, order, counts = r
+            offs = np.zeros(self.n_parts + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            parts_pos = [order[offs[p]:offs[p + 1]]
+                         for p in range(self.n_parts)]
+        else:
+            parts = _part_of_int_keys(key_ids, self.n_parts)
+            parts_pos = [np.where(parts == p)[0]
+                         for p in range(self.n_parts)]
         futs = []
         for p, pos in enumerate(parts_pos):
             if not len(pos):
