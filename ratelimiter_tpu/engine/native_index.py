@@ -146,6 +146,47 @@ def native_available() -> bool:
     return _load_library() is not None
 
 
+_STRPACK_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libstrpack.so"))
+_strpack = None
+_strpack_failed = False
+
+
+def _load_strpack():
+    """Optional CPython-API string packer (native/str_pack.cpp): one C
+    pass over the key list instead of join + encode + separator scan.
+    Needs Python headers + shared libpython to build; any failure means
+    the numpy packer below is used — behavior identical."""
+    global _strpack, _strpack_failed
+    if _strpack is not None or _strpack_failed:
+        return _strpack
+    with _build_lock:
+        if _strpack is not None or _strpack_failed:
+            return _strpack
+        try:
+            src = os.path.join(os.path.abspath(_NATIVE_DIR), "str_pack.cpp")
+            stale = (not os.path.exists(_STRPACK_PATH)
+                     or (os.path.exists(src) and os.path.getmtime(src)
+                         > os.path.getmtime(_STRPACK_PATH)))
+            if stale:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR),
+                     "libstrpack.so"],
+                    check=True, capture_output=True, timeout=120)
+            # PyDLL, not CDLL: these functions touch Python objects, so
+            # the GIL must stay held across the call.
+            lib = ctypes.PyDLL(_STRPACK_PATH)
+            lib.rl_strlist_total.restype = ctypes.c_int64
+            lib.rl_strlist_total.argtypes = [ctypes.py_object]
+            lib.rl_strlist_pack.restype = ctypes.c_int32
+            lib.rl_strlist_pack.argtypes = [
+                ctypes.py_object, ctypes.c_void_p, ctypes.c_void_p]
+        except Exception:  # noqa: BLE001 — optional fast path only
+            _strpack_failed = True
+            return None
+        _strpack = lib
+        return _strpack
+
+
 def _pack_str_keys(keys):
     """(packed bytes u8[:], offsets i64[n+1]) for a batch of string keys.
 
@@ -157,6 +198,15 @@ def _pack_str_keys(keys):
     n = len(keys)
     if n == 0:
         return np.empty(0, dtype=np.uint8), np.zeros(1, dtype=np.int64)
+    sp = _load_strpack() if isinstance(keys, list) else None
+    if sp is not None:
+        total = sp.rl_strlist_total(keys)
+        if total >= 0:
+            buf = np.empty(total, dtype=np.uint8)
+            offs = np.empty(n + 1, dtype=np.int64)
+            if sp.rl_strlist_pack(keys, buf.ctypes.data,
+                                  offs.ctypes.data) == 0:
+                return buf, offs
     try:
         joined = "\x00".join(keys).encode()
     except TypeError:

@@ -303,3 +303,32 @@ def test_restore_remaps_pinned_slot_cleanly():
     s_c, ev_c = ix.assign((0, 9))
     assert ev_c is not None and ev_c == s_c
     assert len(ix) == 2
+
+
+def test_strpack_native_matches_numpy_packer():
+    """The optional CPython-API string packer must produce byte-identical
+    (buffer, offsets) to the numpy join packer — including empty keys,
+    unicode, 300-char keys, and embedded NULs (where the join path takes
+    its slow per-key fallback)."""
+    import ratelimiter_tpu.engine.native_index as ni
+
+    if ni._load_strpack() is None:
+        pytest.skip("strpack unavailable (no Python headers/libpython)")
+    cases = [
+        ["hello", "", "wörld", "a" * 300, "nul\x00byte", "k123"],
+        [f"user-{i}" for i in range(257)],
+        [""],
+    ]
+    sp = ni._strpack
+    for keys in cases:
+        b1, o1 = ni._pack_str_keys(keys)
+        ni._strpack, ni._strpack_failed = None, True
+        try:
+            b2, o2 = ni._pack_str_keys(keys)
+        finally:
+            ni._strpack, ni._strpack_failed = sp, False
+        np.testing.assert_array_equal(o1, o2)
+        np.testing.assert_array_equal(b1, b2)
+    # Non-str items: the native packer declines and the fallback handles.
+    b, o = ni._pack_str_keys(["a", b"raw-bytes", "c"])
+    assert bytes(b) == b"araw-bytesc" and list(o) == [0, 1, 10, 11]
