@@ -44,11 +44,22 @@ int64_t rl_strlist_total(PyObject* seq) {
 }
 
 // Pass 2: copy the UTF-8 bytes into buf and write n+1 offsets.
-// Caller allocated buf (>= rl_strlist_total bytes) and offs (n+1).
-// Returns 0, or -1 on type errors (buffer untouched beyond progress).
-int32_t rl_strlist_pack(PyObject* seq, uint8_t* buf, int64_t* offs) {
+// Caller allocated buf (expect_total bytes, from rl_strlist_total) and
+// offs (expect_n + 1).  Named _pack2: the arity changed when the
+// bounds re-checks landed, and a stale prebuilt .so binding the old
+// 3-arg symbol would silently drop the guard — a new name makes a
+// stale library fail to bind and fall back to the numpy packer.
+// The two passes are separated by Python code
+// (np.empty) where the GIL can drop, so a caller thread mutating the
+// list in between (growing it, or swapping in longer strings) must turn
+// into an error return, not a heap overflow: every length is re-checked
+// against what the buffers were sized for.  Returns 0, or -1 on type
+// errors / size drift (buffer untouched beyond progress).
+int32_t rl_strlist_pack2(PyObject* seq, uint8_t* buf, int64_t* offs,
+                        int64_t expect_n, int64_t expect_total) {
   if (!PyList_Check(seq)) return -1;
   Py_ssize_t n = PyList_GET_SIZE(seq);
+  if (n != expect_n) return -1;
   int64_t pos = 0;
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject* it = PyList_GET_ITEM(seq, i);
@@ -59,6 +70,7 @@ int32_t rl_strlist_pack(PyObject* seq, uint8_t* buf, int64_t* offs) {
       PyErr_Clear();
       return -1;
     }
+    if (pos + len > expect_total) return -1;
     offs[i] = pos;
     std::memcpy(buf + pos, p, static_cast<size_t>(len));
     pos += len;

@@ -1,0 +1,56 @@
+"""Shared engine error types.
+
+``SlotCapacityError`` is raised when a batch slot assignment cannot place
+every key (all slots pinned).  The C walk is not transactional: lanes
+processed before the failing one WERE assigned — their evicted slots are
+already remapped to new keys in the index, so their device state must be
+zeroed before any reuse or a later acquire of a newly mapped key would
+read the evicted key's stale counters.  ``pending_clears`` carries those
+evictions (slot ids local to the raising index) up to the storage layer,
+which routes them through ``_clear_slots`` exactly as the success path
+does (reference analog: the Redis backend's retry wrapper surfaces every
+failure as StorageException AFTER the partial pipeline effects are
+already durable — storage/RedisRateLimitStorage.java:155-178).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SlotCapacityError(RuntimeError):
+    """Batch assignment ran out of evictable slots.
+
+    ``pending_clears``: int32 slot ids (local to the index that raised)
+    whose device state must be cleared — evictions applied by the lanes
+    that succeeded before the failure.  Consumers that clear them should
+    set the attribute to ``None`` so a re-raise through nested handlers
+    cannot double-clear.
+    """
+
+    def __init__(self, msg: str, pending_clears=None):
+        super().__init__(msg)
+        self.pending_clears = (
+            np.asarray(pending_clears, dtype=np.int64)
+            if pending_clears is not None and len(pending_clears)
+            else None)
+
+
+def consume_pending_clears(exc, base: int = 0) -> list:
+    """Extract an exception's ``pending_clears`` as a list of GLOBAL slot
+    ids (each local id offset by ``base``) and null the attribute, so the
+    same raise passing through nested handlers cannot double-clear.  The
+    caller takes over responsibility for actually clearing what it got —
+    use this where the clears from several sub-indexes are pooled and
+    cleared in one call; a handler that clears inline should instead
+    clear FIRST and null the attribute only after the clear landed (a
+    clear-time failure then still propagates with the information
+    intact)."""
+    pc = getattr(exc, "pending_clears", None)
+    if pc is None or not len(pc):
+        return []
+    try:
+        exc.pending_clears = None
+    except AttributeError:  # exotic __slots__ exception: best effort
+        pass
+    return [base + int(s) for s in pc]

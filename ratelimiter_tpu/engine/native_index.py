@@ -21,6 +21,8 @@ from typing import Hashable, Optional, Set, Tuple
 
 import numpy as np
 
+from ratelimiter_tpu.engine.errors import SlotCapacityError
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libslotindex.so"))
 _build_lock = threading.Lock()
@@ -177,9 +179,13 @@ def _load_strpack():
             lib = ctypes.PyDLL(_STRPACK_PATH)
             lib.rl_strlist_total.restype = ctypes.c_int64
             lib.rl_strlist_total.argtypes = [ctypes.py_object]
-            lib.rl_strlist_pack.restype = ctypes.c_int32
-            lib.rl_strlist_pack.argtypes = [
-                ctypes.py_object, ctypes.c_void_p, ctypes.c_void_p]
+            # _pack2: arity changed with the bounds re-checks; binding by
+            # a new name makes a stale prebuilt .so raise AttributeError
+            # here (=> numpy fallback) instead of silently dropping them.
+            lib.rl_strlist_pack2.restype = ctypes.c_int32
+            lib.rl_strlist_pack2.argtypes = [
+                ctypes.py_object, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64]
         except Exception:  # noqa: BLE001 — optional fast path only
             _strpack_failed = True
             return None
@@ -204,8 +210,12 @@ def _pack_str_keys(keys):
         if total >= 0:
             buf = np.empty(total, dtype=np.uint8)
             offs = np.empty(n + 1, dtype=np.int64)
-            if sp.rl_strlist_pack(keys, buf.ctypes.data,
-                                  offs.ctypes.data) == 0:
+            # n/total re-checked inside: the list could have been mutated
+            # between the sizing pass and here (bounds, not a data race
+            # guarantee — concurrent mutation still yields garbage keys,
+            # just never a heap overflow).
+            if sp.rl_strlist_pack2(keys, buf.ctypes.data,
+                                   offs.ctypes.data, n, total) == 0:
                 return buf, offs
     try:
         joined = "\x00".join(keys).encode()
@@ -398,7 +408,8 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return out_slots, out_ev[out_ev >= 0]
 
     def assign_batch_ints_multi(self, keys: np.ndarray, lids: np.ndarray,
@@ -421,7 +432,8 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return out_slots, out_ev[out_ev >= 0]
 
     # -- held pins (streams: assign -> dispatch-enqueue window) ---------------
@@ -472,7 +484,8 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
     def assign_batch_ints_multi_uniques(self, keys: np.ndarray,
@@ -498,7 +511,8 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
@@ -523,7 +537,8 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, np.ascontiguousarray(uslots).ctypes.data, u)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
     # -- fingerprint enumeration (checkpoint/resume at native speed) ----------
@@ -582,7 +597,8 @@ class NativeSlotIndex:
                 self._h, h1.ctypes.data, h2.ctypes.data, n,
                 out_slots.ctypes.data, out_ev.ctypes.data)
         if (out_ev == -2).any():
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return out_slots, out_ev[out_ev >= 0]
 
     def assign_batch_strs(self, keys, lid: int,
@@ -603,5 +619,6 @@ class NativeSlotIndex:
                 self._lib.rl_index_pin_batch(
                     self._h, out_slots.ctypes.data, n)
         if failed:
-            raise RuntimeError("slot capacity exhausted (all pinned)")
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
         return out_slots, out_ev[out_ev >= 0]

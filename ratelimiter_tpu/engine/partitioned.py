@@ -26,6 +26,7 @@ from typing import Hashable, Optional, Set, Tuple
 
 import numpy as np
 
+from ratelimiter_tpu.engine.errors import consume_pending_clears
 from ratelimiter_tpu.engine.native_index import NativeSlotIndex
 
 
@@ -137,9 +138,16 @@ class PartitionedSlotIndex:
     def _collect(self, futs, unpin_of):
         """Gather per-partition futures; if any partition raised, release
         the pins the SUCCESSFUL partitions took (their results never reach
-        the caller, so nothing else could unpin them) and re-raise."""
+        the caller, so nothing else could unpin them), surface EVERY
+        eviction the batch applied — successful partitions' lists plus the
+        failing partitions' partial ones — as global ``pending_clears`` on
+        the re-raised error, and re-raise.  Without that, slots the C
+        index already remapped to new keys would keep stale device state
+        (ADVICE r3)."""
         results, err = [], None
-        for f in futs:
+        spp = self.slots_per_part
+        clears: list = []
+        for p, f in enumerate(futs):
             if f is None:
                 results.append(None)
                 continue
@@ -147,12 +155,21 @@ class PartitionedSlotIndex:
                 results.append(f.result())
             except Exception as exc:  # noqa: BLE001 — re-raised below
                 err = err if err is not None else exc
+                clears.extend(consume_pending_clears(exc, p * spp))
                 results.append(None)
         if err is not None:
-            if unpin_of is not None:
-                for p, res in enumerate(results):
-                    if res is not None:
-                        self._parts[p].unpin_batch(unpin_of(res))
+            for p, res in enumerate(results):
+                if res is None:
+                    continue
+                if unpin_of is not None:
+                    self._parts[p].unpin_batch(unpin_of(res))
+                # Every assign result ends with its eviction list.
+                clears.extend(p * spp + int(e) for e in res[-1])
+            try:  # keep the original type; just carry the clears
+                err.pending_clears = (np.asarray(clears, dtype=np.int64)
+                                      if clears else None)
+            except AttributeError:  # exotic __slots__ exception: best effort
+                pass
             raise err
         return results
 

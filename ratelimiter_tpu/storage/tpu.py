@@ -36,6 +36,7 @@ import numpy as np
 
 from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.engine.batcher import MicroBatcher
+from ratelimiter_tpu.engine.errors import consume_pending_clears
 from ratelimiter_tpu.engine.engine import DeviceEngine
 from ratelimiter_tpu.engine.state import LimiterTable
 from ratelimiter_tpu.storage.base import RateLimitStorage
@@ -94,9 +95,11 @@ def _bucket_pow2(n: int) -> int:
 
 
 def _bucket_fine(n: int, floor: int = 4096) -> int:
-    """Quarter-pow2 bucketing: next multiple of pow2/4 — at most 4 compile
-    shapes per octave instead of 1, for ~12% worst-case padding instead
-    of ~100% (used where a lane's bytes dominate the wire)."""
+    """Quarter-octave bucketing: next multiple of octave/4 (for n in
+    (2^(L-1), 2^L] the step is 2^(L-3)) — 4 compile shapes per octave
+    instead of 1.  Worst-case padding ~25% just above a power of two,
+    ~12% at the octave top, vs ~100% for plain pow2 rounding (used where
+    a lane's bytes dominate the wire)."""
     if n <= floor:
         return floor
     step = 1 << (int(n - 1).bit_length() - 3)
@@ -328,9 +331,11 @@ class TpuBatchedStorage(RateLimitStorage):
             # maps the whole batch; same-batch keys are generation-pinned and
             # slots of requests queued since the flush are pin-protected.
             self._batcher.flush()
-            slots, clears = index.assign_batch_strs(
-                list(keys), lid0, pinned=self._batcher.pending_slots(algo),
-                hold_pins=True)
+            with self._evictions_cleared(algo):
+                slots, clears = index.assign_batch_strs(
+                    list(keys), lid0,
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
             with self._pins_released(index, slots):
                 return self._batcher.dispatch_direct(
                     algo, slots, list(lid_per_req), list(permits),
@@ -339,15 +344,22 @@ class TpuBatchedStorage(RateLimitStorage):
         slots: List[int] = []
         clears: List[int] = []
         # try/finally from the FIRST assign: a mid-loop raise ("all slots
-        # pinned") must release the pins earlier iterations took.
+        # pinned") must release the pins earlier iterations took — and
+        # clear the evictions they applied (the index already remapped
+        # those slots; see _evictions_cleared).
         try:
-            for lid, key in zip(lid_per_req, keys):
-                slot, evicted = index.assign((lid, key), pinned=pinned,
-                                             hold_pin=True)
-                if evicted is not None:
-                    clears.append(evicted)
-                pinned.add(slot)
-                slots.append(slot)
+            try:
+                for lid, key in zip(lid_per_req, keys):
+                    slot, evicted = index.assign((lid, key), pinned=pinned,
+                                                 hold_pin=True)
+                    if evicted is not None:
+                        clears.append(evicted)
+                    pinned.add(slot)
+                    slots.append(slot)
+            except Exception:
+                if clears:
+                    self._clear_slots(algo, clears)
+                raise
             return self._batcher.dispatch_direct(
                 algo, slots, list(lid_per_req), list(permits), clears)
         finally:
@@ -365,25 +377,33 @@ class TpuBatchedStorage(RateLimitStorage):
         index = self._index[algo]
         if hasattr(index, "assign_batch_ints"):
             self._batcher.flush()
-            slots, clears = index.assign_batch_ints(
-                np.ascontiguousarray(key_ids, dtype=np.int64), lid,
-                pinned=self._batcher.pending_slots(algo), hold_pins=True)
+            with self._evictions_cleared(algo):
+                slots, clears = index.assign_batch_ints(
+                    np.ascontiguousarray(key_ids, dtype=np.int64), lid,
+                    pinned=self._batcher.pending_slots(algo),
+                    hold_pins=True)
             clears = list(clears)
         else:
             pinned = self._batcher.pending_slots(algo)
             slots = []
             clears = []
             # try/finally from the FIRST assign (see acquire_many): a
-            # mid-loop raise must release earlier iterations' pins.
+            # mid-loop raise must release earlier iterations' pins and
+            # clear their applied evictions.
             try:
-                for k in np.asarray(key_ids):
-                    slot, evicted = index.assign((lid, int(k)),
-                                                 pinned=pinned,
-                                                 hold_pin=True)
-                    if evicted is not None:
-                        clears.append(evicted)
-                    pinned.add(slot)
-                    slots.append(slot)
+                try:
+                    for k in np.asarray(key_ids):
+                        slot, evicted = index.assign((lid, int(k)),
+                                                     pinned=pinned,
+                                                     hold_pin=True)
+                        if evicted is not None:
+                            clears.append(evicted)
+                        pinned.add(slot)
+                        slots.append(slot)
+                except Exception:
+                    if clears:
+                        self._clear_slots(algo, clears)
+                    raise
                 slots = np.asarray(slots, dtype=np.int32)
                 lids = np.full(len(slots), lid, dtype=np.int32)
                 return self._batcher.dispatch_direct(algo, slots, lids,
@@ -472,12 +492,18 @@ class TpuBatchedStorage(RateLimitStorage):
                     chunk_lids = lid_arr[i:i + batch]
                     pinned = self._batcher.pending_slots(algo)
                     slots, clears = [], []
-                    for l, k in zip(chunk_lids, chunk):
-                        s, ev = index.assign((int(l), int(k)), pinned=pinned)
-                        if ev is not None:
-                            clears.append(ev)
-                        pinned.add(s)
-                        slots.append(s)
+                    try:
+                        for l, k in zip(chunk_lids, chunk):
+                            s, ev = index.assign((int(l), int(k)),
+                                                 pinned=pinned)
+                            if ev is not None:
+                                clears.append(ev)
+                            pinned.add(s)
+                            slots.append(s)
+                    except Exception:  # mid-loop raise: clear applied evs
+                        if clears:
+                            self._clear_slots(algo, clears)
+                        raise
                     res = self._batcher.dispatch_direct(
                         algo, slots, list(chunk_lids), list(p[i:i + batch]),
                         clears)
@@ -506,10 +532,11 @@ class TpuBatchedStorage(RateLimitStorage):
             rb = self.engine.rank_bits
 
             def assign_uniques_w(start, chunk_n):
-                return index.assign_batch_ints_uniques(
-                    key_ids[start:start + chunk_n], lid, rb,
-                    pinned=self._batcher.pending_slots(algo),
-                    hold_pins=True)
+                with self._evictions_cleared(algo):
+                    return index.assign_batch_ints_uniques(
+                        key_ids[start:start + chunk_n], lid, rb,
+                        pinned=self._batcher.pending_slots(algo),
+                        hold_pins=True)
 
             return self._stream_weighted(
                 algo, lid, assign_uniques_w, len(key_ids),
@@ -525,29 +552,31 @@ class TpuBatchedStorage(RateLimitStorage):
 
             def assign_uniques(start, chunk_n):
                 chunk = key_ids[start:start + chunk_n]
-                if multi_lid:
-                    return index.assign_batch_ints_multi_uniques(
-                        chunk, lid_arr[start:start + chunk_n], rb,
+                with self._evictions_cleared(algo):
+                    if multi_lid:
+                        return index.assign_batch_ints_multi_uniques(
+                            chunk, lid_arr[start:start + chunk_n], rb,
+                            pinned=self._batcher.pending_slots(algo),
+                            hold_pins=True)
+                    return index.assign_batch_ints_uniques(
+                        chunk, lid, rb,
                         pinned=self._batcher.pending_slots(algo),
                         hold_pins=True)
-                return index.assign_batch_ints_uniques(
-                    chunk, lid, rb,
-                    pinned=self._batcher.pending_slots(algo),
-                    hold_pins=True)
 
             return self._stream_relay(algo, lid, assign_uniques, len(key_ids),
                                       lid_arr if multi_lid else None)
 
         def assign(start, chunk_n):
             chunk = key_ids[start:start + chunk_n]
-            if multi_lid:
-                return index.assign_batch_ints_multi(
-                    chunk, lid_arr[start:start + chunk_n],
-                    pinned=self._batcher.pending_slots(algo),
+            with self._evictions_cleared(algo):
+                if multi_lid:
+                    return index.assign_batch_ints_multi(
+                        chunk, lid_arr[start:start + chunk_n],
+                        pinned=self._batcher.pending_slots(algo),
+                        hold_pins=True)
+                return index.assign_batch_ints(
+                    chunk, lid, pinned=self._batcher.pending_slots(algo),
                     hold_pins=True)
-            return index.assign_batch_ints(
-                chunk, lid, pinned=self._batcher.pending_slots(algo),
-                hold_pins=True)
 
         return self._stream_flat(algo, lid, assign, len(key_ids), permits,
                                  oversize, batch, subbatches,
@@ -1087,10 +1116,11 @@ class TpuBatchedStorage(RateLimitStorage):
             rb = self.engine.rank_bits
 
             def assign_uniques_w(start, chunk_n):
-                return index.assign_batch_strs_uniques(
-                    list(keys[start:start + chunk_n]), lid, rb,
-                    pinned=self._batcher.pending_slots(algo),
-                    hold_pins=True)
+                with self._evictions_cleared(algo):
+                    return index.assign_batch_strs_uniques(
+                        list(keys[start:start + chunk_n]), lid, rb,
+                        pinned=self._batcher.pending_slots(algo),
+                        hold_pins=True)
 
             return self._stream_weighted(
                 algo, lid, assign_uniques_w, len(keys),
@@ -1102,17 +1132,19 @@ class TpuBatchedStorage(RateLimitStorage):
             rb = self.engine.rank_bits
 
             def assign_uniques(start, chunk_n):
-                return index.assign_batch_strs_uniques(
-                    list(keys[start:start + chunk_n]), lid, rb,
-                    pinned=self._batcher.pending_slots(algo),
-                    hold_pins=True)
+                with self._evictions_cleared(algo):
+                    return index.assign_batch_strs_uniques(
+                        list(keys[start:start + chunk_n]), lid, rb,
+                        pinned=self._batcher.pending_slots(algo),
+                        hold_pins=True)
 
             return self._stream_relay(algo, lid, assign_uniques, len(keys))
 
         def assign(start, chunk_n):
-            return index.assign_batch_strs(
-                list(keys[start:start + chunk_n]), lid,
-                pinned=self._batcher.pending_slots(algo), hold_pins=True)
+            with self._evictions_cleared(algo):
+                return index.assign_batch_strs(
+                    list(keys[start:start + chunk_n]), lid,
+                    pinned=self._batcher.pending_slots(algo), hold_pins=True)
 
         return self._stream_flat(algo, lid, assign, len(keys), permits,
                                  oversize, batch, subbatches)
@@ -1206,6 +1238,9 @@ class TpuBatchedStorage(RateLimitStorage):
                         r = f.result()
                     except Exception as exc:  # noqa: BLE001
                         err = err if err is not None else exc
+                        # Partial-failure lanes still evicted: globalize
+                        # into the pooled clears, cleared below.
+                        clears.extend(consume_pending_clears(exc, s * sps))
                         continue
                     if r is None:
                         continue
@@ -1214,6 +1249,11 @@ class TpuBatchedStorage(RateLimitStorage):
                     held.append(s * sps + sl.astype(np.int64))
                     clears.extend(s * sps + int(e) for e in ev)
                 if err is not None:
+                    # Successful shards' assignments are already in the
+                    # index: their evicted slots must be zeroed even
+                    # though no dispatch happens (ADVICE r3).
+                    if clears:
+                        clear(clears)
                     raise err
                 if clears:
                     clear(clears)
@@ -1362,6 +1402,9 @@ class TpuBatchedStorage(RateLimitStorage):
                         r = f.result()
                     except Exception as exc:  # noqa: BLE001
                         err = err if err is not None else exc
+                        # Partial-failure lanes still evicted: globalize
+                        # into the pooled clears, cleared below.
+                        clears.extend(consume_pending_clears(exc, s * sps))
                         results.append((pos, None, None, 0, None))
                         continue
                     if r is None:
@@ -1377,6 +1420,10 @@ class TpuBatchedStorage(RateLimitStorage):
                     u_max = max(u_max, len(uw))
                     b_max = max(b_max, len(pos))
                 if err is not None:
+                    # Successful shards' evictions must be zeroed even
+                    # though no dispatch happens (ADVICE r3).
+                    if clears:
+                        clear(clears)
                     raise err
                 if clears:
                     clear(clears)
@@ -1501,6 +1548,27 @@ class TpuBatchedStorage(RateLimitStorage):
         shard and must release whatever was taken on any exception path."""
         if held and hasattr(index, "unpin_batch"):
             index.unpin_batch(np.concatenate(held))
+
+    @contextlib.contextmanager
+    def _evictions_cleared(self, algo: str):
+        """A failed batch assignment still applied evictions for the lanes
+        that succeeded before the failure (engine/errors.py
+        SlotCapacityError.pending_clears): those slots are already
+        remapped to new keys in the index, so zero their device state
+        before the error propagates — exactly as the success path clears
+        evictions ahead of reuse.  Clears once (the attribute is consumed)
+        however many handlers the raise passes through."""
+        try:
+            yield
+        except Exception as exc:  # noqa: BLE001 — always re-raised
+            pc = getattr(exc, "pending_clears", None)
+            if pc is not None and len(pc):
+                # Clear FIRST, null after: a clear-time failure must
+                # propagate with the clears still attached so an outer
+                # handler could retry (zeroing is idempotent).
+                self._clear_slots(algo, [int(s) for s in pc])
+                exc.pending_clears = None
+            raise
 
     @contextlib.contextmanager
     def _pins_released(self, index, slots):

@@ -480,3 +480,87 @@ def test_stream_failure_with_prefetched_assign_clears_evictions(monkeypatch):
         out = st.acquire_stream_ids("tb", lid, fresh, None)
         assert bool(out.all()), "stale device state survived the abort"
     st.close()
+
+
+# ---------------------------------------------------------------------------
+# Capacity-exhaustion partial failure (ADVICE r3): the lanes that DID
+# assign before the failing one applied evictions — those slots are
+# remapped in the index, so their device state must be zeroed before the
+# error propagates, or a later acquire of the newly mapped key reads the
+# evicted key's stale counters.
+# ---------------------------------------------------------------------------
+
+def test_capacity_failure_clears_applied_evictions():
+    from ratelimiter_tpu.engine.native_index import native_available
+
+    if not native_available():
+        pytest.skip("needs the native slot index")
+    now = [1_000_000]
+    st = TpuBatchedStorage(num_slots=8, clock_ms=lambda: now[0])
+    lid = st.register_limiter("sw", RateLimitConfig(
+        max_permits=12, window_ms=60_000))
+    # Fill the table; key 7's slot accumulates count 10.
+    st.acquire_many_ids("sw", lid, np.arange(8, dtype=np.int64),
+                        np.ones(8, dtype=np.int64))
+    st.acquire_many_ids("sw", lid, np.full(9, 7, dtype=np.int64),
+                        np.ones(9, dtype=np.int64))
+    index = st._index["sw"]
+    pins = np.asarray([index.get((lid, k)) for k in range(7)],
+                      dtype=np.int32)
+    index.pin_batch(pins)
+    try:
+        # Lane 0 (key 100) evicts key 7's slot — the only unpinned one;
+        # lane 1 (key 101) then finds no victim: capacity error.
+        with pytest.raises(RuntimeError, match="capacity"):
+            st.acquire_many_ids("sw", lid,
+                                np.asarray([100, 101], dtype=np.int64),
+                                np.ones(2, dtype=np.int64))
+    finally:
+        index.unpin_batch(pins)
+    # Key 100 now maps to key 7's old slot.  Its device state must have
+    # been CLEARED by the failure path: count 0 + 12 <= 12 allows; stale
+    # count 10 would deny.
+    out = st.acquire_many_ids("sw", lid, np.asarray([100], dtype=np.int64),
+                              np.asarray([12], dtype=np.int64))
+    assert bool(out["allowed"][0]), \
+        "evicted slot kept stale state through a capacity failure"
+    st.close()
+
+
+def test_partitioned_partial_failure_surfaces_evictions():
+    """One partition fails (-2), the sibling succeeded and evicted: the
+    raised error must carry the sibling's eviction as a GLOBAL slot id in
+    ``pending_clears``, and the sibling's held pins must be released."""
+    from ratelimiter_tpu.engine.native_index import native_available
+
+    if not native_available():
+        pytest.skip("needs the native slot index")
+    from ratelimiter_tpu.engine.partitioned import PartitionedSlotIndex
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+    idx = PartitionedSlotIndex(8, 2)  # 4 slots per partition
+    keys = np.arange(10_000, dtype=np.int64)
+    parts = shard_of_int_keys(keys, 2)
+    p0, p1 = keys[parts == 0], keys[parts == 1]
+    fill = np.concatenate([p0[:4], p1[:4]])
+    slots, ev = idx.assign_batch_ints(fill, 0)
+    assert len(ev) == 0
+    s_of = dict(zip(fill.tolist(), slots.tolist()))
+    pin = np.asarray([s_of[int(k)] for k in p0[:4]]
+                     + [s_of[int(k)] for k in p1[:3]], dtype=np.int32)
+    idx.pin_batch(pin)
+    victim = s_of[int(p1[3])]  # the one unpinned slot
+    try:
+        batch = np.asarray([int(p1[4]), int(p0[4])], dtype=np.int64)
+        with pytest.raises(RuntimeError) as ei:
+            idx.assign_batch_ints(batch, 0, hold_pins=True)
+        pc = getattr(ei.value, "pending_clears", None)
+        assert pc is not None and victim in [int(x) for x in pc], \
+            "successful partition's eviction lost on partial failure"
+    finally:
+        idx.unpin_batch(pin)
+    # No leaked pins: a full table of fresh keys assigns cleanly.
+    fresh = np.concatenate([p0[10:14], p1[10:14]]).astype(np.int64)
+    slots2, _ = idx.assign_batch_ints(fresh, 0)
+    assert len(set(slots2.tolist())) == 8
+    idx.close()

@@ -332,3 +332,30 @@ def test_strpack_native_matches_numpy_packer():
     # Non-str items: the native packer declines and the fallback handles.
     b, o = ni._pack_str_keys(["a", b"raw-bytes", "c"])
     assert bytes(b) == b"araw-bytesc" and list(o) == [0, 1, 10, 11]
+
+
+def test_strpack_rejects_size_drift():
+    """rl_strlist_pack re-checks the list size and total bytes the
+    buffers were allocated for (the GIL can drop between the sizing pass
+    and the pack, so drift must be an error, never a heap overflow)."""
+    import numpy as np
+
+    from ratelimiter_tpu.engine import native_index as ni
+
+    sp = ni._load_strpack()
+    if sp is None:
+        pytest.skip("strpack unavailable")
+    keys = ["abc", "defg"]
+    total = sp.rl_strlist_total(keys)
+    assert total == 7
+    buf = np.empty(total, dtype=np.uint8)
+    offs = np.empty(3, dtype=np.int64)
+    assert sp.rl_strlist_pack2(keys, buf.ctypes.data, offs.ctypes.data,
+                              2, total) == 0
+    assert bytes(buf) == b"abcdefg" and offs.tolist() == [0, 3, 7]
+    # List "grew" after sizing -> error.
+    assert sp.rl_strlist_pack2(keys, buf.ctypes.data, offs.ctypes.data,
+                              1, total) == -1
+    # Content outgrew the buffer -> error before any overflow.
+    assert sp.rl_strlist_pack2(keys, buf.ctypes.data, offs.ctypes.data,
+                              2, total - 1) == -1
