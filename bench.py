@@ -169,6 +169,12 @@ def main() -> None:
         agg = {
             "chunks": len(stats),
             "assign_s": round(sum(r.get("assign_s", 0) for r in stats), 4),
+            # walk_s records are cumulative within a pass: take the max.
+            # assign_s is the walk time EXPOSED on the main thread (a
+            # prefetched walk that hid under a fetch shows ~0); walk_s is
+            # the true walk seconds wherever they ran.
+            "walk_s": round(max((r.get("walk_s", 0) for r in stats),
+                                default=0.0), 4),
             "host_s": round(sum(r.get("host_s", 0) for r in stats), 4),
             "fetch_s": round(sum(r.get("fetch_s", 0) for r in stats), 4),
             "max_fetch_s": round(max((r.get("fetch_s", 0) for r in stats),
@@ -182,18 +188,39 @@ def main() -> None:
         agg["modes"] = modes
         return agg
 
+    def set_link(storage):
+        """Feed the probed link into the storage so its streaming loops
+        can elect pipelined chunk plans (VERDICT r3 #1)."""
+        if detail_link:
+            storage.set_link_profile(
+                detail_link["upload_4mb_mbps"] * (1 << 20),
+                detail_link["round_trip_ms"] / 1000.0)
+
     def run_stream(go, key_ids, permits, reps, storage, warmed=False):
         """Full untimed warmup pass (visits every chunk shape the growth
         schedule reaches), then ``reps`` timed passes with per-pass phase
         breakdowns; re-probes the link and retries once if the pass walls
-        spread wider than 1.6x."""
+        spread wider than 1.6x.  A chunk-plan election during the warmup
+        changes the later passes' shapes, so the warmup reruns until the
+        plan map is stable — timed passes never meet a fresh shape."""
         n = len(key_ids)
         res = {"mode": "stream_ids", "batch": B, "subbatches": K,
                "decisions_per_pass": n}
         if not warmed:
-            with _compiles() as cw:
-                go(key_ids, permits)
-            res["warmup"] = {"n_compiles": cw.n, "compile_s": cw.secs}
+            warmups = []
+            for _ in range(3):  # stable after <= 2 in practice
+                plans_before = dict(storage._chunk_plans)
+                with _compiles() as cw:
+                    go(key_ids, permits)
+                warmups.append({"n_compiles": cw.n, "compile_s": cw.secs})
+                if storage._chunk_plans == plans_before:
+                    break
+            res["warmup"] = warmups[0]
+            if len(warmups) > 1:
+                res["warmup_extra"] = warmups[1:]
+            res["chunk_plans"] = {
+                "/".join(map(str, k)): dict(v)
+                for k, v in storage._chunk_plans.items()}
         passes = []
 
         def timed_pass():
@@ -241,6 +268,7 @@ def main() -> None:
 
     tb_cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
     storage = TpuBatchedStorage(num_slots=max(num_keys * 2, 1 << 16))
+    set_link(storage)
     tb_limiter = TokenBucketRateLimiter(storage, tb_cfg, MeterRegistry())
 
     key_ids = zipf_stream(rng, num_keys, n_requests)
@@ -352,6 +380,7 @@ def main() -> None:
     n3 = super_n * (2 if small else 4)
     log(f"scenario 3: SW uniform over {num_keys3} keys (stream)...")
     storage3 = TpuBatchedStorage(num_slots=max(int(num_keys3 * 1.25), 1 << 16))
+    set_link(storage3)
     sw3 = SlidingWindowRateLimiter(
         storage3,
         RateLimitConfig(max_permits=100, window_ms=60_000,
@@ -388,11 +417,22 @@ def main() -> None:
     # ~8 user keys per tenant, per-request tenant policy.
     keys4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
     lids4 = lids[tenant_of_req]
+    set_link(storage4)
     # Warmup on a DISJOINT key population: compiles every chunk shape and
     # fills the slot space so the churn pass below is 100% first-touch.
+    # A chunk-plan election during the first warmup changes later passes'
+    # shapes, so re-warm (on yet another disjoint population) until the
+    # plan map is stable.
     with _compiles() as cw:
-        storage4.acquire_stream_ids("tb", lids4, keys4 + (n_tenants * 8),
-                                    batch=B, subbatches=K)
+        pop = 1
+        for _ in range(3):
+            plans_before = dict(storage4._chunk_plans)
+            storage4.acquire_stream_ids(
+                "tb", lids4, keys4 + pop * (n_tenants * 8),
+                batch=B, subbatches=K)
+            pop += 1
+            if storage4._chunk_plans == plans_before:
+                break
     storage4.stream_stats = churn_stats = []
     with _compiles() as cc:
         t0 = time.perf_counter()
@@ -429,6 +469,7 @@ def main() -> None:
     n5 = super_n * (2 if small else 3)
     log(f"scenario 5: burst batch-acquire over {num_keys5} keys...")
     storage5 = TpuBatchedStorage(num_slots=max(num_keys5 * 2, 1 << 16))
+    set_link(storage5)
     tb5 = TokenBucketRateLimiter(
         storage5,
         RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=100.0),

@@ -232,3 +232,89 @@ def test_stream_permits_over_i32_denied_not_wrapped():
         np.asarray([1 << 31], dtype=np.int64))
     assert not batch["allowed"][0]
     storage.close()
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_chunk_plan_pipelined_preserves_decisions(monkeypatch, weighted):
+    """Link-adaptive chunk plans (VERDICT r3 #1): a pipelined plan (the
+    fast-link election outcome, forced here for determinism) runs fixed
+    chunks with eager drains — decisions must match a plan-less storage
+    pass-for-pass."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 1 << 14)
+    now = [1_000_000]
+    rng = np.random.default_rng(3)
+    n = 4096
+    ids = rng.integers(0, 1500, n).astype(np.int64)
+    perms = (rng.integers(1, 8, n).astype(np.int64) if weighted
+             else None)
+
+    def make(planned):
+        st = TpuBatchedStorage(num_slots=4096, clock_ms=lambda: now[0])
+        lid = st.register_limiter("tb", RateLimitConfig(
+            max_permits=20, window_ms=60_000, refill_rate=1.0))
+        if planned:  # what a fast-link election produces
+            key = (("weighted", "ints", "tb", n) if weighted
+                   else ("relay", "ints", "tb", False, n))
+            st._chunk_plans[key] = {"kind": "pipelined", "chunk": 600,
+                                    "ref": 1e9, "passes": 0, "best": None}
+        return st, lid
+
+    st_a, lid_a = make(True)
+    st_b, lid_b = make(False)
+    for _ in range(3):
+        got_a = st_a.acquire_stream_ids("tb", lid_a, ids, perms)
+        got_b = st_b.acquire_stream_ids("tb", lid_b, ids, perms)
+        np.testing.assert_array_equal(got_a, got_b)
+    # The huge ref wall keeps the plan from reverting mid-test.
+    kinds = {k[0]: v["kind"] for k, v in st_a._chunk_plans.items()}
+    want = "weighted" if weighted else "relay"
+    assert kinds.get(want) == "pipelined", st_a._chunk_plans
+    st_a.close()
+    st_b.close()
+
+
+def test_chunk_plan_election_logic():
+    """Synthetic election inputs: a walk-bound fast link elects a
+    pipelined split; a wire-bound slow link keeps giant chunks; a
+    pipelined pass measuring clearly worse reverts (sticky)."""
+    st = TpuBatchedStorage(num_slots=1 << 12)
+    n = 1 << 24
+    giant_tot = {"walk_s": 0.65, "wire": 4.7e6, "giant": n,
+                 "fetch_s": 0.28, "chunks": 2}
+    # Fast link (85 MB/s, 107 ms RTT): fetch chain hides under walks.
+    st.set_link_profile(85e6, 0.107)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    plan = st._chunk_plans[("relay", "ints", "tb", False, n)]
+    assert plan["kind"] == "pipelined" and plan["chunk"] >= 1 << 19, plan
+    # Wire-bound (5 MB/s, walk nearly free): splitting only degrades
+    # dedup and adds round trips — giant stays.
+    st.set_link_profile(5e6, 0.107)
+    slow_tot = dict(giant_tot, walk_s=0.05, fetch_s=1.1)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
+    # Revert: pipelined passes clearly worse than the serial baseline
+    # (first pass alone is NOT enough — it pays the new shapes' compiles).
+    st.set_link_profile(85e6, 0.107)
+    st._chunk_plans.clear()
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    ref = st._chunk_plans[("relay", "ints", "tb", False, n)]["ref"]
+    st._maybe_revert_plan(("relay", "ints", "tb", False, n), 10.0)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "pipelined"
+    st._maybe_revert_plan(("relay", "ints", "tb", False, n), 2.0 * ref)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
+    # A reverted plan is LOCKED: a later clean giant pass must not
+    # re-elect it back to pipelined (shape oscillation).
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
+    # Whereas a PROVISIONAL giant (compile-contaminated first pass:
+    # huge measured fetch) is re-elected once clean measurements arrive.
+    st._chunk_plans.clear()
+    dirty = dict(giant_tot, fetch_s=12.0)  # compiles inside the fetches
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, dirty)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "pipelined"
+    st.close()
