@@ -93,25 +93,14 @@ def main() -> None:
         """Upload bandwidth + round-trip floor of the host<->device link,
         recorded with every run: the dev tunnel's throughput swings 4-60
         MB/s hour to hour, and stream scenarios are wire-bound — a run's
-        numbers are only comparable alongside its link health."""
-        import jax.numpy as jnp
+        numbers are only comparable alongside its link health.  Same
+        probe the storages' chunk-plan election consumes (utils/link.py),
+        so the logged link and the elected plans cannot disagree."""
+        from ratelimiter_tpu.utils.link import measure_link
 
-        csum = jax.jit(lambda v: v.sum())
-        probe = np.zeros(1024, dtype=np.int32)
-        np.asarray(csum(jnp.asarray(probe)))  # compile + settle
-        t0 = time.perf_counter()
-        for _ in range(3):
-            np.asarray(csum(jnp.asarray(probe)))
-        rtt_s = (time.perf_counter() - t0) / 3
-        buf = np.random.default_rng(7).integers(
-            0, 1 << 20, 1 << 20).astype(np.int32)  # 4 MB
-        np.asarray(csum(jnp.asarray(buf)))  # compile this shape untimed
-        t0 = time.perf_counter()
-        for _ in range(2):
-            np.asarray(csum(jnp.asarray(buf)))
-        up_s = max((time.perf_counter() - t0) / 2 - rtt_s, 1e-6)
+        up_bps, rtt_s = measure_link()
         return {"round_trip_ms": round(rtt_s * 1000, 1),
-                "upload_4mb_mbps": round(4.0 / up_s, 1)}
+                "upload_4mb_mbps": round(up_bps / (1 << 20), 1)}
 
     detail_link = link_probe() if platform == "tpu" else None
     if detail_link:

@@ -776,4 +776,79 @@ void rl_index_unpin_batch(void* h, const int32_t* slots, int64_t n) {
   for (int64_t i = 0; i < n; i++) unpin_one(ix, slots[i]);
 }
 
+// ---------------------------------------------------------------------------
+// Weighted-relay rank-major layout (storage/tpu.py:_stream_weighted).
+//
+// The device's weighted scan step wants segments sorted by occurrence
+// count DESCENDING so each rank step's active set is a prefix, with the
+// per-request permits laid out rank-major compacted (all rank-0 permits,
+// then rank-1, ...).  The probe walk already produced per-unique counts
+// (in the uwords' count field) and per-request (uidx, rank) — this pass
+// turns them into the device layout in O(u + n), replacing a numpy
+// argsort + bincount/cumsum + fancy-index scatter that cost ~1.4 s on a
+// 16M-request chunk (VERDICT r3 #2).
+//
+// Inputs: uwords[u] with the segment count in bits 1..rank_bits (true,
+// unclamped — the caller verified r_max <= r_cap < r_b), per-request
+// uidx/rank, permits as int64 (values already bounded to the engine's
+// <=255 weighted cap), and r_b = pow2 >= r_max.
+// Outputs (all caller-allocated): uw_sorted (first u entries written;
+// caller pre-fills the padding), spos[u] (unique -> sorted position),
+// roff[r_b] (rank-major block offsets), perms_rank (caller-zeroed;
+// exactly n positions scattered).  Returns 0, or -1 if a count exceeds
+// r_b (caller's r_cap check violated — layout would be out of bounds).
+int32_t rl_weighted_layout(const uint32_t* uwords, int64_t u,
+                           int32_t rank_bits, const int32_t* uidx,
+                           const int32_t* rank, int64_t n,
+                           const int64_t* perms, int64_t r_b,
+                           uint32_t* uw_sorted, int32_t* spos,
+                           int64_t* roff, uint8_t* perms_rank) {
+  if (r_b <= 0 || r_b > 4096) return -1;
+  const uint32_t cmask = (1u << rank_bits) - 1u;
+  std::vector<int64_t> hist(r_b + 1, 0);
+  for (int64_t i = 0; i < u; i++) {
+    uint32_t c = (uwords[i] >> 1) & cmask;
+    if (static_cast<int64_t>(c) > r_b) return -1;
+    hist[c]++;
+  }
+  // start[v] = #segments with count > v — the descending-stable bucket
+  // start, and also k_r (active segments at rank step v).
+  std::vector<int64_t> start(r_b + 1, 0);
+  int64_t acc = 0;
+  for (int64_t v = r_b; v >= 0; v--) {
+    start[v] = acc;
+    acc += hist[v];
+  }
+  // roff[r] = sum_{q<r} k_r[q] — BEFORE start is consumed by placement.
+  int64_t racc = 0;
+  for (int64_t r = 0; r < r_b; r++) {
+    roff[r] = racc;
+    racc += start[r];
+  }
+  for (int64_t i = 0; i < u; i++) {
+    uint32_t c = (uwords[i] >> 1) & cmask;
+    int64_t p = start[c]++;
+    uw_sorted[p] = uwords[i];
+    spos[i] = static_cast<int32_t>(p);
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int64_t p = roff[rank[i]] + spos[uidx[i]];
+    perms_rank[p] = static_cast<uint8_t>(perms[i]);
+  }
+  return 0;
+}
+
+// Decision reconstruction for the layout above: request i's decision is
+// bit (roff[rank[i]] + spos[uidx[i]]) of the fetched bitmask (MSB-first
+// within each byte, matching numpy packbits).  One pass replaces
+// unpackbits + a fancy-index gather.
+void rl_weighted_decide(const uint8_t* bits, const int64_t* roff,
+                        const int32_t* spos, const int32_t* uidx,
+                        const int32_t* rank, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    int64_t p = roff[rank[i]] + spos[uidx[i]];
+    out[i] = (bits[p >> 3] >> (7 - (p & 7))) & 1;
+  }
+}
+
 }  // extern "C"

@@ -142,6 +142,14 @@ def _bind(lib) -> None:
     lib.rl_shard_route.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_weighted_layout.restype = ctypes.c_int32
+    lib.rl_weighted_layout.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_weighted_decide.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
 
 
 def native_available() -> bool:
@@ -263,6 +271,43 @@ def relay_decide(counts: np.ndarray, uidx: np.ndarray,
     lib.rl_relay_decide(counts.ctypes.data, counts.dtype.itemsize,
                         uidx.ctypes.data, rank.ctypes.data, len(uidx),
                         out.ctypes.data)
+    return out.view(np.bool_)
+
+
+def weighted_layout(uwords: np.ndarray, rank_bits: int, uidx: np.ndarray,
+                    rank: np.ndarray, perms: np.ndarray, r_b: int,
+                    uw_sorted: np.ndarray, spos: np.ndarray,
+                    roff: np.ndarray, perms_rank: np.ndarray) -> bool:
+    """Count-descending rank-major layout for the weighted relay, in one
+    C pass (native/slot_index.cpp:rl_weighted_layout) — emits the sorted
+    words into caller-padded ``uw_sorted``, unique->position ``spos``,
+    rank offsets ``roff``, and scatters ``perms`` into the caller-zeroed
+    ``perms_rank``.  Returns False when the native library is missing
+    (callers fall back to the numpy layout, bit-identical)."""
+    lib = _load_library()
+    if lib is None:
+        return False
+    rc = lib.rl_weighted_layout(
+        uwords.ctypes.data, len(uwords), int(rank_bits),
+        uidx.ctypes.data, rank.ctypes.data, len(uidx),
+        perms.ctypes.data, int(r_b), uw_sorted.ctypes.data,
+        spos.ctypes.data, roff.ctypes.data, perms_rank.ctypes.data)
+    if rc != 0:
+        raise ValueError("weighted layout: segment count exceeds r_b")
+    return True
+
+
+def weighted_decide(bits: np.ndarray, roff: np.ndarray, spos: np.ndarray,
+                    uidx: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    """Per-request decisions from the packed weighted bitmask: bit
+    (roff[rank] + spos[uidx]) of ``bits`` (MSB-first), one C pass
+    replacing unpackbits + fancy-index gather.  None-safe: callers only
+    use this when :func:`weighted_layout` returned True."""
+    lib = _load_library()
+    out = np.empty(len(uidx), dtype=np.uint8)
+    lib.rl_weighted_decide(bits.ctypes.data, roff.ctypes.data,
+                           spos.ctypes.data, uidx.ctypes.data,
+                           rank.ctypes.data, len(uidx), out.ctypes.data)
     return out.view(np.bool_)
 
 

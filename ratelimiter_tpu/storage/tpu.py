@@ -660,7 +660,11 @@ class TpuBatchedStorage(RateLimitStorage):
         # chunk.  tot[...] feeds the end-of-pass election.  key_kind
         # separates int- from str-keyed streams: their walks cost very
         # differently, so they must not share a plan.
-        plan_key = ("relay", key_kind, algo, lid_arr is not None, n)
+        # n is BANDED into the plan key (quarter-octave) so a service
+        # with naturally jittering stream lengths reuses one plan per
+        # band instead of re-measuring every distinct n.
+        plan_key = ("relay", key_kind, algo, lid_arr is not None,
+                    _bucket_fine(n, floor=_RELAY_CHUNK))
         plan, pipelined, tot, timed_assign, t_pass0 = self._plan_setup(
             plan_key, assign_uniques)
 
@@ -680,12 +684,6 @@ class TpuBatchedStorage(RateLimitStorage):
                 got = relay_decide(arr[:u], uidx, rank)
             out[start:start + count] = got
             self._record_dispatch(algo, count, int(got.sum()), dt_us)
-
-        def timed_assign(s0, cnt):
-            ta = time.perf_counter()
-            r = assign_uniques(s0, cnt)
-            tot["walk_s"] += time.perf_counter() - ta
-            return r
 
         chunk = plan["chunk"] if pipelined else _RELAY_CHUNK
         start = 0
@@ -859,7 +857,18 @@ class TpuBatchedStorage(RateLimitStorage):
 
         def drain(kind, handle, start, count, extra, t0, rec):
             tf0 = time.perf_counter()
-            if kind == "weighted":
+            if kind == "weighted_native":
+                arr = np.ascontiguousarray(np.asarray(handle))
+                tot["fetch_s"] += time.perf_counter() - tf0
+                if rec is not None:
+                    rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+                from ratelimiter_tpu.engine.native_index import (
+                    weighted_decide,
+                )
+
+                roff, spos32, uidx, rank = extra
+                got = weighted_decide(arr, roff, spos32, uidx, rank)
+            elif kind == "weighted":
                 flat_bits = np.unpackbits(np.asarray(handle))
                 tot["fetch_s"] += time.perf_counter() - tf0
                 if rec is not None:
@@ -881,7 +890,8 @@ class TpuBatchedStorage(RateLimitStorage):
         # Chunk plan election — same machinery as _stream_relay (first
         # pass measures at the growth schedule; later passes may run a
         # fixed pipelined split with eager drains).
-        plan_key = ("weighted", key_kind, algo, n)
+        plan_key = ("weighted", key_kind, algo,
+                    _bucket_fine(n, floor=_RELAY_CHUNK))  # banded, see relay
         plan, pipelined, tot, timed_assign, t_pass0 = self._plan_setup(
             plan_key, assign_uniques)
 
@@ -921,31 +931,52 @@ class TpuBatchedStorage(RateLimitStorage):
                         # _weighted_step_w).  Counts come straight from the
                         # words' count field — unclamped here, since the true
                         # r_max (from the rank scratch) fit under r_cap.
-                        counts = ((uwords >> np.uint32(1))
-                                  & np.uint32((1 << rb) - 1)).astype(np.int64)
-                        order = np.argsort(-counts, kind="stable")
-                        spos = np.empty(max(u, 1), dtype=np.int64)
-                        spos[order] = np.arange(u, dtype=np.int64)
+                        # The layout itself is one C pass over structure the
+                        # probe walk already produced (rl_weighted_layout,
+                        # VERDICT r3 #2); the numpy argsort/bincount/scatter
+                        # below is the library-less fallback, bit-identical.
+                        from ratelimiter_tpu.engine.native_index import (
+                            weighted_layout,
+                        )
+
                         r_b = 2
                         while r_b < r_max:
                             r_b *= 2
-                        # k_r = number of segments with count > r; roff is its
-                        # exclusive prefix sum (rank-major block offsets).
-                        hist = np.bincount(counts, minlength=r_b + 1)
-                        k_r = u - np.cumsum(hist[:r_b])
-                        roff = np.zeros(r_b, dtype=np.int64)
-                        np.cumsum(k_r[:-1], out=roff[1:])
                         u_b = _bucket_fine(max(u, 1))
-                        uw_pad = _pad_tail(uwords[order], u_b, 0xFFFFFFFF,
-                                           np.uint32)
-                        pos = roff[rank] + spos[uidx]
+                        uw_pad = np.full(u_b, 0xFFFFFFFF, dtype=np.uint32)
+                        spos32 = np.empty(max(u, 1), dtype=np.int32)
+                        roff = np.empty(r_b, dtype=np.int64)
                         perms_rank = np.zeros(_bucket_fine(cn) + u_b,
                                               dtype=np.uint8)
-                        perms_rank[pos] = p_chunk
-                        handle = dispatch(uw_pad, perms_rank, roff, lid, now,
-                                          r_b)
-                        pending.append(("weighted", handle, start, cn,
-                                        pos, t0, rec))
+                        p64 = np.ascontiguousarray(p_chunk, dtype=np.int64)
+                        if weighted_layout(uwords, rb, uidx, rank, p64, r_b,
+                                           uw_pad, spos32, roff, perms_rank):
+                            handle = dispatch(uw_pad, perms_rank, roff, lid,
+                                              now, r_b)
+                            pending.append(("weighted_native", handle, start,
+                                            cn, (roff, spos32, uidx, rank),
+                                            t0, rec))
+                        else:
+                            counts = ((uwords >> np.uint32(1))
+                                      & np.uint32((1 << rb) - 1)).astype(
+                                          np.int64)
+                            order = np.argsort(-counts, kind="stable")
+                            spos = np.empty(max(u, 1), dtype=np.int64)
+                            spos[order] = np.arange(u, dtype=np.int64)
+                            # k_r = number of segments with count > r; roff
+                            # is its exclusive prefix sum.
+                            hist = np.bincount(counts, minlength=r_b + 1)
+                            k_r = u - np.cumsum(hist[:r_b])
+                            roff = np.zeros(r_b, dtype=np.int64)
+                            np.cumsum(k_r[:-1], out=roff[1:])
+                            uw_pad = _pad_tail(uwords[order], u_b, 0xFFFFFFFF,
+                                               np.uint32)
+                            pos = roff[rank] + spos[uidx]
+                            perms_rank[pos] = p_chunk
+                            handle = dispatch(uw_pad, perms_rank, roff, lid,
+                                              now, r_b)
+                            pending.append(("weighted", handle, start, cn,
+                                            pos, t0, rec))
                         wire_b = (4 * u_b + len(perms_rank)
                                   + len(perms_rank) // 8)
                         if rec is not None:
@@ -1632,26 +1663,13 @@ class TpuBatchedStorage(RateLimitStorage):
         self._chunk_plans.clear()
 
     def probe_link(self) -> Tuple[float, float]:
-        """Measure (upload bytes/s, round-trip s) with a 4 MB probe and
-        feed :meth:`set_link_profile`.  ~0.5 s on a healthy link; callers
-        gate it (boot, or a periodic health task)."""
-        import jax
-        import jax.numpy as jnp
+        """Measure the link (utils/link.py — the same probe the bench
+        logs) and feed :meth:`set_link_profile`.  ~0.5-1 s on a healthy
+        link; callers gate it (boot, or a periodic health task)."""
+        from ratelimiter_tpu.utils.link import measure_link
 
-        csum = jax.jit(lambda v: v.sum())
-        tiny = np.zeros(1024, dtype=np.int32)
-        np.asarray(csum(jnp.asarray(tiny)))  # compile + settle
-        t0 = time.perf_counter()
-        for _ in range(2):
-            np.asarray(csum(jnp.asarray(tiny)))
-        rtt_s = (time.perf_counter() - t0) / 2
-        buf = np.random.default_rng(7).integers(
-            0, 1 << 20, 1 << 20).astype(np.int32)  # 4 MB
-        np.asarray(csum(jnp.asarray(buf)))  # compile this shape untimed
-        t0 = time.perf_counter()
-        np.asarray(csum(jnp.asarray(buf)))
-        up_s = max(time.perf_counter() - t0 - rtt_s, 1e-6)
-        self.set_link_profile((4 << 20) / up_s, rtt_s)
+        up_bps, rtt_s = measure_link()
+        self.set_link_profile(up_bps, rtt_s)
         return self._link_profile
 
     def _elect_chunk_plan(self, key: tuple, n: int, tot: dict) -> None:
@@ -1699,6 +1717,8 @@ class TpuBatchedStorage(RateLimitStorage):
             w = max(walk, k * fixed + wire_s * degrade) + fixed
             if best is None or w < best[0]:
                 best = (w, int(c))
+        if len(self._chunk_plans) >= 128 and key not in self._chunk_plans:
+            self._chunk_plans.clear()  # bound the cache; plans re-elect
         if best is not None and best[0] < _PIPELINE_WIN_MARGIN * serial_pred:
             self._chunk_plans[key] = {"kind": "pipelined", "chunk": best[1],
                                       "ref": round(serial_pred, 4),

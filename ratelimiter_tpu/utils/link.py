@@ -1,0 +1,45 @@
+"""Host<->device link probe.
+
+One implementation shared by the bench harness (bench.py:link_probe) and
+``TpuBatchedStorage.probe_link`` so the link numbers a run logs and the
+profile the storage elects chunk plans from are measured identically —
+same probe sizes, same rep counts, same arithmetic.
+
+The probe jits a trivial reduction so each fetch is a full round trip
+(on the dev tunnel ``block_until_ready`` does not block; only fetches
+prove completion — ROUND_NOTES).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+import numpy as np
+
+PROBE_BYTES = 4 << 20  # 4 MiB upload probe
+
+
+def measure_link(rtt_reps: int = 3, upload_reps: int = 2
+                 ) -> Tuple[float, float]:
+    """Measure (upload bytes/s, round-trip seconds) with a tiny-fetch
+    RTT probe and a 4 MiB upload probe (each shape compiled untimed
+    first).  ~0.5-1 s on a healthy link; callers gate how often."""
+    import jax
+    import jax.numpy as jnp
+
+    csum = jax.jit(lambda v: v.sum())
+    tiny = np.zeros(1024, dtype=np.int32)
+    np.asarray(csum(jnp.asarray(tiny)))  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(rtt_reps):
+        np.asarray(csum(jnp.asarray(tiny)))
+    rtt_s = (time.perf_counter() - t0) / rtt_reps
+    buf = np.random.default_rng(7).integers(
+        0, 1 << 20, PROBE_BYTES // 4).astype(np.int32)
+    np.asarray(csum(jnp.asarray(buf)))  # compile this shape untimed
+    t0 = time.perf_counter()
+    for _ in range(upload_reps):
+        np.asarray(csum(jnp.asarray(buf)))
+    up_s = max((time.perf_counter() - t0) / upload_reps - rtt_s, 1e-6)
+    return PROBE_BYTES / up_s, rtt_s

@@ -359,3 +359,74 @@ def test_strpack_rejects_size_drift():
     # Content outgrew the buffer -> error before any overflow.
     assert sp.rl_strlist_pack2(keys, buf.ctypes.data, offs.ctypes.data,
                               2, total - 1) == -1
+
+
+def test_weighted_layout_matches_numpy_reference():
+    """rl_weighted_layout/rl_weighted_decide vs the numpy layout they
+    replace (storage/tpu.py fallback): identical sorted words, offsets,
+    permit scatter, and decisions on random duplicate structures."""
+    from ratelimiter_tpu.engine.native_index import (
+        weighted_decide,
+        weighted_layout,
+    )
+
+    if not native_available():
+        pytest.skip("needs the native library")
+    rng = np.random.default_rng(11)
+    rb = 12
+    for trial in range(20):
+        n = int(rng.integers(1, 2000))
+        keys = rng.integers(0, max(n // 3, 1), n)
+        # Build uwords/uidx/rank the way the walk does: first-appearance
+        # order, count field = segment size.
+        uniq, uidx = np.unique(keys, return_inverse=True)
+        first = np.sort(np.unique(uidx, return_index=True)[1])
+        remap = np.empty(len(uniq), dtype=np.int64)
+        remap[uidx[first]] = np.arange(len(uniq))
+        uidx = remap[uidx].astype(np.int32)
+        counts = np.bincount(uidx).astype(np.int64)
+        rank = np.zeros(n, dtype=np.int32)
+        seen: dict = {}
+        for i, ui in enumerate(uidx):
+            rank[i] = seen.get(ui, 0)
+            seen[ui] = rank[i] + 1
+        u = len(uniq)
+        slots = rng.permutation(u).astype(np.uint32)
+        uwords = ((slots << np.uint32(rb + 1))
+                  | (counts.astype(np.uint32) << np.uint32(1)))
+        perms = rng.integers(1, 200, n).astype(np.int64)
+        r_max = int(counts.max())
+        r_b = 2
+        while r_b < r_max:
+            r_b *= 2
+        # numpy reference (the fallback path)
+        order = np.argsort(-counts, kind="stable")
+        spos_ref = np.empty(u, dtype=np.int64)
+        spos_ref[order] = np.arange(u)
+        hist = np.bincount(counts, minlength=r_b + 1)
+        k_r = u - np.cumsum(hist[:r_b])
+        roff_ref = np.zeros(r_b, dtype=np.int64)
+        np.cumsum(k_r[:-1], out=roff_ref[1:])
+        pos_ref = roff_ref[rank] + spos_ref[uidx]
+        plen = n + u + 16
+        pr_ref = np.zeros(plen, dtype=np.uint8)
+        pr_ref[pos_ref] = perms
+        uw_ref = uwords[order]
+        # native
+        uw_nat = np.full(u, 0xFFFFFFFF, dtype=np.uint32)
+        spos_nat = np.empty(u, dtype=np.int32)
+        roff_nat = np.empty(r_b, dtype=np.int64)
+        pr_nat = np.zeros(plen, dtype=np.uint8)
+        assert weighted_layout(np.ascontiguousarray(uwords), rb, uidx,
+                               rank, perms, r_b, uw_nat, spos_nat,
+                               roff_nat, pr_nat)
+        np.testing.assert_array_equal(uw_nat, uw_ref, err_msg=str(trial))
+        np.testing.assert_array_equal(spos_nat, spos_ref.astype(np.int32))
+        np.testing.assert_array_equal(roff_nat, roff_ref)
+        np.testing.assert_array_equal(pr_nat, pr_ref)
+        # decide: random bitmask, both reconstructions agree
+        bits = rng.integers(0, 256, (plen + 7) // 8).astype(np.uint8)
+        flat = np.unpackbits(bits)
+        want = flat[pos_ref].astype(bool)
+        got = weighted_decide(bits, roff_nat, spos_nat, uidx, rank)
+        np.testing.assert_array_equal(got, want)
