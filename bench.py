@@ -177,6 +177,15 @@ def main() -> None:
         agg["modes"] = modes
         return agg
 
+    def plans_settled(storage):
+        """True when no plan can change shape on a later pass: pipelined
+        and locked plans are sticky, giant plans stop re-electing at
+        passes >= 3.  Warmup must not stop before this, or a measured
+        pass could elect new chunk shapes and pay their compiles."""
+        return all(v["kind"] == "pipelined" or v.get("locked")
+                   or v.get("passes", 0) >= 3
+                   for v in storage._chunk_plans.values())
+
     def set_link(storage):
         """Feed the probed link into the storage so its streaming loops
         can elect pipelined chunk plans (VERDICT r3 #1)."""
@@ -196,13 +205,20 @@ def main() -> None:
         res = {"mode": "stream_ids", "batch": B, "subbatches": K,
                "decisions_per_pass": n}
         if not warmed:
+            def plan_sig(st):
+                # Only (kind, chunk) decide dispatch shapes; the pass/best
+                # counters mutate every pass and must not defeat the
+                # stability check.
+                return {k: (v["kind"], v["chunk"])
+                        for k, v in st._chunk_plans.items()}
+
             warmups = []
-            for _ in range(3):  # stable after <= 2 in practice
-                plans_before = dict(storage._chunk_plans)
+            for _ in range(4):  # provisional-giant + elect + new shapes
+                sig_before = plan_sig(storage)
                 with _compiles() as cw:
                     go(key_ids, permits)
                 warmups.append({"n_compiles": cw.n, "compile_s": cw.secs})
-                if storage._chunk_plans == plans_before:
+                if plan_sig(storage) == sig_before and plans_settled(storage):
                     break
             res["warmup"] = warmups[0]
             if len(warmups) > 1:
@@ -414,13 +430,16 @@ def main() -> None:
     # plan map is stable.
     with _compiles() as cw:
         pop = 1
-        for _ in range(3):
-            plans_before = dict(storage4._chunk_plans)
+        for _ in range(4):
+            plans_before = {k: (v["kind"], v["chunk"])
+                            for k, v in storage4._chunk_plans.items()}
             storage4.acquire_stream_ids(
                 "tb", lids4, keys4 + pop * (n_tenants * 8),
                 batch=B, subbatches=K)
             pop += 1
-            if storage4._chunk_plans == plans_before:
+            plans_after = {k: (v["kind"], v["chunk"])
+                           for k, v in storage4._chunk_plans.items()}
+            if plans_after == plans_before and plans_settled(storage4):
                 break
     storage4.stream_stats = churn_stats = []
     with _compiles() as cc:

@@ -142,6 +142,9 @@ def _bind(lib) -> None:
     lib.rl_shard_route.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_rebuild_words.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_void_p]
     lib.rl_weighted_layout.restype = ctypes.c_int32
     lib.rl_weighted_layout.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
@@ -272,6 +275,24 @@ def relay_decide(counts: np.ndarray, uidx: np.ndarray,
                         uidx.ctypes.data, rank.ctypes.data, len(uidx),
                         out.ctypes.data)
     return out.view(np.bool_)
+
+
+def rebuild_words_into(uwords: np.ndarray, uidx: np.ndarray,
+                       rank: np.ndarray, rank_bits: int,
+                       out: np.ndarray) -> bool:
+    """Words-mode per-request reconstruction straight into the caller's
+    (padded) dispatch buffer — one C pass instead of numpy's gather +
+    shift temporaries + pad copy.  ``out`` must be a C-contiguous uint32
+    view with at least len(uidx) lanes.  False when the native library
+    is unavailable (callers fall back to ops/relay.rebuild_words)."""
+    lib = _load_library()
+    if lib is None:
+        return False
+    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.uint32
+    lib.rl_rebuild_words(uwords.ctypes.data, uidx.ctypes.data,
+                         rank.ctypes.data, len(uidx), int(rank_bits),
+                         out.ctypes.data)
+    return True
 
 
 def weighted_layout(uwords: np.ndarray, rank_bits: int, uidx: np.ndarray,

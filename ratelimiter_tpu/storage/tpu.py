@@ -771,9 +771,15 @@ class TpuBatchedStorage(RateLimitStorage):
                             ("digest", counts, start, cn, (uidx, rank, u), t0,
                              rec))
                     else:
-                        words = rebuild_words(uwords, uidx, rank, rb)
+                        from ratelimiter_tpu.engine.native_index import (
+                            rebuild_words_into,
+                        )
+
                         size = _bucket_pow2(cn)
-                        words = _pad_tail(words, size, 0xFFFFFFFF, np.uint32)
+                        words = np.full(size, 0xFFFFFFFF, dtype=np.uint32)
+                        if not rebuild_words_into(uwords, uidx, rank, rb,
+                                                  words[:cn]):
+                            words[:cn] = rebuild_words(uwords, uidx, rank, rb)
                         lid_lane = lid if not multi_lid else _pad_tail(
                             l_chunk, size, 0, np.int32)
                         bits = bits_dispatch(words, lid_lane, now)
@@ -1580,8 +1586,13 @@ class TpuBatchedStorage(RateLimitStorage):
                             per_shard.append((pos,))
                             continue
                         _, uidx, rank, u, uw = item
-                        w_mat[s, :len(pos)] = rebuild_words(uw, uidx, rank,
-                                                            rb)
+                        from ratelimiter_tpu.engine.native_index import (
+                            rebuild_words_into,
+                        )
+
+                        row = w_mat[s, :len(pos)]
+                        if not rebuild_words_into(uw, uidx, rank, rb, row):
+                            row[:] = rebuild_words(uw, uidx, rank, rb)
                         if multi_lid:
                             lid_mat[s, :len(pos)] = l_chunk[pos]
                         per_shard.append((pos,))
@@ -1708,6 +1719,22 @@ class TpuBatchedStorage(RateLimitStorage):
         chunks = max(tot.get("chunks", 1), 1)
         fixed = max(rtt, (tot.get("fetch_s", 0.0) - wire_s) / chunks)
         serial_pred = walk + wire_s + chunks * fixed
+        if cur is None:
+            if len(self._chunk_plans) >= 128:
+                # Bound the cache.  Keep LOCKED (reverted) plans: wiping
+                # one would re-enable the oscillation its lock prevents.
+                self._chunk_plans = {k: v for k, v
+                                     in self._chunk_plans.items()
+                                     if v.get("locked")}
+            # The very first pass over a fresh stream shape is the wrong
+            # evidence to elect from: its walk is insert/eviction-heavy
+            # (2-4x the steady hit walk) and its fetches absorb XLA
+            # compiles.  Record a provisional giant verdict; the next
+            # giant pass measures steady state and elects for real.
+            self._chunk_plans[key] = {"kind": "giant", "chunk": 0,
+                                      "ref": round(serial_pred, 4),
+                                      "passes": 1}
+            return
         best = None
         for k in _PIPELINE_KS:
             c = -(-n // k)
@@ -1717,8 +1744,6 @@ class TpuBatchedStorage(RateLimitStorage):
             w = max(walk, k * fixed + wire_s * degrade) + fixed
             if best is None or w < best[0]:
                 best = (w, int(c))
-        if len(self._chunk_plans) >= 128 and key not in self._chunk_plans:
-            self._chunk_plans.clear()  # bound the cache; plans re-elect
         if best is not None and best[0] < _PIPELINE_WIN_MARGIN * serial_pred:
             self._chunk_plans[key] = {"kind": "pipelined", "chunk": best[1],
                                       "ref": round(serial_pred, 4),

@@ -430,3 +430,38 @@ def test_weighted_layout_matches_numpy_reference():
         want = flat[pos_ref].astype(bool)
         got = weighted_decide(bits, roff_nat, spos_nat, uidx, rank)
         np.testing.assert_array_equal(got, want)
+
+
+def test_rebuild_words_into_matches_numpy():
+    """rl_rebuild_words vs ops/relay.rebuild_words on random duplicate
+    structures, including over-clamp segments."""
+    from ratelimiter_tpu.engine.native_index import rebuild_words_into
+    from ratelimiter_tpu.ops.relay import rebuild_words
+
+    if not native_available():
+        pytest.skip("needs the native library")
+    rng = np.random.default_rng(13)
+    for rb in (3, 7, 12):
+        n = 5000
+        keys = rng.integers(0, 600, n)
+        uniq, uidx = np.unique(keys, return_inverse=True)
+        first = np.sort(np.unique(uidx, return_index=True)[1])
+        remap = np.empty(len(uniq), dtype=np.int64)
+        remap[uidx[first]] = np.arange(len(uniq))
+        uidx = remap[uidx].astype(np.int32)
+        counts = np.bincount(uidx)
+        rank = np.zeros(n, dtype=np.int32)
+        seen: dict = {}
+        for i, ui in enumerate(uidx):
+            rank[i] = seen.get(ui, 0)
+            seen[ui] = rank[i] + 1
+        rmask = (1 << rb) - 1
+        slots = rng.permutation(len(uniq)).astype(np.uint32)
+        uwords = ((slots << np.uint32(rb + 1))
+                  | (np.minimum(counts, rmask).astype(np.uint32)
+                     << np.uint32(1)))
+        want = rebuild_words(uwords, uidx, rank, rb)
+        out = np.empty(n, dtype=np.uint32)
+        assert rebuild_words_into(np.ascontiguousarray(uwords), uidx,
+                                  rank, rb, out)
+        np.testing.assert_array_equal(out, want, err_msg=f"rb={rb}")
