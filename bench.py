@@ -177,6 +177,13 @@ def main() -> None:
         agg["modes"] = modes
         return agg
 
+    def plan_sig(storage):
+        """Only (kind, chunk) decide dispatch shapes; the pass/best
+        counters mutate every pass and must not defeat stability
+        checks."""
+        return {k: (v["kind"], v["chunk"])
+                for k, v in storage._chunk_plans.items()}
+
     def plans_settled(storage):
         """True when no plan can change shape on a later pass: pipelined
         and locked plans are sticky, giant plans stop re-electing at
@@ -205,13 +212,6 @@ def main() -> None:
         res = {"mode": "stream_ids", "batch": B, "subbatches": K,
                "decisions_per_pass": n}
         if not warmed:
-            def plan_sig(st):
-                # Only (kind, chunk) decide dispatch shapes; the pass/best
-                # counters mutate every pass and must not defeat the
-                # stability check.
-                return {k: (v["kind"], v["chunk"])
-                        for k, v in st._chunk_plans.items()}
-
             warmups = []
             for _ in range(4):  # provisional-giant + elect + new shapes
                 sig_before = plan_sig(storage)
@@ -431,15 +431,12 @@ def main() -> None:
     with _compiles() as cw:
         pop = 1
         for _ in range(4):
-            plans_before = {k: (v["kind"], v["chunk"])
-                            for k, v in storage4._chunk_plans.items()}
+            plans_before = plan_sig(storage4)
             storage4.acquire_stream_ids(
                 "tb", lids4, keys4 + pop * (n_tenants * 8),
                 batch=B, subbatches=K)
             pop += 1
-            plans_after = {k: (v["kind"], v["chunk"])
-                           for k, v in storage4._chunk_plans.items()}
-            if plans_after == plans_before and plans_settled(storage4):
+            if plan_sig(storage4) == plans_before and plans_settled(storage4):
                 break
     storage4.stream_stats = churn_stats = []
     with _compiles() as cc:
@@ -522,6 +519,43 @@ def main() -> None:
         if on and off:
             log(f"  pallas on: {on:,.0f}/s, off: {off:,.0f}/s "
                 f"(x{on / off:.2f})")
+
+    # -- device-only chained-step measurement + on-device Pallas A/B --------
+    # K decision steps inside one jit over donated state, one fetched
+    # checksum (VERDICT r3 #4): measures the device step itself with no
+    # per-step wire, and settles the Pallas kernels' value on-device
+    # (subprocess pair — the kernels bind at import).
+    if platform == "tpu" and not small:
+        log("device-only chained steps (subprocess pair)...")
+        dev = {}
+        for flag in ("1", "0"):
+            try:
+                env = dict(os.environ, RATELIMITER_PALLAS=flag,
+                           RATELIMITER_BLOCK_SCATTER=flag)
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(_REPO, "bench",
+                                                  "device_only.py")],
+                    capture_output=True, timeout=900, text=True, cwd=_REPO,
+                    env=env)
+                if proc.returncode != 0 or not proc.stdout.strip():
+                    raise RuntimeError(
+                        f"rc={proc.returncode} stderr={proc.stderr[-400:]!r}")
+                dev["pallas_on" if flag == "1" else "pallas_off"] = (
+                    json.loads(proc.stdout.strip().splitlines()[-1]))
+            except Exception as exc:  # noqa: BLE001
+                dev["pallas_on" if flag == "1" else "pallas_off"] = {
+                    "error": str(exc)}
+        detail["device_only"] = dev
+        on = dev.get("pallas_on", {})
+        off = dev.get("pallas_off", {})
+        if "relay" in on:
+            log(f"  relay step: {on['relay']['decisions_per_sec']:,.0f} "
+                f"lanes/s ({on['relay']['ns_per_decision']} ns)")
+        if "flat_weighted" in on and "flat_weighted" in off:
+            fon = on["flat_weighted"]["decisions_per_sec"]
+            foff = off["flat_weighted"]["decisions_per_sec"]
+            log(f"  flat weighted: pallas on {fon:,.0f}/s, "
+                f"off {foff:,.0f}/s (x{fon / foff:.2f})")
 
     # -- sharded scaling (virtual CPU mesh, subprocess) ----------------------
     # The multi-chip sharding machinery measured 1 -> 8 shards; a separate

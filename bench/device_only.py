@@ -1,0 +1,152 @@
+"""Device-only chained-step benchmark (VERDICT r3 #4).
+
+Chains K decision steps inside ONE jit over donated device state and
+fetches a single checksum — so the measurement contains the decision
+step itself and no per-step wire.  This converts ARCHITECTURE §8b's
+"~300M decisions/s device headroom" from cost-model arithmetic into a
+measurement on this hardware, and gives the Pallas kernels a verdict:
+run the same harness with RATELIMITER_PALLAS=1/0 (subprocess pair from
+bench.py — the kernels bind at import).
+
+Two chained steps are measured:
+- ``relay``: the unit-permit relay words step (ops/relay.py:
+  tb_relay_bits) — the streaming hot path's dominant dispatch.  No
+  sort, no solver; slots rotate per step so every iteration touches a
+  different 512K-slot subset of the 1M-slot state.
+- ``flat``: the sorted flat step with weighted permits (ops/flat.py:
+  tb_flat_bits) — the path that exercises the Pallas sandwich solver
+  and (via scatter_rows_sorted) the block-scatter kernel.
+
+Prints ONE JSON line.  Run with cwd=repo root.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+    from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from ratelimiter_tpu import RateLimitConfig
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.ops import flat, relay
+
+    num_slots = 1 << 20
+    B = 1 << 19
+    table = LimiterTable()
+    lid = table.register(RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    eng = DeviceEngine(num_slots, table)
+    rb = eng.rank_bits
+    tarr = table.device_arrays
+    lid_dev = jnp.int32(lid)
+
+    # RTT floor so the fetch's fixed cost can be subtracted out.
+    tiny = jax.jit(lambda v: v.sum())
+    np.asarray(tiny(jnp.zeros(8, jnp.int32)))  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(3):
+        np.asarray(tiny(jnp.zeros(8, jnp.int32)))
+    rtt_s = (time.perf_counter() - t0) / 3
+
+    base = jnp.arange(B, dtype=jnp.int32) * (num_slots // B)
+
+    def relay_chain(K):
+        def run(packed, now0):
+            def body(i, carry):
+                packed, acc = carry
+                slots = (base + i * jnp.int32(7919)) % num_slots
+                words = (slots.astype(jnp.uint32)
+                         << np.uint32(rb + 1)) | np.uint32(1)
+                packed, bits = relay.tb_relay_bits(
+                    packed, tarr, words, lid_dev, now0 + i, rank_bits=rb)
+                return packed, acc + jnp.sum(bits.astype(jnp.int64))
+            packed, acc = jax.lax.fori_loop(0, K, body,
+                                            (packed, jnp.int64(0)))
+            return packed, acc
+        return jax.jit(run, donate_argnums=0)
+
+    # Weighted flat with duplicates: base has stride 2, so
+    # (base >> 3) * 8 maps every 4 consecutive lanes to one slot —
+    # 4-deep segments driving the segmented solver through real work.
+    perms = jnp.asarray(
+        (np.random.default_rng(5).integers(1, 9, B)).astype(np.int32))
+
+    def flat_chain(K):
+        def run(packed, now0):
+            def body(i, carry):
+                packed, acc = carry
+                slots = ((base >> 3) * 8 + i * jnp.int32(7919)) % num_slots
+                packed, bits = flat.tb_flat_bits(
+                    packed, tarr, slots, lid_dev, perms, now0 + i)
+                return packed, acc + jnp.sum(bits.astype(jnp.int64))
+            packed, acc = jax.lax.fori_loop(0, K, body,
+                                            (packed, jnp.int64(0)))
+            return packed, acc
+        return jax.jit(run, donate_argnums=0)
+
+    def measure(make_chain, packed0):
+        # Calibrate with a short chain, then re-run sized for ~2-4 s of
+        # device time so the round trip amortizes away.
+        K0 = 8
+        fn = make_chain(K0)
+        packed, acc = fn(packed0, jnp.int64(1_000_000))
+        int(np.asarray(acc))  # settle compile + first run
+        t0 = time.perf_counter()
+        packed, acc = fn(packed, jnp.int64(2_000_000))
+        int(np.asarray(acc))
+        dt0 = time.perf_counter() - t0
+        per_step = max((dt0 - rtt_s) / K0, 1e-5)
+        K = int(min(max(2.0 / per_step, K0), 1024))
+        fn = make_chain(K)
+        packed, acc = fn(packed, jnp.int64(3_000_000))
+        int(np.asarray(acc))  # compile the real K untimed
+        t0 = time.perf_counter()
+        packed, acc = fn(packed, jnp.int64(4_000_000))
+        checksum = int(np.asarray(acc))
+        dt = time.perf_counter() - t0
+        dev_s = max(dt - rtt_s, 1e-9)
+        return {
+            "steps": K, "lanes_per_step": B,
+            "decisions": K * B,
+            "wall_s": round(dt, 4),
+            "device_s": round(dev_s, 4),
+            "decisions_per_sec": round(K * B / dev_s, 1),
+            "ns_per_decision": round(dev_s / (K * B) * 1e9, 3),
+            "checksum": checksum,
+        }
+
+    from ratelimiter_tpu.ops.pallas import block_scatter, solver
+
+    out = {
+        "pallas_flag": os.environ.get("RATELIMITER_PALLAS", "1"),
+        "solver_live": bool(solver.settle()),
+        "block_scatter_live": bool(block_scatter.settle()),
+        "rtt_ms": round(rtt_s * 1000, 1),
+        "relay": measure(relay_chain, eng.tb_packed),
+    }
+    # flat chain starts from fresh state (the relay chain donated eng's).
+    from ratelimiter_tpu.ops.token_bucket import make_tb_packed
+
+    out["flat_weighted"] = measure(flat_chain, make_tb_packed(num_slots))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -636,6 +636,7 @@ class TpuBatchedStorage(RateLimitStorage):
         chunking (tests/test_relay.py).  Chunks are ``_RELAY_CHUNK``
         requests (growing to the wire budget) and pipeline three-deep so
         fetches ride in the shadow of later chunks' host work + upload."""
+        from ratelimiter_tpu.engine.native_index import rebuild_words_into
         from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
 
         multi_lid = lid_arr is not None
@@ -771,10 +772,6 @@ class TpuBatchedStorage(RateLimitStorage):
                             ("digest", counts, start, cn, (uidx, rank, u), t0,
                              rec))
                     else:
-                        from ratelimiter_tpu.engine.native_index import (
-                            rebuild_words_into,
-                        )
-
                         size = _bucket_pow2(cn)
                         words = np.full(size, 0xFFFFFFFF, dtype=np.uint32)
                         if not rebuild_words_into(uwords, uidx, rank, rb,
@@ -1419,6 +1416,7 @@ class TpuBatchedStorage(RateLimitStorage):
         skewed traffic, per-request words otherwise.  No device sort/scan
         and zero cross-shard traffic; decisions identical to the
         single-device relay on the same per-key request order."""
+        from ratelimiter_tpu.engine.native_index import rebuild_words_into
         from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
         from ratelimiter_tpu.parallel.sharded import (
             _bucket,
@@ -1586,10 +1584,6 @@ class TpuBatchedStorage(RateLimitStorage):
                             per_shard.append((pos,))
                             continue
                         _, uidx, rank, u, uw = item
-                        from ratelimiter_tpu.engine.native_index import (
-                            rebuild_words_into,
-                        )
-
                         row = w_mat[s, :len(pos)]
                         if not rebuild_words_into(uw, uidx, rank, rb, row):
                             row[:] = rebuild_words(uw, uidx, rank, rb)
@@ -1721,11 +1715,17 @@ class TpuBatchedStorage(RateLimitStorage):
         serial_pred = walk + wire_s + chunks * fixed
         if cur is None:
             if len(self._chunk_plans) >= 128:
-                # Bound the cache.  Keep LOCKED (reverted) plans: wiping
-                # one would re-enable the oscillation its lock prevents.
+                # Bound the cache.  Keep LOCKED (reverted) plans — wiping
+                # one would re-enable the oscillation its lock prevents —
+                # unless locked plans alone exceed the bound, where the
+                # memory bound wins (the rare re-elected shape pays one
+                # extra measuring pass; oscillation stays bounded by the
+                # re-lock).
                 self._chunk_plans = {k: v for k, v
                                      in self._chunk_plans.items()
                                      if v.get("locked")}
+                if len(self._chunk_plans) >= 128:
+                    self._chunk_plans.clear()
             # The very first pass over a fresh stream shape is the wrong
             # evidence to elect from: its walk is insert/eviction-heavy
             # (2-4x the steady hit walk) and its fetches absorb XLA
