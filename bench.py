@@ -380,6 +380,31 @@ def main() -> None:
         detail["sw_single_key_threaded_local"] = {"error": str(exc)}
         log(f"  local single-key failed: {exc}")
 
+    # -- latency SLO, local attachment, realistic load (VERDICT r3 #6) -------
+    # 16 threads x 4096 distinct keys, cache OFF: every request crosses
+    # the device boundary through the micro-batcher, against the <=1 ms
+    # p99 target — with a measured decomposition (flush deadline, single
+    # device step) when the backend's floor makes the target unreachable.
+    log("latency SLO local: 16 threads, multi-key, cache off (subprocess)...")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench",
+                                          "local_latency_slo.py")],
+            capture_output=True, timeout=900, text=True, cwd=_REPO)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"rc={proc.returncode} stderr={proc.stderr[-500:]!r}")
+        detail["latency_slo_local"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+        r = detail["latency_slo_local"]
+        log(f"  local SLO: p50 {r['request_latency']['p50_us']:.0f} us, "
+            f"p99 {r['request_latency']['p99_us']:.0f} us "
+            f"(target 1000 us, meets={r['meets_target']}; device step "
+            f"{r['decomposition']['device_step_16_lanes_ms']} ms)")
+    except Exception as exc:  # noqa: BLE001 — aux section must not kill bench
+        detail["latency_slo_local"] = {"error": str(exc)}
+        log(f"  local SLO failed: {exc}")
+
     # -- scenario 3: 10M-key sliding window, uniform (streaming) -------------
     num_keys3 = 50_000 if small else 10_000_000
     n3 = super_n * (2 if small else 4)

@@ -1,0 +1,105 @@
+"""Latency-SLO scenario with LOCAL device attachment and REALISTIC load
+(VERDICT r3 #6): 16 threads over many distinct keys with the negative
+cache disabled, so essentially every request misses host-side state and
+crosses the device boundary through the micro-batcher.
+
+The <=1 ms p99 target (BASELINE.md) is a local-attachment claim; the
+main bench's SLO section is tunnel-RTT-bound, and the prior local run
+covered only the one-hot-key shape.  This subprocess pins jax to the
+in-process CPU device (RTT ~ 0 — the shape of a production host with a
+local-attached accelerator) and drives the full batcher round trip per
+request: submit -> size-or-deadline flush -> device step -> future.
+bench.py records the output as latency_slo_local.
+
+Run from the repo root (subprocess of bench.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    # Must be pinned before any device op (see local_single_key.py).
+    jax.config.update("jax_platforms", "cpu")
+    import jax.extend
+
+    jax.extend.backend.clear_backends()
+
+    from ratelimiter_tpu import RateLimitConfig
+    from ratelimiter_tpu.algorithms import SlidingWindowRateLimiter
+    from ratelimiter_tpu.bench.harness import bench_threaded
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    # Cache OFF: every decision must cross the device boundary — the
+    # worst-case shape for the 1 ms target (cache hits would be ~100 ns).
+    sw_cfg = RateLimitConfig(max_permits=1_000_000, window_ms=60_000,
+                             enable_local_cache=False)
+    storage = TpuBatchedStorage(num_slots=1 << 14, max_delay_ms=0.3)
+    limiter = SlidingWindowRateLimiter(storage, sw_cfg, MeterRegistry())
+
+    # Warm every batch shape the 16-thread run can produce (the batcher
+    # buckets lane counts, so a handful of sizes covers them).
+    for i in range(200):
+        limiter.try_acquire(f"warm-{i % 64}")
+
+    # Decomposition probes (sequential, untimed threads):
+    # (a) one synchronous acquire = flush deadline + one device step,
+    # (b) one direct engine dispatch+drain at a 16-lane shape = the
+    #     device step alone.
+    t0 = time.perf_counter()
+    for i in range(50):
+        limiter.try_acquire(f"probe-a-{i}")
+    acquire_ms = (time.perf_counter() - t0) / 50 * 1000
+    import numpy as np
+
+    eng = storage.engine
+    slots = list(range(16))
+    lids = [0] * 16
+    perms = [1] * 16
+    h = eng.sw_acquire_dispatch(slots, lids, perms, 1_000_000)
+    eng.sw_acquire_drain(h, 16)
+    t0 = time.perf_counter()
+    for i in range(50):
+        h = eng.sw_acquire_dispatch(slots, lids, perms, 1_000_000 + i)
+        eng.sw_acquire_drain(h, 16)
+    step_ms = (time.perf_counter() - t0) / 50 * 1000
+
+    n_threads = 16
+    keys_per = 256  # 4096 distinct keys; each request a different one
+    res = bench_threaded(
+        limiter,
+        keys_per_thread=lambda t: [f"slo-u{t}-{i}" for i in range(keys_per)],
+        n_threads=n_threads,
+        requests_per_thread=4_000,
+    )
+    lat = res["request_latency"]
+    res["device"] = "cpu-in-process"
+    res["target_p99_ms"] = 1.0
+    res["meets_target"] = bool(lat["p99_us"] < 1000.0)
+    res["decomposition"] = {
+        "batcher_max_delay_ms": 0.3,
+        "single_acquire_ms": round(acquire_ms, 3),
+        "device_step_16_lanes_ms": round(step_ms, 3),
+        "note": ("multi-key, cache-off: every request rides a device "
+                 "micro-batch; p99 ~= flush deadline + one device step + "
+                 "queue depth under 16-thread load.  The step time here "
+                 "is the CPU backend's dispatch+execute+fetch for a "
+                 "16-lane micro-batch — the floor the 1 ms target is "
+                 "judged against in this environment; a local-attached "
+                 "TPU swaps it for its own dispatch + ~10-30 us PCIe "
+                 "round trip."),
+    }
+    storage.close()
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1439,9 +1439,12 @@ class TpuBatchedStorage(RateLimitStorage):
         out = np.empty(n, dtype=bool)
         pending: list[tuple] = []
 
-        def drain(mode, handle, start, per_shard, t0):
+        def drain(mode, handle, start, per_shard, t0, rec=None):
+            tf0 = time.perf_counter()
             arr = np.asarray(handle)
             dt_us = (time.perf_counter() - t0) * 1e6
+            if rec is not None:
+                rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
             cnt = alw = 0
             if mode == "digest":
                 from ratelimiter_tpu.engine.native_index import relay_decide
@@ -1486,19 +1489,26 @@ class TpuBatchedStorage(RateLimitStorage):
             l_st = l_chunk[order] if multi_lid else None
             pool = self._shard_pool(n_sh)
 
+            walk_by_shard = np.zeros(n_sh)
+
             def assign_shard(s):
                 lo, hi = int(soffs[s]), int(soffs[s + 1])
                 if lo == hi:
                     return None
                 sub = index._sub[s]
-                if multi_lid:
-                    return sub.assign_batch_ints_multi_uniques(
-                        kst[lo:hi], l_st[lo:hi], rb,
-                        pinned=pins_by_shard.get(s), hold_pins=True)
-                return sub.assign_batch_ints_uniques(
-                    kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
-                    hold_pins=True)
+                tw0 = time.perf_counter()
+                try:
+                    if multi_lid:
+                        return sub.assign_batch_ints_multi_uniques(
+                            kst[lo:hi], l_st[lo:hi], rb,
+                            pinned=pins_by_shard.get(s), hold_pins=True)
+                    return sub.assign_batch_ints_uniques(
+                        kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
+                        hold_pins=True)
+                finally:
+                    walk_by_shard[s] = time.perf_counter() - tw0
 
+            t_c0 = time.perf_counter()
             results = []
             clears: list = []
             pin_glob: list = []
@@ -1569,7 +1579,7 @@ class TpuBatchedStorage(RateLimitStorage):
                         per_shard.append((pos, uidx, rank, u))
                     counts = counts_dispatch(
                         uw_mat, lid if not multi_lid else lid_mat, now, cdt)
-                    pending.append(("digest", counts, start, per_shard, t0))
+                    pending.append(["digest", counts, start, per_shard, t0])
                 else:
                     b_loc = _bucket(max(b_max, 1))
                     w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF,
@@ -1592,12 +1602,28 @@ class TpuBatchedStorage(RateLimitStorage):
                         per_shard.append((pos,))
                     bits = bits_dispatch(
                         w_mat, lid if not multi_lid else lid_mat, now)
-                    pending.append(("bits", bits, start, per_shard, t0))
+                    pending.append(["bits", bits, start, per_shard, t0])
             finally:
                 self._unpin_held(index, pin_glob)
+            wire_b = digest_bpu * u_total if digest else words_bpr * cn
+            rec = None
+            if self.stream_stats is not None:
+                # Per-shard walk seconds expose where a sharded chunk's
+                # host time goes (the residual n-shard overhead on a
+                # 1-core host is these C calls serializing).
+                rec = {"path": "relay_sharded", "n": int(cn),
+                       "u": int(u_total),
+                       "mode": "digest" if digest else "bits",
+                       "wire_bytes": int(wire_b),
+                       "assign_s": round(float(walk_by_shard.max()), 6),
+                       "shard_walk_s": [round(float(x), 6)
+                                        for x in walk_by_shard],
+                       "host_s": round(time.perf_counter() - t_c0
+                                       - float(walk_by_shard.max()), 6)}
+                self.stream_stats.append(rec)
+            pending[-1].append(rec)
             if len(pending) > 1:
                 drain(*pending.pop(0))
-            wire_b = digest_bpu * u_total if digest else words_bpr * cn
             bpr = max(wire_b / cn, 1e-3)
             budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
                       else _RELAY_WIRE_BUDGET_WORDS)
