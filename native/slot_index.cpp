@@ -890,6 +890,60 @@ int32_t rl_weighted_layout(const uint32_t* uwords, int64_t u,
   return 0;
 }
 
+// Sort a uniques batch by SLOT (radix on the word's slot field) and
+// remap uidx accordingly — in place.  Slot-sorted digests let the
+// device scatter run as a dense block sweep (ops/pallas/block_scatter
+// presorted path) instead of XLA's ~45 ns/index generic scatter, and
+// the gather ride ascending addresses.  Slots are unique within a
+// batch, so stability is irrelevant; 2x11-bit LSD radix passes cover
+// the <= 2^22 slot ids every engine geometry produces (wider slot
+// fields fall back to more passes).  O(u) per pass + O(n) remap.
+int32_t rl_sort_uniques(uint32_t* uwords, int64_t u, int32_t rank_bits,
+                        int32_t* uidx, int64_t n) {
+  if (u <= 1) return 0;
+  const int shift = rank_bits + 1;
+  std::vector<uint32_t> tmp_w(u);
+  std::vector<int32_t> ord(u), ord_tmp(u);
+  for (int64_t i = 0; i < u; i++) ord[i] = static_cast<int32_t>(i);
+  uint32_t max_slot = 0;
+  for (int64_t i = 0; i < u; i++) {
+    uint32_t s = uwords[i] >> shift;
+    if (s > max_slot) max_slot = s;
+  }
+  const int kBits = 11;
+  const uint32_t kMask = (1u << kBits) - 1u;
+  int passes = 1;
+  while (passes * kBits < 32 && (max_slot >> (passes * kBits)) != 0)
+    passes++;
+  std::vector<int64_t> cnt(1u << kBits);
+  for (int p = 0; p < passes; p++) {
+    const int sh = shift + p * kBits;
+    std::fill(cnt.begin(), cnt.end(), 0);
+    for (int64_t i = 0; i < u; i++) cnt[(uwords[ord[i]] >> sh) & kMask]++;
+    int64_t acc = 0;
+    for (uint32_t b = 0; b <= kMask; b++) {
+      int64_t c = cnt[b];
+      cnt[b] = acc;
+      acc += c;
+    }
+    for (int64_t i = 0; i < u; i++)
+      ord_tmp[cnt[(uwords[ord[i]] >> sh) & kMask]++] = ord[i];
+    ord.swap(ord_tmp);
+  }
+  // inv[old] = new position; gather words into sorted order.
+  std::vector<int32_t> inv(u);
+  for (int64_t j = 0; j < u; j++) {
+    inv[ord[j]] = static_cast<int32_t>(j);
+    tmp_w[j] = uwords[ord[j]];
+  }
+  std::memcpy(uwords, tmp_w.data(), u * sizeof(uint32_t));
+  for (int64_t i = 0; i < n; i++) {
+    int32_t ui = uidx[i];
+    if (ui >= 0) uidx[i] = inv[ui];
+  }
+  return 0;
+}
+
 // Per-request words-mode reconstruction (ops/relay.py:rebuild_words in
 // one pass): word = (slot | clamped rank | last-of-segment), written
 // straight into the caller's padded dispatch buffer — the numpy version

@@ -105,7 +105,7 @@ class DeviceEngine:
             sw_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
         self._tb_relay = jax.jit(functools.partial(
             tb_relay_bits, rank_bits=self.rank_bits), donate_argnums=0)
-        self._relay_counts = {}  # (algo, out_dtype name) -> jitted step
+        self._relay_counts = {}  # (algo, out_dtype name, sorted) -> jitted step
         self._relay_weighted = {}  # (algo, r_steps) -> jitted weighted step
         # Largest per-request permits the weighted relay carries (uint8
         # CSR permits lane); larger permits take the sorted flat path.
@@ -359,26 +359,34 @@ class DeviceEngine:
                     perms_rank, roff, lid, now)
         return bits
 
-    def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
+    def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype,
+                                 slots_sorted=False):
         return self._relay_counts_dispatch("sw", uwords, lids, now_ms,
-                                           out_dtype)
+                                           out_dtype,
+                                           slots_sorted=slots_sorted)
 
-    def tb_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype):
+    def tb_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype,
+                                 slots_sorted=False):
         return self._relay_counts_dispatch("tb", uwords, lids, now_ms,
-                                           out_dtype)
+                                           out_dtype,
+                                           slots_sorted=slots_sorted)
 
     def sw_relay_counts_resident_dispatch(self, uwords, delta_slots,
-                                          delta_lids, now_ms, out_dtype):
+                                          delta_lids, now_ms, out_dtype,
+                                          slots_sorted=False):
         return self._relay_resident_dispatch("sw", uwords, delta_slots,
-                                             delta_lids, now_ms, out_dtype)
+                                             delta_lids, now_ms, out_dtype,
+                                             slots_sorted=slots_sorted)
 
     def tb_relay_counts_resident_dispatch(self, uwords, delta_slots,
-                                          delta_lids, now_ms, out_dtype):
+                                          delta_lids, now_ms, out_dtype,
+                                          slots_sorted=False):
         return self._relay_resident_dispatch("tb", uwords, delta_slots,
-                                             delta_lids, now_ms, out_dtype)
+                                             delta_lids, now_ms, out_dtype,
+                                             slots_sorted=slots_sorted)
 
     def _relay_resident_dispatch(self, algo, uwords, delta_slots, delta_lids,
-                                 now_ms, out_dtype):
+                                 now_ms, out_dtype, slots_sorted=False):
         """Digest dispatch with device-resident lids: uwords uint32[U];
         delta (slot, lid) i32 pairs for slots whose lid the device doesn't
         know yet (padding slot = -1).  Returns the lazy counts handle."""
@@ -388,13 +396,14 @@ class DeviceEngine:
         )
 
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
-        key = (algo, out_dtype().dtype.name)
+        key = (algo, out_dtype().dtype.name, bool(slots_sorted))
         fn = self._relay_resident.get(key)
         if fn is None:
             base = (sw_relay_counts_resident if algo == "sw"
                     else tb_relay_counts_resident)
             fn = jax.jit(functools.partial(
-                base, rank_bits=self.rank_bits, out_dtype=jdt),
+                base, rank_bits=self.rank_bits, out_dtype=jdt,
+                slots_sorted=bool(slots_sorted)),
                 donate_argnums=(0, 1))
             self._relay_resident[key] = fn
         uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
@@ -416,16 +425,20 @@ class DeviceEngine:
                     delta_lids, now)
         return counts
 
-    def _relay_counts_dispatch(self, algo, uwords, lids, now_ms, out_dtype):
+    def _relay_counts_dispatch(self, algo, uwords, lids, now_ms, out_dtype,
+                               slots_sorted=False):
         """uwords uint32[U] (slot | clamped count; padding 0xFFFFFFFF);
-        returns a lazy out_dtype[U] per-unique allowed-count handle."""
+        returns a lazy out_dtype[U] per-unique allowed-count handle.
+        ``slots_sorted`` (host sorted the uniques by slot): the scatter
+        runs as the dense presorted block sweep."""
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
-        key = (algo, out_dtype().dtype.name)
+        key = (algo, out_dtype().dtype.name, bool(slots_sorted))
         fn = self._relay_counts.get(key)
         if fn is None:
             base = sw_relay_counts if algo == "sw" else tb_relay_counts
             fn = jax.jit(functools.partial(
-                base, rank_bits=self.rank_bits, out_dtype=jdt),
+                base, rank_bits=self.rank_bits, out_dtype=jdt,
+                slots_sorted=bool(slots_sorted)),
                 donate_argnums=0)
             self._relay_counts[key] = fn
         uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))

@@ -142,6 +142,10 @@ def _bind(lib) -> None:
     lib.rl_shard_route.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p]
+    lib.rl_sort_uniques.restype = ctypes.c_int32
+    lib.rl_sort_uniques.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_int64]
     lib.rl_rebuild_words.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_void_p]
@@ -275,6 +279,24 @@ def relay_decide(counts: np.ndarray, uidx: np.ndarray,
                         uidx.ctypes.data, rank.ctypes.data, len(uidx),
                         out.ctypes.data)
     return out.view(np.bool_)
+
+
+def sort_uniques(uwords: np.ndarray, rank_bits: int,
+                 uidx: np.ndarray) -> bool:
+    """Sort ``uwords`` by slot IN PLACE (radix on the slot field) and
+    remap ``uidx`` to the new positions — the prerequisite for the
+    dense presorted device scatter.  Decision reconstruction is
+    order-agnostic (counts[uidx] with the remapped uidx), so callers
+    can sort freely before a digest dispatch.  False when the native
+    library is unavailable (callers dispatch unsorted)."""
+    lib = _load_library()
+    if lib is None:
+        return False
+    assert uwords.flags["C_CONTIGUOUS"] and uwords.dtype == np.uint32
+    assert uidx.flags["C_CONTIGUOUS"] and uidx.dtype == np.int32
+    lib.rl_sort_uniques(uwords.ctypes.data, len(uwords), int(rank_bits),
+                        uidx.ctypes.data, len(uidx))
+    return True
 
 
 def rebuild_words_into(uwords: np.ndarray, uidx: np.ndarray,

@@ -156,13 +156,38 @@ def scatter_rows(state, sorted_slots, write_mask, rows,
         key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
         ops = jax.lax.sort(
             (key,) + tuple(rows[:, j] for j in range(lanes)), num_keys=1)
-        upd_slots = ops[0].reshape(1, n)
         upd_rows_t = jnp.stack(ops[1:], axis=0)  # (L, B), lane-major
-        bounds = jnp.arange(s_rows // T, dtype=jnp.int32) * T
-        starts = jnp.searchsorted(ops[0], bounds).astype(jnp.int32)
-        sigma = jnp.clip(starts // T, 0, n // T - 2)
-        return _block_scatter(state, upd_slots, upd_rows_t, sigma,
-                              interpret=interpret)
+        return _windowed_call(state, ops[0], upd_rows_t, interpret)
+
+
+def _windowed_call(state, key_sorted, upd_rows_t, interpret):
+    """Shared tail of both entry points: block-aligned window map over
+    the sorted key lane, then the pallas_call."""
+    s_rows, _ = state.shape
+    n = key_sorted.shape[0]
+    bounds = jnp.arange(s_rows // T, dtype=jnp.int32) * T
+    starts = jnp.searchsorted(key_sorted, bounds).astype(jnp.int32)
+    sigma = jnp.clip(starts // T, 0, n // T - 2)
+    return _block_scatter(state, key_sorted.reshape(1, n), upd_rows_t,
+                          sigma, interpret=interpret)
+
+
+def scatter_rows_presorted(state, sorted_slots, write_mask, rows,
+                           interpret: bool | None = None):
+    """:func:`scatter_rows` minus the compaction sort, for callers whose
+    live updates already arrive sorted by slot with every masked-out
+    lane at the TAIL (the host-sorted digest path — the C index sorts
+    uniques before dispatch).  Skipping the ``lax.sort`` removes both
+    its runtime and its super-linear XLA:TPU compile cliff, so this
+    path has no practical lane-count ceiling."""
+    if interpret is None:
+        interpret = _INTERPRET
+    s_rows, lanes = state.shape
+    with jax.enable_x64(False):
+        # Masked lanes are at the tail, so mapping them to the sentinel
+        # (s_rows) preserves ascending order.
+        key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
+        return _windowed_call(state, key, rows.T, interpret)
 
 
 def supported(state_shape, batch: int) -> bool:

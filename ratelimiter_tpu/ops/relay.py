@@ -171,7 +171,7 @@ def tb_relay_bits(packed, table, words, lids, now, *, rank_bits: int):
 
 
 def tb_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
-                    out_dtype=jnp.uint8):
+                    out_dtype=jnp.uint8, slots_sorted: bool = False):
     """Segment-digest token-bucket step: one lane per UNIQUE slot.
 
     uwords uint32[U] carries (slot | clamped segment count); the step
@@ -203,15 +203,23 @@ def tb_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
     any_inc = n_alw > 0
     tokens_new = jnp.where(any_inc, v1 - n_alw * TOKEN_FP_ONE, rows[0])
     last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
-    widx = jnp.where(valid, slot, jnp.int32(num_slots))
-    packed_new = packed.at[widx].set(
-        _tb_encode(tokens_new, last_new), mode="drop")
+    new_rows = _tb_encode(tokens_new, last_new)
+    if slots_sorted:
+        # Host-sorted uniques (padding decodes to slot >= num_slots, at
+        # the tail): the dense presorted block sweep replaces XLA's
+        # per-index scatter (ops/scatter.py).
+        from ratelimiter_tpu.ops.scatter import scatter_rows_presorted
+
+        packed_new = scatter_rows_presorted(packed, slot, valid, new_rows)
+    else:
+        widx = jnp.where(valid, slot, jnp.int32(num_slots))
+        packed_new = packed.at[widx].set(new_rows, mode="drop")
     lim = jnp.int64(jnp.iinfo(out_dtype).max)
     return packed_new, jnp.clip(n_alw, 0, lim).astype(out_dtype)
 
 
 def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
-                    out_dtype=jnp.uint8):
+                    out_dtype=jnp.uint8, slots_sorted: bool = False):
     """Segment-digest sliding-window step (see tb_relay_counts).
 
     The per-request decision ``rank < n_pass`` is exact: with unit
@@ -242,15 +250,20 @@ def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
     cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
     curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
     new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
-    widx = jnp.where(valid, slot, jnp.int32(num_slots))
-    packed_new = packed.at[widx].set(new_rows, mode="drop")
+    if slots_sorted:  # see tb_relay_counts
+        from ratelimiter_tpu.ops.scatter import scatter_rows_presorted
+
+        packed_new = scatter_rows_presorted(packed, slot, valid, new_rows)
+    else:
+        widx = jnp.where(valid, slot, jnp.int32(num_slots))
+        packed_new = packed.at[widx].set(new_rows, mode="drop")
     lim = jnp.int64(jnp.iinfo(out_dtype).max)
     return packed_new, jnp.clip(n_pass, 0, lim).astype(out_dtype)
 
 
 def tb_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
                              delta_lids, now, *, rank_bits: int,
-                             out_dtype=jnp.uint8):
+                             out_dtype=jnp.uint8, slots_sorted: bool = False):
     """Digest step with the tenant ids RESIDENT on device.
 
     One slot is one (limiter, key) pair, so a slot's lid is immutable
@@ -268,13 +281,13 @@ def tb_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
     lids = lid_map[jnp.where(valid, slot, 0)]
     packed_new, counts = tb_relay_counts(
         packed, table, uwords, lids, now, rank_bits=rank_bits,
-        out_dtype=out_dtype)
+        out_dtype=out_dtype, slots_sorted=slots_sorted)
     return packed_new, lid_map, counts
 
 
 def sw_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
                              delta_lids, now, *, rank_bits: int,
-                             out_dtype=jnp.uint8):
+                             out_dtype=jnp.uint8, slots_sorted: bool = False):
     """Sliding-window counterpart of :func:`tb_relay_counts_resident`."""
     lid_map = lid_map.at[jnp.where(delta_slots >= 0, delta_slots,
                                    lid_map.shape[0])].set(
@@ -284,7 +297,7 @@ def sw_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,
     lids = lid_map[jnp.where(valid, slot, 0)]
     packed_new, counts = sw_relay_counts(
         packed, table, uwords, lids, now, rank_bits=rank_bits,
-        out_dtype=out_dtype)
+        out_dtype=out_dtype, slots_sorted=slots_sorted)
     return packed_new, lid_map, counts
 
 

@@ -17,6 +17,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _scatter(state, sorted_slots, write_mask, rows, presorted: bool):
+    from ratelimiter_tpu.ops.pallas import block_scatter
+
+    if block_scatter.enabled(state.shape, sorted_slots.shape[0]):
+        fn = (block_scatter.scatter_rows_presorted if presorted
+              else block_scatter.scatter_rows)
+        return fn(state, sorted_slots, write_mask, rows)
+    n = state.shape[0]
+    widx = jnp.where(write_mask, sorted_slots, n)  # out-of-range -> dropped
+    return state.at[widx].set(rows, mode="drop")
+
+
 def scatter_rows_sorted(state, sorted_slots, write_mask, rows):
     """state[slot] <- rows[j] for each j with write_mask[j].
 
@@ -24,11 +36,14 @@ def scatter_rows_sorted(state, sorted_slots, write_mask, rows):
     masked entries each slot appears at most once.  Unmasked/padding lanes
     are dropped.
     """
-    from ratelimiter_tpu.ops.pallas import block_scatter
+    return _scatter(state, sorted_slots, write_mask, rows, presorted=False)
 
-    if block_scatter.enabled(state.shape, sorted_slots.shape[0]):
-        return block_scatter.scatter_rows(state, sorted_slots, write_mask,
-                                          rows)
-    n = state.shape[0]
-    widx = jnp.where(write_mask, sorted_slots, n)  # out-of-range -> dropped
-    return state.at[widx].set(rows, mode="drop")
+
+def scatter_rows_presorted(state, sorted_slots, write_mask, rows):
+    """Like :func:`scatter_rows_sorted` for callers whose live updates
+    are ALREADY sorted by slot with masked lanes at the tail (the
+    host-sorted digest path): the Pallas dense sweep skips its
+    compaction sort — no sort runtime, no sort compile cliff, so any
+    lane count works.  XLA drop-mode scatter is the fallback (order
+    is irrelevant to it)."""
+    return _scatter(state, sorted_slots, write_mask, rows, presorted=True)

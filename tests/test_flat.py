@@ -281,3 +281,44 @@ def test_try_acquire_many_routes_large_calls_to_stream(monkeypatch):
         st.close()
     np.testing.assert_array_equal(results[False][0], results[True][0])
     np.testing.assert_array_equal(results[False][1], results[True][1])
+
+
+@pytest.mark.parametrize("lanes", [4, 6])
+def test_block_scatter_presorted_matches_xla(lanes):
+    """The presorted entry (no compaction sort: caller-sorted unique
+    slots, padding at the tail — the host-sorted digest layout) against
+    XLA drop-scatter truth, in interpret mode."""
+    from ratelimiter_tpu.ops.pallas import block_scatter as bs
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(21)
+    S, B = 4 * bs.T, 4 * bs.T
+    for trial in range(4):
+        state = rng.integers(-(1 << 30), 1 << 30, (S, lanes)).astype(
+            np.int32)
+        u = int(rng.integers(1, B - 1))
+        live = np.sort(rng.choice(S, size=u, replace=False)).astype(
+            np.int32)
+        # Digest padding decodes to slot >= S, at the tail.
+        slots = np.concatenate([live, np.full(B - u, S + 5, np.int32)])
+        mask = np.r_[np.ones(u, bool), np.zeros(B - u, bool)]
+        rows = rng.integers(-(1 << 30), 1 << 30, (B, lanes)).astype(
+            np.int32)
+        got = np.asarray(bs.scatter_rows_presorted(
+            jnp.asarray(state), jnp.asarray(slots), jnp.asarray(mask),
+            jnp.asarray(rows), interpret=True))
+        np.testing.assert_array_equal(
+            got, _xla_truth(state, slots, mask, rows), err_msg=str(trial))
+    # Edges: everything written; nothing written.
+    state = np.arange(S * lanes, dtype=np.int32).reshape(S, lanes)
+    slots = np.arange(S, dtype=np.int32)
+    rows = -state
+    got = np.asarray(bs.scatter_rows_presorted(
+        jnp.asarray(state), jnp.asarray(slots),
+        jnp.asarray(np.ones(S, bool)), jnp.asarray(rows), interpret=True))
+    np.testing.assert_array_equal(got, rows)
+    got = np.asarray(bs.scatter_rows_presorted(
+        jnp.asarray(state), jnp.asarray(slots),
+        jnp.asarray(np.zeros(S, bool)), jnp.asarray(rows), interpret=True))
+    np.testing.assert_array_equal(got, state)

@@ -568,3 +568,71 @@ def test_stream_weighted_strs_matches_batch_path(monkeypatch, algo):
         now[0] += 433
     st_a.close()
     st_b.close()
+
+
+def test_sorted_digest_stream_matches_unsorted(monkeypatch):
+    """Slot-sorted digest dispatches (u >= _SORT_UNIQUES_MIN triggers the
+    C radix sort + uidx remap + presorted scatter path) decide exactly
+    like the unsorted path on the same stream."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.engine.native_index import native_available
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    if not native_available():
+        pytest.skip("needs the native library")
+    now = [1_000_000]
+    rng = np.random.default_rng(8)
+    n = 1 << 15
+    # Zipf-ish duplication with > 4096 uniques per chunk.
+    ids = rng.integers(0, 12_000, n).astype(np.int64)
+
+    # Force the sorted path on CPU (the device sweep itself is gated to
+    # TPU; the XLA fallback scatter is order-blind, so this exercises
+    # sort + uidx remap + dispatch + reconstruction end to end).
+    monkeypatch.setattr(tpu_mod, "_presorted_scatter_usable",
+                        lambda eng, algo, padded: True)
+
+    def run(sort_min):
+        monkeypatch.setattr(tpu_mod, "_SORT_UNIQUES_MIN", sort_min)
+        st = TpuBatchedStorage(num_slots=1 << 15, clock_ms=lambda: now[0])
+        lid = st.register_limiter("tb", RateLimitConfig(
+            max_permits=5, window_ms=60_000, refill_rate=1.0))
+        outs = [st.acquire_stream_ids("tb", lid, ids, None)
+                for _ in range(2)]
+        st.close()
+        return outs
+
+    sorted_outs = run(1 << 12)   # sorting active
+    unsorted_outs = run(1 << 62)  # threshold unreachable: never sorts
+    for a, b in zip(sorted_outs, unsorted_outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sort_uniques_parity():
+    """rl_sort_uniques: words end up slot-ascending, the multiset of
+    words is preserved, and the remapped uidx points every request at
+    its original word."""
+    from ratelimiter_tpu.engine.native_index import (
+        native_available,
+        sort_uniques,
+    )
+
+    if not native_available():
+        pytest.skip("needs the native library")
+    rng = np.random.default_rng(4)
+    rb = 9
+    for _ in range(10):
+        u = int(rng.integers(2, 5000))
+        n = u * 3
+        slots = rng.choice(1 << 20, size=u, replace=False).astype(np.uint32)
+        counts = rng.integers(1, 7, u).astype(np.uint32)
+        uwords = (slots << np.uint32(rb + 1)) | (counts << np.uint32(1))
+        uidx = rng.integers(0, u, n).astype(np.int32)
+        orig_words = uwords.copy()
+        orig_word_of_req = orig_words[uidx]
+        uw = uwords.copy()
+        ui = uidx.copy()
+        assert sort_uniques(uw, rb, ui)
+        assert (np.diff(uw >> np.uint32(rb + 1)).astype(np.int64) > 0).all()
+        np.testing.assert_array_equal(np.sort(uw), np.sort(orig_words))
+        np.testing.assert_array_equal(uw[ui], orig_word_of_req)
