@@ -116,7 +116,7 @@ class DeviceEngine:
         # the deltas and reads policies from this array.
         self.sw_lid_map = jnp.zeros(self.num_slots, dtype=jnp.int32)
         self.tb_lid_map = jnp.zeros(self.num_slots, dtype=jnp.int32)
-        self._relay_resident = {}  # (algo, out_dtype name) -> jitted step
+        self._relay_resident = {}  # (algo, out_dtype name, sorted) -> jitted step
         self._sw_peek = jax.jit(sw_peek_p)
         self._tb_peek = jax.jit(tb_peek_p)
         # Settle the Pallas probes NOW, before any step kernel compiles:

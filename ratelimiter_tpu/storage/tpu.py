@@ -1741,7 +1741,8 @@ class TpuBatchedStorage(RateLimitStorage):
         self.set_link_profile(up_bps, rtt_s)
         return self._link_profile
 
-    def _elect_chunk_plan(self, key: tuple, n: int, tot: dict) -> None:
+    def _elect_chunk_plan(self, key: tuple, n: int, tot: dict,
+                          wall_s: float) -> None:
         """End-of-first-pass election for a stream shape: keep giant
         chunks (wire-budget growth), or switch later passes to a fixed
         K-way split that overlaps fetches with walks.
@@ -1809,8 +1810,15 @@ class TpuBatchedStorage(RateLimitStorage):
             if best is None or w < best[0]:
                 best = (w, int(c))
         if best is not None and best[0] < _PIPELINE_WIN_MARGIN * serial_pred:
+            # ref: the analytic baseline that justified the election.
+            # giant_wall: the MEASURED wall of the (clean, steady) giant
+            # pass that elected — the revert check compares against this,
+            # not the analytic figure (whose per-fetch fixed cost is
+            # calibrated from lazy drains and underestimates; comparing
+            # against it wrongly reverted plans that beat the real giant).
             self._chunk_plans[key] = {"kind": "pipelined", "chunk": best[1],
                                       "ref": round(serial_pred, 4),
+                                      "giant_wall": round(wall_s, 4),
                                       "passes": 0, "best": None}
         else:
             self._chunk_plans[key] = {
@@ -1846,7 +1854,8 @@ class TpuBatchedStorage(RateLimitStorage):
             self._maybe_revert_plan(plan_key,
                                     time.perf_counter() - t_pass0)
         else:
-            self._elect_chunk_plan(plan_key, n, tot)
+            self._elect_chunk_plan(plan_key, n, tot,
+                                   time.perf_counter() - t_pass0)
 
     def _maybe_revert_plan(self, key: tuple, wall_s: float) -> None:
         """A pipelined plan whose BEST pass (over at least two — the
@@ -1859,7 +1868,8 @@ class TpuBatchedStorage(RateLimitStorage):
         plan["passes"] += 1
         plan["best"] = (wall_s if plan["best"] is None
                         else min(plan["best"], wall_s))
-        if plan["passes"] >= 2 and plan["best"] > _PIPELINE_REVERT * plan["ref"]:
+        ref = plan.get("giant_wall", plan["ref"])
+        if plan["passes"] >= 2 and plan["best"] > _PIPELINE_REVERT * ref:
             # locked: a reverted shape must not be re-elected later, or
             # the plan (and its compile shapes) could oscillate.
             self._chunk_plans[key] = {"kind": "giant", "chunk": 0,

@@ -633,6 +633,9 @@ def test_sort_uniques_parity():
         uw = uwords.copy()
         ui = uidx.copy()
         assert sort_uniques(uw, rb, ui)
-        assert (np.diff(uw >> np.uint32(rb + 1)).astype(np.int64) > 0).all()
+        # Cast BEFORE diff: uint32 diff wraps modulo 2^32, which made
+        # this assertion pass for any permutation.
+        sorted_slots = (uw >> np.uint32(rb + 1)).astype(np.int64)
+        assert (np.diff(sorted_slots) > 0).all()
         np.testing.assert_array_equal(np.sort(uw), np.sort(orig_words))
         np.testing.assert_array_equal(uw[ui], orig_word_of_req)

@@ -289,24 +289,24 @@ def test_chunk_plan_election_logic():
     # shapes' first passes are insert- and compile-heavy); the second
     # elects for real.
     st.set_link_profile(85e6, 0.107)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     plan = st._chunk_plans[("relay", "ints", "tb", False, n)]
     assert plan["kind"] == "pipelined" and plan["chunk"] >= 1 << 19, plan
     # Wire-bound (5 MB/s, walk nearly free): splitting only degrades
     # dedup and adds round trips — giant stays.
     st.set_link_profile(5e6, 0.107)
     slow_tot = dict(giant_tot, walk_s=0.05, fetch_s=1.1)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 1.2)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 1.2)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
     # Revert: pipelined passes clearly worse than the serial baseline
     # (first pass alone is NOT enough — it pays the new shapes' compiles).
     st.set_link_profile(85e6, 0.107)
     st._chunk_plans.clear()
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     ref = st._chunk_plans[("relay", "ints", "tb", False, n)]["ref"]
     st._maybe_revert_plan(("relay", "ints", "tb", False, n), 10.0)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "pipelined"
@@ -314,14 +314,14 @@ def test_chunk_plan_election_logic():
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
     # A reverted plan is LOCKED: a later clean giant pass must not
     # re-elect it back to pipelined (shape oscillation).
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
     # Whereas a PROVISIONAL giant (compile-contaminated first pass:
     # huge measured fetch) is re-elected once clean measurements arrive.
     st._chunk_plans.clear()
     dirty = dict(giant_tot, fetch_s=12.0)  # compiles inside the fetches
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, dirty)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, dirty, 13.0)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "pipelined"
     st.close()
