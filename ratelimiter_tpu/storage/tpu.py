@@ -1860,8 +1860,11 @@ class TpuBatchedStorage(RateLimitStorage):
     def _maybe_revert_plan(self, key: tuple, wall_s: float) -> None:
         """A pipelined plan whose BEST pass (over at least two — the
         first re-compiles the new shapes) still measures clearly worse
-        than the analytic serial baseline reverts to giant — sticky,
-        like the election, so chunk shapes stay deterministic after."""
+        than the MEASURED wall of the giant pass that elected it
+        reverts to giant — sticky, like the election, so chunk shapes
+        stay deterministic after.  (Comparing against the analytic
+        serial baseline instead wrongly reverted plans that beat the
+        real giant: its per-fetch fixed cost is under-calibrated.)"""
         plan = self._chunk_plans.get(key)
         if plan is None or plan["kind"] != "pipelined":
             return

@@ -234,3 +234,62 @@ def test_grand_soak_all_paths_with_reset_and_checkpoint(seed, tmp_path):
             storage.save_checkpoint(ckpt)
             storage.restore_checkpoint(ckpt)
     storage.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_sorted_digest_stream_vs_oracle(seed, monkeypatch):
+    """Unit-stream soak with the slot-sorted digest path FORCED (tiny
+    sort threshold + gate patched onto the XLA fallback): radix sort +
+    uidx remap + sorted dispatch + reconstruction must stay bit-exact
+    against the oracle across evictions, resets, and time steps."""
+    import numpy as np
+
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.engine.native_index import native_available
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    if not native_available():
+        pytest.skip("needs the native library")
+    monkeypatch.setattr(tpu_mod, "_SORT_UNIQUES_MIN", 2)
+    monkeypatch.setattr(tpu_mod, "_presorted_scatter_usable",
+                        lambda eng, algo, padded: True)
+    # Count the sorts: the digest election needs heavy duplication
+    # (6.0*u <= 4.125*n, ops/relay.py:wire_costs), so the traffic below
+    # is many requests over FEW keys — and the test fails if the sorted
+    # path never actually engaged.
+    import ratelimiter_tpu.engine.native_index as ni
+
+    sorts = {"n": 0}
+    real_sort = ni.sort_uniques
+
+    def counting_sort(uw, rb, ui):
+        sorts["n"] += 1
+        return real_sort(uw, rb, ui)
+
+    monkeypatch.setattr(ni, "sort_uniques", counting_sort)
+    rng = random.Random(1700 + seed)
+    nrng = np.random.default_rng(1700 + seed)
+    win = 1200
+    cfg = RateLimitConfig(max_permits=7, window_ms=win, refill_rate=5.0)
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    lid = storage.register_limiter("tb", cfg)
+    oracle = TokenBucketOracle(cfg)
+    n_keys = 24
+    for step in range(40):
+        clock["t"] += biased_dt(rng, win)
+        now = clock["t"]
+        n = rng.randrange(100, 260)  # ~4-10x duplication: digest elects
+        key_ids = nrng.integers(0, n_keys, n)
+        got = storage.acquire_stream_ids("tb", lid, key_ids, None,
+                                         batch=512, subbatches=1)
+        for j in range(n):
+            d = oracle.try_acquire(f"int:{key_ids[j]}", 1, now)
+            assert bool(got[j]) == d.allowed, (seed, step, j)
+        if rng.random() < 0.2:
+            k = rng.randrange(n_keys)
+            storage.reset_key("tb", lid, k)
+            oracle.reset(f"int:{k}", now)
+    storage.close()
+    assert sorts["n"] >= 20, \
+        f"sorted digest path engaged only {sorts['n']} times"
