@@ -108,6 +108,13 @@ _PIPELINE_KS = (8, 6, 4, 3, 2)
 _PIPELINE_WIN_MARGIN = 0.9
 _PIPELINE_REVERT = 1.1
 _DEDUP_DEGRADE_EXP = 0.3
+# Device step cost per dispatched lane (words/weighted: per request;
+# digest: per unique) — measured on this v5e by bench/device_only.py
+# (~58 ns/lane, ROUND_NOTES r4).  The election charges it explicitly:
+# without it the per-fetch fixed cost calibrated from lazy-drained giant
+# passes underestimates, and the argmin picks more chunks than the
+# dispatch overhead can pay for.
+_DEVICE_S_PER_LANE = 60e-9
 
 # Weighted relay: longest rank-major permit matrix the scan step accepts.
 # A chunk whose deepest segment exceeds this (heavy duplication — Zipf
@@ -828,6 +835,9 @@ class TpuBatchedStorage(RateLimitStorage):
                 tot["wire"] += wire_b
                 tot["giant"] = max(tot["giant"], cn)
                 tot["chunks"] += 1
+                tot["device_lanes"] += u if digest else cn
+                if digest:
+                    tot["digest_chunks"] += 1
                 if rec is not None:
                     rec["mode"] = "digest" if digest else "bits"
                     rec["wire_bytes"] = int(wire_b)
@@ -1045,6 +1055,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 tot["wire"] += wire_b
                 tot["giant"] = max(tot["giant"], cn)
                 tot["chunks"] += 1
+                tot["device_lanes"] += cn  # scan work ~ request lanes
                 if rec is not None:
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
                     rec["host_s"] = round(
@@ -1748,12 +1759,20 @@ class TpuBatchedStorage(RateLimitStorage):
         K-way split that overlaps fetches with walks.
 
         ``tot`` holds this pass's measured totals at the giant schedule
-        (walk_s, wire bytes, fetch_s, chunks, giant = largest chunk).
-        Per-fetch fixed cost (round trip + device step) is calibrated
-        from the measured fetch total minus the profiled wire time; the
-        K minimizing max(walk, K*fixed + wire*degrade) + fixed wins if
-        it beats the ANALYTIC serial baseline walk + wire + chunks*fixed
-        by _PIPELINE_WIN_MARGIN.  (Analytic, not the measured wall: a
+        (walk_s, wire bytes, fetch_s, chunks, device_lanes,
+        digest_chunks, giant = largest chunk).  Cost model per K:
+
+            device_s = device_lanes * _DEVICE_S_PER_LANE  (measured ns)
+            fixed    = max(rtt, (fetch_s - wire_s - device_s) / chunks)
+            degrade  = (giant/c)^0.3 on dedup-sensitive passes (digest
+                       or weighted: uniques — wire AND device lanes —
+                       grow as chunks shrink); 1 for pure words mode
+            W(K)     = max(walk, K*fixed + (device_s + wire_s)*degrade)
+                       + fixed + (device_s + wire_s)*degrade / K
+
+        The argmin K wins if it beats the ANALYTIC serial baseline
+        walk + wire_s + device_s + chunks*fixed by
+        _PIPELINE_WIN_MARGIN.  (Analytic, not the measured wall: a
         first pass's wall is usually compile-contaminated, and electing
         against it would flip every shape to pipelined.)  No profile,
         short streams, or unmeasurable passes elect nothing.
@@ -1776,8 +1795,13 @@ class TpuBatchedStorage(RateLimitStorage):
         walk = tot["walk_s"]
         wire_s = tot["wire"] / max(rate, 1.0)
         chunks = max(tot.get("chunks", 1), 1)
-        fixed = max(rtt, (tot.get("fetch_s", 0.0) - wire_s) / chunks)
-        serial_pred = walk + wire_s + chunks * fixed
+        # Device step seconds for the whole pass (K-independent for a
+        # given mode split) — charged explicitly; the residual per-fetch
+        # fixed cost floors at the round trip.
+        device_s = tot.get("device_lanes", 0) * _DEVICE_S_PER_LANE
+        fixed = max(rtt,
+                    (tot.get("fetch_s", 0.0) - wire_s - device_s) / chunks)
+        serial_pred = walk + wire_s + device_s + chunks * fixed
         if cur is None:
             if len(self._chunk_plans) >= 128:
                 # Bound the cache.  Keep LOCKED (reverted) plans — wiping
@@ -1800,13 +1824,24 @@ class TpuBatchedStorage(RateLimitStorage):
                                       "ref": round(serial_pred, 4),
                                       "passes": 1}
             return
+        # Dedup degradation applies to passes whose costs scale with
+        # UNIQUES — digest mode (wire and device lanes are per-unique)
+        # and the weighted relay (per-unique words + layout share).
+        # Pure words-mode relay wire is linear in requests: chunking
+        # costs nothing there.
+        dedup_sensitive = (tot.get("digest_chunks", 0) * 2 > chunks
+                           or key[0] == "weighted")
         best = None
         for k in _PIPELINE_KS:
             c = -(-n // k)
             if c < _RELAY_CHUNK:
                 continue
-            degrade = (max(tot["giant"] / c, 1.0)) ** _DEDUP_DEGRADE_EXP
-            w = max(walk, k * fixed + wire_s * degrade) + fixed
+            degrade = ((max(tot["giant"] / c, 1.0)) ** _DEDUP_DEGRADE_EXP
+                       if dedup_sensitive else 1.0)
+            per_pass = (device_s + wire_s) * degrade
+            chain = k * fixed + per_pass
+            tail = fixed + per_pass / k
+            w = max(walk, chain) + tail
             if best is None or w < best[0]:
                 best = (w, int(c))
         if best is not None and best[0] < _PIPELINE_WIN_MARGIN * serial_pred:
@@ -1834,7 +1869,8 @@ class TpuBatchedStorage(RateLimitStorage):
         plan = self._chunk_plans.get(plan_key)
         pipelined = plan is not None and plan["kind"] == "pipelined"
         tot = {"walk_s": 0.0, "wire": 0.0, "giant": _RELAY_CHUNK,
-               "fetch_s": 0.0, "chunks": 0}
+               "fetch_s": 0.0, "chunks": 0, "device_lanes": 0,
+               "digest_chunks": 0}
 
         def timed_assign(s0, cnt):
             ta = time.perf_counter()
