@@ -132,6 +132,35 @@ def main() -> None:
             "checksum": checksum,
         }
 
+    # Digest counts step, slot-SORTED (presorted dense block sweep) vs
+    # unsorted (XLA per-index scatter) — the r4 sorted-digest change's
+    # on-device verdict.  One uword per unique, count 1; slots fixed per
+    # chain (strided ascending for sorted, a fixed permutation for
+    # unsorted — HBM has no cache to warm either way).
+    uslots_sorted = np.arange(B, dtype=np.uint32) * (num_slots // B)
+    uslots_shuf = np.random.default_rng(9).permutation(
+        uslots_sorted).astype(np.uint32)
+
+    def digest_chain(slots_np, sorted_flag):
+        uw = jnp.asarray((slots_np << np.uint32(rb + 1))
+                         | np.uint32(1 << 1))
+
+        def make(K):
+            def run(packed, now0):
+                def body(i, carry):
+                    packed, acc = carry
+                    packed, counts = relay.tb_relay_counts(
+                        packed, tarr, uw, lid_dev, now0 + i,
+                        rank_bits=rb, out_dtype=jnp.uint8,
+                        slots_sorted=sorted_flag)
+                    return packed, acc + jnp.sum(
+                        counts.astype(jnp.int64))
+                packed, acc = jax.lax.fori_loop(0, K, body,
+                                                (packed, jnp.int64(0)))
+                return packed, acc
+            return jax.jit(run, donate_argnums=0)
+        return make
+
     from ratelimiter_tpu.ops.pallas import block_scatter, solver
 
     out = {
@@ -141,10 +170,14 @@ def main() -> None:
         "rtt_ms": round(rtt_s * 1000, 1),
         "relay": measure(relay_chain, eng.tb_packed),
     }
-    # flat chain starts from fresh state (the relay chain donated eng's).
+    # Later chains start from fresh state (prior chains donated theirs).
     from ratelimiter_tpu.ops.token_bucket import make_tb_packed
 
     out["flat_weighted"] = measure(flat_chain, make_tb_packed(num_slots))
+    out["digest_sorted"] = measure(digest_chain(uslots_sorted, True),
+                                   make_tb_packed(num_slots))
+    out["digest_unsorted"] = measure(digest_chain(uslots_shuf, False),
+                                     make_tb_packed(num_slots))
     print(json.dumps(out))
 
 

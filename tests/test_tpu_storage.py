@@ -325,3 +325,26 @@ def test_chunk_plan_election_logic():
     st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "pipelined"
     st.close()
+
+
+def test_link_probe_and_profile_reset():
+    """probe_link measures once and feeds the storage profile with a
+    bandwidth that cannot be the broken-probe floor clamp, and setting
+    a new profile clears cached chunk plans (they were elected for the
+    old link)."""
+    from ratelimiter_tpu.utils.link import PROBE_BYTES
+
+    st = TpuBatchedStorage(num_slots=256)
+    prof = st.probe_link()
+    # The probe clamps up_s to >= 1e-6 s; a measurement AT the clamp
+    # (PROBE_BYTES / 1e-6) means the timing collapsed — treat as broken.
+    assert st._link_profile == prof
+    assert 0 < prof[0] < PROBE_BYTES / 1e-6
+    assert 0 < prof[1] < 60.0  # a round trip measured, under a minute
+    st._chunk_plans[("relay", "ints", "tb", False, 4096)] = {
+        "kind": "pipelined", "chunk": 512, "ref": 1.0,
+        "giant_wall": 1.2, "passes": 0, "best": None}
+    st.set_link_profile(1e9, 0.001)
+    assert st._link_profile == (1e9, 0.001)
+    assert st._chunk_plans == {}
+    st.close()
