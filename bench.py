@@ -272,7 +272,10 @@ def main() -> None:
     log(f"scenario 2: TB Zipf over {num_keys} keys, {n_requests} reqs/pass...")
 
     tb_cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
-    storage = TpuBatchedStorage(num_slots=max(num_keys * 2, 1 << 16))
+    from ratelimiter_tpu.ops.pallas.block_scatter import align_slots
+
+    storage = TpuBatchedStorage(num_slots=align_slots(
+        max(num_keys * 2, 1 << 16)))
     set_link(storage)
     tb_limiter = TokenBucketRateLimiter(storage, tb_cfg, MeterRegistry())
 
@@ -409,7 +412,8 @@ def main() -> None:
     num_keys3 = 50_000 if small else 10_000_000
     n3 = super_n * (2 if small else 4)
     log(f"scenario 3: SW uniform over {num_keys3} keys (stream)...")
-    storage3 = TpuBatchedStorage(num_slots=max(int(num_keys3 * 1.25), 1 << 16))
+    storage3 = TpuBatchedStorage(
+        num_slots=align_slots(max(int(num_keys3 * 1.25), 1 << 16)))
     set_link(storage3)
     sw3 = SlidingWindowRateLimiter(
         storage3,
@@ -442,7 +446,8 @@ def main() -> None:
             refill_rate=float(5 + i % 20)))
          for i in range(n_tenants)], dtype=np.int64)
     storage4 = TpuBatchedStorage(
-        engine=DeviceEngine(num_slots=max(n_tenants * 8, 1 << 16), table=table))
+        engine=DeviceEngine(num_slots=align_slots(max(n_tenants * 8, 1 << 16)),
+                            table=table))
     tenant_of_req = rng.integers(0, n_tenants, size=n4)
     # ~8 user keys per tenant, per-request tenant policy.
     keys4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
@@ -498,7 +503,8 @@ def main() -> None:
     num_keys5 = 20_000 if small else 1_000_000
     n5 = super_n * (2 if small else 3)
     log(f"scenario 5: burst batch-acquire over {num_keys5} keys...")
-    storage5 = TpuBatchedStorage(num_slots=max(num_keys5 * 2, 1 << 16))
+    storage5 = TpuBatchedStorage(num_slots=align_slots(
+        max(num_keys5 * 2, 1 << 16)))
     set_link(storage5)
     tb5 = TokenBucketRateLimiter(
         storage5,

@@ -62,7 +62,9 @@ def main() -> None:
         perms = None
         slots = int(num_keys * 1.25)
 
-    st = TpuBatchedStorage(num_slots=max(slots, 1 << 16))
+    from ratelimiter_tpu.ops.pallas.block_scatter import align_slots
+
+    st = TpuBatchedStorage(num_slots=align_slots(max(slots, 1 << 16)))
     lid = st.register_limiter(algo, cfg)
     if not args.no_plan:
         prof = st.probe_link()

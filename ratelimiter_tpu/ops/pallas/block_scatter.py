@@ -190,6 +190,15 @@ def scatter_rows_presorted(state, sorted_slots, write_mask, rows,
         return _windowed_call(state, key, rows.T, interpret)
 
 
+def align_slots(n: int) -> int:
+    """Smallest multiple of the block size T at or above ``n`` — the
+    num_slots alignment that lets the dense sweeps engage (supported()
+    requires state rows %% T == 0).  Benchmarks and deployments that
+    want the presorted digest path should size their tables with
+    this."""
+    return -(-int(n) // T) * T
+
+
 def supported(state_shape, batch: int) -> bool:
     """Static geometry gate: aligned table, window-coverable batch."""
     try:

@@ -115,6 +115,15 @@ _DEDUP_DEGRADE_EXP = 0.3
 # passes underestimates, and the argmin picks more chunks than the
 # dispatch overhead can pay for.
 _DEVICE_S_PER_LANE = 60e-9
+# Digest counts step per unique, slot-SORTED through the dense presorted
+# block sweep (24.6 ns measured) vs unsorted XLA scatter (52.2 ns) —
+# bench/device_only.py `digest_sorted`/`digest_unsorted`.  With a link
+# profile set, the words-vs-digest mode election compares TOTAL
+# per-request cost (wire seconds + device seconds) instead of wire bytes
+# alone: on fast links the digest's cheaper device step wins even at
+# u/n ratios where its wire cost loses.
+_DEVICE_S_PER_UNIQUE_SORTED = 25e-9
+_DEVICE_S_PER_UNIQUE_UNSORTED = 52e-9
 
 # Weighted relay: longest rank-major permit matrix the scan step accepts.
 # A chunk whose deepest segment exceeds this (heavy duplication — Zipf
@@ -143,6 +152,25 @@ def _bucket_fine(n: int, floor: int = 4096) -> int:
 
 def _wall_clock_ms() -> int:
     return time.time_ns() // 1_000_000
+
+
+def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
+                       digest_bpu: float, words_bpr: float,
+                       srt_ok: bool) -> bool:
+    """Words-vs-digest election for one chunk.  With a link profile the
+    comparison is TOTAL per-side seconds (wire + device, the digest
+    device rate depending on whether the slot-sorted sweep engages);
+    without one it falls back to wire bytes alone.  cdt presence is the
+    caller's gate."""
+    if link_profile is not None:
+        rate = max(link_profile[0], 1.0)
+        dev_u = (_DEVICE_S_PER_UNIQUE_SORTED if srt_ok
+                 else _DEVICE_S_PER_UNIQUE_UNSORTED)
+        dig_cost = (u * (digest_bpu / rate + dev_u)
+                    + (8 * n_delta / _DELTA_AMORT) / rate)
+        words_cost = cn * (words_bpr / rate + _DEVICE_S_PER_LANE)
+        return dig_cost <= words_cost
+    return digest_bpu * u + 8 * n_delta / _DELTA_AMORT <= words_bpr * cn
 
 
 def _presorted_scatter_usable(eng, algo: str, padded: int) -> bool:
@@ -749,28 +777,32 @@ class TpuBatchedStorage(RateLimitStorage):
                             fresh = ~known[uslots]
                         from ratelimiter_tpu.parallel.sharded import _bucket as _bkt
                         n_delta = _bkt(max(int(fresh.sum()), 1), floor=8)
-                    digest = cdt is not None and (
-                        digest_bpu * u + 8 * n_delta / _DELTA_AMORT
-                        <= words_bpr * cn)
+                    # One sorted-eligibility verdict drives BOTH the
+                    # mode election's device rate and the dispatch path
+                    # below — they must never disagree.
+                    srt_ok = (u >= _SORT_UNIQUES_MIN
+                              and _presorted_scatter_usable(
+                                  eng, algo, _bucket_pow2(u)))
+                    digest = cdt is not None and _elect_digest_mode(
+                        self._link_profile, u, cn, n_delta, digest_bpu,
+                        words_bpr, srt_ok)
                     now = self._monotonic_now()
                     t0 = time.perf_counter()
                     if digest:
                         # Slot-sorted digest: the C index sorts the uniques
                         # in place (uidx remapped — reconstruction is order-
                         # agnostic) so the device write is a dense sweep.
+                        # srt_ok (shared with the election above) already
+                        # gates on the sweep actually engaging — on the
+                        # XLA fallback the scatter is order-blind and the
+                        # sort would be pure overhead.
                         srt = False
-                        if u >= _SORT_UNIQUES_MIN:
-                            # Only pay the host sort when the presorted
-                            # device sweep can actually engage — on the
-                            # XLA fallback the scatter is order-blind and
-                            # the sort would be pure overhead.
+                        if srt_ok:
                             from ratelimiter_tpu.engine.native_index import (
                                 sort_uniques,
                             )
 
-                            if _presorted_scatter_usable(eng, algo,
-                                                         _bucket_pow2(u)):
-                                srt = sort_uniques(uwords, rb, uidx)
+                            srt = sort_uniques(uwords, rb, uidx)
                         size = _bucket_pow2(u)
                         uw = _pad_tail(uwords, size, 0xFFFFFFFF, np.uint32)
                         if multi_lid:
@@ -835,7 +867,10 @@ class TpuBatchedStorage(RateLimitStorage):
                 tot["wire"] += wire_b
                 tot["giant"] = max(tot["giant"], cn)
                 tot["chunks"] += 1
-                tot["device_lanes"] += u if digest else cn
+                tot["device_s"] += (
+                    u * (_DEVICE_S_PER_UNIQUE_SORTED if srt
+                         else _DEVICE_S_PER_UNIQUE_UNSORTED)
+                    if digest else cn * _DEVICE_S_PER_LANE)
                 if digest:
                     tot["digest_chunks"] += 1
                 if rec is not None:
@@ -1055,7 +1090,7 @@ class TpuBatchedStorage(RateLimitStorage):
                 tot["wire"] += wire_b
                 tot["giant"] = max(tot["giant"], cn)
                 tot["chunks"] += 1
-                tot["device_lanes"] += cn  # scan work ~ request lanes
+                tot["device_s"] += cn * _DEVICE_S_PER_LANE  # scan ~ lanes
                 if rec is not None:
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
                     rec["host_s"] = round(
@@ -1762,7 +1797,7 @@ class TpuBatchedStorage(RateLimitStorage):
         (walk_s, wire bytes, fetch_s, chunks, device_lanes,
         digest_chunks, giant = largest chunk).  Cost model per K:
 
-            device_s = device_lanes * _DEVICE_S_PER_LANE  (measured ns)
+            device_s = per-chunk-accumulated measured device seconds
             fixed    = max(rtt, (fetch_s - wire_s - device_s) / chunks)
             degrade  = (giant/c)^0.3 on dedup-sensitive passes (digest
                        or weighted: uniques — wire AND device lanes —
@@ -1795,10 +1830,10 @@ class TpuBatchedStorage(RateLimitStorage):
         walk = tot["walk_s"]
         wire_s = tot["wire"] / max(rate, 1.0)
         chunks = max(tot.get("chunks", 1), 1)
-        # Device step seconds for the whole pass (K-independent for a
-        # given mode split) — charged explicitly; the residual per-fetch
-        # fixed cost floors at the round trip.
-        device_s = tot.get("device_lanes", 0) * _DEVICE_S_PER_LANE
+        # Device step seconds for the whole pass (accumulated per chunk
+        # at the measured per-mode rates) — charged explicitly; the
+        # residual per-fetch fixed cost floors at the round trip.
+        device_s = tot.get("device_s", 0.0)
         fixed = max(rtt,
                     (tot.get("fetch_s", 0.0) - wire_s - device_s) / chunks)
         serial_pred = walk + wire_s + device_s + chunks * fixed
@@ -1869,7 +1904,7 @@ class TpuBatchedStorage(RateLimitStorage):
         plan = self._chunk_plans.get(plan_key)
         pipelined = plan is not None and plan["kind"] == "pipelined"
         tot = {"walk_s": 0.0, "wire": 0.0, "giant": _RELAY_CHUNK,
-               "fetch_s": 0.0, "chunks": 0, "device_lanes": 0,
+               "fetch_s": 0.0, "chunks": 0, "device_s": 0.0,
                "digest_chunks": 0}
 
         def timed_assign(s0, cnt):

@@ -348,3 +348,32 @@ def test_link_probe_and_profile_reset():
     assert st._link_profile == (1e9, 0.001)
     assert st._chunk_plans == {}
     st.close()
+
+
+def test_rate_aware_mode_election():
+    """_elect_digest_mode: on fast links the sorted digest's cheaper
+    device step wins even where its wire cost loses; on slow links wire
+    dominates and the verdict matches the bytes-only fallback."""
+    from ratelimiter_tpu.storage.tpu import _elect_digest_mode
+
+    dig_bpu, words_bpr = 6.0, 4.125
+    u, cn = 900_000, 1_000_000  # u/n = 0.9: wire alone says words
+    assert not _elect_digest_mode(None, u, cn, 0, dig_bpu, words_bpr,
+                                  True)  # bytes-only fallback: words
+    # 85 MB/s, sorted sweep engaged: device savings flip it to digest.
+    assert _elect_digest_mode((85e6, 0.1), u, cn, 0, dig_bpu, words_bpr,
+                              True)
+    # Same link but the sweep can't engage (unsorted 52 ns): words.
+    assert not _elect_digest_mode((85e6, 0.1), u, cn, 0, dig_bpu,
+                                  words_bpr, False)
+    # 5 MB/s: wire dominates; digest only wins with real dedup.
+    assert not _elect_digest_mode((5e6, 0.1), u, cn, 0, dig_bpu,
+                                  words_bpr, True)
+    assert _elect_digest_mode((5e6, 0.1), cn // 3, cn, 0, dig_bpu,
+                              words_bpr, True)
+    # Multi-lid costs (10 B/unique vs 8.125 B/request) with the delta
+    # charge: dedup-poor chunks stay words, dedup-rich ones go digest.
+    assert not _elect_digest_mode((5e6, 0.1), 950_000, cn, 950_000, 10.0,
+                                  8.125, True)
+    assert _elect_digest_mode((5e6, 0.1), cn // 3, cn, cn // 3, 10.0,
+                              8.125, True)
