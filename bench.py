@@ -98,14 +98,16 @@ def main() -> None:
         so the logged link and the elected plans cannot disagree."""
         from ratelimiter_tpu.utils.link import measure_link
 
-        up_bps, rtt_s = measure_link()
+        up_bps, rtt_s, down_bps = measure_link()
         return {"round_trip_ms": round(rtt_s * 1000, 1),
-                "upload_4mb_mbps": round(up_bps / (1 << 20), 1)}
+                "upload_4mb_mbps": round(up_bps / (1 << 20), 1),
+                "download_4mb_mbps": round(down_bps / (1 << 20), 1)}
 
     detail_link = link_probe() if platform == "tpu" else None
     if detail_link:
         log(f"link: rtt {detail_link['round_trip_ms']} ms, "
-            f"upload {detail_link['upload_4mb_mbps']} MB/s")
+            f"upload {detail_link['upload_4mb_mbps']} MB/s, "
+            f"download {detail_link['download_4mb_mbps']} MB/s")
 
     from ratelimiter_tpu import RateLimitConfig
     from ratelimiter_tpu.algorithms import (
@@ -170,6 +172,17 @@ def main() -> None:
                                      default=0.0), 4),
             "wire_bytes": int(sum(r.get("wire_bytes", 0) for r in stats)),
         }
+        # r5: drains run CONCURRENTLY, so the honest fetch wall-clock
+        # figure is the SPAN of fetch activity, not the sum of per-chunk
+        # blocking times (which can exceed the wall under overlap).
+        ats = [r["fetch_at"] for r in stats if r.get("fetch_at")]
+        if ats:
+            agg["fetch_span_s"] = round(
+                max(a[1] for a in ats) - min(a[0] for a in ats), 4)
+        for extra in ("rebuild_s", "dispatch_s"):
+            tot = sum(r.get(extra, 0) for r in stats)
+            if tot:
+                agg[extra] = round(tot, 4)
         modes: dict = {}
         for r in stats:
             m = r.get("mode", "?")
@@ -199,7 +212,8 @@ def main() -> None:
         if detail_link:
             storage.set_link_profile(
                 detail_link["upload_4mb_mbps"] * (1 << 20),
-                detail_link["round_trip_ms"] / 1000.0)
+                detail_link["round_trip_ms"] / 1000.0,
+                detail_link["download_4mb_mbps"] * (1 << 20))
 
     def run_stream(go, key_ids, permits, reps, storage, warmed=False):
         """Full untimed warmup pass (visits every chunk shape the growth

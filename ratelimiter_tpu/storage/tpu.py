@@ -88,26 +88,38 @@ _DELTA_AMORT = 4
 # best when the whole pass is a handful of dispatches.
 _RELAY_WIRE_BUDGET_WEIGHTED = 48 << 20
 
-# Link-adaptive pipelining (VERDICT r3 #1): candidate chunk counts for
-# splitting a stream pass so the prefetched walk of chunk k+1 and the
-# eager-drained fetch of chunk k genuinely overlap.  Giant chunks
-# maximize dedup and win when wire dominates; when walk and fetch are
-# comparable they serialize the pass into their SUM.  The first pass
-# over a stream shape runs the giant growth schedule and measures
-# (walk seconds, wire bytes, fetch seconds, wall); _elect_chunk_plan
-# then predicts the pipelined wall for each K —
-#   max(walk, K * per_fetch_fixed + wire * degrade(K) / rate) + tail
-# — and elects the argmin K when it beats the measured giant wall by
-# _PIPELINE_WIN_MARGIN.  Dedup worsens as chunks shrink; degrade(K) =
-# (giant_chunk / c)^0.3 overestimates that cost (measured Zipf(1.1)
-# u/c scaling is ~c^-0.2), erring toward giant chunks.  A pipelined
-# pass that measures clearly worse than the giant pass it replaced
-# (> _PIPELINE_REVERT x) reverts — sticky both ways, so chunk shapes
-# stay deterministic across timed passes (ROUND_NOTES r3).
-_PIPELINE_KS = (8, 6, 4, 3, 2)
-_PIPELINE_WIN_MARGIN = 0.9
+# Link-adaptive pipelining (VERDICT r3 #1, reworked r5).  The dev
+# tunnel's execution model, measured (bench/profile_stream_r5.py +
+# ROUND_NOTES r5): dispatch enqueue is async and uploads of QUEUED
+# dispatches stream back-to-back, but every result fetch is its own
+# ~RTT round trip — and concurrent fetches from separate threads
+# overlap (3 chunk cycles: 688 ms fetched serially, 295 ms fetched
+# concurrently).  So the loop drains every dispatch CONCURRENTLY on a
+# small pool (the fetch wait sleeps — it does not spin — so the C walk
+# keeps the core), and a pipelined plan is a descending SCHEDULE of
+# chunk sizes: a small head chunk gets the link flowing early, big
+# middle chunks keep dedup strong, and a small tail chunk shrinks the
+# only fetch cycle nothing can hide (the last one).  Chunk sizes stay
+# pow2-aligned where the dispatch pads to pow2 (words mode pads the
+# request lane; digest pads the unique lane) so schedule chunks don't
+# ship padding.  _elect_chunk_plan ranks candidate schedules with a
+# small discrete-event simulation fed by the giant pass's measured
+# walk/host rates and dedup curve; a schedule that measures clearly
+# worse than the giant pass it replaced (> _PIPELINE_REVERT x)
+# reverts — sticky both ways, so chunk shapes stay deterministic
+# across timed passes (ROUND_NOTES r3).
+_PIPELINE_WIN_MARGIN = 0.97
 _PIPELINE_REVERT = 1.1
-_DEDUP_DEGRADE_EXP = 0.3
+# Per-dispatch transfers move at a fraction of the bulk device_put
+# rate the link probe measures (2.6 MB moved in ~85 ms against a
+# 77 MB/s bulk probe — protocol overhead per dispatch cycle).  The
+# simulator derates the probed rate by this; ranking is insensitive
+# to the exact value.
+_DISPATCH_RATE_DERATE = 0.55
+# Concurrent in-flight drains: enough to overlap every mid-schedule
+# fetch cycle, small enough to bound queued result buffers.
+_DRAIN_WORKERS = 4
+_DRAIN_INFLIGHT = 4
 # Device step cost per dispatched lane (words/weighted: per request;
 # digest: per unique) — measured on this v5e by bench/device_only.py
 # (~58 ns/lane, ROUND_NOTES r4).  The election charges it explicitly:
@@ -156,21 +168,246 @@ def _wall_clock_ms() -> int:
 
 def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
                        digest_bpu: float, words_bpr: float,
-                       srt_ok: bool) -> bool:
+                       srt_ok: bool, cdt_size: int = 1) -> bool:
     """Words-vs-digest election for one chunk.  With a link profile the
-    comparison is TOTAL per-side seconds (wire + device, the digest
-    device rate depending on whether the slot-sorted sweep engages);
-    without one it falls back to wire bytes alone.  cdt presence is the
+    comparison is TOTAL per-side seconds — wire charged PER DIRECTION
+    (digest uploads 4 B/unique but downloads a cdt_size count per
+    unique, words uploads 4 B/request but downloads 1 BIT per request;
+    on a download-degraded tunnel that asymmetry decides high-u/n
+    chunks — r5) plus device seconds (the digest rate depending on
+    whether the slot-sorted sweep engages).  Without a profile it falls
+    back to the blended wire-byte constants.  cdt presence is the
     caller's gate."""
     if link_profile is not None:
-        rate = max(link_profile[0], 1.0)
+        up = max(link_profile[0], 1.0)
+        down = max(link_profile[2], 1.0) if len(link_profile) > 2 else up
         dev_u = (_DEVICE_S_PER_UNIQUE_SORTED if srt_ok
                  else _DEVICE_S_PER_UNIQUE_UNSORTED)
-        dig_cost = (u * (digest_bpu / rate + dev_u)
-                    + (8 * n_delta / _DELTA_AMORT) / rate)
-        words_cost = cn * (words_bpr / rate + _DEVICE_S_PER_LANE)
+        # digest_bpu/words_bpr carry the blended per-lane bytes (incl.
+        # the multi-tenant lid lane when not resident); split out the
+        # known download component and charge it at the download rate.
+        dig_cost = (u * ((digest_bpu - cdt_size) / up + cdt_size / down
+                         + dev_u)
+                    + (8 * n_delta / _DELTA_AMORT) / up)
+        words_cost = cn * ((words_bpr - 0.125) / up + 0.125 / down
+                           + _DEVICE_S_PER_LANE)
         return dig_cost <= words_cost
     return digest_bpu * u + 8 * n_delta / _DELTA_AMORT <= words_bpr * cn
+
+
+# Host-side cost of the slot re-sort a sorted-digest dispatch needs
+# (native rl_sort_uniques; ~48 ns/unique measured at 2.7M uniques on
+# the bench host, r5).  The sort buys DEVICE time (52 -> 25 ns/unique,
+# ROUND_NOTES r4) — worth real host CPU only where the device is on
+# the critical path or host CPU is idle anyway.
+_SORT_HOST_S_PER_UNIQUE = 50e-9
+
+
+def _sort_affordable(link_profile, u: int) -> bool:
+    """Whether to spend host CPU slot-sorting a digest chunk's uniques.
+
+    ``RATELIMITER_SORT_UNIQUES=always|never|auto`` (default auto, read
+    per call so tests and config reloads take effect immediately): on
+    a multi-core host the sort overlaps other cores' work, and with no
+    link profile the device is assumed local-attached (device time is
+    the scarce resource) — sort.  On a single-core host with a
+    profiled link, the chunk's upload seconds (4 B/unique / rate) must
+    comfortably exceed the sort's host seconds (~50 ns/unique) — both
+    sides scale with u, so this reduces to a ~40 MB/s link threshold:
+    below it the pass is wire-bound and the host idles through the
+    sort anyway; above it the pass is CPU-bound and the device pays
+    the unsorted scatter instead — that time rides under the link wait
+    (r5: scenario 3 spent 0.9 s/pass sorting to save device time that
+    was never on the critical path)."""
+    import os
+
+    policy = os.environ.get("RATELIMITER_SORT_UNIQUES", "auto")
+    if policy == "always":
+        return True
+    if policy == "never":
+        return False
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    if cores > 2 or link_profile is None:
+        return True
+    rate = max(link_profile[0], 1.0)
+    return 4.0 / rate > 2.0 * _SORT_HOST_S_PER_UNIQUE
+
+
+class _DrainSet:
+    """In-flight drain tracker: every dispatched chunk's drain is
+    submitted to the storage's drain pool IMMEDIATELY, so the ~RTT-sized
+    fetch cycles of consecutive chunks overlap instead of serializing
+    (measured on the dev tunnel: 3 chunk cycles fetched serially
+    688 ms, concurrently 295 ms — the fetch wait sleeps, it does not
+    spin, so the C walk keeps the core).  ``finish()`` blocks until
+    every drain has landed and re-raises the first drain error;
+    ``finish(swallow=True)`` is for paths already propagating a primary
+    exception (drain errors are then secondary)."""
+
+    __slots__ = ("_pool", "_futs", "_inflight")
+
+    def __init__(self, pool, inflight: int = _DRAIN_INFLIGHT):
+        self._pool = pool
+        self._futs: list = []
+        self._inflight = inflight
+
+    def submit(self, fn, *args) -> None:
+        self._futs.append(self._pool.submit(fn, *args))
+        # Backpressure: bound queued result buffers (and tunnel credit)
+        # by waiting out the oldest live drain past the cap.
+        live = [f for f in self._futs if not f.done()]
+        if len(live) > self._inflight:
+            live[0].result()
+
+    def finish(self, swallow: bool = False) -> None:
+        err = None
+        for f in self._futs:
+            try:
+                f.result()
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                if err is None:
+                    err = exc
+        self._futs.clear()
+        if err is not None and not swallow:
+            raise err
+
+
+class _ChunkCursor:
+    """Chunk sizing shared by the relay and weighted streaming loops:
+    either walks a plan's fixed SCHEDULE (the last entry sizes any
+    overflow when a longer stream reuses a banded plan) or runs the
+    mutable growth chunk.  ``next_size`` consumes an entry; ``peek``
+    sizes the prefetch for the following chunk without consuming."""
+
+    __slots__ = ("sched", "chunk", "ci")
+
+    def __init__(self, plan, pipelined: bool):
+        self.sched = plan.get("schedule") if pipelined else None
+        self.chunk = (plan["chunk"] if pipelined and not self.sched
+                      else _RELAY_CHUNK)
+        self.ci = 0
+
+    def _cur(self) -> int:
+        if self.sched:
+            return (self.sched[self.ci] if self.ci < len(self.sched)
+                    else self.sched[-1])
+        return self.chunk
+
+    def next_size(self, remaining: int) -> int:
+        c = min(self._cur(), remaining)
+        if self.sched:
+            self.ci += 1
+        return c
+
+    def peek(self, remaining: int) -> int:
+        return min(self._cur(), remaining)
+
+    def grow(self, chunk: int) -> None:
+        self.chunk = chunk
+
+
+def _schedule_candidates(n: int, head: int, words_pow2: bool) -> list:
+    """Candidate chunk schedules for a pipelined stream pass.
+
+    Shape: small HEAD chunk (the link starts moving after one cheap
+    walk), big MIDDLE chunks (dedup and per-dispatch overhead
+    amortize), small descending TAIL (the last fetch cycle is the only
+    one nothing can hide — make it cheap).  All sizes are pow2 when
+    ``words_pow2`` (the words dispatch pads its request lane to pow2 —
+    a non-pow2 chunk would ship up to 2x padding); digest chunks pad
+    the UNIQUE lane instead, so their sizes are free-form."""
+    floor = _RELAY_CHUNK
+    if n < 4 * floor:
+        return []
+    cands = []
+    # pow2 halving cascade: [head, biggest pow2 <= rest, halving...].
+    # Chunks respect the growth path's _RELAY_CHUNK_MAX lane ceiling,
+    # and a sub-floor remainder folds into its predecessor: the last
+    # entry also SIZES every overflow chunk when a longer stream in the
+    # same banded plan reuses this schedule — a tiny tail entry would
+    # make that overflow drain RTT-sized crumbs.
+    sizes = [head]
+    rem = n - head
+    while rem >= floor:
+        c = 1 << (int(rem).bit_length() - 1)
+        c = min(max(min(c, rem), floor), _RELAY_CHUNK_MAX)
+        sizes.append(int(c))
+        rem -= c
+    if rem > 0:
+        _fold_tail(sizes, int(rem))
+    cands.append(sizes)
+    if not words_pow2:
+        # two-big + tail: maximum dedup, still a cheap exposed tail.
+        tail = max(floor, n // 16)
+        mid = n - head - 2 * tail
+        if mid > 2 * floor:
+            half = (mid + 1) // 2
+            if half <= _RELAY_CHUNK_MAX:
+                cands.append([head, half, mid - half, tail, tail])
+        big = n - head - tail
+        if floor < big <= _RELAY_CHUNK_MAX:
+            cands.append([head, big, tail])
+    else:
+        # equal-pow2 middle: 2M-request chunks (the r4 words plans).
+        c = 4 * floor
+        sizes2 = [head]
+        rem = n - head
+        while rem >= c:
+            sizes2.append(c)
+            rem -= c
+        if rem > 0:
+            _fold_tail(sizes2, int(rem))
+        if len(sizes2) <= 40:
+            cands.append(sizes2)
+    return cands
+
+
+def _fold_tail(sizes: list, rem: int) -> None:
+    """Fold a sub-floor remainder into a schedule's last chunk — the
+    last entry also sizes every OVERFLOW chunk when a longer stream in
+    the same banded plan reuses the schedule, so it must never be an
+    RTT-sized crumb.  If the fold would push the chunk past the
+    _RELAY_CHUNK_MAX lane ceiling, split the total in half instead
+    (both halves >= the fold target > floor)."""
+    total = sizes[-1] + rem
+    if total <= _RELAY_CHUNK_MAX:
+        sizes[-1] = total
+    else:
+        sizes[-1] = total // 2
+        sizes.append(total - total // 2)
+
+
+def _sim_schedule_wall(sizes, *, cpu_per_req: float, digest_frac: float,
+                       dedup_a: float, dedup_alpha: float, bpu_up: float,
+                       bpu_down: float, words_up: float, link_up: float,
+                       link_down: float, rtt: float,
+                       dev_per_lane: float) -> float:
+    """Predicted wall for one schedule under the measured tunnel model:
+    CPU (walk + host prep) strictly serializes on one timeline, link
+    BYTES serialize on another (uploads of queued dispatches stream
+    back-to-back; concurrent drains overlap their RTTs), each chunk's
+    fetch completes one RTT after its step's wire has cleared.  Used to
+    RANK candidate schedules — absolute accuracy is not required, the
+    revert check (measured walls) is the safety net."""
+    t_cpu = 0.0
+    link_free = 0.0
+    done = 0.0
+    for c in sizes:
+        t_cpu += c * cpu_per_req
+        if digest_frac > 0.5:
+            u = min(c, dedup_a * (c ** dedup_alpha))
+            lanes = _bucket_pow2(max(int(u), 1))
+            up_b, down_b = bpu_up * lanes, bpu_down * lanes
+        else:
+            lanes = _bucket_pow2(int(c))
+            up_b, down_b = words_up * lanes, c / 8.0
+        start = max(t_cpu, link_free)
+        link_free = start + up_b / link_up + down_b / link_down
+        done = max(done, link_free + lanes * dev_per_lane + rtt)
+    return done
 
 
 def _presorted_scatter_usable(eng, algo: str, padded: int) -> bool:
@@ -704,7 +941,7 @@ class TpuBatchedStorage(RateLimitStorage):
         def clear(slots):
             self._clear_slots(algo, slots)
         out = np.empty(n, dtype=bool)
-        pending: list[tuple] = []
+        drains = _DrainSet(self._drain_pool())
 
         # Chunk plan (VERDICT r3 #1): the first pass over this stream
         # shape runs the wire-budget growth schedule and measures; later
@@ -724,10 +961,8 @@ class TpuBatchedStorage(RateLimitStorage):
         def drain(mode, handle, start, count, extra, t0, rec):
             tf0 = time.perf_counter()
             arr = np.asarray(handle)  # the one blocking fetch
-            dt_us = (time.perf_counter() - t0) * 1e6
-            tot["fetch_s"] += time.perf_counter() - tf0
-            if rec is not None:
-                rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+            tf1 = time.perf_counter()
+            dt_us = (tf1 - t0) * 1e6
             if mode == "bits":
                 got = np.unpackbits(arr)[:count].astype(bool)
             else:  # digest: reconstruct from per-unique allowed counts
@@ -736,14 +971,21 @@ class TpuBatchedStorage(RateLimitStorage):
                 uidx, rank, u = extra
                 got = relay_decide(arr[:u], uidx, rank)
             out[start:start + count] = got
-            self._record_dispatch(algo, count, int(got.sum()), dt_us)
+            n_allowed = int(got.sum())
+            with tot["_lock"]:
+                tot["fetch_s"] += tf1 - tf0
+                if rec is not None:
+                    rec["fetch_s"] = round(tf1 - tf0, 6)
+                    rec["fetch_at"] = [round(tf0 - t_pass0, 6),
+                                       round(tf1 - t_pass0, 6)]
+                self._record_dispatch(algo, count, n_allowed, dt_us)
 
-        chunk = plan["chunk"] if pipelined else _RELAY_CHUNK
+        cursor = _ChunkCursor(plan, pipelined)
         start = 0
         fut = None  # prefetched next-chunk assignment (holds pins)
         try:
             while start < n:
-                cn = min(chunk, n - start)
+                cn = cursor.next_size(n - start)
                 t_a0 = time.perf_counter()
                 if fut is not None:
                     uwords, uidx, rank, clears = fut.result()
@@ -781,12 +1023,15 @@ class TpuBatchedStorage(RateLimitStorage):
                     # mode election's device rate and the dispatch path
                     # below — they must never disagree.
                     srt_ok = (u >= _SORT_UNIQUES_MIN
+                              and _sort_affordable(self._link_profile, u)
                               and _presorted_scatter_usable(
                                   eng, algo, _bucket_pow2(u)))
                     digest = cdt is not None and _elect_digest_mode(
                         self._link_profile, u, cn, n_delta, digest_bpu,
-                        words_bpr, srt_ok)
+                        words_bpr, srt_ok,
+                        cdt_size=np.dtype(cdt).itemsize if cdt else 1)
                     now = self._monotonic_now()
+                    t_prep = time.perf_counter()
                     t0 = time.perf_counter()
                     if digest:
                         # Slot-sorted digest: the C index sorts the uniques
@@ -845,9 +1090,8 @@ class TpuBatchedStorage(RateLimitStorage):
                         else:
                             counts = counts_dispatch(uw, lid, now, cdt,
                                                      slots_sorted=srt)
-                        pending.append(
-                            ("digest", counts, start, cn, (uidx, rank, u), t0,
-                             rec))
+                        item = ("digest", counts, start, cn,
+                                (uidx, rank, u), t0, rec)
                     else:
                         size = _bucket_pow2(cn)
                         words = np.full(size, 0xFFFFFFFF, dtype=np.uint32)
@@ -856,56 +1100,67 @@ class TpuBatchedStorage(RateLimitStorage):
                             words[:cn] = rebuild_words(uwords, uidx, rank, rb)
                         lid_lane = lid if not multi_lid else _pad_tail(
                             l_chunk, size, 0, np.int32)
+                        if rec is not None:
+                            rec["rebuild_s"] = round(
+                                time.perf_counter() - t_prep, 6)
+                            t_prep = time.perf_counter()
                         bits = bits_dispatch(words, lid_lane, now)
-                        pending.append(("bits", bits, start, cn, None, t0, rec))
+                        item = ("bits", bits, start, cn, None, t0, rec)
+                    if rec is not None:
+                        rec["dispatch_s"] = round(
+                            time.perf_counter() - t_prep, 6)
                 # Grow the next chunk toward the wire budget at this chunk's
                 # measured bytes/request (skewed streams compact hard in
                 # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
                 # the fixed per-dispatch latency amortizes away).
                 wire_b = (digest_bpu * u + 8 * n_delta if digest
                           else words_bpr * cn)
-                tot["wire"] += wire_b
-                tot["giant"] = max(tot["giant"], cn)
-                tot["chunks"] += 1
-                tot["device_s"] += (
-                    u * (_DEVICE_S_PER_UNIQUE_SORTED if srt
-                         else _DEVICE_S_PER_UNIQUE_UNSORTED)
-                    if digest else cn * _DEVICE_S_PER_LANE)
-                if digest:
-                    tot["digest_chunks"] += 1
+                host_span = time.perf_counter() - t_a0 - t_assign
+                with tot["_lock"]:
+                    tot["wire"] += wire_b
+                    tot["chunks"] += 1
+                    tot["host_s"] += host_span
+                    tot["cu"].append((int(cn), int(u)))
+                    tot["device_s"] += (
+                        u * (_DEVICE_S_PER_UNIQUE_SORTED if srt
+                             else _DEVICE_S_PER_UNIQUE_UNSORTED)
+                        if digest else cn * _DEVICE_S_PER_LANE)
+                    if digest:
+                        tot["digest_chunks"] += 1
+                        tot["bpu"] = digest_bpu
+                    else:
+                        tot["bpr"] = words_bpr
                 if rec is not None:
                     rec["mode"] = "digest" if digest else "bits"
                     rec["wire_bytes"] = int(wire_b)
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
-                    rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
-                                          6)
+                    rec["host_s"] = round(host_span, 6)
                 if not pipelined:
                     bpr = max(wire_b / cn, 1e-3)
                     budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
                               else _RELAY_WIRE_BUDGET_WORDS)
-                    chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
-                                    _RELAY_CHUNK_MAX))
+                    cursor.grow(int(min(max(budget / bpr, _RELAY_CHUNK),
+                                        _RELAY_CHUNK_MAX)))
                 start += cn
                 if start < n:
                     # Prefetch the next chunk's assignment on the worker: it
-                    # runs (GIL-free C walk) while the drains below block in
-                    # their (GIL-free) device fetches.
+                    # runs (GIL-free C walk) while this chunk's drain blocks
+                    # in its (GIL-free) fetch on the drain pool.
                     fut = self._assign_pool().submit(
-                        timed_assign, start, min(chunk, n - start))
-                # Pipelined plans drain EAGERLY while the next walk runs
-                # on the worker (both sides GIL-free): fetch k hides
-                # under walk k+1 instead of queuing to the pass tail.
-                while pending and (len(pending) > 2
-                                   or (pipelined and fut is not None)):
-                    drain(*pending.pop(0))
+                        timed_assign, start, cursor.peek(n - start))
+                # Concurrent drain: the fetch cycle of this chunk overlaps
+                # the next chunks' walks AND the other in-flight fetches'
+                # round trips (ROUND_NOTES r5: serial cycles 688 ms vs
+                # concurrent 295 ms for 3 chunks).
+                drains.submit(drain, *item)
+            drains.finish()  # propagate any drain error before returning
         finally:
             if fut is not None:
                 self._abort_prefetch(
                     algo, self._index[algo], fut,
                     lambda res: (res[0] >> np.uint32(rb + 1)).astype(
                         np.int32))
-        for item in pending:
-            drain(*item)
+            drains.finish(swallow=True)  # no-op on the normal path
         self._plan_finish(plan_key, plan, pipelined, n, tot, t_pass0)
         return out
 
@@ -939,15 +1194,13 @@ class TpuBatchedStorage(RateLimitStorage):
         # (1 << rank_bits) - 1, so deeper chunks must fall back.
         r_cap = min(_WREL_MAX_R, (1 << rb) - 1)
         out = np.empty(n, dtype=bool)
-        pending: list[tuple] = []
+        drains = _DrainSet(self._drain_pool())
 
         def drain(kind, handle, start, count, extra, t0, rec):
             tf0 = time.perf_counter()
             if kind == "weighted_native":
                 arr = np.ascontiguousarray(np.asarray(handle))
-                tot["fetch_s"] += time.perf_counter() - tf0
-                if rec is not None:
-                    rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+                tf1 = time.perf_counter()
                 from ratelimiter_tpu.engine.native_index import (
                     weighted_decide,
                 )
@@ -956,22 +1209,24 @@ class TpuBatchedStorage(RateLimitStorage):
                 got = weighted_decide(arr, roff, spos32, uidx, rank)
             elif kind == "weighted":
                 flat_bits = np.unpackbits(np.asarray(handle))
-                tot["fetch_s"] += time.perf_counter() - tf0
-                if rec is not None:
-                    rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+                tf1 = time.perf_counter()
                 pos = extra  # roff[rank] + spos per request
                 got = flat_bits[pos].astype(bool)
             else:  # flat-fallback slice
                 arr = np.asarray(handle)
-                tot["fetch_s"] += time.perf_counter() - tf0
-                if rec is not None:
-                    rec["fetch_s"] = round(
-                        rec.get("fetch_s", 0)
-                        + (time.perf_counter() - tf0), 6)
+                tf1 = time.perf_counter()
                 got = np.unpackbits(arr)[:count].astype(bool)
             out[start:start + count] = got
             dt_us = (time.perf_counter() - t0) * 1e6
-            self._record_dispatch(algo, count, int(got.sum()), dt_us)
+            n_allowed = int(got.sum())
+            with tot["_lock"]:
+                tot["fetch_s"] += tf1 - tf0
+                if rec is not None:
+                    rec["fetch_s"] = round(
+                        rec.get("fetch_s", 0) + (tf1 - tf0), 6)
+                    rec["fetch_at"] = [round(tf0 - t_pass0, 6),
+                                       round(tf1 - t_pass0, 6)]
+                self._record_dispatch(algo, count, n_allowed, dt_us)
 
         # Chunk plan election — same machinery as _stream_relay (first
         # pass measures at the growth schedule; later passes may run a
@@ -981,12 +1236,12 @@ class TpuBatchedStorage(RateLimitStorage):
         plan, pipelined, tot, timed_assign, t_pass0 = self._plan_setup(
             plan_key, assign_uniques)
 
-        chunk = plan["chunk"] if pipelined else _RELAY_CHUNK
+        cursor = _ChunkCursor(plan, pipelined)
         start = 0
         fut = None  # prefetched next-chunk assignment (holds pins)
         try:
             while start < n:
-                cn = min(chunk, n - start)
+                cn = cursor.next_size(n - start)
                 t_a0 = time.perf_counter()
                 if fut is not None:
                     uwords, uidx, rank, clears = fut.result()
@@ -1039,9 +1294,9 @@ class TpuBatchedStorage(RateLimitStorage):
                                            uw_pad, spos32, roff, perms_rank):
                             handle = dispatch(uw_pad, perms_rank, roff, lid,
                                               now, r_b)
-                            pending.append(("weighted_native", handle, start,
-                                            cn, (roff, spos32, uidx, rank),
-                                            t0, rec))
+                            drains.submit(
+                                drain, "weighted_native", handle, start,
+                                cn, (roff, spos32, uidx, rank), t0, rec)
                         else:
                             counts = ((uwords >> np.uint32(1))
                                       & np.uint32((1 << rb) - 1)).astype(
@@ -1061,8 +1316,8 @@ class TpuBatchedStorage(RateLimitStorage):
                             perms_rank[pos] = p_chunk
                             handle = dispatch(uw_pad, perms_rank, roff, lid,
                                               now, r_b)
-                            pending.append(("weighted", handle, start, cn,
-                                            pos, t0, rec))
+                            drains.submit(drain, "weighted", handle, start,
+                                          cn, pos, t0, rec)
                         wire_b = (4 * u_b + len(perms_rank)
                                   + len(perms_rank) // 8)
                         if rec is not None:
@@ -1081,41 +1336,41 @@ class TpuBatchedStorage(RateLimitStorage):
                             p_pad = _pad_tail(p_chunk[off:off + sl], size, 1,
                                               np.uint8)
                             bits = flat_dispatch(s_pad, lid, p_pad, now)
-                            pending.append(("flat", bits, start + off, sl,
-                                            None, t0, rec))
+                            drains.submit(drain, "flat", bits, start + off,
+                                          sl, None, t0, rec)
                         wire_b = 5.0 * cn
                         if rec is not None:
                             rec["mode"] = "flat_fb"
                             rec["wire_bytes"] = int(wire_b)
-                tot["wire"] += wire_b
-                tot["giant"] = max(tot["giant"], cn)
-                tot["chunks"] += 1
-                tot["device_s"] += cn * _DEVICE_S_PER_LANE  # scan ~ lanes
+                host_span = time.perf_counter() - t_a0 - t_assign
+                with tot["_lock"]:
+                    tot["wire"] += wire_b
+                    tot["chunks"] += 1
+                    tot["host_s"] += host_span
+                    tot["cu"].append((int(cn), int(u)))
+                    tot["bpr"] = wire_b / max(cn, 1)
+                    tot["device_s"] += cn * _DEVICE_S_PER_LANE  # scan ~ lanes
                 if rec is not None:
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
-                    rec["host_s"] = round(
-                        time.perf_counter() - t_a0 - t_assign, 6)
+                    rec["host_s"] = round(host_span, 6)
                 if not pipelined:
                     bpr = max(wire_b / cn, 1e-3)
-                    chunk = int(min(max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
-                                        _RELAY_CHUNK), _RELAY_CHUNK_MAX))
+                    cursor.grow(int(min(
+                        max(_RELAY_WIRE_BUDGET_WEIGHTED / bpr,
+                            _RELAY_CHUNK), _RELAY_CHUNK_MAX)))
                 start += cn
                 if start < n:
                     # Prefetch the next chunk's assignment (see _stream_relay).
                     fut = self._assign_pool().submit(
-                        timed_assign, start, min(chunk, n - start))
-                # Eager drains under a pipelined plan (see _stream_relay).
-                while pending and (len(pending) > 2
-                                   or (pipelined and fut is not None)):
-                    drain(*pending.pop(0))
+                        timed_assign, start, cursor.peek(n - start))
+            drains.finish()  # propagate any drain error before returning
         finally:
             if fut is not None:
                 self._abort_prefetch(
                     algo, index, fut,
                     lambda res: (res[0] >> np.uint32(rb + 1)).astype(
                         np.int32))
-        for item in pending:
-            drain(*item)
+            drains.finish(swallow=True)  # no-op on the normal path
         self._plan_finish(plan_key, plan, pipelined, n, tot, t_pass0)
         return out
 
@@ -1167,22 +1422,25 @@ class TpuBatchedStorage(RateLimitStorage):
             p_dtype = np.uint8
 
         out = np.empty(n, dtype=bool)
-        # (start, count, bits, dispatch_t0, rec) per in-flight super-batch
-        pending: list[tuple] = []
+        drains = _DrainSet(self._drain_pool())
+        rec_lock = threading.Lock()
 
         def drain(handle, start, count, t0, rec):
             tf0 = time.perf_counter()
             arr = np.asarray(handle)  # the one blocking fetch
-            dt_us = (time.perf_counter() - t0) * 1e6
-            if rec is not None:
-                rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+            tf1 = time.perf_counter()
+            dt_us = (tf1 - t0) * 1e6
             if k_scan:  # uint8[k, cap//8]
                 got = np.unpackbits(arr, axis=1).reshape(-1)[:count]
                 got = got.astype(bool)
             else:  # uint8[super_n//8]
                 got = np.unpackbits(arr)[:count].astype(bool)
             out[start:start + count] = got
-            self._record_dispatch(algo, count, int(got.sum()), dt_us)
+            n_allowed = int(got.sum())
+            with rec_lock:
+                if rec is not None:
+                    rec["fetch_s"] = round(tf1 - tf0, 6)
+                self._record_dispatch(algo, count, n_allowed, dt_us)
 
         fut = None  # prefetched next-chunk assignment (holds pins)
         try:
@@ -1234,23 +1492,22 @@ class TpuBatchedStorage(RateLimitStorage):
                 if rec is not None:
                     rec["host_s"] = round(time.perf_counter() - t_a0 - t_assign,
                                           6)
-                pending.append((start, cn, bits, t0, rec))
                 nxt = start + super_n
                 if nxt < n:
                     # Prefetch the next super-batch's assignment (see
                     # _stream_relay).
                     fut = self._assign_pool().submit(
                         assign, nxt, min(super_n, n - nxt))
-                if len(pending) > 1:
-                    s0, c0, h0, pt0, r0 = pending.pop(0)
-                    drain(h0, s0, c0, pt0, r0)
+                # Concurrent drain (see _stream_relay): the fetch cycle
+                # overlaps later super-batches' walks and fetches.
+                drains.submit(drain, bits, start, cn, t0, rec)
+            drains.finish()  # propagate any drain error before returning
         finally:
             if fut is not None:
                 self._abort_prefetch(
                     algo, self._index[algo], fut,
                     lambda res: np.asarray(res[0], dtype=np.int32))
-        for s0, c0, h0, pt0, r0 in pending:
-            drain(h0, s0, c0, pt0, r0)
+            drains.finish(swallow=True)  # no-op on the normal path
         return out
 
     def acquire_stream_strs(
@@ -1770,54 +2027,54 @@ class TpuBatchedStorage(RateLimitStorage):
     # Link-adaptive chunk planning (VERDICT r3 #1)
     # ------------------------------------------------------------------------
     def set_link_profile(self, upload_bytes_per_s: float,
-                         rtt_s: float) -> None:
+                         rtt_s: float,
+                         download_bytes_per_s: float | None = None) -> None:
         """Tell the streaming loops what the host<->device link measures
         (bench probes it; a service can call :meth:`probe_link`).  Clears
-        cached chunk plans — they were elected for the old link."""
-        self._link_profile = (float(upload_bytes_per_s), float(rtt_s))
+        cached chunk plans — they were elected for the old link.  The
+        download rate defaults to the upload rate when the caller only
+        probed one direction; the dev tunnel degrades the two
+        independently, so callers that CAN probe both should."""
+        self._link_profile = (float(upload_bytes_per_s), float(rtt_s),
+                              float(download_bytes_per_s
+                                    if download_bytes_per_s is not None
+                                    else upload_bytes_per_s))
         self._chunk_plans.clear()
 
-    def probe_link(self) -> Tuple[float, float]:
+    def probe_link(self) -> Tuple[float, float, float]:
         """Measure the link (utils/link.py — the same probe the bench
-        logs) and feed :meth:`set_link_profile`.  ~0.5-1 s on a healthy
+        logs) and feed :meth:`set_link_profile`.  ~1-1.5 s on a healthy
         link; callers gate it (boot, or a periodic health task)."""
         from ratelimiter_tpu.utils.link import measure_link
 
-        up_bps, rtt_s = measure_link()
-        self.set_link_profile(up_bps, rtt_s)
+        up_bps, rtt_s, down_bps = measure_link()
+        self.set_link_profile(up_bps, rtt_s, down_bps)
         return self._link_profile
 
     def _elect_chunk_plan(self, key: tuple, n: int, tot: dict,
                           wall_s: float) -> None:
         """End-of-first-pass election for a stream shape: keep giant
         chunks (wire-budget growth), or switch later passes to a fixed
-        K-way split that overlaps fetches with walks.
+        descending SCHEDULE of chunk sizes.
 
         ``tot`` holds this pass's measured totals at the giant schedule
-        (walk_s, wire bytes, fetch_s, chunks, device_lanes,
-        digest_chunks, giant = largest chunk).  Cost model per K:
-
-            device_s = per-chunk-accumulated measured device seconds
-            fixed    = max(rtt, (fetch_s - wire_s - device_s) / chunks)
-            degrade  = (giant/c)^0.3 on dedup-sensitive passes (digest
-                       or weighted: uniques — wire AND device lanes —
-                       grow as chunks shrink); 1 for pure words mode
-            W(K)     = max(walk, K*fixed + (device_s + wire_s)*degrade)
-                       + fixed + (device_s + wire_s)*degrade / K
-
-        The argmin K wins if it beats the ANALYTIC serial baseline
-        walk + wire_s + device_s + chunks*fixed by
-        _PIPELINE_WIN_MARGIN.  (Analytic, not the measured wall: a
-        first pass's wall is usually compile-contaminated, and electing
-        against it would flip every shape to pipelined.)  No profile,
-        short streams, or unmeasurable passes elect nothing.
+        (walk_s + host_s -> the pass's serial CPU rate, wire bytes,
+        per-chunk (c, u) pairs -> the dedup curve, digest_chunks ->
+        which mode the pass ran).  Candidate schedules from
+        :func:`_schedule_candidates` are ranked by
+        :func:`_sim_schedule_wall` under the measured tunnel model
+        (concurrent drains overlap fetch round trips; link bytes
+        serialize; CPU serializes); the best wins if it beats the
+        simulated giant baseline by _PIPELINE_WIN_MARGIN.  The revert
+        check (measured pipelined walls vs the giant pass's measured
+        wall) remains the safety net for simulator error.
 
         A GIANT verdict stays provisional for a few passes: the first
-        pass of a fresh storage compiles inside its fetches, inflating
-        the per-fetch fixed cost and wrongly electing giant — later
-        (clean) giant passes re-elect.  A pipelined verdict is sticky,
-        and a plan reverted by _maybe_revert_plan is locked giant, so
-        the plan cannot oscillate."""
+        pass of a fresh storage compiles inside its fetches and walks
+        insert-heavy — later (clean) giant passes re-elect.  A
+        pipelined verdict is sticky, and a plan reverted by
+        _maybe_revert_plan is locked giant, so the plan cannot
+        oscillate."""
         cur = self._chunk_plans.get(key)
         if cur is not None and (cur["kind"] != "giant" or cur.get("locked")
                                 or cur.get("passes", 0) >= 3):
@@ -1826,17 +2083,13 @@ class TpuBatchedStorage(RateLimitStorage):
             return
         if n < (_RELAY_CHUNK << 2) or tot["walk_s"] <= 0:
             return
-        rate, rtt = self._link_profile
-        walk = tot["walk_s"]
-        wire_s = tot["wire"] / max(rate, 1.0)
+        prof = self._link_profile
+        up, rtt = prof[0], prof[1]
+        down = prof[2] if len(prof) > 2 else up
         chunks = max(tot.get("chunks", 1), 1)
-        # Device step seconds for the whole pass (accumulated per chunk
-        # at the measured per-mode rates) — charged explicitly; the
-        # residual per-fetch fixed cost floors at the round trip.
-        device_s = tot.get("device_s", 0.0)
-        fixed = max(rtt,
-                    (tot.get("fetch_s", 0.0) - wire_s - device_s) / chunks)
-        serial_pred = walk + wire_s + device_s + chunks * fixed
+        wire_s = tot["wire"] / max(up, 1.0)
+        serial_pred = (tot["walk_s"] + tot.get("host_s", 0.0) + wire_s
+                       + tot.get("device_s", 0.0) + chunks * rtt)
         if cur is None:
             if len(self._chunk_plans) >= 128:
                 # Bound the cache.  Keep LOCKED (reverted) plans — wiping
@@ -1859,34 +2112,68 @@ class TpuBatchedStorage(RateLimitStorage):
                                       "ref": round(serial_pred, 4),
                                       "passes": 1}
             return
-        # Dedup degradation applies to passes whose costs scale with
-        # UNIQUES — digest mode (wire and device lanes are per-unique)
-        # and the weighted relay (per-unique words + layout share).
-        # Pure words-mode relay wire is linear in requests: chunking
-        # costs nothing there.
-        dedup_sensitive = (tot.get("digest_chunks", 0) * 2 > chunks
-                           or key[0] == "weighted")
+        digest_frac = tot.get("digest_chunks", 0) / chunks
+        # Dedup curve u = A * c^alpha fitted from the growth schedule's
+        # most separated (chunk, uniques) pairs; digest wire AND device
+        # lanes scale with uniques, so schedules with more chunks pay
+        # A * sum(c_i^alpha) > A * n^alpha and the simulator sees it.
+        cu = [p for p in tot.get("cu", []) if p[0] > 0 and p[1] > 0]
+        alpha, a_fit = 1.0, 1.0
+        if len(cu) >= 2:
+            (c1, u1) = cu[0]
+            (c2, u2) = max(cu, key=lambda p: p[0])
+            if c2 > c1 * 1.5:
+                import math
+
+                alpha = min(max(math.log(max(u2, 1) / max(u1, 1))
+                                / math.log(c2 / c1), 0.55), 1.0)
+            a_fit = u2 / (c2 ** alpha)
+        elif cu:
+            a_fit = cu[0][1] / float(cu[0][0])
+        bpu_up = 8.0 if tot.get("bpu", 6.0) >= 10.0 else 4.0
+        bpu_down = 2.0 if tot.get("bpu", 6.0) >= 10.0 else 1.0
+        dev_lane = (_DEVICE_S_PER_UNIQUE_UNSORTED if digest_frac > 0.5
+                    else _DEVICE_S_PER_LANE)
+        if key[0] == "weighted" and cu:
+            # Weighted wire = 4 B/unique words + ~1.125 B/request permits
+            # and bits: express it per UNIQUE through the giant pass's
+            # request/unique ratio so the simulator's dedup curve (the
+            # per-unique share grows subadditively as chunks shrink)
+            # applies — the words branch would wrongly see splitting as
+            # wire-neutral.  Device cost is the per-request scan, also
+            # mapped per unique.
+            r_pu = max(cu[-1][0] / max(cu[-1][1], 1), 1.0)
+            digest_frac = 1.0
+            bpu_up = 4.0 + 1.125 * r_pu
+            bpu_down = 0.125 * r_pu
+            dev_lane = _DEVICE_S_PER_LANE * r_pu
+        sim_args = dict(
+            cpu_per_req=(tot["walk_s"] + tot.get("host_s", 0.0)) / n,
+            digest_frac=digest_frac, dedup_a=a_fit, dedup_alpha=alpha,
+            # blended 6 B/unique = 4 B uword up + count back (resident
+            # lids); blended 10 = uword + 4 B lid lane up + 2 B back.
+            bpu_up=bpu_up, bpu_down=bpu_down,
+            words_up=tot.get("bpr", 4.125) - 0.125,
+            link_up=max(up * _DISPATCH_RATE_DERATE, 1.0),
+            link_down=max(down * _DISPATCH_RATE_DERATE, 1.0), rtt=rtt,
+            dev_per_lane=dev_lane)
+        giant_sim = _sim_schedule_wall([_RELAY_CHUNK, n - _RELAY_CHUNK],
+                                       **sim_args)
         best = None
-        for k in _PIPELINE_KS:
-            c = -(-n // k)
-            if c < _RELAY_CHUNK:
-                continue
-            degrade = ((max(tot["giant"] / c, 1.0)) ** _DEDUP_DEGRADE_EXP
-                       if dedup_sensitive else 1.0)
-            per_pass = (device_s + wire_s) * degrade
-            chain = k * fixed + per_pass
-            tail = fixed + per_pass / k
-            w = max(walk, chain) + tail
+        for sizes in _schedule_candidates(n, _RELAY_CHUNK,
+                                          words_pow2=digest_frac <= 0.5):
+            w = _sim_schedule_wall(sizes, **sim_args)
             if best is None or w < best[0]:
-                best = (w, int(c))
-        if best is not None and best[0] < _PIPELINE_WIN_MARGIN * serial_pred:
-            # ref: the analytic baseline that justified the election.
+                best = (w, sizes)
+        if best is not None and best[0] < _PIPELINE_WIN_MARGIN * giant_sim:
+            # ref: the simulated baseline that justified the election.
             # giant_wall: the MEASURED wall of the (clean, steady) giant
-            # pass that elected — the revert check compares against this,
-            # not the analytic figure (whose per-fetch fixed cost is
-            # calibrated from lazy drains and underestimates; comparing
-            # against it wrongly reverted plans that beat the real giant).
-            self._chunk_plans[key] = {"kind": "pipelined", "chunk": best[1],
+            # pass that elected — the revert check compares against
+            # this, not the simulated figure (simulator error must not
+            # un-revert a plan the measurements rejected).
+            self._chunk_plans[key] = {"kind": "pipelined",
+                                      "schedule": tuple(best[1]),
+                                      "chunk": int(max(best[1])),
                                       "ref": round(serial_pred, 4),
                                       "giant_wall": round(wall_s, 4),
                                       "passes": 0, "best": None}
@@ -1903,9 +2190,9 @@ class TpuBatchedStorage(RateLimitStorage):
         (plan, pipelined, tot, timed_assign, t_pass0)."""
         plan = self._chunk_plans.get(plan_key)
         pipelined = plan is not None and plan["kind"] == "pipelined"
-        tot = {"walk_s": 0.0, "wire": 0.0, "giant": _RELAY_CHUNK,
-               "fetch_s": 0.0, "chunks": 0, "device_s": 0.0,
-               "digest_chunks": 0}
+        tot = {"walk_s": 0.0, "wire": 0.0, "fetch_s": 0.0, "chunks": 0,
+               "device_s": 0.0, "digest_chunks": 0, "host_s": 0.0,
+               "cu": [], "_lock": threading.Lock()}
 
         def timed_assign(s0, cnt):
             ta = time.perf_counter()
@@ -2105,7 +2392,8 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def close(self) -> None:
         self._batcher.close()
-        for attr in ("_shard_pool_obj", "_assign_pool_obj"):
+        for attr in ("_shard_pool_obj", "_assign_pool_obj",
+                     "_drain_pool_obj"):
             pool = getattr(self, attr, None)
             if pool is not None:
                 pool.shutdown(wait=False)
@@ -2145,6 +2433,20 @@ class TpuBatchedStorage(RateLimitStorage):
 
             pool = cf.ThreadPoolExecutor(1, thread_name_prefix="assignpf")
             self._assign_pool_obj = pool
+        return pool
+
+    def _drain_pool(self):
+        """Drain workers: device fetches block here CONCURRENTLY so
+        their per-fetch round trips overlap (see _DrainSet).  The fetch
+        wait sleeps in the runtime, so these threads cost no CPU beyond
+        the drains' own numpy post-processing."""
+        pool = getattr(self, "_drain_pool_obj", None)
+        if pool is None:
+            import concurrent.futures as cf
+
+            pool = cf.ThreadPoolExecutor(_DRAIN_WORKERS,
+                                         thread_name_prefix="drain")
+            self._drain_pool_obj = pool
         return pool
 
     def _shard_pool(self, n_sh: int):

@@ -277,29 +277,38 @@ def test_chunk_plan_pipelined_preserves_decisions(monkeypatch, weighted):
 
 
 def test_chunk_plan_election_logic():
-    """Synthetic election inputs: a walk-bound fast link elects a
-    pipelined split; a wire-bound slow link keeps giant chunks; a
-    pipelined pass measuring clearly worse reverts (sticky)."""
+    """Synthetic election inputs: a CPU-bound words pass elects a
+    pipelined schedule (its wire is linear in requests — splitting is
+    free and overlaps the fetch cycles); a wire-bound DIGEST pass with
+    strong dedup keeps giant chunks on a slow link (splitting inflates
+    the per-unique wire); a pipelined pass measuring clearly worse
+    reverts (sticky)."""
     st = TpuBatchedStorage(num_slots=1 << 12)
     n = 1 << 24
-    giant_tot = {"walk_s": 0.65, "wire": 4.7e6, "giant": n,
-                 "fetch_s": 0.28, "chunks": 2}
-    # Fast link (85 MB/s, 107 ms RTT): fetch chain hides under walks.
+    # Uniform words traffic: u ~ 0.9 n, wire 4.125 B/request.
+    giant_tot = {"walk_s": 1.6, "host_s": 0.4, "wire": 4.125 * n,
+                 "giant": n - (1 << 19), "fetch_s": 1.5, "chunks": 2,
+                 "digest_chunks": 0, "bpr": 4.125, "device_s": 1.0,
+                 "cu": [(1 << 19, 480_000), (n - (1 << 19), 14_800_000)]}
     # The FIRST measurement only records a provisional giant (fresh
     # shapes' first passes are insert- and compile-heavy); the second
     # elects for real.
-    st.set_link_profile(85e6, 0.107)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
+    st.set_link_profile(85e6, 0.107, 85e6)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 3.5)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 0.95)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, giant_tot, 3.5)
     plan = st._chunk_plans[("relay", "ints", "tb", False, n)]
     assert plan["kind"] == "pipelined" and plan["chunk"] >= 1 << 19, plan
-    # Wire-bound (5 MB/s, walk nearly free): splitting only degrades
-    # dedup and adds round trips — giant stays.
-    st.set_link_profile(5e6, 0.107)
-    slow_tot = dict(giant_tot, walk_s=0.05, fetch_s=1.1)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 1.2)
-    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 1.2)
+    assert sum(plan["schedule"]) >= n, plan  # schedule covers the stream
+    # Wire-bound slow-link DIGEST pass with strong dedup (u ~ c^0.6):
+    # splitting multiplies the per-unique upload — giant stays.
+    st.set_link_profile(5e6, 0.107, 5e6)
+    slow_tot = {"walk_s": 0.05, "host_s": 0.02, "wire": 8.1e6,
+                "giant": n - (1 << 19), "fetch_s": 3.0, "chunks": 2,
+                "digest_chunks": 2, "bpu": 6.0, "device_s": 0.07,
+                "cu": [(1 << 19, 150_000), (n - (1 << 19), 1_200_000)]}
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 3.2)
+    st._elect_chunk_plan(("relay", "ints", "tb", False, n), n, slow_tot, 3.2)
     assert st._chunk_plans[("relay", "ints", "tb", False, n)]["kind"] == "giant"
     # Revert: pipelined passes clearly worse than the serial baseline
     # (first pass alone is NOT enough — it pays the new shapes' compiles).
@@ -345,7 +354,7 @@ def test_link_probe_and_profile_reset():
         "kind": "pipelined", "chunk": 512, "ref": 1.0,
         "giant_wall": 1.2, "passes": 0, "best": None}
     st.set_link_profile(1e9, 0.001)
-    assert st._link_profile == (1e9, 0.001)
+    assert st._link_profile == (1e9, 0.001, 1e9)  # down defaults to up
     assert st._chunk_plans == {}
     st.close()
 
