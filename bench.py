@@ -109,6 +109,14 @@ def main() -> None:
             f"upload {detail_link['upload_4mb_mbps']} MB/s, "
             f"download {detail_link['download_4mb_mbps']} MB/s")
 
+    # Device step rates the elections will run on: probed per (platform,
+    # device kind), disk-cached (engine/device_rates.py, VERDICT r4 #5) —
+    # recorded so the plan/mode decisions in this run are reproducible.
+    from ratelimiter_tpu.engine.device_rates import get_device_rates
+
+    detail["device_rates"] = get_device_rates()
+    log(f"device rates: {detail['device_rates']}")
+
     from ratelimiter_tpu import RateLimitConfig
     from ratelimiter_tpu.algorithms import (
         SlidingWindowRateLimiter,
@@ -179,7 +187,7 @@ def main() -> None:
         if ats:
             agg["fetch_span_s"] = round(
                 max(a[1] for a in ats) - min(a[0] for a in ats), 4)
-        for extra in ("rebuild_s", "dispatch_s"):
+        for extra in ("rebuild_s", "dispatch_s", "pack_s"):
             tot = sum(r.get(extra, 0) for r in stats)
             if tot:
                 agg[extra] = round(tot, 4)
@@ -308,11 +316,17 @@ def main() -> None:
         f"{res['best_pass_decisions_per_sec']:,.0f})")
 
     # String-key end-to-end (Python key handling included; streamed).
-    n_str = min(n_requests, 50_000 if small else 2_000_000)
+    # 4M requests (r5, was 2M): the string walk runs ~70 ns/request, so
+    # at 2M the pass was dominated by its fixed tail (final fetch round
+    # trip) and measured the link, not the path.
+    n_str = min(n_requests, 50_000 if small else 4_000_000)
     keys = [f"k{i}" for i in key_ids[:n_str]]
-    res = bench_end_to_end_stream(tb_limiter, keys, None)
+    res = bench_end_to_end_stream(tb_limiter, keys, None, storage=storage)
+    for p in res["passes"]:  # collapse raw chunk records to phase lanes
+        p["phase"] = _agg_stats(p.pop("stats"))
     detail["tb_1m_zipf_end_to_end_strs"] = res
-    log(f"  end-to-end (str keys): {res['decisions_per_sec']:,.0f} decisions/s")
+    log(f"  end-to-end (str keys): {res['decisions_per_sec']:,.0f} decisions/s"
+        f" (median pass {res['median_pass_decisions_per_sec']:,.0f})")
     storage.close()
 
     # -- scenario 1: single-key SW, 10 threads through the batcher -----------
@@ -625,6 +639,31 @@ def main() -> None:
         log(f"  sharded scaling failed: {exc}")
 
     detail["total_bench_seconds"] = time.time() - t_start
+
+    # Link-dependence record (VERDICT r4 #8): every stream scenario's
+    # median throughput alongside the link it ran on, so the headline's
+    # swing across rounds is attributable to the tunnel, not guessed.
+    # The link of record is the run's probe (plus any mid-scenario
+    # re-probe stored by run_stream as "relink").
+    if detail_link:
+        curve = []
+        for scen in ("tb_1m_zipf_stream_ids", "tb_1m_zipf_end_to_end_strs",
+                     "sw_10m_uniform_stream", "multi_tenant_100k_stream",
+                     "tb_burst_batch_stream"):
+            res = detail.get(scen)
+            if not isinstance(res, dict) or "error" in res:
+                continue
+            med = res.get("median_pass_decisions_per_sec",
+                          res.get("decisions_per_sec"))
+            curve.append({
+                "scenario": scen,
+                "upload_mbps": detail_link["upload_4mb_mbps"],
+                "download_mbps": detail_link["download_4mb_mbps"],
+                "rtt_ms": detail_link["round_trip_ms"],
+                "relink": res.get("relink"),
+                "median_dps": round(float(med), 1),
+            })
+        detail["link_curve"] = curve
 
     with open(os.path.join(_REPO, "BENCH_DETAIL.json"), "w") as fh:
         json.dump(detail, fh, indent=2)

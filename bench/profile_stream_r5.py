@@ -47,24 +47,25 @@ def main() -> None:
     B, K = 1 << 19, 8
     n = B * K * 4  # 16.7M, bench parity
 
-    def run(name, storage, limiter, key_ids):
+    def run(name, storage, limiter, key_ids, permits=None):
+        nn = len(key_ids)
         storage.set_link_profile(up_bps, rtt_s, down_bps)
         print(f"== {name}: warmup ==", flush=True)
         for i in range(4):
             t0 = time.perf_counter()
-            limiter.try_acquire_stream_ids(key_ids, None, batch=B,
+            limiter.try_acquire_stream_ids(key_ids, permits, batch=B,
                                            subbatches=K)
             print(f"  warm {i}: {time.perf_counter() - t0:.3f} s "
                   f"plans={storage._chunk_plans}", flush=True)
         for r in range(reps):
             storage.stream_stats = stats = []
             t0 = time.perf_counter()
-            limiter.try_acquire_stream_ids(key_ids, None, batch=B,
+            limiter.try_acquire_stream_ids(key_ids, permits, batch=B,
                                            subbatches=K)
             wall = time.perf_counter() - t0
             storage.stream_stats = None
             print(f"-- {name} pass {r}: wall {wall:.3f} s "
-                  f"({n / wall / 1e6:.2f} M/s)", flush=True)
+                  f"({nn / wall / 1e6:.2f} M/s)", flush=True)
             for rec in stats:
                 print("   " + json.dumps(rec), flush=True)
 
@@ -76,6 +77,42 @@ def main() -> None:
                             refill_rate=50.0),
             MeterRegistry())
         run("headline", storage, tb, zipf_stream(rng, 1_000_000, n))
+        storage.close()
+
+    if which in ("burst",):
+        storage = TpuBatchedStorage(num_slots=align_slots(2_000_000))
+        tb = TokenBucketRateLimiter(
+            storage,
+            RateLimitConfig(max_permits=100, window_ms=60_000,
+                            refill_rate=100.0),
+            MeterRegistry())
+        n5 = B * K * 3
+        perms = rng.integers(1, 101, size=n5).astype(np.int64)
+        run("burst", storage, tb,
+            uniform_stream(rng, 1_000_000, n5), perms)
+        storage.close()
+
+    if which in ("strs",):
+        storage = TpuBatchedStorage(num_slots=align_slots(2_000_000))
+        tb = TokenBucketRateLimiter(
+            storage,
+            RateLimitConfig(max_permits=100, window_ms=60_000,
+                            refill_rate=50.0),
+            MeterRegistry())
+        storage.set_link_profile(up_bps, rtt_s, down_bps)
+        ids = zipf_stream(rng, 1_000_000, 2_000_000)
+        keys = [f"k{i}" for i in ids]
+        tb.try_acquire_many(keys, None)  # warm shapes
+        for i in range(3):
+            storage.stream_stats = stats = []
+            t0 = time.perf_counter()
+            tb.try_acquire_many(keys, None)
+            wall = time.perf_counter() - t0
+            storage.stream_stats = None
+            print(f"  strs pass {i}: {len(keys) / wall / 1e6:.2f} M/s "
+                  f"(wall {wall:.3f} s)", flush=True)
+            for rec in stats:
+                print("   " + json.dumps(rec), flush=True)
         storage.close()
 
     if which in ("sc3", "both"):

@@ -203,6 +203,7 @@ class MicroBatcher:
 
     def _run(self) -> None:
         while True:
+            locked = False
             with self._cv:
                 while not self._closed:
                     now = time.monotonic()
@@ -217,14 +218,41 @@ class MicroBatcher:
                             remaining = self.max_delay_s - age
                             wait = remaining if wait is None else min(wait, remaining)
                     if ready:
-                        break
+                        # Deadline hit — but if a dispatch is mid-flight,
+                        # do NOT freeze the batch yet: a batch taken now
+                        # would sit waiting for the lock while new
+                        # arrivals start a fresh queue and pay a whole
+                        # extra dispatch cycle (the convoy behind the r4
+                        # SLO miss's ~1.6 ms of batcher-owned latency).
+                        # Keep accumulating and re-check shortly; the
+                        # take happens with the lock ALREADY HELD, so
+                        # the batch carries everything that arrived
+                        # during the previous step.
+                        if self._dispatch_lock.acquire(blocking=False):
+                            locked = True
+                            break
+                        # Floored: with max_delay_ms=0 an unfloored wait
+                        # would spin the cv at full speed for as long as
+                        # the in-flight dispatch holds the lock.
+                        self._cv.wait(timeout=max(
+                            min(self.max_delay_s, 3e-4), 5e-5))
+                        continue
                     self._cv.wait(timeout=wait)
                 if self._closed and not any(
                     p.born is not None for p in self._pending.values()
                 ):
+                    if locked:
+                        self._dispatch_lock.release()
                     return
                 taken = {a: self._take(a) for a in self._pending}
-            self._execute(taken)
+            try:
+                if locked:
+                    self._execute_locked(taken)
+                else:  # close() drained the cv loop: plain locked path
+                    self._execute(taken)
+            finally:
+                if locked:
+                    self._dispatch_lock.release()
 
     def close(self) -> None:
         with self._cv:

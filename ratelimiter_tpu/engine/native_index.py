@@ -292,8 +292,12 @@ def sort_uniques(uwords: np.ndarray, rank_bits: int,
     lib = _load_library()
     if lib is None:
         return False
-    assert uwords.flags["C_CONTIGUOUS"] and uwords.dtype == np.uint32
-    assert uidx.flags["C_CONTIGUOUS"] and uidx.dtype == np.int32
+    # Explicit precondition checks (NOT asserts: under `python -O` an
+    # assert vanishes and a non-contiguous or wrong-dtype array would
+    # hand the C sort a garbage pointer) — ADVICE r4.
+    if not (uwords.flags["C_CONTIGUOUS"] and uwords.dtype == np.uint32
+            and uidx.flags["C_CONTIGUOUS"] and uidx.dtype == np.int32):
+        return False  # caller dispatches unsorted, decisions unchanged
     lib.rl_sort_uniques(uwords.ctypes.data, len(uwords), int(rank_bits),
                         uidx.ctypes.data, len(uidx))
     return True
@@ -310,7 +314,9 @@ def rebuild_words_into(uwords: np.ndarray, uidx: np.ndarray,
     lib = _load_library()
     if lib is None:
         return False
-    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.uint32
+    # Explicit check, not an assert (see sort_uniques) — ADVICE r4.
+    if not (out.flags["C_CONTIGUOUS"] and out.dtype == np.uint32):
+        return False  # caller rebuilds via ops/relay.rebuild_words
     lib.rl_rebuild_words(uwords.ctypes.data, uidx.ctypes.data,
                          rank.ctypes.data, len(uidx), int(rank_bits),
                          out.ctypes.data)
@@ -335,9 +341,12 @@ def weighted_layout(uwords: np.ndarray, rank_bits: int, uidx: np.ndarray,
         uidx.ctypes.data, rank.ctypes.data, len(uidx),
         perms.ctypes.data, int(r_b), uw_sorted.ctypes.data,
         spos.ctypes.data, roff.ctypes.data, perms_rank.ctypes.data)
-    if rc != 0:
-        raise ValueError("weighted layout: segment count exceeds r_b")
-    return True
+    # rc != 0 = the C guard's own r_b ceiling (4096, slot_index.cpp)
+    # tripped.  Unreachable while _WREL_MAX_R (64) stays far below it,
+    # but if the cap is ever raised past 4096 the right behavior is the
+    # bit-identical numpy fallback, not a hard failure of the whole
+    # weighted pass — ADVICE r4.
+    return rc == 0
 
 
 def weighted_decide(bits: np.ndarray, roff: np.ndarray, spos: np.ndarray,
@@ -606,7 +615,15 @@ class NativeSlotIndex:
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
                                   pinned: Optional[Set[int]] = None,
                                   hold_pins: bool = False):
+        import time as _time
+
+        t_p0 = _time.perf_counter()
         packed, offs = _pack_str_keys(keys)
+        # Exposed for the stream loop's per-chunk phase lanes (pack vs
+        # hash+walk — VERDICT r4 #7); the caller reads it before it
+        # submits the next chunk's prefetch, so it always refers to the
+        # chunk just assigned.
+        self.str_pack_s = _time.perf_counter() - t_p0
         n = len(keys)
         uwords = np.empty(n, dtype=np.uint32)
         uidx = np.empty(n, dtype=np.int32)

@@ -121,21 +121,16 @@ _DISPATCH_RATE_DERATE = 0.55
 _DRAIN_WORKERS = 4
 _DRAIN_INFLIGHT = 4
 # Device step cost per dispatched lane (words/weighted: per request;
-# digest: per unique) — measured on this v5e by bench/device_only.py
-# (~58 ns/lane, ROUND_NOTES r4).  The election charges it explicitly:
-# without it the per-fetch fixed cost calibrated from lazy-drained giant
-# passes underestimates, and the argmin picks more chunks than the
-# dispatch overhead can pay for.
-_DEVICE_S_PER_LANE = 60e-9
-# Digest counts step per unique, slot-SORTED through the dense presorted
-# block sweep (24.6 ns measured) vs unsorted XLA scatter (52.2 ns) —
-# bench/device_only.py `digest_sorted`/`digest_unsorted`.  With a link
-# profile set, the words-vs-digest mode election compares TOTAL
-# per-request cost (wire seconds + device seconds) instead of wire bytes
-# alone: on fast links the digest's cheaper device step wins even at
-# u/n ratios where its wire cost loses.
-_DEVICE_S_PER_UNIQUE_SORTED = 25e-9
-_DEVICE_S_PER_UNIQUE_UNSORTED = 52e-9
+# digest: per unique, sorted vs unsorted scatter).  The elections
+# charge these explicitly; since r5 they are PROBED at runtime per
+# (platform, device kind) and disk-cached (engine/device_rates.py,
+# VERDICT r4 #5) — these module constants are only the v5e-measured
+# fallback for profile-less paths and failed probes.
+from ratelimiter_tpu.engine.device_rates import FALLBACK_RATES as _FB_RATES
+
+_DEVICE_S_PER_LANE = _FB_RATES["s_per_lane"]
+_DEVICE_S_PER_UNIQUE_SORTED = _FB_RATES["s_per_unique_sorted"]
+_DEVICE_S_PER_UNIQUE_UNSORTED = _FB_RATES["s_per_unique_unsorted"]
 
 # Weighted relay: longest rank-major permit matrix the scan step accepts.
 # A chunk whose deepest segment exceeds this (heavy duplication — Zipf
@@ -168,7 +163,8 @@ def _wall_clock_ms() -> int:
 
 def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
                        digest_bpu: float, words_bpr: float,
-                       srt_ok: bool, cdt_size: int = 1) -> bool:
+                       srt_ok: bool, cdt_size: int = 1,
+                       rates: dict | None = None) -> bool:
     """Words-vs-digest election for one chunk.  With a link profile the
     comparison is TOTAL per-side seconds — wire charged PER DIRECTION
     (digest uploads 4 B/unique but downloads a cdt_size count per
@@ -181,8 +177,10 @@ def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
     if link_profile is not None:
         up = max(link_profile[0], 1.0)
         down = max(link_profile[2], 1.0) if len(link_profile) > 2 else up
-        dev_u = (_DEVICE_S_PER_UNIQUE_SORTED if srt_ok
-                 else _DEVICE_S_PER_UNIQUE_UNSORTED)
+        if rates is None:
+            rates = _FB_RATES
+        dev_u = rates["s_per_unique_sorted" if srt_ok
+                      else "s_per_unique_unsorted"]
         # digest_bpu/words_bpr carry the blended per-lane bytes (incl.
         # the multi-tenant lid lane when not resident); split out the
         # known download component and charge it at the download rate.
@@ -190,7 +188,7 @@ def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
                          + dev_u)
                     + (8 * n_delta / _DELTA_AMORT) / up)
         words_cost = cn * ((words_bpr - 0.125) / up + 0.125 / down
-                           + _DEVICE_S_PER_LANE)
+                           + rates["s_per_lane"])
         return dig_cost <= words_cost
     return digest_bpu * u + 8 * n_delta / _DELTA_AMORT <= words_bpr * cn
 
@@ -957,6 +955,7 @@ class TpuBatchedStorage(RateLimitStorage):
                     _bucket_fine(n, floor=_RELAY_CHUNK))
         plan, pipelined, tot, timed_assign, t_pass0 = self._plan_setup(
             plan_key, assign_uniques)
+        rates = self._device_rates()
 
         def drain(mode, handle, start, count, extra, t0, rec):
             tf0 = time.perf_counter()
@@ -998,6 +997,11 @@ class TpuBatchedStorage(RateLimitStorage):
                 if self.stream_stats is not None:
                     rec = {"path": "relay", "n": int(cn), "u": int(u),
                            "assign_s": round(t_assign, 6)}
+                    if key_kind == "strs":
+                        pack_s = getattr(self._index[algo], "str_pack_s",
+                                         None)
+                        if pack_s is not None:
+                            rec["pack_s"] = round(pack_s, 6)
                     self.stream_stats.append(rec)
                 uslots_all = (uwords >> np.uint32(rb + 1)).astype(np.int32)
                 with self._pins_released(self._index[algo], uslots_all):
@@ -1029,7 +1033,8 @@ class TpuBatchedStorage(RateLimitStorage):
                     digest = cdt is not None and _elect_digest_mode(
                         self._link_profile, u, cn, n_delta, digest_bpu,
                         words_bpr, srt_ok,
-                        cdt_size=np.dtype(cdt).itemsize if cdt else 1)
+                        cdt_size=np.dtype(cdt).itemsize if cdt else 1,
+                        rates=rates)
                     now = self._monotonic_now()
                     t_prep = time.perf_counter()
                     t0 = time.perf_counter()
@@ -1122,9 +1127,9 @@ class TpuBatchedStorage(RateLimitStorage):
                     tot["host_s"] += host_span
                     tot["cu"].append((int(cn), int(u)))
                     tot["device_s"] += (
-                        u * (_DEVICE_S_PER_UNIQUE_SORTED if srt
-                             else _DEVICE_S_PER_UNIQUE_UNSORTED)
-                        if digest else cn * _DEVICE_S_PER_LANE)
+                        u * rates["s_per_unique_sorted" if srt
+                                  else "s_per_unique_unsorted"]
+                        if digest else cn * rates["s_per_lane"])
                     if digest:
                         tot["digest_chunks"] += 1
                         tot["bpu"] = digest_bpu
@@ -1235,6 +1240,7 @@ class TpuBatchedStorage(RateLimitStorage):
                     _bucket_fine(n, floor=_RELAY_CHUNK))  # banded, see relay
         plan, pipelined, tot, timed_assign, t_pass0 = self._plan_setup(
             plan_key, assign_uniques)
+        rates = self._device_rates()
 
         cursor = _ChunkCursor(plan, pipelined)
         start = 0
@@ -1349,7 +1355,7 @@ class TpuBatchedStorage(RateLimitStorage):
                     tot["host_s"] += host_span
                     tot["cu"].append((int(cn), int(u)))
                     tot["bpr"] = wire_b / max(cn, 1)
-                    tot["device_s"] += cn * _DEVICE_S_PER_LANE  # scan ~ lanes
+                    tot["device_s"] += cn * rates["s_per_lane"]  # scan~lanes
                 if rec is not None:
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
                     rec["host_s"] = round(host_span, 6)
@@ -1558,6 +1564,15 @@ class TpuBatchedStorage(RateLimitStorage):
         if oversize is not None:
             permits = np.where(oversize, 1, permits)
 
+        if isinstance(keys, list):
+            # A list slice already IS a fresh list — re-wrapping it in
+            # list() copied every chunk a second time (~7 ns/key).
+            def key_chunk(a, b):
+                return keys[a:b]
+        else:
+            def key_chunk(a, b):
+                return list(keys[a:b])
+
         if (permits is not None and oversize is None
                 and hasattr(index, "assign_batch_strs_uniques")
                 and permits.size
@@ -1570,7 +1585,7 @@ class TpuBatchedStorage(RateLimitStorage):
             def assign_uniques_w(start, chunk_n):
                 with self._evictions_cleared(algo):
                     return index.assign_batch_strs_uniques(
-                        list(keys[start:start + chunk_n]), lid, rb,
+                        key_chunk(start, start + chunk_n), lid, rb,
                         pinned=self._batcher.pending_slots(algo),
                         hold_pins=True)
 
@@ -1587,7 +1602,7 @@ class TpuBatchedStorage(RateLimitStorage):
             def assign_uniques(start, chunk_n):
                 with self._evictions_cleared(algo):
                     return index.assign_batch_strs_uniques(
-                        list(keys[start:start + chunk_n]), lid, rb,
+                        key_chunk(start, start + chunk_n), lid, rb,
                         pinned=self._batcher.pending_slots(algo),
                         hold_pins=True)
 
@@ -1597,7 +1612,7 @@ class TpuBatchedStorage(RateLimitStorage):
         def assign(start, chunk_n):
             with self._evictions_cleared(algo):
                 return index.assign_batch_strs(
-                    list(keys[start:start + chunk_n]), lid,
+                    key_chunk(start, start + chunk_n), lid,
                     pinned=self._batcher.pending_slots(algo), hold_pins=True)
 
         return self._stream_flat(algo, lid, assign, len(keys), permits,
@@ -1639,7 +1654,8 @@ class TpuBatchedStorage(RateLimitStorage):
             self._clear_slots(algo, slots)
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
-        pending: list = []
+        drains = _DrainSet(self._drain_pool())
+        rec_lock = threading.Lock()
 
         def drain(handle, start, cnt, shard, cols, b_loc, t0):
             arr = np.asarray(handle)  # uint8[n_sh, b_loc//8]
@@ -1647,106 +1663,120 @@ class TpuBatchedStorage(RateLimitStorage):
             bits = np.unpackbits(arr, axis=1)[:, :b_loc].astype(bool)
             got = bits[shard, cols]
             out[start:start + cnt] = got
-            self._record_dispatch(algo, cnt, int(got.sum()), dt_us)
+            n_allowed = int(got.sum())
+            with rec_lock:
+                self._record_dispatch(algo, cnt, n_allowed, dt_us)
 
         pool = self._shard_pool(n_sh)
-        for start in range(0, n, super_n):
-            chunk = key_ids[start:start + super_n]
-            cn = len(chunk)
-            clears: list = []
-            pins_by_shard: dict = {}
-            for g in self._batcher.pending_slots(algo):
-                pins_by_shard.setdefault(g // sps, set()).add(g % sps)
-            l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            # One routing pass (see _stream_relay_sharded); per-shard C
-            # calls run on the pool against contiguous slices.
-            shard, order, counts = _route_chunk(chunk, n_sh)
-            offs = np.zeros(n_sh + 1, dtype=np.int64)
-            np.cumsum(counts, out=offs[1:])
-            kst = chunk[order]
-            l_st = l_chunk[order] if multi_lid else None
+        try:
+            for start in range(0, n, super_n):
+                self._stream_sharded_chunk(
+                    algo, lid, key_ids, permits, oversize, index, multi_lid,
+                    lid_arr, start, super_n, n_sh, sps, pool, dispatch,
+                    clear, drains, drain)
+            drains.finish()
+        finally:
+            drains.finish(swallow=True)  # no-op on the normal path
+        return out
 
-            def assign_shard(s):
-                lo, hi = int(offs[s]), int(offs[s + 1])
-                if lo == hi:
-                    return None
-                sub = index._sub[s]
-                if multi_lid:
-                    return sub.assign_batch_ints_multi(
-                        kst[lo:hi], l_st[lo:hi],
-                        pinned=pins_by_shard.get(s), hold_pins=True)
-                return sub.assign_batch_ints(
-                    kst[lo:hi], lid, pinned=pins_by_shard.get(s),
-                    hold_pins=True)
+    def _stream_sharded_chunk(self, algo, lid, key_ids, permits, oversize,
+                              index, multi_lid, lid_arr, start, super_n,
+                              n_sh, sps, pool, dispatch, clear, drains,
+                              drain) -> None:
+        """One super-batch of the sharded FLAT stream (split out so the
+        loop in :meth:`_stream_sharded` can wrap drain lifetime cleanly)."""
+        chunk = key_ids[start:start + super_n]
+        cn = len(chunk)
+        clears: list = []
+        pins_by_shard: dict = {}
+        for g in self._batcher.pending_slots(algo):
+            pins_by_shard.setdefault(g // sps, set()).add(g % sps)
+        l_chunk = lid_arr[start:start + cn] if multi_lid else None
+        # One routing pass (see _stream_relay_sharded); per-shard C
+        # calls run on the pool against contiguous slices.
+        shard, order, counts = _route_chunk(chunk, n_sh)
+        offs = np.zeros(n_sh + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        kst = chunk[order]
+        l_st = l_chunk[order] if multi_lid else None
 
-            # Pins of successful shards accumulate in held as results are
-            # collected; the finally releases them on ANY raise (a leaked
-            # pin would make its slot permanently unevictable).
-            local_sorted = np.empty(cn, dtype=np.int32)
-            held: list = []
-            try:
-                futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
-                err = None
-                for s, f in enumerate(futs):
-                    try:
-                        r = f.result()
-                    except Exception as exc:  # noqa: BLE001
-                        err = err if err is not None else exc
-                        # Partial-failure lanes still evicted: globalize
-                        # into the pooled clears, cleared below.
-                        clears.extend(consume_pending_clears(exc, s * sps))
-                        continue
-                    if r is None:
-                        continue
-                    sl, ev = r
-                    local_sorted[offs[s]:offs[s + 1]] = sl
-                    held.append(s * sps + sl.astype(np.int64))
-                    clears.extend(s * sps + int(e) for e in ev)
-                if err is not None:
-                    # Successful shards' assignments are already in the
-                    # index: their evicted slots must be zeroed even
-                    # though no dispatch happens (ADVICE r3).
-                    if clears:
-                        clear(clears)
-                    raise err
+        def assign_shard(s):
+            lo, hi = int(offs[s]), int(offs[s + 1])
+            if lo == hi:
+                return None
+            sub = index._sub[s]
+            if multi_lid:
+                return sub.assign_batch_ints_multi(
+                    kst[lo:hi], l_st[lo:hi],
+                    pinned=pins_by_shard.get(s), hold_pins=True)
+            return sub.assign_batch_ints(
+                kst[lo:hi], lid, pinned=pins_by_shard.get(s),
+                hold_pins=True)
+
+        # Pins of successful shards accumulate in held as results are
+        # collected; the finally releases them on ANY raise (a leaked
+        # pin would make its slot permanently unevictable).
+        local_sorted = np.empty(cn, dtype=np.int32)
+        held: list = []
+        try:
+            futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
+            err = None
+            for s, f in enumerate(futs):
+                try:
+                    r = f.result()
+                except Exception as exc:  # noqa: BLE001
+                    err = err if err is not None else exc
+                    # Partial-failure lanes still evicted: globalize
+                    # into the pooled clears, cleared below.
+                    clears.extend(consume_pending_clears(exc, s * sps))
+                    continue
+                if r is None:
+                    continue
+                sl, ev = r
+                local_sorted[offs[s]:offs[s + 1]] = sl
+                held.append(s * sps + sl.astype(np.int64))
+                clears.extend(s * sps + int(e) for e in ev)
+            if err is not None:
+                # Successful shards' assignments are already in the
+                # index: their evicted slots must be zeroed even
+                # though no dispatch happens (ADVICE r3).
                 if clears:
                     clear(clears)
-                local = np.empty(cn, dtype=np.int32)
-                local[order] = local_sorted
-                # Column of each request within its shard row (arrival
-                # order — the stable per-slot segment order the flat step
-                # sorts by).
-                cols = np.empty(cn, dtype=np.int64)
-                cols[order] = np.arange(cn) - offs[shard[order]]
-                from ratelimiter_tpu.parallel.sharded import _bucket
+                raise err
+            if clears:
+                clear(clears)
+            local = np.empty(cn, dtype=np.int32)
+            local[order] = local_sorted
+            # Column of each request within its shard row (arrival
+            # order — the stable per-slot segment order the flat step
+            # sorts by).
+            cols = np.empty(cn, dtype=np.int64)
+            cols[order] = np.arange(cn) - offs[shard[order]]
+            from ratelimiter_tpu.parallel.sharded import _bucket
 
-                b_loc = _bucket(int(counts.max(initial=1)))
-                slots_mat = np.full((n_sh, b_loc), -1, dtype=np.int32)
-                slots_mat[shard, cols] = local
-                if oversize is not None:
-                    ov = oversize[start:start + cn]
-                    slots_mat[shard[ov], cols[ov]] = -1  # force-deny
-                lid_sb = lid
-                if multi_lid:
-                    lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
-                    lid_mat[shard, cols] = l_chunk
-                    lid_sb = lid_mat
-                p_sb = None
-                if permits is not None:
-                    p_mat = np.ones((n_sh, b_loc), dtype=np.int32)
-                    p_mat[shard, cols] = permits[start:start + cn]
-                    p_sb = p_mat
-                now = self._monotonic_now()
-                t0 = time.perf_counter()
-                bits = dispatch(slots_mat, lid_sb, p_sb, now)
-            finally:
-                self._unpin_held(index, held)
-            pending.append((bits, start, cn, shard, cols, b_loc, t0))
-            if len(pending) > 1:
-                drain(*pending.pop(0))
-        for item in pending:
-            drain(*item)
-        return out
+            b_loc = _bucket(int(counts.max(initial=1)))
+            slots_mat = np.full((n_sh, b_loc), -1, dtype=np.int32)
+            slots_mat[shard, cols] = local
+            if oversize is not None:
+                ov = oversize[start:start + cn]
+                slots_mat[shard[ov], cols[ov]] = -1  # force-deny
+            lid_sb = lid
+            if multi_lid:
+                lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                lid_mat[shard, cols] = l_chunk
+                lid_sb = lid_mat
+            p_sb = None
+            if permits is not None:
+                p_mat = np.ones((n_sh, b_loc), dtype=np.int32)
+                p_mat[shard, cols] = permits[start:start + cn]
+                p_sb = p_mat
+            now = self._monotonic_now()
+            t0 = time.perf_counter()
+            bits = dispatch(slots_mat, lid_sb, p_sb, now)
+        finally:
+            self._unpin_held(index, held)
+        # Concurrent drain (see _stream_relay): fetch cycles overlap.
+        drains.submit(drain, bits, start, cn, shard, cols, b_loc, t0)
 
     def _stream_relay_sharded(self, algo, lid, key_ids, index, multi_lid,
                               lid_arr) -> np.ndarray:
@@ -1778,14 +1808,16 @@ class TpuBatchedStorage(RateLimitStorage):
             self._clear_slots(algo, slots)
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
-        pending: list[tuple] = []
+        drains = _DrainSet(self._drain_pool())
+        rec_lock = threading.Lock()
 
         def drain(mode, handle, start, per_shard, t0, rec=None):
             tf0 = time.perf_counter()
             arr = np.asarray(handle)
             dt_us = (time.perf_counter() - t0) * 1e6
-            if rec is not None:
-                rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
+            with rec_lock:
+                if rec is not None:
+                    rec["fetch_s"] = round(time.perf_counter() - tf0, 6)
             cnt = alw = 0
             if mode == "digest":
                 from ratelimiter_tpu.engine.native_index import relay_decide
@@ -1806,173 +1838,178 @@ class TpuBatchedStorage(RateLimitStorage):
                     out[start + pos] = got
                     cnt += len(pos)
                     alw += int(got.sum())
-            self._record_dispatch(algo, cnt, alw, dt_us)
+            with rec_lock:
+                self._record_dispatch(algo, cnt, alw, dt_us)
 
         chunk = _RELAY_CHUNK
         start = 0
-        while start < n:
-            cn = min(chunk, n - start)
-            kchunk = key_ids[start:start + cn]
-            l_chunk = lid_arr[start:start + cn] if multi_lid else None
-            pins_by_shard: dict = {}
-            for g in self._batcher.pending_slots(algo):
-                pins_by_shard.setdefault(g // sps, set()).add(g % sps)
-            # One routing pass turns each shard's requests into a
-            # contiguous slice (still in arrival order): the C helper
-            # hashes + counting-sorts in O(n) (numpy fallback: splitmix
-            # hash + stable argsort, bit-identical); per-shard C calls
-            # then run on the pool — parallel probe walks on multi-core
-            # hosts, no O(n) mask scan per shard.
-            shard, order, scnt = _route_chunk(kchunk, n_sh)
-            soffs = np.zeros(n_sh + 1, dtype=np.int64)
-            np.cumsum(scnt, out=soffs[1:])
-            kst = kchunk[order]
-            l_st = l_chunk[order] if multi_lid else None
-            pool = self._shard_pool(n_sh)
+        try:
+            while start < n:
+                cn = min(chunk, n - start)
+                kchunk = key_ids[start:start + cn]
+                l_chunk = lid_arr[start:start + cn] if multi_lid else None
+                pins_by_shard: dict = {}
+                for g in self._batcher.pending_slots(algo):
+                    pins_by_shard.setdefault(g // sps, set()).add(g % sps)
+                # One routing pass turns each shard's requests into a
+                # contiguous slice (still in arrival order): the C helper
+                # hashes + counting-sorts in O(n) (numpy fallback: splitmix
+                # hash + stable argsort, bit-identical); per-shard C calls
+                # then run on the pool — parallel probe walks on multi-core
+                # hosts, no O(n) mask scan per shard.
+                shard, order, scnt = _route_chunk(kchunk, n_sh)
+                soffs = np.zeros(n_sh + 1, dtype=np.int64)
+                np.cumsum(scnt, out=soffs[1:])
+                kst = kchunk[order]
+                l_st = l_chunk[order] if multi_lid else None
+                pool = self._shard_pool(n_sh)
 
-            walk_by_shard = np.zeros(n_sh)
+                walk_by_shard = np.zeros(n_sh)
 
-            def assign_shard(s):
-                lo, hi = int(soffs[s]), int(soffs[s + 1])
-                if lo == hi:
-                    return None
-                sub = index._sub[s]
-                tw0 = time.perf_counter()
-                try:
-                    if multi_lid:
-                        return sub.assign_batch_ints_multi_uniques(
-                            kst[lo:hi], l_st[lo:hi], rb,
-                            pinned=pins_by_shard.get(s), hold_pins=True)
-                    return sub.assign_batch_ints_uniques(
-                        kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
-                        hold_pins=True)
-                finally:
-                    walk_by_shard[s] = time.perf_counter() - tw0
-
-            t_c0 = time.perf_counter()
-            results = []
-            clears: list = []
-            pin_glob: list = []
-            u_total = u_max = b_max = 0
-            # Pins of successful shards accumulate in pin_glob as results
-            # are collected; the finally releases them on ANY raise —
-            # including a partial assignment failure, whose successful
-            # siblings' results never reach a caller.
-            try:
-                futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
-                err = None
-                for s, f in enumerate(futs):
-                    pos = order[soffs[s]:soffs[s + 1]]
+                def assign_shard(s):
+                    lo, hi = int(soffs[s]), int(soffs[s + 1])
+                    if lo == hi:
+                        return None
+                    sub = index._sub[s]
+                    tw0 = time.perf_counter()
                     try:
-                        r = f.result()
-                    except Exception as exc:  # noqa: BLE001
-                        err = err if err is not None else exc
-                        # Partial-failure lanes still evicted: globalize
-                        # into the pooled clears, cleared below.
-                        clears.extend(consume_pending_clears(exc, s * sps))
-                        results.append((pos, None, None, 0, None))
-                        continue
-                    if r is None:
-                        results.append((pos, None, None, 0, None))
-                        continue
-                    uw, uidx, rank, ev = r
-                    clears.extend(s * sps + int(e) for e in ev)
-                    results.append((pos, uidx, rank, len(uw), uw))
-                    pin_glob.append(
-                        ((uw >> np.uint32(rb + 1)).astype(np.int64)
-                         + s * sps))
-                    u_total += len(uw)
-                    u_max = max(u_max, len(uw))
-                    b_max = max(b_max, len(pos))
-                if err is not None:
-                    # Successful shards' evictions must be zeroed even
-                    # though no dispatch happens (ADVICE r3).
+                        if multi_lid:
+                            return sub.assign_batch_ints_multi_uniques(
+                                kst[lo:hi], l_st[lo:hi], rb,
+                                pinned=pins_by_shard.get(s), hold_pins=True)
+                        return sub.assign_batch_ints_uniques(
+                            kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
+                            hold_pins=True)
+                    finally:
+                        walk_by_shard[s] = time.perf_counter() - tw0
+
+                t_c0 = time.perf_counter()
+                results = []
+                clears: list = []
+                pin_glob: list = []
+                u_total = u_max = b_max = 0
+                # Pins of successful shards accumulate in pin_glob as results
+                # are collected; the finally releases them on ANY raise —
+                # including a partial assignment failure, whose successful
+                # siblings' results never reach a caller.
+                try:
+                    futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
+                    err = None
+                    for s, f in enumerate(futs):
+                        pos = order[soffs[s]:soffs[s + 1]]
+                        try:
+                            r = f.result()
+                        except Exception as exc:  # noqa: BLE001
+                            err = err if err is not None else exc
+                            # Partial-failure lanes still evicted: globalize
+                            # into the pooled clears, cleared below.
+                            clears.extend(consume_pending_clears(exc, s * sps))
+                            results.append((pos, None, None, 0, None))
+                            continue
+                        if r is None:
+                            results.append((pos, None, None, 0, None))
+                            continue
+                        uw, uidx, rank, ev = r
+                        clears.extend(s * sps + int(e) for e in ev)
+                        results.append((pos, uidx, rank, len(uw), uw))
+                        pin_glob.append(
+                            ((uw >> np.uint32(rb + 1)).astype(np.int64)
+                             + s * sps))
+                        u_total += len(uw)
+                        u_max = max(u_max, len(uw))
+                        b_max = max(b_max, len(pos))
+                    if err is not None:
+                        # Successful shards' evictions must be zeroed even
+                        # though no dispatch happens (ADVICE r3).
+                        if clears:
+                            clear(clears)
+                        raise err
                     if clears:
                         clear(clears)
-                    raise err
-                if clears:
-                    clear(clears)
-                digest = cdt is not None and (
-                    digest_bpu * n_sh * _bucket(max(u_max, 1))
-                    <= words_bpr * cn)
-                now = self._monotonic_now()
-                t0 = time.perf_counter()
-                if digest:
-                    u_loc = _bucket(max(u_max, 1))
-                    uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF,
-                                     dtype=np.uint32)
-                    lid_mat = None
-                    if multi_lid:
-                        lid_mat = np.zeros((n_sh, u_loc), dtype=np.int32)
-                    per_shard = []
-                    for s, item in enumerate(results):
-                        pos = item[0]
-                        if not len(pos):
-                            per_shard.append((pos, None, None, 0))
-                            continue
-                        _, uidx, rank, u, uw = item
-                        uw_mat[s, :u] = uw
+                    digest = cdt is not None and (
+                        digest_bpu * n_sh * _bucket(max(u_max, 1))
+                        <= words_bpr * cn)
+                    now = self._monotonic_now()
+                    t0 = time.perf_counter()
+                    if digest:
+                        u_loc = _bucket(max(u_max, 1))
+                        uw_mat = np.full((n_sh, u_loc), 0xFFFFFFFF,
+                                         dtype=np.uint32)
+                        lid_mat = None
                         if multi_lid:
-                            first = rank == 0
-                            ulids = np.zeros(u, dtype=np.int32)
-                            ulids[uidx[first]] = l_chunk[pos][first]
-                            lid_mat[s, :u] = ulids
-                        per_shard.append((pos, uidx, rank, u))
-                    counts = counts_dispatch(
-                        uw_mat, lid if not multi_lid else lid_mat, now, cdt)
-                    pending.append(["digest", counts, start, per_shard, t0])
-                else:
-                    b_loc = _bucket(max(b_max, 1))
-                    w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF,
-                                    dtype=np.uint32)
-                    lid_mat = None
-                    if multi_lid:
-                        lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
-                    per_shard = []
-                    for s, item in enumerate(results):
-                        pos = item[0]
-                        if not len(pos):
+                            lid_mat = np.zeros((n_sh, u_loc), dtype=np.int32)
+                        per_shard = []
+                        for s, item in enumerate(results):
+                            pos = item[0]
+                            if not len(pos):
+                                per_shard.append((pos, None, None, 0))
+                                continue
+                            _, uidx, rank, u, uw = item
+                            uw_mat[s, :u] = uw
+                            if multi_lid:
+                                first = rank == 0
+                                ulids = np.zeros(u, dtype=np.int32)
+                                ulids[uidx[first]] = l_chunk[pos][first]
+                                lid_mat[s, :u] = ulids
+                            per_shard.append((pos, uidx, rank, u))
+                        counts = counts_dispatch(
+                            uw_mat, lid if not multi_lid else lid_mat, now, cdt)
+                        item = ["digest", counts, start, per_shard, t0]
+                    else:
+                        b_loc = _bucket(max(b_max, 1))
+                        w_mat = np.full((n_sh, b_loc), 0xFFFFFFFF,
+                                        dtype=np.uint32)
+                        lid_mat = None
+                        if multi_lid:
+                            lid_mat = np.zeros((n_sh, b_loc), dtype=np.int32)
+                        per_shard = []
+                        for s, item in enumerate(results):
+                            pos = item[0]
+                            if not len(pos):
+                                per_shard.append((pos,))
+                                continue
+                            _, uidx, rank, u, uw = item
+                            row = w_mat[s, :len(pos)]
+                            if not rebuild_words_into(uw, uidx, rank, rb, row):
+                                row[:] = rebuild_words(uw, uidx, rank, rb)
+                            if multi_lid:
+                                lid_mat[s, :len(pos)] = l_chunk[pos]
                             per_shard.append((pos,))
-                            continue
-                        _, uidx, rank, u, uw = item
-                        row = w_mat[s, :len(pos)]
-                        if not rebuild_words_into(uw, uidx, rank, rb, row):
-                            row[:] = rebuild_words(uw, uidx, rank, rb)
-                        if multi_lid:
-                            lid_mat[s, :len(pos)] = l_chunk[pos]
-                        per_shard.append((pos,))
-                    bits = bits_dispatch(
-                        w_mat, lid if not multi_lid else lid_mat, now)
-                    pending.append(["bits", bits, start, per_shard, t0])
-            finally:
-                self._unpin_held(index, pin_glob)
-            wire_b = digest_bpu * u_total if digest else words_bpr * cn
-            rec = None
-            if self.stream_stats is not None:
-                # Per-shard walk seconds expose where a sharded chunk's
-                # host time goes (the residual n-shard overhead on a
-                # 1-core host is these C calls serializing).
-                rec = {"path": "relay_sharded", "n": int(cn),
-                       "u": int(u_total),
-                       "mode": "digest" if digest else "bits",
-                       "wire_bytes": int(wire_b),
-                       "assign_s": round(float(walk_by_shard.max()), 6),
-                       "shard_walk_s": [round(float(x), 6)
-                                        for x in walk_by_shard],
-                       "host_s": round(time.perf_counter() - t_c0
-                                       - float(walk_by_shard.max()), 6)}
-                self.stream_stats.append(rec)
-            pending[-1].append(rec)
-            if len(pending) > 1:
-                drain(*pending.pop(0))
-            bpr = max(wire_b / cn, 1e-3)
-            budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
-                      else _RELAY_WIRE_BUDGET_WORDS)
-            chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
-                            _RELAY_CHUNK_MAX))
-            start += cn
-        for item in pending:
-            drain(*item)
+                        bits = bits_dispatch(
+                            w_mat, lid if not multi_lid else lid_mat, now)
+                        item = ["bits", bits, start, per_shard, t0]
+                finally:
+                    self._unpin_held(index, pin_glob)
+                wire_b = digest_bpu * u_total if digest else words_bpr * cn
+                rec = None
+                if self.stream_stats is not None:
+                    # Per-shard walk seconds AND request counts expose where
+                    # a sharded chunk's host time goes — walk spread with
+                    # balanced shard_n is core contention, walk spread
+                    # tracking shard_n is routing skew (VERDICT r4 #6).
+                    rec = {"path": "relay_sharded", "n": int(cn),
+                           "u": int(u_total),
+                           "mode": "digest" if digest else "bits",
+                           "wire_bytes": int(wire_b),
+                           "assign_s": round(float(walk_by_shard.max()), 6),
+                           "shard_walk_s": [round(float(x), 6)
+                                            for x in walk_by_shard],
+                           "shard_n": [int(x) for x in scnt],
+                           "host_s": round(time.perf_counter() - t_c0
+                                           - float(walk_by_shard.max()), 6)}
+                    self.stream_stats.append(rec)
+                item.append(rec)
+                # Concurrent drain (see _stream_relay): fetch cycles overlap.
+                drains.submit(drain, *item)
+                bpr = max(wire_b / cn, 1e-3)
+                budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
+                          else _RELAY_WIRE_BUDGET_WORDS)
+                chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
+                                _RELAY_CHUNK_MAX))
+                start += cn
+            drains.finish()
+        finally:
+            drains.finish(swallow=True)  # no-op on the normal path
         return out
 
     def available_many(
@@ -2092,15 +2129,22 @@ class TpuBatchedStorage(RateLimitStorage):
                        + tot.get("device_s", 0.0) + chunks * rtt)
         if cur is None:
             if len(self._chunk_plans) >= 128:
-                # Bound the cache.  Keep LOCKED (reverted) plans — wiping
-                # one would re-enable the oscillation its lock prevents —
-                # unless locked plans alone exceed the bound, where the
-                # memory bound wins (the rare re-elected shape pays one
-                # extra measuring pass; oscillation stays bounded by the
-                # re-lock).
+                # Bound the cache, evicting cheapest-to-lose first
+                # (ADVICE r4): giant/provisional plans cost one measuring
+                # pass to rebuild, so they go before ACTIVE pipelined
+                # plans (wiping one forces a mid-service re-measure plus
+                # fresh compile shapes) and before LOCKED plans (wiping
+                # one re-enables the oscillation its lock prevents).
+                # Only if each tier alone still exceeds the bound does
+                # the memory bound win outright.
                 self._chunk_plans = {k: v for k, v
                                      in self._chunk_plans.items()
-                                     if v.get("locked")}
+                                     if v.get("locked")
+                                     or v["kind"] == "pipelined"}
+                if len(self._chunk_plans) >= 128:
+                    self._chunk_plans = {k: v for k, v
+                                         in self._chunk_plans.items()
+                                         if v.get("locked")}
                 if len(self._chunk_plans) >= 128:
                     self._chunk_plans.clear()
             # The very first pass over a fresh stream shape is the wrong
@@ -2130,10 +2174,11 @@ class TpuBatchedStorage(RateLimitStorage):
             a_fit = u2 / (c2 ** alpha)
         elif cu:
             a_fit = cu[0][1] / float(cu[0][0])
+        rates = self._device_rates()
         bpu_up = 8.0 if tot.get("bpu", 6.0) >= 10.0 else 4.0
         bpu_down = 2.0 if tot.get("bpu", 6.0) >= 10.0 else 1.0
-        dev_lane = (_DEVICE_S_PER_UNIQUE_UNSORTED if digest_frac > 0.5
-                    else _DEVICE_S_PER_LANE)
+        dev_lane = rates["s_per_unique_unsorted" if digest_frac > 0.5
+                         else "s_per_lane"]
         if key[0] == "weighted" and cu:
             # Weighted wire = 4 B/unique words + ~1.125 B/request permits
             # and bits: express it per UNIQUE through the giant pass's
@@ -2146,7 +2191,7 @@ class TpuBatchedStorage(RateLimitStorage):
             digest_frac = 1.0
             bpu_up = 4.0 + 1.125 * r_pu
             bpu_down = 0.125 * r_pu
-            dev_lane = _DEVICE_S_PER_LANE * r_pu
+            dev_lane = rates["s_per_lane"] * r_pu
         sim_args = dict(
             cpu_per_req=(tot["walk_s"] + tot.get("host_s", 0.0)) / n,
             digest_frac=digest_frac, dedup_a=a_fit, dedup_alpha=alpha,
@@ -2434,6 +2479,21 @@ class TpuBatchedStorage(RateLimitStorage):
             pool = cf.ThreadPoolExecutor(1, thread_name_prefix="assignpf")
             self._assign_pool_obj = pool
         return pool
+
+    def _device_rates(self) -> dict:
+        """Per-lane device step rates for the elections: probed per
+        (platform, device kind) and cached (engine/device_rates.py)
+        when a link profile is set — profile-less storages never probe
+        (elections don't run without one) and use the v5e fallback."""
+        if self._link_profile is None:
+            return _FB_RATES
+        r = getattr(self, "_device_rates_obj", None)
+        if r is None:
+            from ratelimiter_tpu.engine.device_rates import get_device_rates
+
+            r = get_device_rates()
+            self._device_rates_obj = r
+        return r
 
     def _drain_pool(self):
         """Drain workers: device fetches block here CONCURRENTLY so

@@ -386,3 +386,66 @@ def test_rate_aware_mode_election():
                                   8.125, True)
     assert _elect_digest_mode((5e6, 0.1), cn // 3, cn, cn // 3, 10.0,
                               8.125, True)
+
+
+def test_digest_mode_election_flips_with_device_rates():
+    """VERDICT r4 #5: the words-vs-digest election consumes the PROBED
+    device rates — on a device with a cheap per-lane words step the
+    same chunk elects words, on one with an expensive step it elects
+    digest (wire identical in both cases)."""
+    from ratelimiter_tpu.storage.tpu import _elect_digest_mode
+
+    link = (50e6, 0.1, 50e6)
+    base = {"s_per_unique_sorted": 25e-9, "s_per_unique_unsorted": 52e-9}
+    fast_lane = dict(base, s_per_lane=5e-9)
+    slow_lane = dict(base, s_per_lane=300e-9)
+    kw = dict(u=900, cn=1000, n_delta=0, digest_bpu=6.0, words_bpr=4.125,
+              srt_ok=False, cdt_size=1)
+    assert _elect_digest_mode(link, rates=slow_lane, **kw) is True
+    assert _elect_digest_mode(link, rates=fast_lane, **kw) is False
+
+
+def test_device_rates_fallback_and_cache(monkeypatch, tmp_path):
+    """RATELIMITER_RATE_PROBE=0 yields the v5e fallback constants; a
+    pre-seeded disk cache is honored without probing; both are
+    memoized per (platform, kind)."""
+    import json as _json
+
+    import jax
+
+    from ratelimiter_tpu.engine import device_rates as dr
+
+    monkeypatch.setattr(dr, "_mem_cache", {})
+    monkeypatch.setenv("RATELIMITER_RATE_PROBE", "0")
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    got = dr.get_device_rates()
+    assert got["source"] == "fallback"
+    assert got["s_per_lane"] == dr.FALLBACK_RATES["s_per_lane"]
+    # Seed the disk cache as a probe artifact would; a fresh mem cache
+    # must read it instead of falling back (or probing).
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    path = dr._cache_path(dev.platform, kind)
+    assert str(tmp_path) in path
+    rates = {"s_per_lane": 1e-9, "s_per_unique_sorted": 2e-9,
+             "s_per_unique_unsorted": 3e-9, "source": "probe"}
+    import os as _os
+
+    _os.makedirs(_os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        _json.dump(rates, fh)
+    monkeypatch.setattr(dr, "_mem_cache", {})
+    try:
+        # The opt-out beats the disk artifact (determinism pin) ...
+        assert dr.get_device_rates()["source"] == "fallback"
+        # ... and with probing allowed, the artifact is honored without
+        # re-probing.
+        monkeypatch.setenv("RATELIMITER_RATE_PROBE", "1")
+        monkeypatch.setattr(dr, "_probe", lambda: (_ for _ in ()).throw(
+            AssertionError("disk cache must prevent probing")))
+        monkeypatch.setattr(dr, "_mem_cache", {})
+        got2 = dr.get_device_rates()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+    assert got2["s_per_lane"] == 1e-9 and got2["source"] == "probe"
