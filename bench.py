@@ -114,8 +114,8 @@ def main() -> None:
     # recorded so the plan/mode decisions in this run are reproducible.
     from ratelimiter_tpu.engine.device_rates import get_device_rates
 
-    detail["device_rates"] = get_device_rates()
-    log(f"device rates: {detail['device_rates']}")
+    device_rates = get_device_rates()
+    log(f"device rates: {device_rates}")
 
     from ratelimiter_tpu import RateLimitConfig
     from ratelimiter_tpu.algorithms import (
@@ -137,7 +137,8 @@ def main() -> None:
 
     profile_dir = os.environ.get("BENCH_PROFILE")
     rng = np.random.default_rng(42)
-    detail = {"platform": platform, "scale": scale}
+    detail = {"platform": platform, "scale": scale,
+              "device_rates": device_rates}
     if detail_link:
         detail["link"] = detail_link
     t_start = time.time()

@@ -363,6 +363,35 @@ def weighted_decide(bits: np.ndarray, roff: np.ndarray, spos: np.ndarray,
     return out.view(np.bool_)
 
 
+def split_layout(uwords: np.ndarray, rank_bits: int, uidx: np.ndarray,
+                 singles: np.ndarray | None = None):
+    """Partition a digest chunk's uniques into SINGLETONS and
+    multi-count segments for the split dispatch (ops/relay.py:
+    _relay_counts_split, r5).
+
+    Returns ``(s3, mwords, uidx2, n_singles)``: the singletons' slots
+    as a uint8[S, 3] little-endian 24-bit plane, the multis' uwords
+    unchanged, and uidx remapped to singles-then-multis positions
+    (reconstruction: position < S reads an allow bit, else a count).
+    A count FIELD of 1 is an exact singleton — relay_usable() forces
+    rank_bits >= 2, so the clamp sentinel is >= 3 and can't alias 1.
+    Vectorized numpy (~4 passes over u); measured ~15-25 ns/unique.
+    ``singles`` lets a caller that already computed the singleton mask
+    (the election did, to price the split) pass it in."""
+    if singles is None:
+        rank_mask = np.uint32((1 << rank_bits) - 1)
+        singles = ((uwords >> np.uint32(1)) & rank_mask) == 1
+    u = len(uwords)
+    n_s = int(singles.sum())
+    newpos = np.empty(u, dtype=np.int32)
+    newpos[singles] = np.arange(n_s, dtype=np.int32)
+    newpos[~singles] = np.arange(n_s, u, dtype=np.int32)
+    uidx2 = newpos[uidx]
+    s_slots = (uwords[singles] >> np.uint32(rank_bits + 1)).astype("<u4")
+    s3 = s_slots.view(np.uint8).reshape(-1, 4)[:, :3]
+    return s3, uwords[~singles], uidx2, n_s
+
+
 def shard_route(key_ids: np.ndarray, n_shards: int):
     """(shard i32[n], stable order i64[n], counts i64[n_shards]) for an
     int64 key batch — one C pass of splitmix hash + counting sort,

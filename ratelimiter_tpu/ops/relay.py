@@ -180,40 +180,14 @@ def tb_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
     max_permits fits), and the host reconstructs per-request booleans as
     ``rank < n_allowed[uidx]``.  State writes are identical to
     tb_relay_bits on the expanded batch: every valid lane is its own
-    last occurrence.
+    last occurrence.  Decision/state math lives in _tb_counts_core —
+    shared with the split dispatch so the modes cannot drift.
     """
     num_slots = packed.shape[0]
     slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
-    sc = jnp.where(valid, slot, 0)
-    scalar_lid = jnp.ndim(lids) == 0
-    lidc = lids if scalar_lid else jnp.clip(
-        lids, 0, table.cap_fp.shape[0] - 1)
-    cap = table.cap_fp[lidc]
-    rate = table.rate_fp[lidc]
-    maxp = table.max_permits[lidc]
-    ttl2 = table.ttl2_ms[lidc]
-
-    rows = _tb_decode(packed[sc])
-    v1 = _refilled(rows, cap, rate, ttl2, now)
-    pre_ok = valid & (1 <= maxp)
-    u = jnp.where(pre_ok, v1 - TOKEN_FP_ONE, jnp.int64(-1))
-    avail = jnp.where(u >= 0, u // TOKEN_FP_ONE + 1, jnp.int64(0))
-    n_alw = jnp.minimum(avail, count)
-
-    any_inc = n_alw > 0
-    tokens_new = jnp.where(any_inc, v1 - n_alw * TOKEN_FP_ONE, rows[0])
-    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
-    new_rows = _tb_encode(tokens_new, last_new)
-    if slots_sorted:
-        # Host-sorted uniques (padding decodes to slot >= num_slots, at
-        # the tail): the dense presorted block sweep replaces XLA's
-        # per-index scatter (ops/scatter.py).
-        from ratelimiter_tpu.ops.scatter import scatter_rows_presorted
-
-        packed_new = scatter_rows_presorted(packed, slot, valid, new_rows)
-    else:
-        widx = jnp.where(valid, slot, jnp.int32(num_slots))
-        packed_new = packed.at[widx].set(new_rows, mode="drop")
+    packed_new, n_alw = _tb_counts_core(packed, table, slot, count, valid,
+                                        lids, now,
+                                        slots_sorted=slots_sorted)
     lim = jnp.int64(jnp.iinfo(out_dtype).max)
     return packed_new, jnp.clip(n_alw, 0, lim).astype(out_dtype)
 
@@ -222,27 +196,129 @@ def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
                     out_dtype=jnp.uint8, slots_sorted: bool = False):
     """Segment-digest sliding-window step (see tb_relay_counts).
 
-    The per-request decision ``rank < n_pass`` is exact: with unit
+    The per-request decision ``rank < n_allowed`` is exact: with unit
     permits the Q2 post-increment re-check is implied — n_pass =
     maxp - base - curr_e (when positive) and base >= 0, so any rank
-    below n_pass also satisfies curr_e + rank + 1 <= maxp.
+    below n_pass also satisfies curr_e + rank + 1 <= maxp.  The core
+    returns tot = min(count, n_pass), which reconstructs identically
+    (rank < count always, so rank < tot <=> rank < n_pass).
     """
     num_slots = packed.shape[0]
     slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    packed_new, tot = _sw_counts_core(packed, table, slot, count, valid,
+                                      lids, now, slots_sorted=slots_sorted)
+    lim = jnp.int64(jnp.iinfo(out_dtype).max)
+    return packed_new, jnp.clip(tot, 0, lim).astype(out_dtype)
+
+
+def _decode_s3(s3, num_slots):
+    """uint8[S, 3] little-endian 24-bit slot plane -> (slot i32[S],
+    valid bool[S]).  The 0xFFFFFF padding sentinel decodes to a slot
+    >= num_slots (callers gate split mode on num_slots < 2^24)."""
+    w = s3.astype(jnp.uint32)
+    slot = (w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16)).astype(jnp.int32)
+    return slot, slot < num_slots
+
+
+def _relay_counts_split(algo_core, packed, table, s3, mwords, lids, now, *,
+                        rank_bits, out_dtype):
+    """Split-digest decision step shared by both algorithms (r5).
+
+    Unit-permit digest traffic is mostly SINGLETON uniques (uniform:
+    ~80-90% of uniques; Zipf: the tail).  A singleton needs no count
+    field on the way in (count == 1) and only an allow BIT on the way
+    out — so singles ship as a 3-byte slot plane (s3) and come back as
+    packed bits, while multi-count uniques keep the 4-byte uword and
+    the count download.  Wire vs classic digest: upload 4 -> 3 B and
+    download 1-2 B -> 1/8 B per singleton; decisions and state writes
+    are identical (tests/test_relay.py drives all three modes on the
+    same chunks).  Both lane sets decide in ONE fused body over their
+    concatenation (disjoint slots — singles and multis are different
+    uniques), and the result ships as ONE uint8 array
+    [packed singles bits | counts bytes] so the drain stays a single
+    fetch round trip.
+    """
+    num_slots = packed.shape[0]
+    slot_s, valid_s = _decode_s3(s3, num_slots)
+    slot_m, count_m, _, valid_m = decode_words(mwords, rank_bits, num_slots)
+    slot = jnp.concatenate([slot_s, slot_m])
+    count = jnp.concatenate([jnp.ones_like(slot_s, dtype=jnp.int64),
+                             count_m])
+    valid = jnp.concatenate([valid_s, valid_m])
+    n_s = s3.shape[0]
+    packed_new, n_alw = algo_core(packed, table, slot, count, valid, lids,
+                                  now)
+    bits_s = jnp.packbits(n_alw[:n_s] > 0)
+    csize = out_dtype(0).dtype.itemsize  # static (python) at trace time
+    counts_m = jnp.clip(n_alw[n_s:], 0,
+                        jnp.int64(jnp.iinfo(out_dtype).max)).astype(out_dtype)
+    if csize > 1:
+        counts_m = jax.lax.bitcast_convert_type(
+            counts_m, jnp.uint8).reshape(-1)
+    return packed_new, jnp.concatenate([bits_s, counts_m])
+
+
+def _scatter_rows(packed, slot, valid, new_rows, slots_sorted):
+    """Unique-row state write: the dense presorted block sweep when the
+    host sorted the uniques by slot (padding decodes to slot >=
+    num_slots, at the tail), else XLA's per-index scatter."""
+    if slots_sorted:
+        from ratelimiter_tpu.ops.scatter import scatter_rows_presorted
+
+        return scatter_rows_presorted(packed, slot, valid, new_rows)
+    widx = jnp.where(valid, slot, jnp.int32(packed.shape[0]))
+    return packed.at[widx].set(new_rows, mode="drop")
+
+
+def _tb_counts_core(packed, table, slot, count, valid, lids, now,
+                    slots_sorted: bool = False):
+    """(new_packed, n_allowed per lane) — THE token-bucket digest body.
+    tb_relay_counts (classic uwords) and the split dispatch both decide
+    through this, so the two modes cannot drift."""
+    sc = jnp.where(valid, slot, 0)
+    scalar_lid = jnp.ndim(lids) == 0
+    lidc = lids if scalar_lid else jnp.clip(
+        lids, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    maxp = table.max_permits[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+    pre_ok = valid & (1 <= maxp)
+    u = jnp.where(pre_ok, v1 - TOKEN_FP_ONE, jnp.int64(-1))
+    avail = jnp.where(u >= 0, u // TOKEN_FP_ONE + 1, jnp.int64(0))
+    n_alw = jnp.minimum(avail, count)
+    any_inc = n_alw > 0
+    tokens_new = jnp.where(any_inc, v1 - n_alw * TOKEN_FP_ONE, rows[0])
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
+    packed_new = _scatter_rows(packed, slot, valid,
+                               _tb_encode(tokens_new, last_new),
+                               slots_sorted)
+    return packed_new, n_alw
+
+
+def _sw_counts_core(packed, table, slot, count, valid, lids, now,
+                    slots_sorted: bool = False):
+    """Sliding-window counterpart of :func:`_tb_counts_core` (see
+    sw_relay_counts for the derivation, incl. the implied Q2 check).
+
+    Returns tot = min(count, n_pass) per lane: equivalent to n_pass for
+    both the bit (tot > 0 <=> n_pass >= 1 for count >= 1) and the count
+    reconstruction (rank < min(count, n_pass) <=> rank < n_pass, since
+    rank < count by construction)."""
     sc = jnp.where(valid, slot, 0)
     scalar_lid = jnp.ndim(lids) == 0
     lidc = lids if scalar_lid else jnp.clip(
         lids, 0, table.max_permits.shape[0] - 1)
     maxp = table.max_permits[lidc]
     win = table.window_ms[lidc]
-
     rows = _sw_decode(packed[sc])
     curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
     rem = now % win
     base = (prev_e * (win - rem)) // win
     u = jnp.where(valid, maxp - base - curr_e - 1, jnp.int64(-1))
     n_pass = jnp.maximum(u + 1, 0)
-
     tot = jnp.minimum(count, n_pass)
     any_inc = tot > 0
     curr_new = curr_e + tot
@@ -250,15 +326,22 @@ def sw_relay_counts(packed, table, uwords, lids, now, *, rank_bits: int,
     cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
     curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
     new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
-    if slots_sorted:  # see tb_relay_counts
-        from ratelimiter_tpu.ops.scatter import scatter_rows_presorted
+    packed_new = _scatter_rows(packed, slot, valid, new_rows, slots_sorted)
+    return packed_new, tot
 
-        packed_new = scatter_rows_presorted(packed, slot, valid, new_rows)
-    else:
-        widx = jnp.where(valid, slot, jnp.int32(num_slots))
-        packed_new = packed.at[widx].set(new_rows, mode="drop")
-    lim = jnp.int64(jnp.iinfo(out_dtype).max)
-    return packed_new, jnp.clip(n_pass, 0, lim).astype(out_dtype)
+
+def tb_relay_counts_split(packed, table, s3, mwords, lids, now, *,
+                          rank_bits: int, out_dtype=jnp.uint8):
+    return _relay_counts_split(_tb_counts_core, packed, table, s3, mwords,
+                               lids, now, rank_bits=rank_bits,
+                               out_dtype=out_dtype)
+
+
+def sw_relay_counts_split(packed, table, s3, mwords, lids, now, *,
+                          rank_bits: int, out_dtype=jnp.uint8):
+    return _relay_counts_split(_sw_counts_core, packed, table, s3, mwords,
+                               lids, now, rank_bits=rank_bits,
+                               out_dtype=out_dtype)
 
 
 def tb_relay_counts_resident(packed, lid_map, table, uwords, delta_slots,

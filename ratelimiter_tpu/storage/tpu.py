@@ -132,6 +132,11 @@ _DEVICE_S_PER_LANE = _FB_RATES["s_per_lane"]
 _DEVICE_S_PER_UNIQUE_SORTED = _FB_RATES["s_per_unique_sorted"]
 _DEVICE_S_PER_UNIQUE_UNSORTED = _FB_RATES["s_per_unique_unsorted"]
 
+# Split-digest host partition cost per unique (numpy singleton mask +
+# remap, engine/native_index.py:split_layout — measured ~15-25 ns/u);
+# the split election charges it against the wire it saves.
+_SPLIT_HOST_S_PER_UNIQUE = 25e-9
+
 # Weighted relay: longest rank-major permit matrix the scan step accepts.
 # A chunk whose deepest segment exceeds this (heavy duplication — Zipf
 # bursts) dispatches through the sorted flat step instead; duplicate-poor
@@ -964,6 +969,20 @@ class TpuBatchedStorage(RateLimitStorage):
             dt_us = (tf1 - t0) * 1e6
             if mode == "bits":
                 got = np.unpackbits(arr)[:count].astype(bool)
+            elif mode == "split":
+                # [packed singleton bits | multi count bytes] -> one
+                # per-unique counts lane, then the standard rank compare
+                # (singleton counts are exactly their allow bit).
+                from ratelimiter_tpu.engine.native_index import relay_decide
+
+                uidx2, rank, u, n_s, s_pad, m_pad, cdt_l = extra
+                csize = np.dtype(cdt_l).itemsize
+                counts_all = np.empty(u, dtype=cdt_l)
+                counts_all[:n_s] = np.unpackbits(arr[:s_pad // 8])[:n_s]
+                counts_all[n_s:] = arr[
+                    s_pad // 8:s_pad // 8 + m_pad * csize].view(
+                        cdt_l)[:u - n_s]
+                got = relay_decide(counts_all, uidx2, rank)
             else:  # digest: reconstruct from per-unique allowed counts
                 from ratelimiter_tpu.engine.native_index import relay_decide
 
@@ -1035,10 +1054,78 @@ class TpuBatchedStorage(RateLimitStorage):
                         words_bpr, srt_ok,
                         cdt_size=np.dtype(cdt).itemsize if cdt else 1,
                         rates=rates)
+                    # Split-digest election (r5): singletons as a 3-byte
+                    # slot plane with BIT decisions back, multis as
+                    # classic uwords+counts — beats classic digest when
+                    # most uniques are singletons and beats words mode
+                    # at high u/n, per-direction costs compared against
+                    # whichever of the two won above.
+                    split = False
+                    n_singles = 0
+                    if (self._link_profile is not None and cdt is not None
+                            and not multi_lid and rb >= 2
+                            and eng.num_slots <= 0xFFFFFF
+                            and u >= _SORT_UNIQUES_MIN):
+                        prof = self._link_profile
+                        up_r = max(prof[0], 1.0)
+                        down_r = max(prof[2], 1.0) if len(prof) > 2 else up_r
+                        cdt_b = np.dtype(cdt).itemsize
+                        singles_mask = (((uwords >> np.uint32(1))
+                                         & np.uint32((1 << rb) - 1)) == 1)
+                        n_singles = int(singles_mask.sum())
+                        n_multi = u - n_singles
+                        cost_split = (
+                            n_singles * (3.0 / up_r + 0.125 / down_r)
+                            + n_multi * (4.0 / up_r + cdt_b / down_r)
+                            + u * (rates["s_per_unique_unsorted"]
+                                   + _SPLIT_HOST_S_PER_UNIQUE))
+                        if digest:
+                            # Classic digest uploads exactly the 4 B
+                            # uword and downloads the cdt count (the
+                            # blended digest_bpu would overcharge the
+                            # upload by 1 B at cdt_b=1).
+                            dev_u = rates["s_per_unique_sorted" if srt_ok
+                                          else "s_per_unique_unsorted"]
+                            rival = u * (4.0 / up_r + cdt_b / down_r
+                                         + dev_u)
+                        else:
+                            rival = cn * ((words_bpr - 0.125) / up_r
+                                          + 0.125 / down_r
+                                          + rates["s_per_lane"])
+                        split = cost_split < rival
                     now = self._monotonic_now()
                     t_prep = time.perf_counter()
                     t0 = time.perf_counter()
-                    if digest:
+                    if split:
+                        from ratelimiter_tpu.engine.native_index import (
+                            split_layout,
+                        )
+
+                        srt = False  # split lanes dispatch unsorted
+                        s3, mwords, uidx2, n_s = split_layout(
+                            uwords, rb, uidx, singles=singles_mask)
+                        # Quarter-octave buckets: pow2 padding at these
+                        # lane counts wastes up to ~55% of the wire the
+                        # split exists to save (2.7M singles -> 4.19M
+                        # pow2 lanes, measured); fine buckets cap the
+                        # waste at ~12% for a couple extra compile
+                        # shapes.  Both stay multiples of 8 (packbits).
+                        s_pad = _bucket_fine(n_s)
+                        m_pad = _bucket_fine(u - n_s)
+                        s3p = np.full((s_pad, 3), 0xFF, dtype=np.uint8)
+                        s3p[:n_s] = s3
+                        mw = _pad_tail(mwords, m_pad, 0xFFFFFFFF,
+                                       np.uint32)
+                        split_dispatch = (
+                            eng.sw_relay_counts_split_dispatch
+                            if algo == "sw"
+                            else eng.tb_relay_counts_split_dispatch)
+                        outh = split_dispatch(s3p, mw, lid, now, cdt)
+                        item = ("split", outh, start, cn,
+                                (uidx2, rank, u, n_s, s_pad, m_pad, cdt),
+                                t0, rec)
+                        digest = True  # per-unique accounting below
+                    elif digest:
                         # Slot-sorted digest: the C index sorts the uniques
                         # in place (uidx remapped — reconstruction is order-
                         # agnostic) so the device write is a dense sweep.
@@ -1118,8 +1205,16 @@ class TpuBatchedStorage(RateLimitStorage):
                 # measured bytes/request (skewed streams compact hard in
                 # digest mode, so their chunks grow to _RELAY_CHUNK_MAX and
                 # the fixed per-dispatch latency amortizes away).
-                wire_b = (digest_bpu * u + 8 * n_delta if digest
-                          else words_bpr * cn)
+                if split:
+                    # Charge the PADDED lanes: that is what actually
+                    # ships, and the chunk-growth/election feedback
+                    # must see it.
+                    wire_b = (3.125 * _bucket_fine(n_singles)
+                              + (4.0 + np.dtype(cdt).itemsize)
+                              * _bucket_fine(u - n_singles))
+                else:
+                    wire_b = (digest_bpu * u + 8 * n_delta if digest
+                              else words_bpr * cn)
                 host_span = time.perf_counter() - t_a0 - t_assign
                 with tot["_lock"]:
                     tot["wire"] += wire_b
@@ -1136,10 +1231,13 @@ class TpuBatchedStorage(RateLimitStorage):
                     else:
                         tot["bpr"] = words_bpr
                 if rec is not None:
-                    rec["mode"] = "digest" if digest else "bits"
+                    rec["mode"] = ("split" if split
+                                   else "digest" if digest else "bits")
                     rec["wire_bytes"] = int(wire_b)
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
                     rec["host_s"] = round(host_span, 6)
+                    if split:
+                        rec["singles"] = int(n_singles)
                 if not pipelined:
                     bpr = max(wire_b / cn, 1e-3)
                     budget = (_RELAY_WIRE_BUDGET_DIGEST if digest
