@@ -215,14 +215,25 @@ def main() -> None:
                    or v.get("passes", 0) >= 3
                    for v in storage._chunk_plans.values())
 
-    def set_link(storage):
-        """Feed the probed link into the storage so its streaming loops
-        can elect pipelined chunk plans (VERDICT r3 #1)."""
-        if detail_link:
-            storage.set_link_profile(
-                detail_link["upload_4mb_mbps"] * (1 << 20),
-                detail_link["round_trip_ms"] / 1000.0,
-                detail_link["download_4mb_mbps"] * (1 << 20))
+    scenario_links: dict = {}
+
+    def set_link(storage, scenario=None):
+        """Feed a FRESH link probe into the storage so its streaming
+        loops elect chunk plans for the link as it is NOW — the tunnel
+        swings hour to hour and a start-of-run probe is stale by the
+        third scenario (r5: 77 MB/s at boot, 28 MB/s ninety minutes
+        in).  Each scenario's probe is recorded for the link curve."""
+        if not detail_link:
+            return
+        probe = link_probe()
+        if scenario:
+            scenario_links[scenario] = probe
+            log(f"  link now: up {probe['upload_4mb_mbps']} MB/s, "
+                f"down {probe['download_4mb_mbps']} MB/s")
+        storage.set_link_profile(
+            probe["upload_4mb_mbps"] * (1 << 20),
+            probe["round_trip_ms"] / 1000.0,
+            probe["download_4mb_mbps"] * (1 << 20))
 
     def run_stream(go, key_ids, permits, reps, storage, warmed=False):
         """Full untimed warmup pass (visits every chunk shape the growth
@@ -299,7 +310,7 @@ def main() -> None:
 
     storage = TpuBatchedStorage(num_slots=align_slots(
         max(num_keys * 2, 1 << 16)))
-    set_link(storage)
+    set_link(storage, 'tb_1m_zipf_stream_ids')
     tb_limiter = TokenBucketRateLimiter(storage, tb_cfg, MeterRegistry())
 
     key_ids = zipf_stream(rng, num_keys, n_requests)
@@ -443,7 +454,7 @@ def main() -> None:
     log(f"scenario 3: SW uniform over {num_keys3} keys (stream)...")
     storage3 = TpuBatchedStorage(
         num_slots=align_slots(max(int(num_keys3 * 1.25), 1 << 16)))
-    set_link(storage3)
+    set_link(storage3, 'sw_10m_uniform_stream')
     sw3 = SlidingWindowRateLimiter(
         storage3,
         RateLimitConfig(max_permits=100, window_ms=60_000,
@@ -481,7 +492,7 @@ def main() -> None:
     # ~8 user keys per tenant, per-request tenant policy.
     keys4 = (tenant_of_req * 8 + rng.integers(0, 8, size=n4)).astype(np.int64)
     lids4 = lids[tenant_of_req]
-    set_link(storage4)
+    set_link(storage4, 'multi_tenant_100k_stream')
     # Warmup on a DISJOINT key population: compiles every chunk shape and
     # fills the slot space so the churn pass below is 100% first-touch.
     # A chunk-plan election during the first warmup changes later passes'
@@ -534,7 +545,7 @@ def main() -> None:
     log(f"scenario 5: burst batch-acquire over {num_keys5} keys...")
     storage5 = TpuBatchedStorage(num_slots=align_slots(
         max(num_keys5 * 2, 1 << 16)))
-    set_link(storage5)
+    set_link(storage5, 'tb_burst_batch_stream')
     tb5 = TokenBucketRateLimiter(
         storage5,
         RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=100.0),
@@ -656,11 +667,12 @@ def main() -> None:
                 continue
             med = res.get("median_pass_decisions_per_sec",
                           res.get("decisions_per_sec"))
+            probe = scenario_links.get(scen, detail_link)
             curve.append({
                 "scenario": scen,
-                "upload_mbps": detail_link["upload_4mb_mbps"],
-                "download_mbps": detail_link["download_4mb_mbps"],
-                "rtt_ms": detail_link["round_trip_ms"],
+                "upload_mbps": probe["upload_4mb_mbps"],
+                "download_mbps": probe["download_4mb_mbps"],
+                "rtt_ms": probe["round_trip_ms"],
                 "relink": res.get("relink"),
                 "median_dps": round(float(med), 1),
             })
