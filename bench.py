@@ -667,7 +667,12 @@ def main() -> None:
                 continue
             med = res.get("median_pass_decisions_per_sec",
                           res.get("decisions_per_sec"))
-            probe = scenario_links.get(scen, detail_link)
+            # The string scenario runs on the headline's storage (and
+            # its elected plans): its link of record is that probe, not
+            # the boot probe.
+            probe_key = ("tb_1m_zipf_stream_ids"
+                         if scen == "tb_1m_zipf_end_to_end_strs" else scen)
+            probe = scenario_links.get(probe_key, detail_link)
             curve.append({
                 "scenario": scen,
                 "upload_mbps": probe["upload_4mb_mbps"],

@@ -157,6 +157,16 @@ def _bind(lib) -> None:
     lib.rl_weighted_decide.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    # Optional (r5): a stale prebuilt .so without the symbol must not
+    # kill the library load — split_layout falls back to numpy.
+    try:
+        lib.rl_split_layout.restype = ctypes.c_int64
+        lib.rl_split_layout.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    except AttributeError:
+        pass
 
 
 def native_available() -> bool:
@@ -375,13 +385,30 @@ def split_layout(uwords: np.ndarray, rank_bits: int, uidx: np.ndarray,
     (reconstruction: position < S reads an allow bit, else a count).
     A count FIELD of 1 is an exact singleton — relay_usable() forces
     rank_bits >= 2, so the clamp sentinel is >= 3 and can't alias 1.
-    Vectorized numpy (~4 passes over u); measured ~15-25 ns/unique.
+    C fast path (rl_split_layout: two GIL-free passes; ~19 ns/unique
+    all-in at 3M uniques, output allocation included); the numpy
+    fallback (~4 passes, ~46 ns/unique) is bit-identical.
     ``singles`` lets a caller that already computed the singleton mask
-    (the election did, to price the split) pass it in."""
+    (the election did, to price the split) pass it in (numpy path
+    only — the C pass re-classifies for ~1 ns/unique)."""
+    u = len(uwords)
+    n = len(uidx)
+    lib = _load_library()
+    if (lib is not None and hasattr(lib, "rl_split_layout")
+            and uwords.flags["C_CONTIGUOUS"] and uwords.dtype == np.uint32
+            and uidx.flags["C_CONTIGUOUS"] and uidx.dtype == np.int32):
+        s3 = np.empty((u, 3), dtype=np.uint8)
+        mwords = np.empty(max(u, 1), dtype=np.uint32)
+        uidx2 = np.empty(n, dtype=np.int32)
+        scratch = np.empty(max(u, 1), dtype=np.int32)
+        n_s = int(lib.rl_split_layout(
+            uwords.ctypes.data, u, int(rank_bits), uidx.ctypes.data, n,
+            s3.ctypes.data, mwords.ctypes.data, uidx2.ctypes.data,
+            scratch.ctypes.data))
+        return s3[:n_s], mwords[:u - n_s], uidx2, n_s
     if singles is None:
         rank_mask = np.uint32((1 << rank_bits) - 1)
         singles = ((uwords >> np.uint32(1)) & rank_mask) == 1
-    u = len(uwords)
     n_s = int(singles.sum())
     newpos = np.empty(u, dtype=np.int32)
     newpos[singles] = np.arange(n_s, dtype=np.int32)

@@ -132,10 +132,11 @@ _DEVICE_S_PER_LANE = _FB_RATES["s_per_lane"]
 _DEVICE_S_PER_UNIQUE_SORTED = _FB_RATES["s_per_unique_sorted"]
 _DEVICE_S_PER_UNIQUE_UNSORTED = _FB_RATES["s_per_unique_unsorted"]
 
-# Split-digest host partition cost per unique (numpy singleton mask +
-# remap, engine/native_index.py:split_layout — measured ~15-25 ns/u);
-# the split election charges it against the wire it saves.
-_SPLIT_HOST_S_PER_UNIQUE = 25e-9
+# Split-digest host partition cost per unique
+# (engine/native_index.py:split_layout — C path measured ~19 ns/u
+# all-in at 3M uniques, output allocation included; numpy fallback
+# ~46 ns/u); the split election charges it against the wire it saves.
+_SPLIT_HOST_S_PER_UNIQUE = 15e-9
 
 # Weighted relay: longest rank-major permit matrix the scan step accepts.
 # A chunk whose deepest segment exceeds this (heavy duplication — Zipf
