@@ -449,3 +449,52 @@ def test_device_rates_fallback_and_cache(monkeypatch, tmp_path):
     finally:
         jax.config.update("jax_compilation_cache_dir", old)
     assert got2["s_per_lane"] == 1e-9 and got2["source"] == "probe"
+
+
+def test_schedule_candidates_invariants():
+    """Every candidate schedule covers n exactly, never emits a chunk
+    above _RELAY_CHUNK_MAX, and never ends in a sub-floor crumb (the
+    last entry sizes OVERFLOW chunks when a longer stream reuses a
+    banded plan — an RTT-sized tail entry would drain the overflow in
+    crumbs)."""
+    from ratelimiter_tpu.storage.tpu import (
+        _RELAY_CHUNK,
+        _RELAY_CHUNK_MAX,
+        _schedule_candidates,
+    )
+
+    for n in (1 << 24, (1 << 24) + 1234, 12_582_912,
+              _RELAY_CHUNK + _RELAY_CHUNK_MAX + 300_000, 1 << 26):
+        for words_pow2 in (False, True):
+            for sched in _schedule_candidates(n, _RELAY_CHUNK, words_pow2):
+                assert sum(sched) == n, (n, words_pow2, sched)
+                assert max(sched) <= _RELAY_CHUNK_MAX, sched
+                assert sched[-1] >= _RELAY_CHUNK, (n, words_pow2, sched)
+    assert _schedule_candidates(2 * _RELAY_CHUNK, _RELAY_CHUNK,
+                                False) == []  # short streams: no plan
+
+
+def test_chunk_cursor_overflow_uses_last_entry():
+    """A stream longer than its banded plan's schedule drains the
+    overflow at the LAST entry's size (never crumbs), and peek() sizes
+    the prefetch identically to the next next_size()."""
+    from ratelimiter_tpu.storage.tpu import _ChunkCursor
+
+    plan = {"kind": "pipelined", "schedule": (100, 500, 200),
+            "chunk": 500}
+    cur = _ChunkCursor(plan, True)
+    n = 1600  # 800 scheduled + 800 overflow
+    sizes, start = [], 0
+    while start < n:
+        peek = cur.peek(n - start) if sizes else None
+        c = cur.next_size(n - start)
+        if peek is not None:
+            assert peek == c
+        sizes.append(c)
+        start += c
+    assert sizes == [100, 500, 200, 200, 200, 200, 200]
+    # Legacy int-chunk plans still honor growth.
+    cur2 = _ChunkCursor({"kind": "pipelined", "chunk": 300}, True)
+    assert cur2.next_size(10_000) == 300
+    cur2.grow(700)
+    assert cur2.next_size(10_000) == 700
