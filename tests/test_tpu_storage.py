@@ -498,3 +498,58 @@ def test_chunk_cursor_overflow_uses_last_entry():
     assert cur2.next_size(10_000) == 300
     cur2.grow(700)
     assert cur2.next_size(10_000) == 700
+
+
+def test_drain_set_error_propagation_and_backpressure():
+    """_DrainSet: finish() re-raises the first drain error once all
+    drains land; finish(swallow=True) waits but never raises (the
+    primary-exception path); submit() bounds in-flight drains."""
+    import concurrent.futures as cf
+    import threading
+    import time as _time
+
+    from ratelimiter_tpu.storage.tpu import _DrainSet
+
+    pool = cf.ThreadPoolExecutor(4)
+    try:
+        ds = _DrainSet(pool, inflight=2)
+        done = []
+
+        def ok(i):
+            _time.sleep(0.01)
+            done.append(i)
+
+        def boom(i):
+            raise RuntimeError(f"drain {i} failed")
+
+        ds.submit(ok, 1)
+        ds.submit(boom, 2)
+        ds.submit(ok, 3)
+        with pytest.raises(RuntimeError, match="drain 2 failed"):
+            ds.finish()
+        assert sorted(done) == [1, 3]  # every drain ran to completion
+        ds.finish()  # cleared: a second finish is a no-op
+        # swallow=True: waits, never raises.
+        ds.submit(boom, 4)
+        ds.finish(swallow=True)
+        # Backpressure: with inflight=2, the third submit must WAIT on
+        # the oldest live drain (released by a timer thread) instead of
+        # queueing unboundedly — measured by the submit's block time.
+        gate = threading.Event()
+        slow_done = []
+
+        def slow(i):
+            gate.wait(5.0)
+            slow_done.append(i)
+
+        ds.submit(slow, 1)
+        ds.submit(slow, 2)
+        threading.Timer(0.2, gate.set).start()
+        t0 = _time.perf_counter()
+        ds.submit(slow, 3)  # blocks on live[0] until the gate opens
+        blocked = _time.perf_counter() - t0
+        ds.finish()
+        assert sorted(slow_done) == [1, 2, 3]
+        assert blocked >= 0.15, blocked  # the cap actually held
+    finally:
+        pool.shutdown(wait=False)
