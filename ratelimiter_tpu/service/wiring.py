@@ -154,6 +154,20 @@ def build_app(props: AppProperties | None = None,
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
+        # Boot-time link probe (r5): feeds the streaming loops' chunk-plan
+        # and wire-format elections.  Best-effort — a backend without a
+        # device link (memory) or a probe failure leaves the loops on the
+        # profile-less defaults (giant growth, device-first sort policy).
+        if props.get_bool("link.probe.enabled", True):
+            if hasattr(storage, "probe_link"):
+                try:
+                    storage.probe_link()
+                except Exception as exc:  # noqa: BLE001 — degraded boot
+                    import logging
+
+                    logging.getLogger("ratelimiter").warning(
+                        "boot link probe failed (%s): streaming loops run "
+                        "on profile-less defaults", exc)
         storage = _maybe_retry(_maybe_chaos(storage, props), props)
 
     limiters: Dict[str, RateLimiter] = {
