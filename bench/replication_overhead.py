@@ -1,0 +1,104 @@
+"""Hot-path cost of replication: decision rate with the journal off vs on.
+
+The replication design promise (ISSUE: "asynchronously off the decision
+path") cashes out here: with replication enabled the hot path pays ONE
+boolean scatter per dispatched chunk (SlotJournal.mark) while the
+replicator thread cuts/ships epochs concurrently.  This bench measures
+the streaming decision rate (acquire_stream_ids, the hyperscale path)
+three ways — journal detached, journal attached but idle, and journal
+attached with the async replicator shipping to an in-process standby —
+and reports the overhead percentage.  Acceptance: <= 10% with
+replication on.
+
+    JAX_PLATFORMS=cpu python bench/replication_overhead.py --n 262144
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_passes(storage, lid, key_ids, passes: int) -> float:
+    """Best decisions/s over ``passes`` timed stream passes."""
+    best = 0.0
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        storage.acquire_stream_ids("tb", lid, key_ids)
+        dt = time.perf_counter() - t0
+        best = max(best, len(key_ids) / dt)
+    return best
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n", type=int, default=1 << 18,
+                        help="requests per stream pass")
+    parser.add_argument("--keys", type=int, default=1 << 14,
+                        help="distinct tenant keys")
+    parser.add_argument("--passes", type=int, default=3)
+    parser.add_argument("--num-slots", type=int, default=1 << 16)
+    parser.add_argument("--interval-ms", type=float, default=50.0,
+                        help="replicator ship interval")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.replication import (
+        InProcessSink,
+        ReplicationLog,
+        Replicator,
+        StandbyReceiver,
+    )
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = np.random.default_rng(42)
+    key_ids = rng.integers(0, args.keys, size=args.n)
+    storage = TpuBatchedStorage(num_slots=args.num_slots)
+    lid = storage.register_limiter("tb", RateLimitConfig(
+        max_permits=1000, window_ms=1000, refill_rate=500.0))
+
+    storage.acquire_stream_ids("tb", lid, key_ids)  # compile + warm index
+
+    off_rps = run_passes(storage, lid, key_ids, args.passes)
+
+    log = ReplicationLog(storage)
+    journal_rps = run_passes(storage, lid, key_ids, args.passes)
+
+    standby = TpuBatchedStorage(num_slots=args.num_slots)
+    repl = Replicator(log, InProcessSink(StandbyReceiver(standby)),
+                      interval_ms=args.interval_ms).start()
+    on_rps = run_passes(storage, lid, key_ids, args.passes)
+    repl.stop(final_ship=True)
+
+    report = {
+        "n_per_pass": args.n,
+        "distinct_keys": args.keys,
+        "off_rps": round(off_rps),
+        "journal_only_rps": round(journal_rps),
+        "replicating_rps": round(on_rps),
+        "journal_overhead_pct": round(100 * (1 - journal_rps / off_rps), 2),
+        "replication_overhead_pct": round(100 * (1 - on_rps / off_rps), 2),
+        "frames_shipped": repl.frames_shipped,
+        "bytes_shipped": repl.bytes_shipped,
+        "epoch": log.epoch,
+    }
+    repl.close()
+    storage.close()
+    standby.close()
+    print(json.dumps(report, indent=2))
+    if report["replication_overhead_pct"] > 10.0:
+        raise SystemExit(
+            f"replication overhead {report['replication_overhead_pct']}% "
+            "exceeds the 10% budget")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,9 +75,19 @@ def _pad_i64(x: np.ndarray, size: int, fill: int) -> jnp.ndarray:
 class DeviceEngine:
     """Batched decision engine over TPU-resident counter arrays."""
 
+    # Replication (replication/log.py) works at this engine's packed-row
+    # granularity; the sharded engine partitions state differently and is
+    # not journaled yet.
+    supports_replication = True
+
     def __init__(self, num_slots: int, table: LimiterTable):
         self.num_slots = int(num_slots)
         self.table = table
+        # Optional dirty-slot journal (engine/state.py:SlotJournal): when
+        # attached, every mutation path marks the slots it touches before
+        # dispatching, so a replication log can ship per-epoch deltas.
+        # None (the default) keeps the hot path at one attribute check.
+        self.journal = None
         # The step functions donate the state buffers (in-place HBM updates),
         # so every access — including read-only peeks, which must not grab a
         # reference that a concurrent step is about to invalidate — is
@@ -129,6 +139,20 @@ class DeviceEngine:
         self._sw_reset = jax.jit(sw_reset_p, donate_argnums=0)
         self._tb_reset = jax.jit(tb_reset_p, donate_argnums=0)
 
+    # -- dirty-slot journal hooks (replication) --------------------------------
+    def _mark(self, algo: str, slots) -> None:
+        j = self.journal
+        if j is not None:
+            j.mark(algo, slots)
+
+    def _mark_words(self, algo: str, words) -> None:
+        """Mark from relay uwords (slot in the high bits; padding words
+        decode past num_slots and are filtered by the journal)."""
+        j = self.journal
+        if j is not None:
+            j.mark(algo, np.asarray(words).astype(np.uint64)
+                   >> np.uint64(self.rank_bits + 1))
+
     # -- i64 field view (checkpoint/compat) ------------------------------------
     @property
     def sw_state(self) -> SWState:
@@ -136,6 +160,8 @@ class DeviceEngine:
 
     @sw_state.setter
     def sw_state(self, state: SWState) -> None:
+        if self.journal is not None:
+            self.journal.mark_all("sw")
         self.sw_packed = sw_pack_state(
             SWState(*(jnp.asarray(f) for f in state)))
 
@@ -145,6 +171,8 @@ class DeviceEngine:
 
     @tb_state.setter
     def tb_state(self, state: TBState) -> None:
+        if self.journal is not None:
+            self.journal.mark_all("tb")
         self.tb_packed = tb_pack_state(
             TBState(*(jnp.asarray(f) for f in state)))
 
@@ -158,6 +186,7 @@ class DeviceEngine:
     def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         """Dispatch a sliding-window batch; returns a lazy fused handle
         (pass to :meth:`sw_acquire_drain` with the batch length)."""
+        self._mark("sw", slots)
         size = _bucket_size(len(slots))
         with self._lock:
             new_state, packed = self._sw_step(
@@ -182,6 +211,7 @@ class DeviceEngine:
         return self.sw_acquire_drain(handle, len(slots))
 
     def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
+        self._mark("tb", slots)
         size = _bucket_size(len(slots))
         with self._lock:
             new_state, packed = self._tb_step(
@@ -215,6 +245,7 @@ class DeviceEngine:
         return self._scan_dispatch("tb", slots_kb, lids, permits_kb, now_k)
 
     def _scan_dispatch(self, algo, slots_kb, lids, permits_kb, now_k):
+        self._mark(algo, slots_kb)
         slots_kb = jnp.asarray(np.ascontiguousarray(slots_kb, dtype=np.int32))
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
@@ -248,6 +279,7 @@ class DeviceEngine:
         return self._flat_dispatch("tb", slots, lids, permits, now_ms)
 
     def _flat_dispatch(self, algo, slots, lids, permits, now_ms):
+        self._mark(algo, slots)
         slots = jnp.asarray(np.ascontiguousarray(slots, dtype=np.int32))
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
@@ -291,6 +323,7 @@ class DeviceEngine:
     def _relay_dispatch(self, algo, words, lids, now_ms):
         """words uint32[B] (padding 0xFFFFFFFF); lids scalar or i32[B];
         returns a lazy uint8[B/8] arrival-order allow bitmask handle."""
+        self._mark_words(algo, words)
         words = jnp.asarray(np.ascontiguousarray(words, dtype=np.uint32))
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
@@ -334,6 +367,7 @@ class DeviceEngine:
             tb_relay_weighted,
         )
 
+        self._mark_words(algo, uwords)
         key = (algo, int(r_steps))
         fn = self._relay_weighted.get(key)
         if fn is None:
@@ -393,6 +427,14 @@ class DeviceEngine:
             tb_relay_counts_split,
         )
 
+        if self.journal is not None:
+            # Singleton plane: little-endian 24-bit slots (padding 0xFFFFFF
+            # decodes past num_slots — the journal filters it).
+            s3a = np.asarray(s3, dtype=np.int64)
+            self.journal.mark(
+                algo, s3a[:, 0] | (s3a[:, 1] << 8) | (s3a[:, 2] << 16))
+            self._mark_words(algo, mwords)
+
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
         key = (algo, out_dtype().dtype.name, "split")
         fn = self._relay_counts.get(key)
@@ -445,6 +487,8 @@ class DeviceEngine:
             tb_relay_counts_resident,
         )
 
+        self._mark_words(algo, uwords)
+
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
         key = (algo, out_dtype().dtype.name, bool(slots_sorted))
         fn = self._relay_resident.get(key)
@@ -481,6 +525,7 @@ class DeviceEngine:
         returns a lazy out_dtype[U] per-unique allowed-count handle.
         ``slots_sorted`` (host sorted the uniques by slot): the scatter
         runs as the dense presorted block sweep."""
+        self._mark_words(algo, uwords)
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
         key = (algo, out_dtype().dtype.name, bool(slots_sorted))
         fn = self._relay_counts.get(key)
@@ -537,12 +582,14 @@ class DeviceEngine:
 
     # -- reset ----------------------------------------------------------------
     def sw_clear(self, slots: Sequence[int]) -> None:
+        self._mark("sw", slots)
         size = _bucket_size(max(len(slots), 1))
         with self._lock:
             self.sw_packed = self._sw_reset(
                 self.sw_packed, _pad_i32(np.asarray(slots, dtype=np.int32), size, -1))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
+        self._mark("tb", slots)
         size = _bucket_size(max(len(slots), 1))
         with self._lock:
             self.tb_packed = self._tb_reset(
@@ -558,6 +605,7 @@ class DeviceEngine:
 
     def write_rows(self, algo: str, slots, rows: np.ndarray) -> None:
         """Overwrite packed state rows (import side of a rebalance)."""
+        self._mark(algo, slots)
         with self._lock:
             idx = jnp.asarray(np.ascontiguousarray(slots, dtype=np.int32))
             vals = jnp.asarray(np.ascontiguousarray(rows, dtype=np.int32))

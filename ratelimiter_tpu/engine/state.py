@@ -21,7 +21,8 @@ so one device batch can mix tenants freely.
 from __future__ import annotations
 
 import threading
-from typing import NamedTuple
+import time
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -169,3 +170,86 @@ class LimiterTable:
         the relay word layout's rank-clamp ceiling must exceed this."""
         with self._lock:
             return int(self._max_permits[:self._n].max(initial=0))
+
+
+class SlotJournal:
+    """Host-side dirty-slot journal feeding the replication log.
+
+    Every ``DeviceEngine`` mutation path calls :meth:`mark` with the
+    host-side slot ids of the rows it is about to touch — a boolean
+    scatter into a per-algo mask, O(batch) and off the device critical
+    path (the dispatch itself has not been enqueued yet, so no device
+    work waits on the mark).  ``drain`` atomically swaps the masks out
+    and returns the coalesced dirty slot set per algo — the delta a
+    replication epoch ships (replication/log.py).
+
+    Marks are a superset of actual mutations (a denied request's slot is
+    marked even though the row may be unchanged); shipping an unchanged
+    row is idempotent, so over-marking costs bytes, never correctness.
+    Out-of-range ids (batch padding -1, relay padding words) are
+    filtered here so callers can mark their raw lane arrays.
+    """
+
+    __slots__ = ("num_slots", "_lock", "_dirty", "_all", "_oldest_ns",
+                 "marks")
+
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self._lock = threading.Lock()
+        self._dirty: Dict[str, np.ndarray] = {
+            "sw": np.zeros(self.num_slots, dtype=bool),
+            "tb": np.zeros(self.num_slots, dtype=bool),
+        }
+        self._all = {"sw": False, "tb": False}
+        # Wall time of the first mark since the last drain — the age of
+        # the oldest unreplicated mutation, i.e. the replication lag.
+        self._oldest_ns: Optional[int] = None
+        self.marks = 0
+
+    def mark(self, algo: str, slots) -> None:
+        a = np.asarray(slots).reshape(-1).astype(np.int64, copy=False)
+        if not len(a):
+            return
+        sel = a[(a >= 0) & (a < self.num_slots)]
+        with self._lock:
+            self.marks += 1
+            if len(sel):
+                self._dirty[algo][sel] = True
+                if self._oldest_ns is None:
+                    self._oldest_ns = time.time_ns()
+
+    def mark_all(self, algo: str) -> None:
+        """Mark every slot dirty (bulk restores/imports, or a full-state
+        catch-up frame after a ship failure or a late-joining standby)."""
+        with self._lock:
+            self._all[algo] = True
+            if self._oldest_ns is None:
+                self._oldest_ns = time.time_ns()
+
+    def drain(self) -> Tuple[Dict[str, np.ndarray], Optional[int], bool]:
+        """Swap out and return ``(dirty slot ids per algo, wall ns of the
+        oldest pending mark, whether any algo was marked-all)``."""
+        with self._lock:
+            out: Dict[str, np.ndarray] = {}
+            was_all = False
+            for algo, mask in self._dirty.items():
+                if self._all[algo]:
+                    out[algo] = np.arange(self.num_slots, dtype=np.int64)
+                    self._all[algo] = False
+                    mask[:] = False
+                    was_all = True
+                else:
+                    ids = np.nonzero(mask)[0]
+                    if len(ids):
+                        out[algo] = ids
+                        mask[ids] = False
+            oldest = self._oldest_ns
+            self._oldest_ns = None
+            return out, oldest, was_all
+
+    def pending(self) -> int:
+        """Total dirty slots across algos (cheap visibility for tests
+        and the lag gauge)."""
+        with self._lock:
+            return sum(self.num_slots if self._all[a] else int(m.sum())
+                       for a, m in self._dirty.items())

@@ -1,3 +1,3 @@
-from ratelimiter_tpu.metrics.registry import Counter, MeterRegistry
+from ratelimiter_tpu.metrics.registry import Counter, Gauge, MeterRegistry, Timer
 
-__all__ = ["Counter", "MeterRegistry"]
+__all__ = ["Counter", "Gauge", "MeterRegistry", "Timer"]

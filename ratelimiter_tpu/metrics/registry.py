@@ -46,6 +46,30 @@ class Counter:
             return self._value
 
 
+class Gauge:
+    """A named point-in-time value (Micrometer Gauge analog).
+
+    Unlike ``Counter`` it is set, not accumulated — used for values that
+    can move both ways, e.g. ``ratelimiter.replication.lag_ms``.
+    """
+
+    __slots__ = ("name", "description", "_value", "_lock")
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
 class Timer:
     """Latency recorder with percentile snapshots.
 
@@ -113,6 +137,16 @@ class MeterRegistry:
                 raise TypeError(f"meter {name!r} already registered as {type(meter).__name__}")
             return meter
 
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        with self._lock:
+            meter = self._meters.get(name)
+            if meter is None:
+                meter = Gauge(name, description)
+                self._meters[name] = meter
+            if not isinstance(meter, Gauge):
+                raise TypeError(f"meter {name!r} already registered as {type(meter).__name__}")
+            return meter
+
     def timer(self, name: str, description: str = "") -> Timer:
         with self._lock:
             meter = self._meters.get(name)
@@ -131,6 +165,8 @@ class MeterRegistry:
         for name, meter in meters.items():
             if isinstance(meter, Counter):
                 out[name] = meter.count()
+            elif isinstance(meter, Gauge):
+                out[name] = meter.value()
             elif isinstance(meter, Timer):
                 out[name] = meter.snapshot()
         return out

@@ -49,6 +49,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # jax >= 0.6 exports the context manager at top level
+    enable_x64 = jax.enable_x64
+except AttributeError:  # older jax: experimental API, same semantics
+    from jax.experimental import enable_x64
+
 T = 256          # state rows per block; S must divide by this
 
 _FLAG = os.environ.get("RATELIMITER_BLOCK_SCATTER", "1") == "1"
@@ -152,7 +157,7 @@ def scatter_rows(state, sorted_slots, write_mask, rows,
     # under jax_enable_x64 the grid/BlockSpec index plumbing emits i64
     # index arithmetic that crashes the TPU compiler outright (any
     # grid-ful pallas_call does, even a block copy — found on v5e).
-    with jax.enable_x64(False):
+    with enable_x64(False):
         key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))
         ops = jax.lax.sort(
             (key,) + tuple(rows[:, j] for j in range(lanes)), num_keys=1)
@@ -183,7 +188,7 @@ def scatter_rows_presorted(state, sorted_slots, write_mask, rows,
     if interpret is None:
         interpret = _INTERPRET
     s_rows, lanes = state.shape
-    with jax.enable_x64(False):
+    with enable_x64(False):
         # Masked lanes are at the tail, so mapping them to the sentinel
         # (s_rows) preserves ascending order.
         key = jnp.where(write_mask, sorted_slots, jnp.int32(s_rows))

@@ -27,6 +27,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exports shard_map at top level
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # older jax: the same API lives in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with the replication checker off: the flat
+    step's duplicate solver lowers a ``while_loop``, for which older
+    checkers have no replication rule (every spec here is explicit, so
+    the checker adds nothing)."""
+    try:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=False)
+    except TypeError:  # newer jax dropped/renamed check_rep
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
+
 from ratelimiter_tpu.engine.slots import SlotIndex
 from ratelimiter_tpu.engine.state import LimiterTable
 from ratelimiter_tpu.ops.sliding_window import (
@@ -170,7 +188,7 @@ def build_sharded_sw_step(mesh):
         totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
         return new_state[None], SWOut(*(f[None] for f in out)), totals
 
-    return jax.shard_map(
+    return shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
@@ -187,7 +205,7 @@ def build_sharded_tb_step(mesh):
         totals = jax.lax.psum(jnp.stack([n_allowed, n_total]), SHARD_AXIS)
         return new_state[None], TBOut(*(f[None] for f in out)), totals
 
-    return jax.shard_map(
+    return shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
@@ -222,7 +240,7 @@ def build_sharded_scan(mesh, step_p, lids_scalar: bool, has_permits: bool):
             return st[None], bits[None]
 
         in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P())
-    return jax.shard_map(
+    return shard_map(
         local_scan,
         mesh=mesh,
         in_specs=in_specs,
@@ -256,7 +274,7 @@ def build_sharded_flat(mesh, flat_fn, lids_scalar: bool, has_permits: bool):
             return st[None], bits[None]
 
         in_specs = (P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P())
-    return jax.shard_map(
+    return shard_map(
         local_flat,
         mesh=mesh,
         in_specs=in_specs,
@@ -280,7 +298,7 @@ def build_sharded_relay(mesh, relay_fn, lids_scalar: bool):
                            lids if lids_scalar else lids[0], now)
         return st[None], out[None]
 
-    return jax.shard_map(
+    return shard_map(
         local_relay,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), lid_spec, P()),
@@ -293,7 +311,7 @@ def build_sharded_peek(mesh, peek_fn):
         out = peek_fn(state[0], table, slots[0], lids[0], now)
         return out[None]
 
-    return jax.shard_map(
+    return shard_map(
         local_peek,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(), P(SHARD_AXIS), P(SHARD_AXIS), P()),
@@ -305,7 +323,7 @@ def build_sharded_reset(mesh, reset_fn):
     def local_reset(state, slots):
         return reset_fn(state[0], slots[0])[None]
 
-    return jax.shard_map(
+    return shard_map(
         local_reset,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),

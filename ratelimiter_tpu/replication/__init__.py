@@ -1,0 +1,59 @@
+"""Live state replication & hot-standby failover for the TPU engine.
+
+The availability layer Redis AOF/replication gave the reference and the
+device-resident engine lacked: the primary's engine journals dirty slots
+per dispatched batch (engine/state.py:SlotJournal), a ``ReplicationLog``
+coalesces them into epoch-stamped frames (replication/wire.py), an async
+``Replicator`` ships the frames off the decision path, and a
+``StandbyReceiver`` applies them to a shadow engine that can be promoted
+on failover with decisions bit-identical to ``semantics/oracle.py`` for
+every key at or before the last replicated epoch.
+
+Wiring (service/wiring.py) is config-gated and OFF by default:
+
+    replication.enabled     = true
+    replication.role        = primary | standby
+    replication.target      = standby-host:7401        (primary)
+    replication.listen_port = 7401                     (standby)
+    replication.interval_ms = 200                      (primary)
+"""
+
+from ratelimiter_tpu.replication.log import (
+    ReplicationLog,
+    engine_state_fingerprint,
+)
+from ratelimiter_tpu.replication.replicator import Replicator
+from ratelimiter_tpu.replication.standby import (
+    ReplicationStateError,
+    StandbyReceiver,
+)
+from ratelimiter_tpu.replication.transport import (
+    FrameArchive,
+    InProcessSink,
+    ReplicationServer,
+    SocketSink,
+    TeeSink,
+)
+from ratelimiter_tpu.replication.wire import (
+    DEFAULT_FRAME_BUDGET,
+    chunk_frames,
+    decode_frame,
+    encode_frame,
+)
+
+__all__ = [
+    "DEFAULT_FRAME_BUDGET",
+    "FrameArchive",
+    "InProcessSink",
+    "ReplicationLog",
+    "ReplicationServer",
+    "ReplicationStateError",
+    "Replicator",
+    "SocketSink",
+    "StandbyReceiver",
+    "TeeSink",
+    "chunk_frames",
+    "decode_frame",
+    "encode_frame",
+    "engine_state_fingerprint",
+]

@@ -1,0 +1,129 @@
+"""Replication log: dirty-slot deltas coalesced into epoch-stamped frames.
+
+The primary's ``DeviceEngine`` marks every slot a dispatch touches into a
+``SlotJournal`` (engine/state.py) — off the decision path, one boolean
+scatter per batch.  ``ReplicationLog.cut()`` turns the journal's
+accumulated delta into wire frames:
+
+1. flush the micro-batcher (queued requests dispatch, marking their slots);
+2. drain the journal (atomic swap — marks racing the drain land in the
+   NEXT epoch, and a row read here that a concurrent dispatch then
+   overwrites is simply re-shipped next cut: row writes are idempotent);
+3. read the dirty rows from the device (one gather per algo);
+4. dump the key->slot index journal + limiter table (the addressing a
+   standby needs to serve the rows after promotion);
+5. stamp everything with the next epoch and chunk to the wire budget
+   (replication/wire.py).
+
+Consistency model: a frame captures every mutation that completed before
+its cut began; mutations concurrent with the cut land in this epoch, the
+next, or both (both is harmless).  Slot REUSE concurrent with a cut (an
+eviction remapping a slot between the row read and the index dump) can
+pair a new key with its predecessor's row for one epoch — the next cut
+repairs it, and keys whose last mutation precedes the cut are exact,
+which is precisely the "at or before the replicated epoch" guarantee the
+failover drill checks (storage/chaos.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from ratelimiter_tpu.engine.state import SlotJournal
+from ratelimiter_tpu.replication.wire import DEFAULT_FRAME_BUDGET, chunk_frames
+
+
+def _wall_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class ReplicationLog:
+    """Owns the primary's journal and cuts epoch-stamped frame batches."""
+
+    def __init__(self, storage, max_frame_bytes: int = DEFAULT_FRAME_BUDGET):
+        engine = storage.engine
+        if not getattr(engine, "supports_replication", False):
+            raise ValueError(
+                "replication requires the single-device DeviceEngine "
+                "(the sharded engine is not journaled yet)")
+        self.storage = storage
+        self.engine = engine
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.journal = SlotJournal(engine.num_slots)
+        engine.journal = self.journal
+        self.epoch = 0
+        self._full_pending = True  # first cut bootstraps the standby
+        self._lock = threading.Lock()
+        # Lag of the newest cut: age of the oldest mutation it shipped.
+        self.last_cut_lag_ms = 0.0
+
+    def request_full(self) -> None:
+        """Make the next cut ship the complete state (standby bootstrap,
+        or recovery after a ship failure left the stream gapped)."""
+        with self._lock:
+            self._full_pending = True
+            self.journal.mark_all("sw")
+            self.journal.mark_all("tb")
+
+    def cut(self) -> List[Dict]:
+        """Cut one epoch: returns the frame dicts to ship (empty when
+        nothing changed since the last cut — the epoch is not consumed)."""
+        with self._lock:
+            self.storage.flush()
+            if self._full_pending:
+                self.journal.mark_all("sw")
+                self.journal.mark_all("tb")
+            deltas_ids, oldest_ns, was_all = self.journal.drain()
+            full = self._full_pending or was_all
+            if not deltas_ids and not full:
+                self.last_cut_lag_ms = 0.0
+                return []
+            deltas = {}
+            for algo, ids in deltas_ids.items():
+                deltas[algo] = {
+                    "slots": ids,
+                    "rows": self.engine.read_rows(algo, ids),
+                }
+            from ratelimiter_tpu.engine.checkpoint import (
+                _limiter_table_dump,
+                dump_slot_indexes,
+            )
+
+            index_dump = dump_slot_indexes(self.storage)
+            limiters = _limiter_table_dump(self.storage)
+            self.epoch += 1
+            self._full_pending = False
+            now = time.time_ns()
+            self.last_cut_lag_ms = ((now - oldest_ns) / 1e6
+                                    if oldest_ns is not None else 0.0)
+            return chunk_frames(self.epoch, _wall_ms(),
+                                self.engine.num_slots, deltas, index_dump,
+                                limiters, full=full,
+                                max_bytes=self.max_frame_bytes)
+
+    def remark(self, frames: List[Dict]) -> None:
+        """Put a failed ship's slots back in the journal so the delta is
+        re-sent (the replicator also requests a full frame, since the
+        standby's epoch stream now has a gap)."""
+        for frame in frames:
+            for algo, payload in frame.get("algos", {}).items():
+                self.journal.mark(algo, payload["slots"])
+
+    def pending(self) -> int:
+        return self.journal.pending()
+
+    def detach(self) -> None:
+        """Stop journaling (the engine reverts to zero-overhead marks)."""
+        self.engine.journal = None
+
+
+def engine_state_fingerprint(engine) -> Dict[str, np.ndarray]:
+    """Host copies of both packed state arrays (test/drill equality
+    checks between a primary and a caught-up standby)."""
+    engine.block_until_ready()
+    return {"sw": np.asarray(engine.sw_packed).copy(),
+            "tb": np.asarray(engine.tb_packed).copy()}

@@ -1,0 +1,139 @@
+"""Async replicator: ships epoch frames from a ReplicationLog to a sink.
+
+Replication is strictly OFF the decision path ("When Two is Worse Than
+One", PAPERS.md — naive synchronous redundancy degrades tail latency):
+the hot path only marks a dirty mask; this thread wakes every
+``interval_ms``, cuts an epoch, and pushes the frames through the sink.
+A slow or dead standby therefore costs the primary nothing but memory
+for the dirty mask — decisions never wait on the wire.
+
+Failure model: a sink error re-marks the failed frames' slots into the
+journal and requests a FULL next frame (the standby's epoch stream now
+has a gap it will refuse to promote across until re-baselined), bumps
+the error counter, and keeps looping — asynchronous replication degrades
+to "standby lags further", never to "primary stops deciding".
+
+Metrics (metrics/registry.py, scraped by /actuator/metrics):
+  ratelimiter.replication.lag_ms    gauge   age of the oldest unshipped
+                                            mutation at the last cut
+  ratelimiter.replication.epoch     gauge   newest epoch cut
+  ratelimiter.replication.frames    counter frames shipped
+  ratelimiter.replication.bytes     counter encoded bytes shipped
+  ratelimiter.replication.errors    counter ship failures
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ratelimiter_tpu.replication.wire import encode_frame
+from ratelimiter_tpu.utils.logging import get_logger
+
+_log = get_logger("replication")
+
+
+class Replicator:
+    def __init__(self, log, sink, interval_ms: float = 200.0,
+                 registry=None):
+        self.log = log
+        self.sink = sink
+        self.interval_ms = float(interval_ms)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._ship_lock = threading.Lock()
+        self.frames_shipped = 0
+        self.bytes_shipped = 0
+        self.errors = 0
+        if registry is not None:
+            self._m_lag = registry.gauge(
+                "ratelimiter.replication.lag_ms",
+                "Age (ms) of the oldest unreplicated mutation at the "
+                "last epoch cut")
+            self._m_epoch = registry.gauge(
+                "ratelimiter.replication.epoch",
+                "Newest replication epoch cut on the primary")
+            self._m_frames = registry.counter(
+                "ratelimiter.replication.frames",
+                "Replication frames shipped to the standby")
+            self._m_bytes = registry.counter(
+                "ratelimiter.replication.bytes",
+                "Encoded replication bytes shipped")
+            self._m_errors = registry.counter(
+                "ratelimiter.replication.errors",
+                "Replication ship failures (frames re-marked, next "
+                "frame full)")
+        else:
+            self._m_lag = self._m_epoch = None
+            self._m_frames = self._m_bytes = self._m_errors = None
+
+    # -- one synchronous ship cycle (tests drive this deterministically) ------
+    def ship_now(self) -> int:
+        """Cut an epoch and ship it; returns frames shipped (0 = clean)."""
+        with self._ship_lock:
+            frames = self.log.cut()
+            if self._m_lag is not None:
+                self._m_lag.set(self.log.last_cut_lag_ms)
+            if not frames:
+                return 0
+            if self._m_epoch is not None:
+                self._m_epoch.set(self.log.epoch)
+            shipped = 0
+            try:
+                for i, frame in enumerate(frames):
+                    data = encode_frame(frame)
+                    self.sink.send(data)
+                    shipped += 1
+                    self.frames_shipped += 1
+                    self.bytes_shipped += len(data)
+                    if self._m_frames is not None:
+                        self._m_frames.increment()
+                        self._m_bytes.add(len(data))
+            except Exception:
+                # Unshipped rows go back in the journal; the epoch the
+                # standby half-saw is re-baselined by a full next frame.
+                self.errors += 1
+                if self._m_errors is not None:
+                    self._m_errors.increment()
+                self.log.remark(frames[shipped:])
+                self.log.request_full()
+                raise
+            return shipped
+
+    # -- background loop ------------------------------------------------------
+    def start(self) -> "Replicator":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="replicator", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.ship_now()
+            except Exception as exc:  # noqa: BLE001 — async loop survives
+                _log.warning("replication ship failed: %s (will retry "
+                             "with a full frame)", exc)
+
+    def stop(self, final_ship: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_ship:
+            try:
+                self.ship_now()
+            except Exception as exc:  # noqa: BLE001 — best effort drain
+                _log.warning("final replication ship failed: %s", exc)
+
+    def close(self) -> None:
+        self.stop()
+        self.log.detach()
+        if hasattr(self.sink, "close"):
+            self.sink.close()
+
+    def lag_ms(self) -> float:
+        """Current lag estimate: the last cut's measured lag, or — when
+        mutations are pending — the time since the interval began."""
+        return self.log.last_cut_lag_ms

@@ -1,0 +1,150 @@
+"""Hot-standby receiver: applies replication frames to a shadow engine.
+
+The standby owns an idle ``TpuBatchedStorage`` of the SAME geometry as
+the primary (num_slots must match — rows address slots 1:1, like
+checkpoints).  Frames apply as they arrive:
+
+- limiter registrations replay in lid order (device decisions gather
+  policy rows by lid, so lids must mean the same policy on both sides);
+- state rows write straight into the shadow engine's HBM arrays
+  (idempotent — a re-shipped row is a no-op);
+- the epoch's LAST sub-frame carries the key->slot index journal, which
+  is stashed (not applied): the standby's own index stays empty until
+  promotion, so nothing can route traffic into half-replicated state.
+
+Epoch accounting: frames must arrive in epoch order with no gaps.  A gap
+(lost frames, a restarted primary) marks the receiver INCONSISTENT — it
+keeps applying rows (they only ever move the shadow closer to the
+primary) but refuses to promote until a ``full`` frame re-baselines the
+stream.  The ``epoch_gap`` counter makes the event observable.
+
+``promote()`` is failover: rebuild the key->slot index from the last
+replicated journal frame (``TpuBatchedStorage.promote_from_replica``),
+bump the failover counter, and return the storage — now serving
+decisions bit-identical to the oracle for every key whose last mutation
+was at or before the promoted epoch (tests/test_replication.py drives
+the differential).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.replication.wire import decode_frame
+
+
+class ReplicationStateError(RuntimeError):
+    """Promotion refused: the replica stream is gapped or unbootstrapped."""
+
+
+class StandbyReceiver:
+    """Applies frames to a shadow storage; promotes it on failover."""
+
+    def __init__(self, storage, registry=None, start_epoch: int = 0):
+        self.storage = storage
+        self.last_epoch = int(start_epoch)
+        # A receiver seeded from a checkpoint taken at epoch E starts
+        # consistent at E; a fresh one must first see a full frame.
+        self.consistent = start_epoch > 0
+        self.promoted = False
+        self._index_dump: Optional[Dict] = None
+        self._lock = threading.Lock()
+        self._frames_applied = 0
+        if registry is not None:
+            self._applied_epoch = registry.gauge(
+                "ratelimiter.replication.applied_epoch",
+                "Newest fully applied replication epoch")
+            self._gaps = registry.counter(
+                "ratelimiter.replication.epoch_gap",
+                "Replication epoch gaps observed (stream inconsistent "
+                "until the next full frame)")
+            self._failovers = registry.counter(
+                "ratelimiter.replication.failovers",
+                "Standby promotions executed")
+        else:
+            self._applied_epoch = self._gaps = self._failovers = None
+
+    # -- frame application ----------------------------------------------------
+    def apply_bytes(self, data: bytes) -> None:
+        self.apply(decode_frame(data))
+
+    def apply(self, frame: Dict) -> None:
+        with self._lock:
+            if frame["num_slots"] != self.storage.engine.num_slots:
+                raise ValueError(
+                    f"frame geometry {frame['num_slots']} != standby "
+                    f"{self.storage.engine.num_slots}; replication is "
+                    "geometry-locked (like checkpoints)")
+            epoch = int(frame["epoch"])
+            if frame.get("full") and frame.get("seq", 0) == 0:
+                # A full frame re-baselines the stream unconditionally.
+                self.consistent = True
+            elif epoch > self.last_epoch + 1 and not frame.get("full"):
+                self.consistent = False
+                if self._gaps is not None:
+                    self._gaps.increment()
+            if "limiters" in frame:
+                self._register_limiters(frame["limiters"])
+            for algo, payload in frame.get("algos", {}).items():
+                self.storage.engine.write_rows(
+                    algo, payload["slots"], payload["rows"])
+            self._frames_applied += 1
+            if frame.get("last"):
+                self._index_dump = frame.get("index")
+                self.last_epoch = epoch
+                if self._applied_epoch is not None:
+                    self._applied_epoch.set(epoch)
+
+    def _register_limiters(self, limiters: Dict) -> None:
+        """Replay the primary's limiter registrations (lid order) and
+        verify rows already registered still agree — a drifted policy
+        would silently mis-decide every replicated row of that tenant."""
+        have = self.storage._configs
+        for lid in sorted(limiters, key=int):
+            cfg = limiters[lid]
+            lid_i = int(lid)
+            if lid_i in have:
+                algo, existing = have[lid_i]
+                if (algo != cfg["algo"]
+                        or existing.max_permits != cfg["max_permits"]
+                        or existing.window_ms != cfg["window_ms"]
+                        or existing.refill_rate != cfg["refill_rate"]):
+                    raise ValueError(
+                        f"standby limiter {lid_i} diverges from the "
+                        "primary's registration")
+                continue
+            got = self.storage.register_limiter(
+                cfg["algo"],
+                RateLimitConfig(max_permits=cfg["max_permits"],
+                                window_ms=cfg["window_ms"],
+                                refill_rate=cfg["refill_rate"]))
+            if got != lid_i:
+                raise ValueError(
+                    f"standby assigned lid {got} where the primary has "
+                    f"{lid_i}; register limiters in the same order on "
+                    "both sides (or let replication do all registration)")
+
+    # -- failover -------------------------------------------------------------
+    def promote(self, force: bool = False):
+        """Promote the shadow to serving primary; returns its storage."""
+        with self._lock:
+            if not self.consistent and not force:
+                raise ReplicationStateError(
+                    "replica stream is gapped/unbootstrapped; wait for a "
+                    "full frame or promote(force=True) to accept data "
+                    "loss beyond the last consistent epoch")
+            if self._index_dump is None and not force:
+                raise ReplicationStateError(
+                    "no index journal replicated yet; nothing to promote")
+            if self._index_dump is not None:
+                self.storage.promote_from_replica(self._index_dump)
+            self.promoted = True
+            if self._failovers is not None:
+                self._failovers.increment()
+            return self.storage
+
+    @property
+    def frames_applied(self) -> int:
+        return self._frames_applied

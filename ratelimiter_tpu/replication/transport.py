@@ -1,0 +1,187 @@
+"""Replication transports: in-process and sidecar-style TCP.
+
+The TCP framing deliberately mirrors the decision sidecar
+(service/sidecar.py) — ``u32 length | payload`` little-endian, one ack
+byte back per frame — so any environment that can deploy the sidecar
+can deploy a standby next to it.  The ack is what makes ship failures
+*detectable*: a frame the standby could not apply (geometry mismatch,
+decode error) acks nonzero, and the replicator's failure path re-marks
+the delta and re-baselines with a full frame.
+
+``InProcessSink`` round-trips frames through encode/decode even though
+it could hand the dict over directly — the in-process path (tests, the
+chaos drill) then exercises the exact bytes the TCP path ships.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+ACK_OK = 0
+ACK_ERROR = 1
+
+_LEN = struct.Struct("<I")
+
+
+class InProcessSink:
+    """Feeds a StandbyReceiver in the same process (tests, drills)."""
+
+    def __init__(self, receiver):
+        self.receiver = receiver
+
+    def send(self, data: bytes) -> None:
+        self.receiver.apply_bytes(data)
+
+    def close(self) -> None:
+        pass
+
+
+class TeeSink:
+    """Fan out frames to several sinks (e.g. a standby plus a frame
+    archive in the checkpoint-catch-up tests).  All sinks get every
+    frame; the first failure propagates after the fan-out completes."""
+
+    def __init__(self, *sinks):
+        self.sinks = list(sinks)
+
+    def send(self, data: bytes) -> None:
+        err = None
+        for sink in self.sinks:
+            try:
+                sink.send(data)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                err = err or exc
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            if hasattr(sink, "close"):
+                sink.close()
+
+
+class FrameArchive:
+    """A sink that just records encoded frames (replay / catch-up)."""
+
+    def __init__(self):
+        self.frames: list = []
+
+    def send(self, data: bytes) -> None:
+        self.frames.append(data)
+
+
+class SocketSink:
+    """Primary-side TCP sender with per-frame acks.
+
+    Connects lazily and reconnects on the next send after a failure, so
+    a standby restart does not wedge the replicator permanently.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def send(self, data: bytes) -> None:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._sock.sendall(_LEN.pack(len(data)) + data)
+                ack = self._recv_exact(1)
+            except OSError:
+                self._drop()
+                raise
+            if ack[0] != ACK_OK:
+                self._drop()
+                raise ConnectionError(
+                    f"standby rejected replication frame (ack={ack[0]})")
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("standby closed connection")
+            buf += chunk
+        return buf
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+class ReplicationServer:
+    """Standby-side TCP listener feeding a StandbyReceiver."""
+
+    def __init__(self, receiver, host: str = "0.0.0.0", port: int = 0):
+        self.receiver = receiver
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                buf = b""
+                while True:
+                    try:
+                        chunk = sock.recv(1 << 20)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    out = b""
+                    while len(buf) >= _LEN.size:
+                        (length,) = _LEN.unpack_from(buf)
+                        if len(buf) < _LEN.size + length:
+                            break
+                        frame = buf[_LEN.size:_LEN.size + length]
+                        buf = buf[_LEN.size + length:]
+                        try:
+                            outer.receiver.apply_bytes(frame)
+                            out += bytes([ACK_OK])
+                        except Exception:  # noqa: BLE001 — ack the failure
+                            out += bytes([ACK_ERROR])
+                    if out:
+                        try:
+                            sock.sendall(out)
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="replication-rx",
+            daemon=True)
+
+    def start(self) -> "ReplicationServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -119,6 +119,11 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
                               {"status": "UP" if up else "DOWN"})
         if self.path == "/actuator/metrics":
             return self._json(200, {"meters": self.ctx.registry.scrape()})
+        if self.path == "/actuator/replication":
+            repl = self.ctx.replication
+            if repl is None:
+                return self._json(200, {"enabled": False})
+            return self._json(200, {"enabled": True, **repl.status()})
         if self.path.startswith("/actuator/trace"):
             trace = getattr(self.ctx.storage, "trace", None)
             if trace is None:
@@ -131,7 +136,23 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._login()
         if self.path == "/api/batch":
             return self._batch()
+        if self.path == "/actuator/replication/promote":
+            return self._promote()
         self._json(404, {"error": "not found"})
+
+    def _promote(self):
+        """Failover control: promote a standby to serving primary."""
+        repl = self.ctx.replication
+        if repl is None or repl.receiver is None:
+            return self._json(409, {"error": "not a replication standby"})
+        from ratelimiter_tpu.replication import ReplicationStateError
+
+        force = bool(self._body().get("force", False))
+        try:
+            repl.receiver.promote(force=force)
+        except ReplicationStateError as exc:
+            return self._json(409, {"error": str(exc)})
+        return self._json(200, repl.status())
 
     def do_DELETE(self):
         m = _RESET_RE.match(self.path)

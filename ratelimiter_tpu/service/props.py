@@ -48,6 +48,16 @@ DEFAULTS = {
     # escalates to fail-open. 0 retries disables the wrapper.
     "storage.retry.max_retries": "3",
     "storage.retry.delay_ms": "10",
+    # Live state replication (replication/): OFF by default.  A primary
+    # journals dirty slots and ships epoch frames to replication.target
+    # (host:port of a standby's listener); a standby listens on
+    # replication.listen_port, applies frames to its shadow engine, and
+    # promotes via POST /actuator/replication/promote on failover.
+    "replication.enabled": "false",
+    "replication.role": "primary",
+    "replication.target": "",
+    "replication.listen_port": "7401",
+    "replication.interval_ms": "200",
 }
 
 

@@ -31,14 +31,49 @@ from ratelimiter_tpu.storage import (
 
 
 @dataclasses.dataclass
+class ReplicationHandle:
+    """What replication wiring hands the app: the primary's replicator
+    or the standby's receiver+server, behind one close()."""
+
+    role: str
+    replicator: object = None
+    receiver: object = None
+    server: object = None
+
+    def status(self) -> Dict:
+        out = {"role": self.role}
+        if self.replicator is not None:
+            out.update(epoch=self.replicator.log.epoch,
+                       lag_ms=self.replicator.lag_ms(),
+                       frames_shipped=self.replicator.frames_shipped,
+                       bytes_shipped=self.replicator.bytes_shipped,
+                       errors=self.replicator.errors)
+        if self.receiver is not None:
+            out.update(applied_epoch=self.receiver.last_epoch,
+                       consistent=self.receiver.consistent,
+                       promoted=self.receiver.promoted,
+                       frames_applied=self.receiver.frames_applied)
+        return out
+
+    def close(self) -> None:
+        if self.replicator is not None:
+            self.replicator.close()
+        if self.server is not None:
+            self.server.stop()
+
+
+@dataclasses.dataclass
 class AppContext:
     props: AppProperties
     storage: RateLimitStorage
     registry: MeterRegistry
     limiters: Dict[str, RateLimiter]
     fail_open: bool
+    replication: ReplicationHandle | None = None
 
     def close(self) -> None:
+        if self.replication is not None:
+            self.replication.close()
         self.storage.close()
 
 
@@ -139,6 +174,60 @@ def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
         retry_delay_ms=props.get_float("storage.retry.delay_ms", 10.0)))
 
 
+def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
+                       registry: MeterRegistry) -> ReplicationHandle | None:
+    """Config-gated replication wiring (OFF by default).
+
+    ``replication.role=primary`` journals this storage and ships epoch
+    frames to ``replication.target`` (host:port of a standby's
+    listener); ``replication.role=standby`` starts the frame listener
+    on ``replication.listen_port`` over this storage — which then idles
+    as a shadow until an operator (or orchestrator) promotes it.
+    """
+    if not props.get_bool("replication.enabled", False):
+        return None
+    import logging
+
+    logger = logging.getLogger("ratelimiter")
+    if not getattr(getattr(storage, "engine", None), "supports_replication",
+                   False):
+        logger.warning("replication.enabled but the %s backend has no "
+                       "journaled engine; replication disabled",
+                       type(storage).__name__)
+        return None
+    from ratelimiter_tpu.replication import (
+        ReplicationLog,
+        ReplicationServer,
+        Replicator,
+        SocketSink,
+        StandbyReceiver,
+    )
+
+    role = (props.get("replication.role") or "primary").lower()
+    if role == "primary":
+        target = props.get("replication.target")
+        if not target:
+            logger.warning("replication.role=primary without "
+                           "replication.target; replication disabled")
+            return None
+        host, _, port = target.rpartition(":")
+        repl = Replicator(
+            ReplicationLog(storage),
+            SocketSink(host or "127.0.0.1", int(port)),
+            interval_ms=props.get_float("replication.interval_ms", 200.0),
+            registry=registry,
+        ).start()
+        return ReplicationHandle(role="primary", replicator=repl)
+    if role == "standby":
+        receiver = StandbyReceiver(storage, registry=registry)
+        server = ReplicationServer(
+            receiver, port=props.get_int("replication.listen_port", 7401),
+        ).start()
+        return ReplicationHandle(role="standby", receiver=receiver,
+                                 server=server)
+    raise ValueError(f"unknown replication.role: {role!r}")
+
+
 def build_app(props: AppProperties | None = None,
               storage: RateLimitStorage | None = None) -> AppContext:
     props = props or AppProperties.load()
@@ -150,7 +239,11 @@ def build_app(props: AppProperties | None = None,
     registry = MeterRegistry()
     own_storage = storage is None
     storage = storage or build_storage(props, meter_registry=registry)
+    replication = None
     if own_storage:
+        # Replication attaches to the RAW TPU storage (the journal hooks
+        # the engine), before the chaos/retry wrappers compose around it.
+        replication = _maybe_replication(storage, props, registry)
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
@@ -199,4 +292,5 @@ def build_app(props: AppProperties | None = None,
         registry=registry,
         limiters=limiters,
         fail_open=props.get_bool("ratelimiter.fail_open", True),
+        replication=replication,
     )

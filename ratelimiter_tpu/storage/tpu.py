@@ -2479,6 +2479,27 @@ class TpuBatchedStorage(RateLimitStorage):
         # "knows" so the next digest-multi dispatch re-uploads lids.
         self._lid_known.clear()
 
+    def promote_from_replica(self, index_dump: Dict) -> None:
+        """Failover promotion hook (replication/standby.py).
+
+        The standby's engine already holds the replicated rows; what it
+        lacks is ADDRESSING — its key->slot indexes are empty so no
+        traffic could route into half-replicated state.  Promotion
+        rebuilds the indexes from the last replicated journal frame
+        (native fingerprint dumps restore at native speed, exactly as
+        checkpoint restore does) and clears the host's resident-lid
+        mirror — the shadow device's lid map was never populated, so
+        the first digest-multi dispatch must re-upload tenant ids.
+        After this returns the storage serves decisions bit-identical
+        to the oracle for every key at or before the replicated epoch.
+        """
+        from ratelimiter_tpu.engine import checkpoint as ckpt
+
+        self._batcher.flush()
+        ckpt.restore_slot_indexes(self, index_dump)
+        self._lid_known.clear()
+        self.engine.block_until_ready()
+
     def export_keys(self) -> Dict:
         """Geometry-free export of all live per-key state (the rebalance
         counterpart to checkpoints; engine/checkpoint.py:export_keys —

@@ -29,3 +29,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/drill tests (excluded from tier-1 "
+        "'-m \"not slow\"' runs; verify.sh runs them with RUN_SLOW=1)")

@@ -1,0 +1,544 @@
+"""Replication & hot-standby failover (ratelimiter_tpu/replication/).
+
+Layers under test, bottom-up:
+
+- the engine's dirty-slot journal marks every dispatch path;
+- frame encode/decode round-trips and budget chunking;
+- continuous replication converges the standby's packed state to the
+  primary's, bit for bit;
+- failover (the chaos drill) serves decisions bit-identical to
+  ``semantics/oracle.py`` for keys at or before the promoted epoch;
+- checkpoint restore + catch-up-from-log equals continuous replication;
+- epoch gaps are detected, refuse promotion, and heal via a full frame;
+- the sidecar-style TCP transport carries the same guarantee.
+"""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine.state import SlotJournal
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.replication import (
+    FrameArchive,
+    InProcessSink,
+    ReplicationLog,
+    ReplicationServer,
+    ReplicationStateError,
+    Replicator,
+    SocketSink,
+    StandbyReceiver,
+    TeeSink,
+    chunk_frames,
+    decode_frame,
+    encode_frame,
+    engine_state_fingerprint,
+)
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+T0 = 1_753_000_000_000
+
+
+def make_pair(num_slots=512, clock=None):
+    clock = clock if clock is not None else {"t": T0}
+    primary = TpuBatchedStorage(num_slots=num_slots,
+                                clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=num_slots,
+                                clock_ms=lambda: clock["t"])
+    return clock, primary, standby
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+def test_journal_marks_and_drains():
+    j = SlotJournal(64)
+    j.mark("sw", [3, 5, 5, -1, 999])   # padding/out-of-range filtered
+    j.mark("tb", np.array([7], dtype=np.int32))
+    assert j.pending() == 3
+    deltas, oldest, was_all = j.drain()
+    assert sorted(deltas["sw"].tolist()) == [3, 5]
+    assert deltas["tb"].tolist() == [7]
+    assert oldest is not None and not was_all
+    # drained: empty until new marks
+    deltas, oldest, _ = j.drain()
+    assert deltas == {} and oldest is None
+    j.mark_all("sw")
+    deltas, _, was_all = j.drain()
+    assert was_all and len(deltas["sw"]) == 64 and "tb" not in deltas
+
+
+def test_engine_dispatch_paths_mark_journal():
+    """Every storage decision path must leave its touched slots dirty."""
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    log = ReplicationLog(storage)
+    j = log.journal
+    lid = storage.register_limiter("tb", RateLimitConfig(
+        max_permits=50, window_ms=2000, refill_rate=10.0))
+    lid_sw = storage.register_limiter("sw", RateLimitConfig(
+        max_permits=20, window_ms=2000, enable_local_cache=False))
+
+    # batch path (acquire_many) + scalar path (acquire)
+    storage.acquire_many("tb", [lid] * 4, ["a", "b", "c", "d"], [1] * 4)
+    storage.acquire("sw", lid_sw, "z", 1)
+    storage.flush()
+    assert j.pending() >= 5
+
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 4 and len(deltas["sw"]) >= 1
+
+    # stream paths (relay/digest/flat elections all mark via the engine)
+    keys = np.asarray([1, 2, 3, 1, 2, 9, 9, 9], dtype=np.int64)
+    storage.acquire_stream_ids("tb", lid, keys)                      # relay
+    storage.acquire_stream_ids("tb", lid, keys,
+                               permits=np.full(8, 2))                # weighted
+    storage.flush()
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 4  # 4 distinct keys touched
+
+    # reset path
+    storage.reset_key("tb", lid, "a")
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 1
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip_and_chunking():
+    deltas = {
+        "sw": {"slots": np.arange(10, dtype=np.int64),
+               "rows": np.arange(60, dtype=np.int32).reshape(10, 6)},
+        "tb": {"slots": np.array([3, 9], dtype=np.int64),
+               "rows": np.arange(8, dtype=np.int32).reshape(2, 4)},
+    }
+    index_dump = {"algos": {"sw": {"kind": "flat",
+                                   "entries": [[[1, "k"], 4]]}}}
+    limiters = {"1": {"algo": "sw", "max_permits": 5, "window_ms": 1000,
+                      "refill_rate": 0.0}}
+    # Tiny budget: every row lands in its own sub-frame.
+    frames = chunk_frames(7, 123456, 512, deltas, index_dump, limiters,
+                          max_bytes=40)
+    assert len(frames) > 3
+    assert all(f["epoch"] == 7 for f in frames)
+    assert [f["seq"] for f in frames] == list(range(len(frames)))
+    assert sum(1 for f in frames if f["last"]) == 1
+    assert frames[-1]["last"] and "index" in frames[-1]
+    assert all("index" not in f for f in frames[:-1])
+    # Every delta row survives the chunking exactly once.
+    got = {"sw": [], "tb": []}
+    for f in frames:
+        rt = decode_frame(encode_frame(f))
+        assert rt["epoch"] == 7 and rt["num_slots"] == 512
+        for algo, p in rt["algos"].items():
+            got[algo].append((p["slots"], p["rows"]))
+        if rt["last"]:
+            assert rt["index"]["algos"]["sw"]["entries"] == [[[1, "k"], 4]]
+            assert rt["limiters"] == limiters
+    for algo in ("sw", "tb"):
+        slots = np.concatenate([s for s, _ in got[algo]])
+        rows = np.concatenate([r for _, r in got[algo]])
+        np.testing.assert_array_equal(slots, deltas[algo]["slots"])
+        np.testing.assert_array_equal(rows, deltas[algo]["rows"])
+
+
+def test_frame_rejects_bad_magic():
+    with pytest.raises(ValueError):
+        decode_frame(b"XXXX" + b"\0" * 16)
+
+
+# ---------------------------------------------------------------------------
+# Continuous replication -> state convergence
+# ---------------------------------------------------------------------------
+
+def test_continuous_replication_converges_state():
+    clock, primary, standby = make_pair()
+    lid = primary.register_limiter("sw", RateLimitConfig(
+        max_permits=10, window_ms=1000, enable_local_cache=False))
+    lid_tb = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=30, window_ms=1000, refill_rate=5.0))
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby)
+    repl = Replicator(log, InProcessSink(receiver))
+
+    rng = random.Random(1)
+    for _ in range(5):
+        clock["t"] += rng.choice([1, 500, 1000, 2500])
+        keys = [f"k{rng.randrange(24)}" for _ in range(32)]
+        primary.acquire_many("sw", [lid] * 32, keys, [1] * 32)
+        primary.acquire_many("tb", [lid_tb] * 32, keys,
+                             [rng.choice([1, 2]) for _ in range(32)])
+        repl.ship_now()
+
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["sw"], fp_s["sw"])
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    assert receiver.last_epoch == log.epoch > 0
+    primary.close()
+    standby.close()
+
+
+def test_stream_paths_replicate():
+    """Relay/digest/flat stream traffic (uwords marking) converges too."""
+    clock, primary, standby = make_pair(num_slots=1024)
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=100, window_ms=1000, refill_rate=50.0))
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby)
+    repl = Replicator(log, InProcessSink(receiver))
+
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        clock["t"] += 137
+        keys = rng.integers(0, 500, size=4096)
+        primary.acquire_stream_ids("tb", lid, keys)
+        repl.ship_now()
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    primary.close()
+    standby.close()
+
+
+# ---------------------------------------------------------------------------
+# Failover drill (fast deterministic; verify.sh runs this one)
+# ---------------------------------------------------------------------------
+
+def test_failover_drill_fast():
+    from ratelimiter_tpu.storage.chaos import failover_drill
+
+    registry = MeterRegistry()
+    report = failover_drill(num_slots=1024, n_keys=32, batch=24,
+                            registry=registry)
+    assert report["mismatches"] == 0
+    assert report["decisions"] > 200
+    assert report["loss_wave_decisions"] > 0     # the kill WAS mid-stream
+    assert max(report["lag_ms_samples"]) > 0     # lag observed during soak
+    meters = registry.scrape()
+    assert meters["ratelimiter.replication.failovers"] == 1.0
+    assert meters["ratelimiter.replication.epoch_gap"] == 0.0
+    assert meters["ratelimiter.replication.frames"] >= report["frames"]
+
+
+@pytest.mark.slow
+def test_failover_soak_slow():
+    """Bigger drill with the ASYNC replicator thread running mid-soak
+    (the production shape) — the kill still lands between the last
+    replicated epoch and unshipped traffic."""
+    registry = MeterRegistry()
+    from ratelimiter_tpu.storage.chaos import failover_drill
+
+    report = failover_drill(num_slots=4096, n_keys=256, waves=12,
+                            kill_after_wave=10, post_waves=6, batch=128,
+                            registry=registry, background_interval_ms=20.0)
+    assert report["mismatches"] == 0
+    assert report["decisions"] > 4000
+    meters = registry.scrape()
+    assert meters["ratelimiter.replication.failovers"] == 1.0
+    assert meters["ratelimiter.replication.epoch_gap"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint x replication interplay
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_then_catchup_equals_continuous(tmp_path):
+    """Restore-from-checkpoint + catch-up-from-log must equal continuous
+    replication — and both must serve decisions bit-identical to the
+    oracle after promotion."""
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    cont = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    cfg = RateLimitConfig(max_permits=15, window_ms=2000,
+                          enable_local_cache=False)
+    lid = primary.register_limiter("sw", cfg)
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(cont)
+    archive = FrameArchive()
+    repl = Replicator(log, TeeSink(InProcessSink(receiver), archive))
+    oracle = SlidingWindowOracle(cfg)
+
+    rng = random.Random(7)
+
+    def wave():
+        clock["t"] += rng.choice([1, 999, 2000])
+        keys = [f"u{rng.randrange(20)}" for _ in range(24)]
+        out = primary.acquire_many("sw", [lid] * 24, keys, [1] * 24)
+        for j, k in enumerate(keys):
+            d = oracle.try_acquire(k, 1, clock["t"])
+            assert bool(out["allowed"][j]) == d.allowed
+
+    for _ in range(3):
+        wave()
+        repl.ship_now()
+    ckpt_epoch = log.epoch
+    primary.save_checkpoint(str(tmp_path / "ckpt"))
+
+    for _ in range(3):
+        wave()
+        repl.ship_now()
+
+    # Late joiner: checkpoint restore, then replay the log's frames
+    # cut after the checkpoint epoch.
+    late = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    late.register_limiter("sw", cfg)  # same registration order as primary
+    late.restore_checkpoint(str(tmp_path / "ckpt"))
+    late_rx = StandbyReceiver(late, start_epoch=ckpt_epoch)
+    for data in archive.frames:
+        if decode_frame(data)["epoch"] > ckpt_epoch:
+            late_rx.apply_bytes(data)
+    assert late_rx.consistent and late_rx.last_epoch == log.epoch
+
+    fp_cont = engine_state_fingerprint(cont.engine)
+    fp_late = engine_state_fingerprint(late.engine)
+    np.testing.assert_array_equal(fp_cont["sw"], fp_late["sw"])
+    np.testing.assert_array_equal(fp_cont["tb"], fp_late["tb"])
+
+    # Promote the late joiner and keep matching the oracle exactly.
+    primary.close()
+    promoted = late_rx.promote()
+    for _ in range(3):
+        clock["t"] += rng.choice([1, 999, 2000])
+        keys = [f"u{rng.randrange(20)}" for _ in range(24)]
+        out = promoted.acquire_many("sw", [lid] * 24, keys, [1] * 24)
+        for j, k in enumerate(keys):
+            d = oracle.try_acquire(k, 1, clock["t"])
+            assert bool(out["allowed"][j]) == d.allowed
+            assert int(out["observed"][j]) == d.observed
+    promoted.close()
+    cont.close()
+
+
+# ---------------------------------------------------------------------------
+# Gap detection & recovery
+# ---------------------------------------------------------------------------
+
+def test_epoch_gap_refuses_promotion_until_full_frame():
+    registry = MeterRegistry()
+    clock, primary, standby = make_pair()
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=40, window_ms=1000, refill_rate=10.0))
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby, registry=registry)
+
+    def traffic():
+        clock["t"] += 77
+        primary.acquire_many("tb", [lid] * 8,
+                             [f"g{i}" for i in range(8)], [1] * 8)
+
+    traffic()
+    for f in log.cut():                       # epoch 1 (full bootstrap)
+        receiver.apply(f)
+    assert receiver.consistent
+    traffic()
+    dropped = log.cut()                       # epoch 2: lost in transit
+    assert dropped
+    traffic()
+    for f in log.cut():                       # epoch 3 arrives -> gap
+        receiver.apply(f)
+    assert not receiver.consistent
+    assert registry.scrape()["ratelimiter.replication.epoch_gap"] == 1.0
+    with pytest.raises(ReplicationStateError):
+        receiver.promote()
+
+    # Recovery: a full frame re-baselines the stream.
+    log.request_full()
+    for f in log.cut():
+        receiver.apply(f)
+    assert receiver.consistent
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    receiver.promote()
+    primary.close()
+    standby.close()
+
+
+def test_ship_failure_remarks_and_requests_full():
+    class FlakySink:
+        def __init__(self):
+            self.fail = False
+            self.delivered = []
+
+        def send(self, data):
+            if self.fail:
+                raise ConnectionError("standby unreachable")
+            self.delivered.append(data)
+
+    clock, primary, standby = make_pair()
+    lid = primary.register_limiter("sw", RateLimitConfig(
+        max_permits=9, window_ms=1000, enable_local_cache=False))
+    log = ReplicationLog(primary)
+    sink = FlakySink()
+    repl = Replicator(log, sink)
+
+    clock["t"] += 5
+    primary.acquire_many("sw", [lid] * 4, list("abcd"), [1] * 4)
+    repl.ship_now()
+    n_ok = len(sink.delivered)
+
+    clock["t"] += 5
+    primary.acquire_many("sw", [lid] * 4, list("efgh"), [1] * 4)
+    sink.fail = True
+    with pytest.raises(ConnectionError):
+        repl.ship_now()
+    assert repl.errors == 1
+    assert log.pending() > 0  # failed delta re-marked
+
+    sink.fail = False
+    repl.ship_now()  # full recovery frame
+    assert len(sink.delivered) > n_ok
+    receiver = StandbyReceiver(standby)
+    for data in sink.delivered:
+        receiver.apply_bytes(data)
+    # The post-failure full frame re-baselines despite the gap.
+    assert receiver.consistent
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["sw"], fp_s["sw"])
+    primary.close()
+    standby.close()
+
+
+def test_geometry_mismatch_rejected():
+    clock, primary, _ = make_pair(num_slots=512)
+    other = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("sw", RateLimitConfig(
+        max_permits=5, window_ms=1000, enable_local_cache=False))
+    clock["t"] += 1
+    primary.acquire("sw", lid, "x", 1)
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(other)
+    with pytest.raises(ValueError, match="geometry"):
+        for f in log.cut():
+            receiver.apply(f)
+    primary.close()
+    other.close()
+
+
+# ---------------------------------------------------------------------------
+# TCP transport (sidecar-style framing)
+# ---------------------------------------------------------------------------
+
+def test_tcp_transport_failover_vs_oracle():
+    clock, primary, standby = make_pair()
+    cfg = RateLimitConfig(max_permits=12, window_ms=1500,
+                          enable_local_cache=False)
+    lid = primary.register_limiter("sw", cfg)
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby)
+    server = ReplicationServer(receiver, host="127.0.0.1").start()
+    sink = SocketSink("127.0.0.1", server.port)
+    repl = Replicator(log, sink)
+    oracle = SlidingWindowOracle(cfg)
+    rng = random.Random(11)
+
+    try:
+        for _ in range(4):
+            clock["t"] += rng.choice([3, 700, 1500])
+            keys = [f"t{rng.randrange(16)}" for _ in range(20)]
+            out = primary.acquire_many("sw", [lid] * 20, keys, [1] * 20)
+            for j, k in enumerate(keys):
+                d = oracle.try_acquire(k, 1, clock["t"])
+                assert bool(out["allowed"][j]) == d.allowed
+            repl.ship_now()
+        snap = copy.deepcopy(oracle)
+        # loss wave, then crash
+        clock["t"] += 3
+        primary.acquire_many("sw", [lid] * 4, ["t0", "t1", "t2", "t3"],
+                             [1] * 4)
+    finally:
+        primary.close()
+        sink.close()
+        server.stop()
+
+    oracle = snap
+    promoted = receiver.promote()
+    for _ in range(3):
+        clock["t"] += rng.choice([3, 700, 1500])
+        keys = [f"t{rng.randrange(16)}" for _ in range(20)]
+        out = promoted.acquire_many("sw", [lid] * 20, keys, [1] * 20)
+        for j, k in enumerate(keys):
+            d = oracle.try_acquire(k, 1, clock["t"])
+            assert bool(out["allowed"][j]) == d.allowed
+            assert int(out["observed"][j]) == d.observed
+    promoted.close()
+
+
+# ---------------------------------------------------------------------------
+# Service wiring & metrics exposure
+# ---------------------------------------------------------------------------
+
+def test_wiring_replication_disabled_by_default():
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import _maybe_replication
+
+    props = AppProperties({"storage.backend": "memory"})
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    assert _maybe_replication(storage, props, MeterRegistry()) is None
+    assert storage.engine.journal is None  # zero hot-path overhead when off
+    storage.close()
+
+
+def test_wiring_primary_standby_roundtrip_over_tcp():
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import _maybe_replication
+
+    clock = {"t": T0}
+    registry = MeterRegistry()
+    standby = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    h_standby = _maybe_replication(standby, AppProperties({
+        "replication.enabled": "true", "replication.role": "standby",
+        "replication.listen_port": "0"}), registry)
+    assert h_standby is not None and h_standby.role == "standby"
+    port = h_standby.server.port
+
+    primary = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    h_primary = _maybe_replication(primary, AppProperties({
+        "replication.enabled": "true", "replication.role": "primary",
+        "replication.target": f"127.0.0.1:{port}",
+        "replication.interval_ms": "10000"}), registry)
+    assert h_primary is not None and h_primary.role == "primary"
+
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=25, window_ms=1000, refill_rate=10.0))
+    clock["t"] += 9
+    primary.acquire_many("tb", [lid] * 6, [f"w{i}" for i in range(6)],
+                         [1] * 6)
+    h_primary.replicator.ship_now()
+    assert h_standby.receiver.last_epoch == 1
+    status = h_primary.status()
+    assert status["epoch"] == 1 and status["frames_shipped"] >= 1
+    meters = registry.scrape()
+    assert meters["ratelimiter.replication.frames"] >= 1
+    assert meters["ratelimiter.replication.bytes"] > 0
+    assert "ratelimiter.replication.lag_ms" in meters
+
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+
+    h_primary.close()
+    h_standby.close()
+    primary.close()
+    standby.close()
+
+
+def test_gauge_meter():
+    registry = MeterRegistry()
+    g = registry.gauge("x.lag", "test gauge")
+    g.set(12.5)
+    assert registry.scrape()["x.lag"] == 12.5
+    assert registry.gauge("x.lag") is g
+    with pytest.raises(TypeError):
+        registry.counter("x.lag")

@@ -65,6 +65,30 @@ for pat in "${forbidden[@]}"; do
   fi
 done
 
+echo "running fast failover drill (replication)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_replication.py::test_failover_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  failover drill"
+else
+  echo "  FAILED  failover drill"
+  fail=1
+fi
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "running slow failover soak (RUN_SLOW=1)..."
+  if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/test_replication.py::test_failover_soak_slow \
+      -q -m slow -p no:cacheprovider; then
+    echo "  ok  failover soak"
+  else
+    echo "  FAILED  failover soak"
+    fail=1
+  fi
+else
+  echo "skipping slow failover soak (set RUN_SLOW=1 to run it)"
+fi
+
 if [[ $fail -eq 0 ]]; then
   echo "structure OK"
 else
