@@ -1,0 +1,76 @@
+"""Goodput and shed rate under overload: 1x / 2x / 4x offered load.
+
+Drives the admission-controlled micro-batcher (bounded pending queue +
+queue-deadline budgets, engine/batcher.py) over a fixed-rate synthetic
+device via ``storage/chaos.py:overload_drill`` and reports, per offered
+load: goodput fraction, shed fraction (queue-full + deadline-expired),
+queue-depth high-water mark, and p99 latency of the ADMITTED requests.
+
+The claim being measured ("Designing Scalable Rate Limiting Systems",
+PAPERS.md): shedding the excess keeps the admitted requests' tail flat —
+without the bound, 2x offered load queues without limit and every
+request's latency grows with the backlog.
+
+    JAX_PLATFORMS=cpu python bench/overload_shedding.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--multipliers", type=float, nargs="+",
+                        default=[1.0, 2.0, 4.0],
+                        help="offered load as multiples of device capacity")
+    parser.add_argument("--max-pending", type=int, default=256)
+    parser.add_argument("--deadline-ms", type=float, default=1000.0)
+    parser.add_argument("--dispatch-ms", type=float, default=5.0,
+                        help="synthetic device step latency")
+    parser.add_argument("--max-batch", type=int, default=32)
+    parser.add_argument("--bursts", type=int, default=80)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw report as JSON only")
+    args = parser.parse_args()
+
+    from ratelimiter_tpu.storage.chaos import overload_drill
+
+    report = overload_drill(
+        load_multipliers=tuple(args.multipliers),
+        max_pending=args.max_pending,
+        deadline_ms=args.deadline_ms,
+        dispatch_ms=args.dispatch_ms,
+        max_batch=args.max_batch,
+        bursts=args.bursts,
+        p99_slack_ms=10_000.0,  # bench reports the tail; it doesn't gate
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return
+
+    print(f"device capacity: {report['capacity_rps']:.0f} req/s "
+          f"(batch {args.max_batch} / {args.dispatch_ms} ms step); "
+          f"max_pending={args.max_pending} deadline={args.deadline_ms} ms")
+    print(f"{'load':>6} {'offered':>8} {'admitted':>9} {'shed':>6} "
+          f"{'expired':>8} {'goodput':>8} {'shed%':>7} {'depth':>6} "
+          f"{'p99 ms':>8}")
+    for run in report["runs"]:
+        print(f"{run['multiplier']:>5.1f}x {run['offered']:>8} "
+              f"{run['admitted']:>9} {run['shed']:>6} "
+              f"{run['deadline_expired']:>8} "
+              f"{run['goodput_frac']:>8.1%} {run['shed_frac']:>7.1%} "
+              f"{run['max_depth_seen']:>6} {run['p99_ms']:>8.1f}")
+    bound_ok = all(r["max_depth_seen"] <= args.max_pending
+                   for r in report["runs"])
+    print(f"queue bound held at every load: {bound_ok}")
+
+
+if __name__ == "__main__":
+    main()

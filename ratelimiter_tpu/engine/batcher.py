@@ -25,28 +25,51 @@ submission order; each drain only reads its own batch's output buffer.
 
 Eviction-clears stay safe for the same reason: cleared slots are zeroed in
 the dispatch stream ahead of the batch that reuses them.
+
+**Admission control & overload protection.**  ``submit`` used to accept
+unbounded work and strand waiters if the flusher died.  Now:
+
+- ``max_pending`` bounds each algo's pending queue; a submit over the
+  bound is shed with a typed ``OverloadedError`` (reason ``queue_full``)
+  instead of queuing forever.
+- ``deadline_ms`` gives each request a *queue* budget: a request that
+  cannot be dispatched within its deadline (e.g. a 90 s compile or a
+  hung device holds the dispatch lock) is failed with ``OverloadedError``
+  (reason ``deadline``) at take time or by the watchdog.  The budget
+  covers queue wait only — once dispatched, a batch's drain latency is
+  the device's business (first-compile stalls must not shed).
+- a watchdog thread expires queued deadlines even while the flusher is
+  wedged inside a dispatch, and detects a dead flusher (failing everything
+  queued rather than hanging callers).
+- ``close()`` fails every still-pending future with a typed
+  ``ShutdownError`` after a bounded wait — a caller blocked on
+  ``Future.result()`` is never stranded by shutdown.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
+from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
 from ratelimiter_tpu.utils.logging import get_logger
 
 log = get_logger("engine.batcher")
 
 
 class _Pending:
-    __slots__ = ("slots", "lids", "permits", "futures", "clears", "born")
+    __slots__ = ("slots", "lids", "permits", "futures", "deadlines",
+                 "clears", "born")
 
     def __init__(self):
         self.slots: List[int] = []
         self.lids: List[int] = []
         self.permits: List[int] = []
         self.futures: List[Future] = []
+        self.deadlines: List[float] = []  # monotonic queue deadlines (inf=none)
         self.clears: List[int] = []
         self.born: float | None = None  # monotonic time of oldest request
 
@@ -62,6 +85,9 @@ class MicroBatcher:
         max_batch: int = 8192,
         max_delay_ms: float = 0.5,
         max_inflight: int = 4,
+        max_pending: int = 0,
+        deadline_ms: float = 0.0,
+        meter_registry=None,
     ):
         self._dispatch = dispatch
         # Without a drain fn the dispatch result IS the output dict
@@ -71,10 +97,35 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_inflight = max(int(max_inflight), 1)
+        # Admission control (0 disables either bound — the library default;
+        # service wiring turns both on via ratelimiter.overload.* props).
+        self.max_pending = int(max_pending)
+        self.deadline_ms = float(deadline_ms)
+        self.shed_total = 0           # queue-full sheds (submit refused)
+        self.deadline_total = 0       # queued requests expired pre-dispatch
+        self.max_depth_seen = 0       # high-water mark of any algo queue
+        self.last_shed_s = 0.0        # monotonic stamp of the last shed
+        self._shed_counter = (
+            meter_registry.counter(
+                "ratelimiter.overload.shed",
+                "Requests shed at submit: pending queue at max_pending")
+            if meter_registry is not None else None)
+        self._deadline_counter = (
+            meter_registry.counter(
+                "ratelimiter.overload.deadline_exceeded",
+                "Queued requests failed: not dispatched within deadline_ms")
+            if meter_registry is not None else None)
+        self._depth_gauge = (
+            meter_registry.gauge(
+                "ratelimiter.overload.queue_depth",
+                "Pending micro-batch queue depth (largest algo queue)")
+            if meter_registry is not None else None)
         self._cv = threading.Condition()
         self._pending: Dict[str, _Pending] = {a: _Pending() for a in dispatch}
+        self._waiters: Set[Future] = set()  # every unresolved submit future
         self._dispatch_lock = threading.Lock()  # serializes device batches
         self._closed = False
+        self._flusher_dead = False
         # Concurrent fetches: one worker per in-flight batch; the semaphore
         # is the backpressure bound on the device queue.
         self._drain_pool = ThreadPoolExecutor(
@@ -84,22 +135,71 @@ class MicroBatcher:
         self._flusher = threading.Thread(
             target=self._run, name="ratelimiter-flusher", daemon=True)
         self._flusher.start()
+        # Watchdog: expires queued deadlines even while the flusher is
+        # wedged inside a dispatch, and fails the queue if the flusher
+        # dies.  Cheap (one lock + O(pending) scan per tick).
+        self._watch_stop = threading.Event()
+        self._watch_interval = (
+            max(0.005, min(0.05, self.deadline_ms / 4000.0))
+            if self.deadline_ms > 0 else 0.05)
+        self._watchdog = threading.Thread(
+            target=self._watch, name="ratelimiter-watchdog", daemon=True)
+        self._watchdog.start()
 
     # -- submission -----------------------------------------------------------
-    def submit(self, algo: str, slot: int, lid: int, permits: int) -> Future:
+    def submit(self, algo: str, slot: int, lid: int, permits: int,
+               deadline_ms: float | None = None) -> Future:
+        """Queue one decision; returns its Future.
+
+        ``deadline_ms`` overrides the batcher-wide queue-deadline budget
+        for this request (None = default; 0 = no deadline).  Raises
+        ``OverloadedError`` when the pending queue is at ``max_pending``
+        or the flusher has died, ``ShutdownError`` when closed.
+        """
         fut: Future = Future()
         with self._cv:
             if self._closed:
-                raise RuntimeError("batcher closed")
+                raise ShutdownError("batcher closed")
+            if self._flusher_dead:
+                raise OverloadedError(
+                    "flusher thread died; nothing will dispatch this queue",
+                    reason="flusher_dead", retry_after_ms=1000.0)
             pend = self._pending[algo]
+            if self.max_pending and len(pend.slots) >= self.max_pending:
+                self.shed_total += 1
+                self.last_shed_s = time.monotonic()
+                if self._shed_counter is not None:
+                    self._shed_counter.increment()
+                # The queue drains one max_batch per dispatch cycle; a
+                # rough cycle estimate keeps the hint cheap and honest.
+                cycles = max(len(pend.slots) / max(self.max_batch, 1), 1.0)
+                raise OverloadedError(
+                    f"pending queue full ({len(pend.slots)} >= "
+                    f"{self.max_pending})", reason="queue_full",
+                    retry_after_ms=cycles * max(self.max_delay_s * 1000.0,
+                                                1.0))
             if pend.born is None:
                 pend.born = time.monotonic()
+            budget = self.deadline_ms if deadline_ms is None else deadline_ms
             pend.slots.append(slot)
             pend.lids.append(lid)
             pend.permits.append(permits)
             pend.futures.append(fut)
+            pend.deadlines.append(
+                time.monotonic() + budget / 1000.0 if budget and budget > 0
+                else math.inf)
+            if len(pend.slots) > self.max_depth_seen:
+                self.max_depth_seen = len(pend.slots)
+            self._waiters.add(fut)
             self._cv.notify()
         return fut
+
+    def queue_depth(self) -> int:
+        """Largest per-algo pending queue (the admission-control bound's
+        operand), for health reporting."""
+        with self._cv:
+            return max((len(p.slots) for p in self._pending.values()),
+                       default=0)
 
     def add_clear(self, algo: str, slot: int) -> None:
         """Schedule a slot zeroing ahead of the next batch (eviction)."""
@@ -132,17 +232,32 @@ class MicroBatcher:
             taken = {a: self._take(a) for a in self._pending}
         self._execute(taken)
 
+    def _finish(self, futures: List[Future]) -> None:
+        """Drop resolved futures from the stranding-watch set."""
+        with self._cv:
+            for fut in futures:
+                self._waiters.discard(fut)
+
+    def _fail(self, fut: Future, exc: Exception) -> None:
+        if not fut.done():
+            fut.set_exception(exc)
+        with self._cv:
+            self._waiters.discard(fut)
+
     def _resolve(self, algo: str, handle, futures: List[Future]) -> None:
         """Fetch a dispatched batch's results and resolve its futures."""
         try:
             drain = self._drain.get(algo)
             out = drain(handle, len(futures)) if drain else handle
             for i, fut in enumerate(futures):
-                fut.set_result({k: v[i] for k, v in out.items()})
+                if not fut.done():  # close() may have failed it already
+                    fut.set_result({k: v[i] for k, v in out.items()})
         except Exception as exc:  # noqa: BLE001 — fail every waiter
             for fut in futures:
                 if not fut.done():
                     fut.set_exception(exc)
+        finally:
+            self._finish(futures)
 
     def _enqueue_drain(self, algo: str, handle, futures: List[Future]) -> None:
         self._inflight_sem.acquire()  # backpressure on the device queue
@@ -162,10 +277,41 @@ class MicroBatcher:
         with self._dispatch_lock:
             self._execute_locked(taken)
 
+    def _shed_expired(self, pend: _Pending, now: float,
+                      in_queue: bool = False) -> None:
+        """Fail requests whose queue deadline passed before dispatch.
+
+        Mutates ``pend`` in place (both taken batches and — under the cv,
+        from the watchdog — the live queues).  The deadline budget covers
+        queue wait only; a dispatched batch is never expired.
+        """
+        if not pend.futures or all(d > now for d in pend.deadlines):
+            return
+        keep = [i for i, d in enumerate(pend.deadlines) if d > now]
+        expired = [f for f, d in zip(pend.futures, pend.deadlines)
+                   if d <= now]
+        n = len(expired)
+        self.deadline_total += n
+        self.last_shed_s = now
+        if self._deadline_counter is not None:
+            self._deadline_counter.add(n)
+        log.warning("shed %d queued request(s): queue deadline exceeded "
+                    "before dispatch%s", n,
+                    " (watchdog)" if in_queue else "")
+        for name in ("slots", "lids", "permits", "futures", "deadlines"):
+            vals = getattr(pend, name)
+            setattr(pend, name, [vals[i] for i in keep])
+        exc = OverloadedError(
+            "queue deadline exceeded before dispatch", reason="deadline",
+            retry_after_ms=max(self.max_delay_s * 1000.0, 1.0))
+        for fut in expired:
+            self._fail(fut, exc)
+
     def _execute_locked(self, taken) -> None:
         for algo, pend in taken.items():
             if pend is None:
                 continue
+            self._shed_expired(pend, time.monotonic())
             try:
                 if pend.clears:
                     self._clear[algo](pend.clears)
@@ -181,6 +327,7 @@ class MicroBatcher:
                 for fut in pend.futures:
                     if not fut.done():
                         fut.set_exception(exc)
+                self._finish(pend.futures)
 
     def dispatch_direct(self, algo: str, slots, lids, permits, clears=None):
         """Synchronous whole-batch dispatch (the vectorized/bench path).
@@ -201,7 +348,52 @@ class MicroBatcher:
         drain = self._drain.get(algo)
         return drain(handle, len(slots)) if drain else handle
 
+    def _watch(self) -> None:
+        """Overload watchdog: queue-deadline expiry that does not depend on
+        the flusher being schedulable (it may be wedged inside a 90 s
+        compile holding the dispatch lock), plus dead-flusher detection so
+        queued callers fail instead of blocking forever."""
+        while not self._watch_stop.wait(self._watch_interval):
+            with self._cv:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                for pend in self._pending.values():
+                    self._shed_expired(pend, now, in_queue=True)
+                if self._depth_gauge is not None:
+                    self._depth_gauge.set(max(
+                        (len(p.slots) for p in self._pending.values()),
+                        default=0))
+                if not self._flusher_dead and not self._flusher.is_alive():
+                    self._flusher_dead = True
+                if self._flusher_dead:
+                    taken = {a: self._take(a) for a in self._pending}
+                else:
+                    continue
+            self._fail_taken(taken, OverloadedError(
+                "flusher thread died; request abandoned",
+                reason="flusher_dead", retry_after_ms=1000.0))
+
+    def _fail_taken(self, taken, exc: Exception) -> None:
+        for pend in taken.values():
+            if pend is None:
+                continue
+            for fut in pend.futures:
+                self._fail(fut, exc)
+
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except Exception:  # noqa: BLE001 — flusher must never die silently
+            log.exception("flusher died; failing all queued requests")
+            with self._cv:
+                self._flusher_dead = True
+                taken = {a: self._take(a) for a in self._pending}
+            self._fail_taken(taken, OverloadedError(
+                "flusher thread died; request abandoned",
+                reason="flusher_dead", retry_after_ms=1000.0))
+
+    def _run_loop(self) -> None:
         while True:
             locked = False
             with self._cv:
@@ -254,11 +446,50 @@ class MicroBatcher:
                 if locked:
                     self._dispatch_lock.release()
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
+        """Shut down; never strands a waiter.
+
+        The healthy path dispatches whatever is queued and waits for the
+        in-flight drains.  Every path that can hang is bounded: a stuck
+        dispatch (lock never acquired), a dead flusher, or a hung drain
+        all end with the remaining futures failed by a typed
+        ``ShutdownError`` after ``timeout`` — a caller blocked on
+        ``Future.result()`` always gets an answer.
+        """
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._flusher.join(timeout=5)
-        self.flush()
-        # Resolve whatever is still on the wire before returning.
-        self._drain_pool.shutdown(wait=True)
+        self._watch_stop.set()
+        self._flusher.join(timeout=timeout)
+        # Dispatch the remaining queue — but never hang on a wedged
+        # dispatch: if the lock cannot be had, the queued futures are
+        # failed below instead of dispatched.
+        with self._cv:
+            taken = {a: self._take(a) for a in self._pending}
+        if any(p is not None for p in taken.values()):
+            if self._dispatch_lock.acquire(timeout=max(timeout, 0.1)):
+                try:
+                    self._execute_locked(taken)
+                finally:
+                    self._dispatch_lock.release()
+            else:
+                self._fail_taken(taken, ShutdownError(
+                    "batcher closed before the batch could be dispatched"))
+        # Resolve whatever is on the wire, bounded by the same timeout.
+        self._drain_pool.shutdown(wait=False)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._waiters:
+                    break
+            time.sleep(0.005)
+        with self._cv:
+            stranded = [f for f in self._waiters if not f.done()]
+            self._waiters.clear()
+        if stranded:
+            log.warning("close(): failing %d stranded future(s)",
+                        len(stranded))
+            exc = ShutdownError("batcher closed; request abandoned")
+            for fut in stranded:
+                if not fut.done():
+                    fut.set_exception(exc)

@@ -18,6 +18,36 @@ from __future__ import annotations
 import numpy as np
 
 
+class OverloadedError(RuntimeError):
+    """A request was shed by admission control instead of queued.
+
+    Raised by ``MicroBatcher.submit`` when the bounded pending queue is
+    full (``reason="queue_full"``), by the dispatch/watchdog path when a
+    queued request's deadline budget expires before it can be dispatched
+    (``reason="deadline"``), and when the flusher thread has died and
+    nothing will ever dispatch the queue (``reason="flusher_dead"``).
+
+    Deliberately NOT a ``StorageException``: shedding is a local
+    admission decision, not a backend fault — it must not be retried
+    (retrying amplifies the overload), must not trip the circuit
+    breaker, and must not be converted into a fail-open allow.  The
+    service tier maps it to 429 with a Retry-After header.
+    """
+
+    def __init__(self, msg: str, reason: str = "overloaded",
+                 retry_after_ms: float = 0.0):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class ShutdownError(RuntimeError):
+    """The batcher (or a component above it) is closed: the request was
+    refused at submit, or a still-pending future was failed by
+    ``MicroBatcher.close()`` instead of being left blocked forever on
+    ``Future.result()``."""
+
+
 class SlotCapacityError(RuntimeError):
     """Batch assignment ran out of evictable slots.
 

@@ -143,6 +143,15 @@ class SlidingWindowOracle:
     def get_available_permits(self, key: str, now_ms: int) -> int:
         return max(0, self.config.max_permits - self.current_count(key, now_ms))
 
+    def seed_count(self, key: str, count: int, now_ms: int) -> None:
+        """Install ``count`` as the current-window bucket as of ``now_ms``
+        (TTL = one window, as a real increment would set).  Used by the
+        degraded-mode host limiter (storage/degraded.py) to start its
+        approximation from the last counter value the device reported."""
+        win = self.config.window_ms
+        self._buckets[(key, (now_ms // win) * win)] = (
+            max(int(count), 0), now_ms + win)
+
     def reset(self, key: str, now_ms: int) -> None:
         win = self.config.window_ms
         curr_ws = (now_ms // win) * win
@@ -222,6 +231,15 @@ class TokenBucketOracle:
         """Refill-then-floor, replacing the reference's broken string-GET of a
         hash (quirk Q3)."""
         return self._refilled(key, now_ms) // TOKEN_FP_ONE
+
+    def seed_tokens(self, key: str, whole_tokens: int, now_ms: int) -> None:
+        """Install a bucket holding ``whole_tokens`` as of ``now_ms`` (TTL =
+        2x window, as the allow branch would set).  Degraded-mode seeding:
+        the device's last reported remaining-token count becomes the
+        approximation's starting state (storage/degraded.py)."""
+        cfg = self.config
+        fp = max(0, min(cfg.max_permits_fp, int(whole_tokens) * TOKEN_FP_ONE))
+        self._buckets[key] = (fp, now_ms, now_ms + 2 * cfg.window_ms)
 
     def reset(self, key: str, now_ms: int) -> None:
         self._buckets.pop(key, None)

@@ -36,6 +36,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
 from ratelimiter_tpu.service.wiring import AppContext, build_app
 from ratelimiter_tpu.storage.errors import StorageException
 from ratelimiter_tpu.utils.logging import get_logger
@@ -74,6 +75,26 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return json.loads(self.rfile.read(length) or b"{}")
         except json.JSONDecodeError:
             return {}
+
+    def _overloaded(self, exc: OverloadedError):
+        """429 + Retry-After: the request was SHED by admission control
+        (bounded queue / queue deadline), distinct from both the policy
+        429 (_rate_limit_exceeded) and the storage-down 503."""
+        retry_ms = float(getattr(exc, "retry_after_ms", 0.0)) or 1000.0
+        secs = max(1, int(-(-retry_ms // 1000.0)))
+        self.ctx.registry.counter(
+            "ratelimiter.overload.rejected",
+            "Requests answered 429 by overload admission control",
+        ).increment()
+        return self._json(429, {
+            "error": "Overloaded",
+            "message": "Server is shedding load. Please retry later.",
+            "reason": getattr(exc, "reason", "overloaded"),
+        }, headers={"Retry-After": secs})
+
+    def _storage_unavailable(self):
+        return self._json(503, {"error": "storage unavailable"},
+                          headers={"Retry-After": 1})
 
     def _rate_limit_exceeded(self, limiter, key: str, limit: int):
         # 429 with the same error body shape (DemoController.java:129-140).
@@ -114,9 +135,9 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         if self.path == "/api/health":
             return self._json(200, {"status": "UP", "timestamp": str(_now_ms())})
         if self.path == "/actuator/health":
-            up = self.ctx.storage.is_available()
-            return self._json(200 if up else 503,
-                              {"status": "UP" if up else "DOWN"})
+            payload = self._health_payload()
+            return self._json(503 if payload["status"] == "DOWN" else 200,
+                              payload)
         if self.path == "/actuator/metrics":
             return self._json(200, {"meters": self.ctx.registry.scrape()})
         if self.path == "/actuator/replication":
@@ -160,6 +181,55 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._reset(m.group(1))
         self._json(404, {"error": "not found"})
 
+    # -- health state machine -------------------------------------------------
+    def _health_payload(self) -> dict:
+        """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
+
+        - DOWN: the backend is unavailable (or the breaker is open with no
+          degraded fallback and fail-open off) — only DOWN returns 503.
+        - DEGRADED: the breaker is open/half-open; decisions are served by
+          the degraded host limiter (or fail-open).
+        - SHEDDING: admission control shed requests within the health
+          window; the service is healthy but at capacity.
+        - UP: everything on the device path.
+        """
+        ctx = self.ctx
+        try:
+            storage_up = bool(ctx.storage.is_available())
+        except Exception:  # noqa: BLE001 — an erroring health probe is DOWN
+            storage_up = False
+        breaker = getattr(ctx, "breaker", None)
+        batcher = getattr(ctx.storage, "_batcher", None)
+        payload: dict = {"storage": {"available": storage_up}}
+        shedding = False
+        if batcher is not None:
+            window_s = ctx.props.get_float(
+                "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
+            last = float(getattr(batcher, "last_shed_s", 0.0))
+            shedding = last > 0 and (time.monotonic() - last) <= window_s
+            payload["overload"] = {
+                "queue_depth": batcher.queue_depth(),
+                "max_pending": batcher.max_pending,
+                "shed_total": batcher.shed_total,
+                "deadline_expired_total": batcher.deadline_total,
+            }
+        if breaker is not None:
+            payload["breaker"] = breaker.status()
+            if breaker.fallback is not None:
+                payload["degraded"] = {
+                    "touched_keys": len(breaker.fallback.touched())}
+        if breaker is not None and breaker.state != "closed":
+            degraded_serving = (breaker.fallback is not None
+                                or ctx.fail_open)
+            payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
+        elif not storage_up:
+            payload["status"] = "DOWN"
+        elif shedding:
+            payload["status"] = "SHEDDING"
+        else:
+            payload["status"] = "UP"
+        return payload
+
     # -- endpoint bodies ------------------------------------------------------
     def _get_data(self):
         limiter = self.ctx.limiters["api"]
@@ -167,8 +237,12 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         try:
             if not self._try_acquire(limiter, key):
                 return self._rate_limit_exceeded(limiter, key, 100)
+        except OverloadedError as exc:
+            return self._overloaded(exc)
+        except ShutdownError:
+            return self._storage_unavailable()
         except StorageException:
-            return self._json(503, {"error": "storage unavailable"})
+            return self._storage_unavailable()
         remaining = self._safe_available(limiter, key)
         self._json(200, {
             "message": "Success!",
@@ -182,8 +256,12 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         try:
             if not self._try_acquire(limiter, username):
                 return self._rate_limit_exceeded(limiter, username, 10)
+        except OverloadedError as exc:
+            return self._overloaded(exc)
+        except ShutdownError:
+            return self._storage_unavailable()
         except StorageException:
-            return self._json(503, {"error": "storage unavailable"})
+            return self._storage_unavailable()
         self._json(200, {
             "message": "Login successful",
             "remaining_attempts": self._safe_available(limiter, username),
@@ -200,8 +278,12 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         try:
             if not self._try_acquire(limiter, user_id, size):
                 return self._rate_limit_exceeded(limiter, user_id, 50)
+        except OverloadedError as exc:
+            return self._overloaded(exc)
+        except ShutdownError:
+            return self._storage_unavailable()
         except StorageException:
-            return self._json(503, {"error": "storage unavailable"})
+            return self._storage_unavailable()
         self._json(200, {
             "message": "Batch processed",
             "items_processed": size,

@@ -5,12 +5,22 @@ The reference configures itself via Spring ``application.properties``
 overrides from docker-compose.  Here: the same ``key=value`` file format,
 env-var overrides (``RATELIMITER_<KEY with . -> _ uppercased>``), and typed
 accessors with defaults.
+
+Values are validated at construction: a malformed int/float/bool for a
+known key logs a warning naming the offending key and falls back to the
+default (a typo'd ``batcher.max_batch=81q2`` must not crash — or silently
+zero — the batcher at first access), and unknown ``RATELIMITER_*`` env
+keys / unknown file keys are warned about instead of passing silently.
 """
 
 from __future__ import annotations
 
 import os
 from typing import Dict, Optional
+
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("service.props")
 
 
 DEFAULTS = {
@@ -27,11 +37,36 @@ DEFAULTS = {
     # architecture notes but never implemented there (SURVEY.md §5.3);
     # implemented here and ON by default as documented.
     "ratelimiter.fail_open": "true",
+    # Admission control (engine/batcher.py): bound on each algo's pending
+    # micro-batch queue (0 = unbounded) and the per-request QUEUE deadline
+    # budget in ms (0 = none) — a request not dispatched within it is shed
+    # with a 429 + Retry-After instead of waiting forever.
+    "ratelimiter.overload.max_pending": "65536",
+    "ratelimiter.overload.deadline_ms": "1000",
+    # /actuator/health reports SHEDDING while a shed happened within this
+    # window (sheds are bursty; an instantaneous queue-depth read flaps).
+    "ratelimiter.overload.shed_health_window_ms": "5000",
+    # Circuit breaker (storage/breaker.py), composed retry(breaker(chaos(
+    # storage))): consecutive backend faults open it; while open, decisions
+    # short-circuit to the degraded host limiter (storage/degraded.py)
+    # instead of paying retry exhaustion per request.
+    "breaker.enabled": "true",
+    "breaker.failure_threshold": "8",
+    "breaker.open_ms": "5000",
+    "breaker.half_open_probes": "1",
+    # Degraded-mode host limiter: fail-approximate instead of fail-open
+    # while the breaker is open (device-batching backends only).
+    # max_keys bounds the last-seen-counter snapshot cache.
+    "ratelimiter.degraded.enabled": "true",
+    "ratelimiter.degraded.max_keys": "65536",
     # Shard the slot array over all visible devices when > 1.
     "parallel.shard": "auto",
     # Compile hot dispatch shapes at boot (moves 40-90s/shape jit stalls
     # out of the first requests).
     "warmup.enabled": "true",
+    # Boot-time host<->device link probe feeding the streaming loops'
+    # chunk plans (storage/tpu.py).
+    "link.probe.enabled": "true",
     # Persistent XLA compile-cache dir; empty -> ~/.cache/ratelimiter_tpu/jax.
     "jax.cache.dir": "",
     # Chaos drill: inject StorageException on this fraction of storage ops
@@ -60,16 +95,71 @@ DEFAULTS = {
     "replication.interval_ms": "200",
 }
 
+# Typed keys: anything listed here is parse-checked at construction.
+_INT_KEYS = (
+    "server.port", "storage.num_slots", "batcher.max_batch",
+    "batcher.max_inflight", "storage.retry.max_retries",
+    "replication.listen_port", "ratelimiter.overload.max_pending",
+    "breaker.failure_threshold", "breaker.half_open_probes",
+    "ratelimiter.degraded.max_keys",
+)
+_FLOAT_KEYS = (
+    "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
+    "storage.retry.delay_ms", "replication.interval_ms",
+    "ratelimiter.overload.deadline_ms",
+    "ratelimiter.overload.shed_health_window_ms", "breaker.open_ms",
+)
+_BOOL_KEYS = (
+    "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
+    "link.probe.enabled", "breaker.enabled", "ratelimiter.degraded.enabled",
+)
+_BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
+
+# RATELIMITER_* env vars read directly by engine/ops modules, not through
+# this properties layer — the unknown-env scan must not warn about them.
+_ENV_DIRECT = frozenset({
+    "RATELIMITER_SORT_UNIQUES", "RATELIMITER_RATE_PROBE",
+    "RATELIMITER_PALLAS", "RATELIMITER_PALLAS_INTERPRET",
+    "RATELIMITER_BLOCK_SCATTER", "RATELIMITER_BLOCK_SCATTER_INTERPRET",
+})
+
 
 def _env_key(key: str) -> str:
     return "RATELIMITER_" + key.replace(".", "_").replace("-", "_").upper()
+
+
+def _parses(key: str, value: str) -> bool:
+    try:
+        if key in _INT_KEYS:
+            int(value)
+        elif key in _FLOAT_KEYS:
+            float(value)
+        elif key in _BOOL_KEYS:
+            return value.strip().lower() in _BOOL_TOKENS
+        return True
+    except (TypeError, ValueError):
+        return False
 
 
 class AppProperties:
     def __init__(self, values: Optional[Dict[str, str]] = None):
         self._values = dict(DEFAULTS)
         if values:
+            for key in values:
+                if key not in DEFAULTS:
+                    log.warning("unknown property key %r (kept, but no "
+                                "component reads it — typo?)", key)
             self._values.update(values)
+        self._validate()
+
+    def _validate(self) -> None:
+        """Replace malformed typed values with their defaults, loudly."""
+        for key, value in list(self._values.items()):
+            if key in DEFAULTS and not _parses(key, value):
+                log.warning(
+                    "malformed value %r for property %r; using default %r",
+                    value, key, DEFAULTS[key])
+                self._values[key] = DEFAULTS[key]
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "AppProperties":
@@ -83,23 +173,42 @@ class AppProperties:
                     if "=" in line:
                         k, v = line.split("=", 1)
                         values[k.strip()] = v.strip()
-        props = cls(values)
-        for key in list(props._values):
-            env = os.environ.get(_env_key(key))
-            if env is not None:
-                props._values[key] = env
-        return props
+        known_env = {_env_key(k): k for k in DEFAULTS}
+        for env_name, env_value in os.environ.items():
+            if not env_name.startswith("RATELIMITER_"):
+                continue
+            key = known_env.get(env_name)
+            if key is not None:
+                values[key] = env_value
+            elif env_name not in _ENV_DIRECT:
+                log.warning("unknown env override %s (no property maps to "
+                            "it — typo?)", env_name)
+        return cls(values)
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
         return self._values.get(key, default)
 
     def get_int(self, key: str, default: int = 0) -> int:
         value = self._values.get(key)
-        return int(value) if value is not None else default
+        if value is None:
+            return default
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            log.warning("malformed int %r for property %r; using %r",
+                        value, key, default)
+            return default
 
     def get_float(self, key: str, default: float = 0.0) -> float:
         value = self._values.get(key)
-        return float(value) if value is not None else default
+        if value is None:
+            return default
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            log.warning("malformed float %r for property %r; using %r",
+                        value, key, default)
+            return default
 
     def get_bool(self, key: str, default: bool = False) -> bool:
         value = self._values.get(key)

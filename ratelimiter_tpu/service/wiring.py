@@ -70,6 +70,9 @@ class AppContext:
     limiters: Dict[str, RateLimiter]
     fail_open: bool
     replication: ReplicationHandle | None = None
+    # The CircuitBreakerStorage layer (None when breaker.enabled=false or
+    # the storage was injected) — the health state machine reads it.
+    breaker: object = None
 
     def close(self) -> None:
         if self.replication is not None:
@@ -143,6 +146,13 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
             max_batch=props.get_int("batcher.max_batch", 8192),
             max_delay_ms=props.get_float("batcher.max_delay_ms", 0.5),
             max_inflight=props.get_int("batcher.max_inflight", 4),
+            # Admission control (engine/batcher.py): bounded pending queue
+            # + per-request queue-deadline budgets; sheds raise
+            # OverloadedError, which service/app.py maps to 429+Retry-After.
+            max_pending=props.get_int("ratelimiter.overload.max_pending",
+                                      65536),
+            queue_deadline_ms=props.get_float(
+                "ratelimiter.overload.deadline_ms", 1000.0),
             engine=engine,
             meter_registry=meter_registry,
         )
@@ -157,6 +167,36 @@ def _maybe_chaos(storage: RateLimitStorage, props: AppProperties):
         return storage
     return FaultInjectingStorage(storage, failure_rate=rate,
                                  latency_ms=latency)
+
+
+def _maybe_breaker(storage: RateLimitStorage, props: AppProperties,
+                   registry: MeterRegistry):
+    """Circuit breaker between retry and chaos — ``retry(breaker(chaos(
+    storage)))`` — so every retry attempt against a dead backend counts
+    toward the threshold, and once open, decisions short-circuit to the
+    degraded host limiter instead of paying retry exhaustion per request.
+    Returns ``(wrapped_storage, breaker_or_None)``."""
+    if not props.get_bool("breaker.enabled", True):
+        return storage, None
+    from ratelimiter_tpu.storage.breaker import CircuitBreakerStorage
+
+    fallback = None
+    if (props.get_bool("ratelimiter.degraded.enabled", True)
+            and getattr(storage, "supports_device_batching", False)):
+        from ratelimiter_tpu.storage.degraded import DegradedHostLimiter
+
+        fallback = DegradedHostLimiter(
+            registry=registry,
+            max_keys=props.get_int("ratelimiter.degraded.max_keys", 65536))
+    breaker = CircuitBreakerStorage(
+        storage,
+        failure_threshold=props.get_int("breaker.failure_threshold", 8),
+        open_ms=props.get_float("breaker.open_ms", 5000.0),
+        half_open_probes=props.get_int("breaker.half_open_probes", 1),
+        fallback=fallback,
+        registry=registry,
+    )
+    return breaker, breaker
 
 
 def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
@@ -240,6 +280,7 @@ def build_app(props: AppProperties | None = None,
     own_storage = storage is None
     storage = storage or build_storage(props, meter_registry=registry)
     replication = None
+    breaker = None
     if own_storage:
         # Replication attaches to the RAW TPU storage (the journal hooks
         # the engine), before the chaos/retry wrappers compose around it.
@@ -261,7 +302,9 @@ def build_app(props: AppProperties | None = None,
                     logging.getLogger("ratelimiter").warning(
                         "boot link probe failed (%s): streaming loops run "
                         "on profile-less defaults", exc)
-        storage = _maybe_retry(_maybe_chaos(storage, props), props)
+        wrapped, breaker = _maybe_breaker(_maybe_chaos(storage, props),
+                                          props, registry)
+        storage = _maybe_retry(wrapped, props)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache
@@ -293,4 +336,5 @@ def build_app(props: AppProperties | None = None,
         limiters=limiters,
         fail_open=props.get_bool("ratelimiter.fail_open", True),
         replication=replication,
+        breaker=breaker,
     )

@@ -1,11 +1,20 @@
 from ratelimiter_tpu.storage.base import RateLimitStorage
+from ratelimiter_tpu.storage.breaker import CircuitBreakerStorage
 from ratelimiter_tpu.storage.chaos import FaultInjectingStorage
-from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
+from ratelimiter_tpu.storage.degraded import DegradedHostLimiter
+from ratelimiter_tpu.storage.errors import (
+    CircuitOpenError,
+    RetryPolicy,
+    StorageException,
+)
 from ratelimiter_tpu.storage.memory import InMemoryStorage
 from ratelimiter_tpu.storage.retry import RetryingStorage
 from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
 __all__ = [
+    "CircuitBreakerStorage",
+    "CircuitOpenError",
+    "DegradedHostLimiter",
     "FaultInjectingStorage",
     "RateLimitStorage",
     "InMemoryStorage",

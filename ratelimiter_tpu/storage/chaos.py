@@ -60,6 +60,11 @@ class FaultInjectingStorage(RateLimitStorage):
         with self._lock:
             self._forced += int(n)
 
+    def heal(self) -> None:
+        """Cancel any remaining forced failures (drills: end an outage)."""
+        with self._lock:
+            self._forced = 0
+
     def _maybe_fail(self, op: str) -> None:
         if op not in self._ops:
             return
@@ -242,4 +247,312 @@ def failover_drill(
     if report["mismatches"]:
         raise AssertionError(
             f"failover drill diverged from the oracle: {report}")
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Sustained-outage drill (breaker open -> degraded -> resync -> bit-identical)
+# ---------------------------------------------------------------------------
+
+def outage_drill(
+    num_slots: int = 512,
+    n_keys: int = 24,
+    healthy_waves: int = 3,
+    outage_waves: int = 4,
+    post_waves: int = 3,
+    batch: int = 24,
+    seed: int = 0,
+    failure_threshold: int = 4,
+    max_retries: int = 2,
+    open_ms: float = 5000.0,
+    registry=None,
+) -> dict:
+    """Deterministic sustained-outage drill over the production composition
+    ``retry(breaker(chaos(storage)))``, differential vs the oracle.
+
+    Phases, all under a controlled clock:
+
+    1. **Healthy** — mixed sw/tb waves through single ``acquire``; every
+       decision checked bit-exact against ``semantics/oracle.py`` (and the
+       breaker's healthy path snapshots each key's last counter into the
+       degraded limiter's seed cache).
+    2. **Outage** — every backend op is forced to fail.  The drill proves
+       the breaker opens within ``ceil(threshold / attempts)`` requests
+       (each retry attempt counts), then that decisions are served by the
+       degraded host limiter — marked ``degraded``, ZERO backend calls
+       (the short-circuit claim, checked against the injector's op log),
+       and per-key-per-window admission never exceeds ``max_permits``
+       (bounded over-admission: fail-*approximate*, not fail-open).
+    3. **Recovery** — the fault is healed and the clock advanced past
+       ``open_ms``; a half-open probe on a dedicated key closes the
+       breaker, which resyncs: every key the degraded limiter mutated is
+       reset on the device.  The drill mirrors those resets in the oracle.
+    4. **Post-resync** — waves again, bit-identical vs the oracle.
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import math
+    import random
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.breaker import (
+        CLOSED,
+        OPEN,
+        CircuitBreakerStorage,
+    )
+    from ratelimiter_tpu.storage.degraded import DegradedHostLimiter
+    from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
+    from ratelimiter_tpu.storage.retry import RetryingStorage
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = random.Random(seed)
+    clock = {"t": 1_753_000_000_000}
+    inner = TpuBatchedStorage(num_slots=num_slots, clock_ms=lambda: clock["t"])
+    chaos = FaultInjectingStorage(inner)
+    fallback = DegradedHostLimiter(clock_ms=lambda: clock["t"],
+                                   registry=registry)
+    breaker = CircuitBreakerStorage(
+        chaos, failure_threshold=failure_threshold, open_ms=open_ms,
+        half_open_probes=1, clock_ms=lambda: clock["t"], fallback=fallback,
+        registry=registry)
+    storage = RetryingStorage(breaker, RetryPolicy(
+        max_retries=max_retries, retry_delay_ms=0.01))
+
+    cfg_sw = RateLimitConfig(max_permits=12, window_ms=2000,
+                             enable_local_cache=False)
+    cfg_tb = RateLimitConfig(max_permits=20, window_ms=2000, refill_rate=8.0)
+    lid_sw = storage.register_limiter("sw", cfg_sw)
+    lid_tb = storage.register_limiter("tb", cfg_tb)
+    oracle_sw = SlidingWindowOracle(cfg_sw)
+    oracle_tb = TokenBucketOracle(cfg_tb)
+
+    report = {"decisions": 0, "mismatches": 0, "requests_to_open": 0,
+              "degraded_decisions": 0, "over_admissions": 0,
+              "touched_keys": 0, "shorted_backend_calls": 0}
+
+    def one(algo, lid, oracle, key, permits, check=True):
+        now = clock["t"]
+        out = storage.acquire(algo, lid, key, permits)
+        if not check:
+            return out
+        d = oracle.try_acquire(key, permits, now)
+        report["decisions"] += 1
+        hint = out.get("cache_value", out.get("remaining"))
+        if (bool(out["allowed"]) != d.allowed
+                or int(out["observed"]) != d.observed
+                or int(hint) != d.remaining_hint):
+            report["mismatches"] += 1
+        return out
+
+    def wave(check=True):
+        clock["t"] += rng.choice([3, 17, 250, 999, 2000])
+        for _ in range(batch):
+            key = f"u{rng.randrange(n_keys)}"
+            permits = rng.choice([1, 1, 1, 2, 5])
+            one("sw", lid_sw, oracle_sw, key, permits, check=check)
+            one("tb", lid_tb, oracle_tb, key, permits, check=check)
+
+    try:
+        # Phase 1: healthy, bit-identical.
+        for _ in range(healthy_waves):
+            wave()
+        assert report["mismatches"] == 0, (
+            f"healthy phase diverged from the oracle: {report}")
+
+        # Phase 2: sustained outage.
+        chaos.fail_next(10_000_000)
+        budget = math.ceil(failure_threshold / max(max_retries, 1)) + 1
+        opened_after = None
+        for i in range(budget):
+            try:
+                storage.acquire("sw", lid_sw, f"u{i % n_keys}", 1)
+            except StorageException:
+                pass
+            if breaker.state == OPEN:
+                opened_after = i + 1
+                break
+        assert opened_after is not None, (
+            f"breaker failed to open within {budget} requests of a "
+            f"sustained outage (threshold={failure_threshold}, "
+            f"attempts/request={max_retries})")
+        report["requests_to_open"] = opened_after
+
+        # Degraded service: no exceptions, no backend traffic, admission
+        # bounded per key per window by the policy ceiling.
+        backend_calls_at_open = len(chaos.calls)
+        admitted: dict = {}
+        for _ in range(outage_waves):
+            clock["t"] += rng.choice([3, 17, 250, 999])
+            for _ in range(batch):
+                key = f"u{rng.randrange(n_keys)}"
+                permits = rng.choice([1, 1, 2, 5])
+                out = storage.acquire("sw", lid_sw, key, permits)
+                assert out.get("degraded"), (
+                    "breaker open but the decision did not come from the "
+                    f"degraded host limiter: {out}")
+                report["degraded_decisions"] += 1
+                if out["allowed"]:
+                    # The sw bucket counts REQUESTS (one increment per
+                    # acquire regardless of permits — reference quirk
+                    # Q1/Q2), so the per-bucket admission ceiling is
+                    # max_permits requests.
+                    win = clock["t"] // cfg_sw.window_ms
+                    admitted[key, win] = admitted.get((key, win), 0) + 1
+        report["shorted_backend_calls"] = (
+            len(chaos.calls) - backend_calls_at_open)
+        assert report["shorted_backend_calls"] == 0, (
+            "degraded decisions still reached the backend: "
+            f"{report['shorted_backend_calls']} op(s) after open")
+        report["over_admissions"] = sum(
+            1 for count in admitted.values() if count > cfg_sw.max_permits)
+        assert report["over_admissions"] == 0, (
+            f"degraded mode over-admitted past the policy ceiling: {admitted}")
+
+        # Phase 3: heal, half-open probe, close + resync.
+        chaos.heal()
+        clock["t"] += int(open_ms) + 1
+        touched = fallback.touched()
+        report["touched_keys"] = len(touched)
+        assert report["touched_keys"] > 0, "outage phase mutated no keys?"
+        probe = storage.acquire("sw", lid_sw, "__probe__", 1)
+        assert not probe.get("degraded") and breaker.state == CLOSED, (
+            f"half-open probe did not close the breaker: state="
+            f"{breaker.state}")
+        assert breaker.resyncs_total == 1
+        # Mirror the resync in the oracle: reset exactly the touched keys.
+        oracle_sw.try_acquire("__probe__", 1, clock["t"])
+        for algo, _lid, key in touched:
+            (oracle_sw if algo == "sw" else oracle_tb).reset(key, clock["t"])
+
+        # Phase 4: post-resync, bit-identical again.
+        for _ in range(post_waves):
+            wave()
+        assert report["mismatches"] == 0, (
+            f"post-resync decisions diverged from the oracle: {report}")
+    finally:
+        storage.close()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Overload drill (bounded queue depth, shed-not-hang, p99 under load)
+# ---------------------------------------------------------------------------
+
+def overload_drill(
+    load_multipliers=(1.0, 2.0),
+    max_pending: int = 256,
+    deadline_ms: float = 1000.0,
+    dispatch_ms: float = 5.0,
+    max_batch: int = 32,
+    bursts: int = 40,
+    burst_interval_ms: float = 10.0,
+    p99_slack_ms: float = 250.0,
+) -> dict:
+    """Drive a MicroBatcher over a fixed-rate synthetic device at 1x..Nx
+    its capacity and prove the admission-control claims:
+
+    - pending queue depth never exceeds ``max_pending`` (hard bound),
+    - overload is SHED (typed ``OverloadedError`` with a positive
+      Retry-After hint), never queued forever,
+    - p99 latency of *admitted* requests stays within the queue-deadline
+      budget plus a dispatch cycle (shedding protects the admitted).
+
+    The synthetic device resolves a batch in ``dispatch_ms`` regardless of
+    size, so capacity = ``max_batch / dispatch_ms`` requests/s and the
+    offered load is ``multiplier * capacity`` submitted in bursts.  The
+    defaults are deliberately coarse (deep queue, 1 s deadline) so that
+    scheduler stalls on a loaded CI box do not read as overload; tighten
+    them when measuring, not when gating.
+    Returns per-multiplier stats; raises AssertionError on any violation.
+    """
+    import statistics
+
+    from ratelimiter_tpu.engine.batcher import MicroBatcher
+    from ratelimiter_tpu.engine.errors import OverloadedError
+
+    capacity_rps = max_batch / (dispatch_ms / 1000.0)
+    report = {"capacity_rps": capacity_rps, "runs": []}
+
+    for mult in load_multipliers:
+        def dispatch(slots, lids, permits):
+            # Cost scales with the number of max_batch-sized device steps:
+            # the flusher hands over whatever accumulated, and an elastic
+            # single-sleep model would let a deep queue raise capacity.
+            n = len(slots)
+            time.sleep(-(-n // max_batch) * dispatch_ms / 1000.0)
+            return {"allowed": [True] * n}
+
+        batcher = MicroBatcher(
+            dispatch={"sw": dispatch}, clear={"sw": lambda slots: None},
+            max_batch=max_batch, max_delay_ms=0.0, max_inflight=1,
+            max_pending=max_pending, deadline_ms=deadline_ms)
+        done_ms: dict = {}  # future -> completion latency (done callback,
+        shed = deadline = admitted = 0  # so collection order can't inflate)
+        per_burst = max(int(capacity_rps * burst_interval_ms / 1000.0
+                            * mult), 1)
+        pending: list = []
+
+        def stamp(fut, born):
+            fut.add_done_callback(
+                lambda f: done_ms.setdefault(
+                    f, (time.monotonic() - born) * 1000.0))
+            return fut
+
+        try:
+            start = time.monotonic()
+            for k in range(bursts):
+                # Absolute schedule: a late burst fires immediately rather
+                # than sliding every later burst (which would quietly lower
+                # the offered rate on a loaded box).
+                delay = start + k * burst_interval_ms / 1000.0 \
+                    - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                born = time.monotonic()
+                for i in range(per_burst):
+                    try:
+                        pending.append(stamp(
+                            batcher.submit("sw", i % 32, 0, 1), born))
+                    except OverloadedError as exc:
+                        assert exc.retry_after_ms > 0, (
+                            "shed without a Retry-After hint")
+                        shed += 1
+            lat_ms = []
+            for fut in pending:
+                try:
+                    fut.result(timeout=10.0)
+                    lat_ms.append(done_ms[fut])
+                    admitted += 1
+                except OverloadedError:
+                    deadline += 1
+            depth_seen = batcher.max_depth_seen
+        finally:
+            batcher.close()
+
+        offered = shed + len(pending)
+        p99 = (statistics.quantiles(lat_ms, n=100)[98]
+               if len(lat_ms) >= 100 else max(lat_ms, default=0.0))
+        run = {"multiplier": mult, "offered": offered, "admitted": admitted,
+               "shed": shed, "deadline_expired": deadline,
+               "goodput_frac": admitted / max(offered, 1),
+               "shed_frac": (shed + deadline) / max(offered, 1),
+               "max_depth_seen": depth_seen, "p99_ms": p99}
+        report["runs"].append(run)
+
+        assert depth_seen <= max_pending, (
+            f"queue depth {depth_seen} exceeded the configured bound "
+            f"{max_pending} at {mult}x load")
+        assert admitted + shed + deadline == offered  # nothing stranded
+        budget = deadline_ms + 2 * dispatch_ms + p99_slack_ms
+        assert p99 <= budget, (
+            f"p99 of admitted requests {p99:.1f} ms blew the "
+            f"{budget:.1f} ms budget at {mult}x load")
+        if mult >= 2.0:
+            assert run["shed_frac"] > 0, (
+                f"{mult}x offered load shed nothing — the queue bound "
+                "is not engaging")
     return report

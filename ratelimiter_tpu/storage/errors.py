@@ -12,11 +12,24 @@ import dataclasses
 import time
 from typing import Callable, TypeVar
 
+from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
+
 T = TypeVar("T")
 
 
 class StorageException(RuntimeError):
     """Raised when a storage operation fails after all retries."""
+
+
+class CircuitOpenError(StorageException):
+    """The circuit breaker is open: the backend was not called.
+
+    A ``StorageException`` subclass so the service tier's existing
+    fail-open policy absorbs it on paths with no degraded fallback — but
+    listed in ``RetryPolicy.no_retry`` because retrying a deterministic
+    short-circuit only burns the backoff budget (the breaker will not
+    close until its open window elapses and a half-open probe succeeds).
+    """
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,12 +40,16 @@ class RetryPolicy:
     through: the Java wrapper retried JedisException — transport faults —
     not argument errors, and converting a ValueError into StorageException
     would hand it to the fail-open policy, silently allowing requests a
-    caller bug produced.
+    caller bug produced.  The overload/lifecycle family is equally
+    non-retryable: replaying a shed request amplifies the overload it was
+    shed to relieve, a closed batcher will not reopen, and an open
+    breaker is deterministic until its window elapses.
     """
 
     max_retries: int = 3
     retry_delay_ms: float = 10.0
-    no_retry: tuple = (ValueError, TypeError, KeyError)
+    no_retry: tuple = (ValueError, TypeError, KeyError,
+                       OverloadedError, ShutdownError, CircuitOpenError)
 
     def execute(self, operation: Callable[[], T], sleep=time.sleep) -> T:
         last_exc: Exception | None = None

@@ -457,6 +457,8 @@ class TpuBatchedStorage(RateLimitStorage):
         max_batch: int = 8192,
         max_delay_ms: float = 0.5,
         max_inflight: int = 4,
+        max_pending: int = 0,
+        queue_deadline_ms: float = 0.0,
         clock_ms: Callable[[], int] = _wall_clock_ms,
         engine: DeviceEngine | None = None,
         table: LimiterTable | None = None,
@@ -619,6 +621,9 @@ class TpuBatchedStorage(RateLimitStorage):
             max_batch=max_batch,
             max_delay_ms=max_delay_ms,
             max_inflight=max_inflight,
+            max_pending=max_pending,
+            deadline_ms=queue_deadline_ms,
+            meter_registry=meter_registry,
         )
 
     # ------------------------------------------------------------------------
@@ -633,14 +638,19 @@ class TpuBatchedStorage(RateLimitStorage):
         self._configs[lid] = (algo, config)
         return lid
 
-    def acquire(self, algo: str, lid: int, key: str, permits: int) -> dict:
+    def acquire(self, algo: str, lid: int, key: str, permits: int,
+                deadline_ms: float | None = None) -> dict:
         """Single decision through the micro-batcher (blocks until the batch
-        containing this request lands; bounded by max_delay_ms)."""
+        containing this request lands; bounded by max_delay_ms).
+
+        ``deadline_ms`` overrides the storage-wide queue-deadline budget
+        for this request (admission control; engine/batcher.py)."""
         slot = self._assign_slot(algo, lid, key, hold_pin=True)
         # The pin (taken atomically inside the assign) holds until the
         # submit registers the slot in pending_slots.
         with self._pins_released(self._index[algo], [slot]):
-            fut = self._batcher.submit(algo, slot, lid, permits)
+            fut = self._batcher.submit(algo, slot, lid, permits,
+                                       deadline_ms=deadline_ms)
         return fut.result()
 
     def acquire_many(

@@ -1,0 +1,212 @@
+"""Circuit breaker + degraded-mode host limiter (storage/breaker.py,
+storage/degraded.py) and the sustained-outage chaos drill.
+
+The contract under test: consecutive backend faults open the breaker;
+while open, decisions short-circuit to the degraded host limiter (zero
+backend traffic, bounded over-admission); a half-open probe closes it and
+resyncs every key the degraded limiter mutated, after which decisions are
+bit-identical to ``semantics/oracle.py`` again.
+"""
+
+import pytest
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.storage import (
+    CircuitBreakerStorage,
+    CircuitOpenError,
+    DegradedHostLimiter,
+    FaultInjectingStorage,
+)
+from ratelimiter_tpu.storage.errors import RetryPolicy, StorageException
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+
+@pytest.fixture()
+def stack():
+    """retry-less breaker stack over a real device storage, manual clock."""
+    clock = {"t": 1_753_000_000_000}
+    inner = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    chaos = FaultInjectingStorage(inner)
+    fallback = DegradedHostLimiter(clock_ms=lambda: clock["t"])
+    breaker = CircuitBreakerStorage(
+        chaos, failure_threshold=3, open_ms=1000.0, half_open_probes=1,
+        clock_ms=lambda: clock["t"], fallback=fallback)
+    yield clock, chaos, fallback, breaker
+    inner.close()
+
+
+def _trip(breaker, chaos, lid, n=3):
+    chaos.fail_next(n)
+    for _ in range(n):
+        with pytest.raises(StorageException):
+            breaker.acquire("sw", lid, "trip-key", 1)
+
+
+def test_breaker_opens_after_consecutive_failures(stack):
+    clock, chaos, fallback, breaker = stack
+    lid = breaker.register_limiter("sw", RateLimitConfig(
+        max_permits=5, window_ms=1000))
+    chaos.fail_next(2)  # below threshold: a success resets the streak
+    for _ in range(2):
+        with pytest.raises(StorageException):
+            breaker.acquire("sw", lid, "k", 1)
+    assert breaker.state == "closed"
+    assert breaker.acquire("sw", lid, "k", 1)["allowed"]
+    assert breaker.status()["consecutive_failures"] == 0
+
+    _trip(breaker, chaos, lid)
+    assert breaker.state == "open"
+    assert breaker.opened_total == 1
+
+
+def test_open_breaker_short_circuits_without_backend_calls(stack):
+    clock, chaos, fallback, breaker = stack
+    lid = breaker.register_limiter("sw", RateLimitConfig(
+        max_permits=5, window_ms=1000))
+    _trip(breaker, chaos, lid)
+    calls_at_open = len(chaos.calls)
+    for _ in range(5):
+        out = breaker.acquire("sw", lid, "k", 1)
+        assert out["degraded"]
+    with pytest.raises(CircuitOpenError):  # no fallback for this surface
+        breaker.increment_and_expire("legacy-key", 1000)
+    assert len(chaos.calls) == calls_at_open  # backend never touched
+
+
+def test_half_open_probe_failure_reopens(stack):
+    clock, chaos, fallback, breaker = stack
+    lid = breaker.register_limiter("sw", RateLimitConfig(
+        max_permits=5, window_ms=1000))
+    _trip(breaker, chaos, lid)
+    clock["t"] += 1001
+    chaos.fail_next(1)  # the probe itself fails
+    with pytest.raises(StorageException):
+        breaker.acquire("sw", lid, "k", 1)
+    assert breaker.state == "open"
+    assert breaker.opened_total == 2
+    # ...and while re-opened, degraded service continues.
+    assert breaker.acquire("sw", lid, "k", 1)["degraded"]
+
+
+def test_half_open_probe_success_closes_and_resyncs(stack):
+    # window > open_ms so the pre-outage device count is still live when
+    # the breaker closes — the resync reset is what restores the budget.
+    clock, chaos, fallback, breaker = stack
+    cfg = RateLimitConfig(max_permits=5, window_ms=5000)
+    lid = breaker.register_limiter("sw", cfg)
+    assert breaker.acquire("sw", lid, "k", 1)["allowed"]  # device count: 1
+    _trip(breaker, chaos, lid)
+    assert breaker.acquire("sw", lid, "k", 1)["degraded"]  # mutates "k"
+    assert ("sw", lid, "k") in fallback.touched()
+    clock["t"] += 1001
+    out = breaker.acquire("sw", lid, "probe", 1)
+    assert breaker.state == "closed" and not out.get("degraded")
+    assert breaker.resyncs_total == 1
+    assert fallback.touched() == []  # episode state dropped
+    # "k" was reset on the device: full budget again, bit-identical to a
+    # fresh oracle key.
+    assert int(breaker.available_many("sw", lid, ["k"])[0]) == 5
+
+
+def test_failed_resync_reopens_and_keeps_touched_set():
+    clock = {"t": 1_753_000_000_000}
+    inner = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    # Chaos that can ONLY fail reset_key — the resync op.
+    chaos = FaultInjectingStorage(inner, ops=("reset_key",))
+    fallback = DegradedHostLimiter(clock_ms=lambda: clock["t"])
+    breaker = CircuitBreakerStorage(
+        chaos, failure_threshold=1, open_ms=1000.0,
+        clock_ms=lambda: clock["t"], fallback=fallback)
+    try:
+        lid = breaker.register_limiter("sw", RateLimitConfig(
+            max_permits=5, window_ms=1000))
+        breaker.trip()
+        assert breaker.acquire("sw", lid, "k", 1)["degraded"]
+        clock["t"] += 1001
+        chaos.fail_next(1)  # probe acquire succeeds; resync reset fails
+        breaker.acquire("sw", lid, "probe", 1)
+        assert breaker.state == "open"  # reopened by the failed resync
+        assert fallback.touched() != []  # kept for the next recovery
+        clock["t"] += 1001
+        breaker.acquire("sw", lid, "probe", 1)  # clean recovery this time
+        assert breaker.state == "closed"
+        assert breaker.resyncs_total == 1
+        assert fallback.touched() == []
+    finally:
+        inner.close()
+
+
+def test_validation_errors_do_not_count_or_convert():
+    class _BadInputBackend:
+        supports_device_batching = True
+
+        def acquire(self, *args, **kwargs):
+            raise ValueError("caller bug")
+
+    breaker = CircuitBreakerStorage(_BadInputBackend(), failure_threshold=2)
+    for _ in range(5):  # > threshold: caller bugs must not open the breaker
+        with pytest.raises(ValueError):
+            breaker.acquire("sw", 0, "k", 1)
+    assert breaker.state == "closed"
+
+
+def test_healthy_path_seeds_degraded_budget(stack):
+    """A key near its limit before the outage stays near its limit in
+    degraded mode: the last device-reported counter seeds the host
+    approximation (fail-approximate, not a blank-slate fail-open)."""
+    clock, chaos, fallback, breaker = stack
+    lid = breaker.register_limiter("sw", RateLimitConfig(
+        max_permits=5, window_ms=1000))
+    for _ in range(3):  # burn 3 of 5 on the device
+        assert breaker.acquire("sw", lid, "hot", 1)["allowed"]
+    breaker.trip()
+    allowed = sum(
+        bool(breaker.acquire("sw", lid, "hot", 1)["allowed"])
+        for _ in range(5))
+    assert allowed == 2  # only the remaining budget, not a fresh 5
+
+
+def test_degraded_limiter_unknown_lid_raises_circuit_open():
+    fb = DegradedHostLimiter(clock_ms=lambda: 1000)
+    with pytest.raises(CircuitOpenError):
+        fb.acquire("sw", 99, "k", 1)
+
+
+def test_degraded_limiter_shapes_and_reset():
+    fb = DegradedHostLimiter(clock_ms=lambda: 10_000)
+    fb.register(0, "sw", RateLimitConfig(max_permits=3, window_ms=1000))
+    fb.register(1, "tb", RateLimitConfig(max_permits=4, window_ms=1000,
+                                         refill_rate=1.0))
+    sw = fb.acquire("sw", 0, "k", 1)
+    assert sw["degraded"] and {"allowed", "mutated", "observed",
+                               "cache_value"} <= set(sw)
+    tb = fb.acquire("tb", 1, "k", 1)
+    assert tb["degraded"] and {"allowed", "observed", "remaining"} <= set(tb)
+    assert fb.available("sw", 0, ["k", "fresh"]) == [2, 3]
+    fb.reset("sw", 0, "k")
+    assert fb.available("sw", 0, ["k"]) == [3]
+    assert ("sw", 0, "k") in fb.touched()  # admin reset must reach resync
+    fb.clear_state()
+    assert fb.touched() == []
+
+
+def test_outage_drill_fast():
+    """Chaos drill: sustained outage -> breaker opens -> degraded serving
+    (bounded, zero backend traffic) -> heal -> resync -> bit-identical."""
+    from ratelimiter_tpu.storage.chaos import outage_drill
+
+    report = outage_drill()
+    assert report["mismatches"] == 0
+    assert report["degraded_decisions"] > 0
+    assert report["shorted_backend_calls"] == 0
+    assert report["over_admissions"] == 0
+
+
+@pytest.mark.slow
+def test_outage_soak_slow():
+    from ratelimiter_tpu.storage.chaos import outage_drill
+
+    report = outage_drill(num_slots=2048, n_keys=96, healthy_waves=10,
+                          outage_waves=12, post_waves=10, batch=64, seed=7)
+    assert report["mismatches"] == 0
+    assert report["over_admissions"] == 0

@@ -94,12 +94,15 @@ def test_chaos_wraps_device_storage_stream():
     chaos.close()
 
 
-def test_default_wiring_composes_retry_over_chaos():
-    """build_app wires retry(chaos(storage)): transient faults are absorbed
-    by the retry layer (the RedisRateLimitStorage.java:155-178 analog) and
-    never reach the caller; only exhaustion escalates."""
+def test_default_wiring_composes_retry_over_breaker_over_chaos():
+    """build_app wires retry(breaker(chaos(storage))): transient faults are
+    absorbed by the retry layer (the RedisRateLimitStorage.java:155-178
+    analog) and never reach the caller; only exhaustion escalates.  The
+    breaker sits INSIDE retry so every attempt counts toward its
+    threshold."""
     from ratelimiter_tpu.service.props import AppProperties
     from ratelimiter_tpu.service.wiring import build_app
+    from ratelimiter_tpu.storage.breaker import CircuitBreakerStorage
     from ratelimiter_tpu.storage.retry import RetryingStorage
 
     props = AppProperties({
@@ -112,7 +115,10 @@ def test_default_wiring_composes_retry_over_chaos():
     ctx = build_app(props)
     try:
         assert isinstance(ctx.storage, RetryingStorage)
-        chaos = ctx.storage._inner
+        breaker = ctx.storage._inner
+        assert isinstance(breaker, CircuitBreakerStorage)
+        assert ctx.breaker is breaker
+        chaos = breaker._inner
         assert isinstance(chaos, FaultInjectingStorage)
         chaos.failure_rate = 0.0  # deterministic: forced faults only
 
@@ -153,7 +159,8 @@ def test_retry_exhaustion_reaches_fail_open_counter():
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     port = server.server_address[1]
-    chaos = ctx.storage._inner
+    chaos = ctx.storage._inner._inner  # retry -> breaker -> chaos
+    assert isinstance(chaos, FaultInjectingStorage)
     chaos.failure_rate = 0.0  # deterministic: forced faults only
 
     def hit():
@@ -682,3 +689,105 @@ def test_sharded_relay_shard_assign_failure_clears_and_releases(monkeypatch):
     assert len(cleared) > 0, "successful shards' evictions were dropped"
     _assert_no_sharded_pin_leak(st, "tb")
     st.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry passthrough contract (satellite): multi-dispatch batch/stream ops
+# must NOT be retried — a replay re-charges already-committed requests.
+# ---------------------------------------------------------------------------
+
+class _CountingBackend:
+    """Duck-typed backend that always fails, counting attempts per op."""
+
+    supports_device_batching = True
+
+    def __init__(self):
+        self.attempts = {}
+
+    def __getattr__(self, name):
+        def op(*args, **kwargs):
+            self.attempts[name] = self.attempts.get(name, 0) + 1
+            raise StorageException(f"down ({name})")
+
+        return op
+
+
+def test_retry_covers_exactly_the_replay_safe_surface():
+    from ratelimiter_tpu.storage.retry import (
+        _PASSTHROUGH_OPS,
+        REPLAY_SAFE_OPS,
+        RetryingStorage,
+    )
+
+    inner = _CountingBackend()
+    st = RetryingStorage(inner, RetryPolicy(max_retries=3,
+                                            retry_delay_ms=0.01))
+    for op in ("acquire_many", "acquire_many_ids", "acquire_stream_ids",
+               "acquire_stream_strs"):
+        assert op in _PASSTHROUGH_OPS
+        with pytest.raises(StorageException):
+            getattr(st, op)("sw", 0, [], [])
+        assert inner.attempts[op] == 1, (
+            f"{op} was replayed {inner.attempts[op]}x — it mutates state "
+            "per super-batch and must pass through un-retried")
+    for op in ("acquire", "available_many", "reset_key"):
+        assert op in REPLAY_SAFE_OPS
+        with pytest.raises(StorageException):
+            getattr(st, op)("sw", 0, "k")
+        assert inner.attempts[op] == 3, f"{op} should be retried to exhaustion"
+
+
+def test_retry_policy_skips_overload_and_lifecycle_errors():
+    """Shed/shutdown/breaker-open signals are deterministic local
+    decisions: replaying them amplifies the condition they report."""
+    from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
+    from ratelimiter_tpu.storage.errors import CircuitOpenError
+
+    for exc in (OverloadedError("shed", reason="queue_full"),
+                ShutdownError("closed"),
+                CircuitOpenError("open")):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise exc
+
+        with pytest.raises(type(exc)):
+            RetryPolicy(max_retries=3, retry_delay_ms=0.01).execute(op)
+        assert len(calls) == 1, f"{type(exc).__name__} must not be retried"
+
+
+# ---------------------------------------------------------------------------
+# consume_pending_clears double-clear protection (satellite): an eviction
+# failure's pending_clears must be consumed exactly once even when the
+# same exception propagates through nested handlers.
+# ---------------------------------------------------------------------------
+
+def test_consume_pending_clears_once_through_nested_handlers():
+    from ratelimiter_tpu.engine.errors import (
+        SlotCapacityError,
+        consume_pending_clears,
+    )
+
+    pooled = []
+    try:
+        try:  # inner handler: consumes (with a shard offset) and re-raises
+            raise SlotCapacityError("full", pending_clears=[2, 5])
+        except SlotCapacityError as exc:
+            pooled.extend(consume_pending_clears(exc, base=100))
+            raise
+    except SlotCapacityError as exc:  # outer handler: same raise, no clears
+        pooled.extend(consume_pending_clears(exc, base=100))
+        assert exc.pending_clears is None
+    assert pooled == [102, 105]  # offset applied, exactly once
+
+
+def test_consume_pending_clears_handles_absent_and_empty():
+    from ratelimiter_tpu.engine.errors import (
+        SlotCapacityError,
+        consume_pending_clears,
+    )
+
+    assert consume_pending_clears(RuntimeError("no attr")) == []
+    assert consume_pending_clears(
+        SlotCapacityError("full", pending_clears=[])) == []

@@ -1,0 +1,289 @@
+"""Admission control & overload shedding (engine/batcher.py + service tier).
+
+The overload contract: a request is answered — allowed, denied, shed with
+a typed retryable error, or failed by shutdown — but NEVER stranded on
+``Future.result()``.  Covers the bounded pending queue, queue-deadline
+budgets, the flusher watchdog, ``close()`` stranding, the overload chaos
+drill, and the service tier's 429-with-Retry-After / health-state surface.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ratelimiter_tpu.engine.batcher import MicroBatcher
+from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
+
+
+def _sync_batcher(**kw):
+    """Batcher over an instant synchronous dispatch (no drain fn)."""
+    def dispatch(slots, lids, permits):
+        return {"allowed": [True] * len(slots)}
+
+    kw.setdefault("max_delay_ms", 10_000.0)  # accumulate unless told not to
+    return MicroBatcher(dispatch={"sw": dispatch},
+                        clear={"sw": lambda slots: None}, **kw)
+
+
+def test_submit_sheds_at_max_pending_with_retry_after():
+    b = _sync_batcher(max_pending=2)
+    try:
+        b.submit("sw", 0, 0, 1)
+        b.submit("sw", 1, 0, 1)
+        with pytest.raises(OverloadedError) as exc_info:
+            b.submit("sw", 2, 0, 1)
+        assert exc_info.value.reason == "queue_full"
+        assert exc_info.value.retry_after_ms > 0
+        assert b.shed_total == 1
+        assert b.queue_depth() == 2
+    finally:
+        b.close()
+
+
+def test_zero_max_pending_disables_the_bound():
+    b = _sync_batcher(max_pending=0)
+    try:
+        futs = [b.submit("sw", i, 0, 1) for i in range(64)]
+        b.flush()
+        assert all(f.result(timeout=5)["allowed"] for f in futs)
+        assert b.shed_total == 0
+    finally:
+        b.close()
+
+
+def test_queue_deadline_expires_undispatched_requests():
+    """A request the flusher cannot dispatch in time (here: a dispatch
+    wedged holding the lock) is failed by the watchdog with a typed
+    deadline error — not left waiting."""
+    release = threading.Event()
+
+    def slow_dispatch(slots, lids, permits):
+        release.wait(timeout=10)
+        return {"allowed": [True] * len(slots)}
+
+    b = MicroBatcher(dispatch={"sw": slow_dispatch},
+                     clear={"sw": lambda slots: None},
+                     max_delay_ms=0.0, deadline_ms=60.0)
+    try:
+        first = b.submit("sw", 0, 0, 1)   # wedges inside dispatch
+        time.sleep(0.02)                   # let the flusher take it
+        second = b.submit("sw", 1, 0, 1)  # queued behind the wedge
+        with pytest.raises(OverloadedError) as exc_info:
+            second.result(timeout=5)
+        assert exc_info.value.reason == "deadline"
+        assert b.deadline_total == 1
+        release.set()
+        assert first.result(timeout=5)["allowed"]  # dispatched: never shed
+    finally:
+        release.set()
+        b.close()
+
+
+def test_per_request_deadline_overrides_batcher_default():
+    release = threading.Event()
+
+    def slow_dispatch(slots, lids, permits):
+        release.wait(timeout=10)
+        return {"allowed": [True] * len(slots)}
+
+    b = MicroBatcher(dispatch={"sw": slow_dispatch},
+                     clear={"sw": lambda slots: None},
+                     max_delay_ms=0.0, deadline_ms=0.0)  # no default budget
+    try:
+        b.submit("sw", 0, 0, 1)
+        time.sleep(0.02)
+        tight = b.submit("sw", 1, 0, 1, deadline_ms=50.0)
+        with pytest.raises(OverloadedError):
+            tight.result(timeout=5)
+    finally:
+        release.set()
+        b.close()
+
+
+def test_dead_flusher_fails_queue_and_refuses_submits():
+    b = _sync_batcher()
+    try:
+        queued = b.submit("sw", 0, 0, 1)
+        b.max_delay_s = None  # poison: the flusher loop dies on compare
+        with b._cv:
+            b._cv.notify_all()
+        with pytest.raises(OverloadedError) as exc_info:
+            queued.result(timeout=5)
+        assert exc_info.value.reason == "flusher_dead"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:  # watchdog flags the corpse
+            try:
+                b.submit("sw", 1, 0, 1)
+            except OverloadedError as exc:
+                assert exc.reason == "flusher_dead"
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("submit kept queuing onto a dead flusher")
+    finally:
+        b.max_delay_s = 10.0
+        b.close()
+
+
+def test_close_fails_pending_futures_with_shutdown_error():
+    """Satellite: close() must fail still-pending futures instead of
+    leaving callers blocked on Future.result() — even when a dispatch is
+    wedged and never returns."""
+    stuck = threading.Event()
+
+    def hung_dispatch(slots, lids, permits):
+        stuck.wait(timeout=30)
+        return {"allowed": [True] * len(slots)}
+
+    b = MicroBatcher(dispatch={"sw": hung_dispatch},
+                     clear={"sw": lambda slots: None}, max_delay_ms=0.0)
+    dispatched = b.submit("sw", 0, 0, 1)  # wedges inside dispatch
+    time.sleep(0.02)
+    queued = b.submit("sw", 1, 0, 1)      # never dispatched
+    t0 = time.monotonic()
+    b.close(timeout=0.3)
+    assert time.monotonic() - t0 < 5  # bounded, not hung
+    for fut in (dispatched, queued):
+        with pytest.raises(ShutdownError):
+            fut.result(timeout=1)
+    stuck.set()
+
+
+def test_submit_after_close_raises_shutdown_error():
+    b = _sync_batcher()
+    b.close()
+    with pytest.raises(ShutdownError):
+        b.submit("sw", 0, 0, 1)
+
+
+def test_overload_drill_fast():
+    """Chaos drill: queue depth bounded, overload shed not queued, p99 of
+    admitted requests within the deadline budget at 2x offered load."""
+    from ratelimiter_tpu.storage.chaos import overload_drill
+
+    # 0.8x as the under-capacity point: at exactly 1x the synthetic
+    # device's sleep() overhead makes Python effectively over-subscribed.
+    report = overload_drill(load_multipliers=(0.8, 2.0), bursts=25)
+    assert report["runs"][0]["goodput_frac"] > 0.9     # under capacity: no shed
+    two_x = report["runs"][1]
+    assert two_x["shed_frac"] > 0.2                    # 2x: overload shed
+    assert two_x["max_depth_seen"] <= 256              # drill default bound
+
+
+@pytest.mark.slow
+def test_overload_soak_slow():
+    from ratelimiter_tpu.storage.chaos import overload_drill
+
+    report = overload_drill(load_multipliers=(1.0, 2.0, 4.0), bursts=120)
+    four_x = report["runs"][-1]
+    assert four_x["shed_frac"] > 0.4
+    assert four_x["max_depth_seen"] <= 256             # drill default bound
+
+
+# ---------------------------------------------------------------------------
+# Service tier: 429-with-Retry-After vs 503, health state machine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ctx_server():
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.app import make_server
+    from ratelimiter_tpu.service.wiring import build_app
+
+    props = AppProperties({
+        "storage.backend": "memory",
+        "chaos.failure_rate": "0.000001",  # arms chaos so the stack is full
+        "warmup.enabled": "false",
+        "server.port": "0",
+    })
+    ctx = build_app(props)
+    ctx.storage._inner._inner.failure_rate = 0.0  # deterministic again
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield ctx, srv
+    srv.shutdown()
+    thread.join(timeout=5)
+    ctx.close()
+
+
+def _get(srv, path, headers=None):
+    port = srv.server_address[1]
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.getheaders())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read() or b"{}"), dict(err.headers)
+
+
+def test_shed_request_gets_429_with_retry_after(ctx_server):
+    ctx, srv = ctx_server
+
+    def shed(key, permits=1):
+        raise OverloadedError("queue full", reason="queue_full",
+                              retry_after_ms=2500.0)
+
+    ctx.limiters["api"].try_acquire = shed
+    status, data, headers = _get(srv, "/api/data",
+                                 headers={"X-User-ID": "alice"})
+    assert status == 429
+    assert data["error"] == "Overloaded"
+    assert data["reason"] == "queue_full"
+    assert int(headers["Retry-After"]) == 3  # ceil(2500 ms)
+    meters = ctx.registry.scrape()
+    assert meters["ratelimiter.overload.rejected"] == 1
+
+
+def test_shutdown_gets_503(ctx_server):
+    ctx, srv = ctx_server
+
+    def closed(key, permits=1):
+        raise ShutdownError("batcher closed")
+
+    ctx.limiters["api"].try_acquire = closed
+    status, data, headers = _get(srv, "/api/data")
+    assert status == 503
+    assert "Retry-After" in headers
+
+
+def test_health_up_then_degraded_then_down(ctx_server):
+    ctx, srv = ctx_server
+    status, data, _ = _get(srv, "/actuator/health")
+    assert (status, data["status"]) == (200, "UP")
+
+    ctx.breaker.trip()  # breaker open + fail_open: still serving -> DEGRADED
+    status, data, _ = _get(srv, "/actuator/health")
+    assert (status, data["status"]) == (200, "DEGRADED")
+    assert data["breaker"]["state"] == "open"
+
+    ctx.fail_open = False  # open breaker, no fallback, no fail-open -> DOWN
+    status, data, _ = _get(srv, "/actuator/health")
+    assert (status, data["status"]) == (503, "DOWN")
+
+
+def test_health_shedding_window(ctx_server):
+    ctx, srv = ctx_server
+
+    class _StubBatcher:
+        max_pending = 8
+        shed_total = 3
+        deadline_total = 0
+        last_shed_s = time.monotonic()
+
+        def queue_depth(self):
+            return 8
+
+    ctx.storage._batcher = _StubBatcher()
+    status, data, _ = _get(srv, "/actuator/health")
+    assert (status, data["status"]) == (200, "SHEDDING")
+    assert data["overload"]["queue_depth"] == 8
+    # Outside the shed window the state decays back to UP.
+    ctx.storage._batcher.last_shed_s = time.monotonic() - 3600.0
+    status, data, _ = _get(srv, "/actuator/health")
+    assert (status, data["status"]) == (200, "UP")

@@ -75,18 +75,31 @@ else
   fail=1
 fi
 
+echo "running fast overload + breaker chaos drills..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_overload.py::test_overload_drill_fast \
+    tests/test_breaker.py::test_outage_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  overload + outage drills"
+else
+  echo "  FAILED  overload + outage drills"
+  fail=1
+fi
+
 if [[ "${RUN_SLOW:-0}" == "1" ]]; then
-  echo "running slow failover soak (RUN_SLOW=1)..."
+  echo "running slow failover + overload + outage soaks (RUN_SLOW=1)..."
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
       tests/test_replication.py::test_failover_soak_slow \
+      tests/test_overload.py::test_overload_soak_slow \
+      tests/test_breaker.py::test_outage_soak_slow \
       -q -m slow -p no:cacheprovider; then
-    echo "  ok  failover soak"
+    echo "  ok  slow soaks"
   else
-    echo "  FAILED  failover soak"
+    echo "  FAILED  slow soaks"
     fail=1
   fi
 else
-  echo "skipping slow failover soak (set RUN_SLOW=1 to run it)"
+  echo "skipping slow soaks (set RUN_SLOW=1 to run them)"
 fi
 
 if [[ $fail -eq 0 ]]; then
