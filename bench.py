@@ -646,8 +646,11 @@ def main() -> None:
         detail["sharded_scaling"] = json.loads(
             proc.stdout.strip().splitlines()[-1])
         for p in detail["sharded_scaling"]["points"]:
+            s = p.get("str_end_to_end")
+            extra = (f"; strs {s['decisions_per_sec']:,.0f}/s"
+                     if s else "")
             log(f"  {p['n_shards']} shard(s): "
-                f"{p['decisions_per_sec']:,.0f} decisions/s")
+                f"{p['decisions_per_sec']:,.0f} decisions/s{extra}")
     except Exception as exc:  # noqa: BLE001 — aux section must not kill bench
         detail["sharded_scaling"] = {"error": str(exc)}
         log(f"  sharded scaling failed: {exc}")

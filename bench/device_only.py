@@ -162,12 +162,13 @@ def main() -> None:
         return make
 
     # Micro-batch step at the batcher's bucket shapes (VERDICT r4 #3):
-    # K chained 256-lane relay steps in one jit — the per-step figure is
-    # the DEVICE term of a local-attached deployment's per-request
-    # latency floor (flush deadline + this + PCIe round trip), measured
-    # instead of projected.
-    def micro_chain(K):
-        mb = 256
+    # K chained relay steps in one jit — the per-step figure is the
+    # DEVICE term of a local-attached deployment's per-request latency
+    # floor (flush deadline + this + PCIe round trip), measured instead
+    # of projected.  Measured at 256 lanes (the r4 figure) AND at the
+    # r6 _MICRO_FLOOR (32 lanes — the shape interactive micro-batches
+    # actually dispatch at now).
+    def micro_chain_lanes(K, mb):
         mbase = jnp.arange(mb, dtype=jnp.int32) * (num_slots // mb)
 
         def run(packed, now0):
@@ -184,14 +185,14 @@ def main() -> None:
             return packed, acc
         return jax.jit(run, donate_argnums=0)
 
-    def measure_micro():
+    def measure_micro(mb=256):
         from ratelimiter_tpu.ops.token_bucket import make_tb_packed
 
         # 32K chained steps: a 256-lane step is sub-microsecond on TPU
         # (a 512-step chain vanished inside the tunnel's RTT jitter), so
         # the chain must run tens of ms to measure above it.
         K = 32768
-        fn = micro_chain(K)
+        fn = micro_chain_lanes(K, mb)
         # Fresh state: eng.tb_packed is the relay chain's (donated there).
         packed, acc = fn(make_tb_packed(num_slots), jnp.int64(1_000_000))
         int(np.asarray(acc))  # compile + settle
@@ -200,7 +201,7 @@ def main() -> None:
         checksum = int(np.asarray(acc))
         dt = time.perf_counter() - t0
         per_step_us = max(dt - rtt_s, 1e-9) / K * 1e6
-        return {"steps": K, "lanes_per_step": 256,
+        return {"steps": K, "lanes_per_step": mb,
                 "us_per_step": round(per_step_us, 3),
                 "checksum": checksum,
                 "note": ("device term of the local-attachment per-"
@@ -214,9 +215,24 @@ def main() -> None:
         "solver_live": bool(solver.settle()),
         "block_scatter_live": bool(block_scatter.settle()),
         "rtt_ms": round(rtt_s * 1000, 1),
-        "microbatch_256": measure_micro(),
+        "microbatch_256": measure_micro(256),
+        "microbatch_32": measure_micro(32),
         "relay": measure(relay_chain, eng.tb_packed),
     }
+    # Local-SLO floor guard (ISSUE r6 satellite): the micro-batch device
+    # step must sit below the 0.697 ms figure the r5 SLO decomposition
+    # attributed to the device — a regression here silently re-opens the
+    # p50 miss, so it fails the bench loudly instead.
+    slo_floor_ms = 0.697
+    out["micro_step_slo"] = {
+        "floor_ms": slo_floor_ms,
+        "us_per_step_32": out["microbatch_32"]["us_per_step"],
+        "meets": bool(out["microbatch_32"]["us_per_step"] / 1000.0
+                      < slo_floor_ms),
+    }
+    assert out["micro_step_slo"]["meets"], (
+        f"32-lane micro step {out['microbatch_32']['us_per_step']} us "
+        f">= SLO floor {slo_floor_ms} ms")
     # Later chains start from fresh state (prior chains donated theirs).
     from ratelimiter_tpu.ops.token_bucket import make_tb_packed
 

@@ -45,8 +45,11 @@ def main() -> None:
     storage = TpuBatchedStorage(num_slots=1 << 14, max_delay_ms=0.3)
     limiter = SlidingWindowRateLimiter(storage, sw_cfg, MeterRegistry())
 
-    # Warm every batch shape the 16-thread run can produce (the batcher
+    # Pre-compile the dedicated small-shape step (r6: micro-batches
+    # bucket at the 32-lane floor instead of padding to 256), then warm
+    # every batch shape the 16-thread run can produce (the batcher
     # buckets lane counts, so a handful of sizes covers them).
+    storage.warm_micro_shapes()
     for i in range(200):
         limiter.try_acquire(f"warm-{i % 64}")
 

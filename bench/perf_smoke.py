@@ -1,0 +1,119 @@
+"""Fast sharded-scaling perf smoke (CPU, small shapes) — CI guard.
+
+ISSUE r6: the virtual-mesh scaling curve silently anti-scaled for two
+rounds (19.5M/s at 1 shard -> 4.3M/s at 8 in BENCH_r05) because nothing
+failed when the sharding machinery regressed.  This smoke runs the TB
+Zipf stream at 1 and 2 virtual shards and asserts the 2-shard
+throughput is at least 0.9x of 1 shard — a scaling INVERSION fails CI
+loudly instead of waiting for the next full bench round.
+
+Each point runs in its OWN subprocess (matching bench.py's discipline:
+backend state, donated-buffer history, and virtual-device count must
+not leak between points), with one full warmup pass and best-of-3
+timed passes; the 0.9 margin absorbs CI timer noise — the threshold is
+meant to catch structural regressions (a serialized per-shard walk, a
+lost pipeline overlap), not 5% jitter.  The stream is the headline
+shape scaled down (4M Zipf decisions over 1M keys: multi-chunk, so the
+pipelined prepare actually overlaps).
+
+Prints one JSON line; exit code 1 on inversion.  Run from the repo
+root (verify.sh invokes it):  python bench/perf_smoke.py
+With --point N it runs a single N-shard point and prints its
+decisions/s (the subprocess mode).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARGIN = 0.9
+
+
+def run_point(n_shards: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    os.environ.setdefault("RATELIMITER_RATE_PROBE", "0")
+
+    import time
+
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, _REPO)
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+    from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                          refill_rate=50.0)
+    clock = lambda: 100_000  # noqa: E731 — frozen: identical decisions
+    rng = np.random.default_rng(11)
+    key_ids = (rng.zipf(1.1, size=1 << 22).astype(np.int64) % 1_000_000)
+    num_slots = 1 << 21
+    if n_shards == 1:
+        storage = TpuBatchedStorage(num_slots=num_slots, clock_ms=clock)
+    else:
+        from ratelimiter_tpu.parallel import ShardedDeviceEngine
+        from ratelimiter_tpu.parallel.mesh import make_mesh
+
+        engine = ShardedDeviceEngine(
+            slots_per_shard=num_slots // n_shards,
+            table=LimiterTable(),
+            mesh=make_mesh(jax.devices()[:n_shards]))
+        storage = TpuBatchedStorage(engine=engine, clock_ms=clock)
+    lid = storage.register_limiter("tb", cfg)
+    storage.acquire_stream_ids("tb", lid, key_ids, None)  # warm shapes
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        storage.acquire_stream_ids("tb", lid, key_ids, None)
+        best = min(best, time.perf_counter() - t0)
+    storage.close()
+    print(json.dumps({"n_shards": n_shards,
+                      "decisions_per_sec": len(key_ids) / best}))
+
+
+def main() -> int:
+    if "--point" in sys.argv:
+        run_point(int(sys.argv[sys.argv.index("--point") + 1]))
+        return 0
+    dps = {}
+    for s in (1, 2):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--point", str(s)],
+            capture_output=True, timeout=540, text=True, cwd=_REPO)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            print(f"PERF SMOKE FAILED: point {s} rc={proc.returncode} "
+                  f"stderr={proc.stderr[-400:]!r}", file=sys.stderr)
+            return 1
+        dps[s] = json.loads(proc.stdout.strip().splitlines()[-1])[
+            "decisions_per_sec"]
+    ratio = dps[2] / dps[1]
+    ok = ratio >= MARGIN
+    print(json.dumps({
+        "smoke": "sharded_scaling_2shard",
+        "dps_1shard": round(dps[1], 1),
+        "dps_2shard": round(dps[2], 1),
+        "ratio": round(ratio, 3),
+        "margin": MARGIN,
+        "ok": ok,
+    }))
+    if not ok:
+        print(f"PERF SMOKE FAILED: 2-shard throughput {ratio:.2f}x of "
+              f"1 shard (< {MARGIN}x) — sharded dispatch regressed",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

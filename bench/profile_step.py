@@ -9,6 +9,12 @@ carries a data dependency so iterations serialize.  Only a tiny reduction
 is fetched.
 
 Run from /root/repo:   python bench/profile_step.py [--small]
+
+``--host-stages`` instead runs the HOST pipeline decomposition (r6): a
+small int-key and str-key stream through TpuBatchedStorage with a meter
+registry, printing the per-stage timers the storage now records
+(ratelimiter.stream.pack / index / layout / enqueue / fetch) — where a
+stream chunk's milliseconds go before and after the device.
 """
 
 from __future__ import annotations
@@ -51,7 +57,61 @@ def bench(name, make_fn, *args):
     return per_op_ms
 
 
+def host_stages():
+    """Per-stage host pipeline timers over a small stream pair (int +
+    str keys), printed as one JSON line per scenario."""
+    import numpy as np
+
+    sys.path.insert(0, "/root/repo")
+    from ratelimiter_tpu import RateLimitConfig
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+
+    rng = np.random.default_rng(5)
+    n = 1 << 20
+    ids = (rng.zipf(1.1, size=n).astype(np.int64) % 100_000)
+    keys = [f"k{i}" for i in ids]
+    for kind in ("ints", "strs"):
+        reg = MeterRegistry()
+        storage = TpuBatchedStorage(num_slots=1 << 18,
+                                    meter_registry=reg)
+        lid = storage.register_limiter(
+            "tb", RateLimitConfig(max_permits=100, window_ms=60_000,
+                                  refill_rate=50.0))
+        def go():
+            if kind == "ints":
+                return storage.acquire_stream_ids("tb", lid, ids, None)
+            return storage.acquire_stream_strs("tb", lid, keys)
+        go()  # warm compile shapes
+        t0 = time.perf_counter()
+        go()
+        wall = time.perf_counter() - t0
+        stages = {
+            name.split(".")[-1]: reg.timer(name).snapshot()
+            for name in ("ratelimiter.stream.pack",
+                         "ratelimiter.stream.index",
+                         "ratelimiter.stream.layout",
+                         "ratelimiter.stream.enqueue",
+                         "ratelimiter.stream.fetch")}
+        print(json.dumps({
+            "scenario": f"host_stages_{kind}", "n": n,
+            "wall_s": round(wall, 4),
+            "decisions_per_sec": round(n / wall, 1),
+            "stage_totals_ms": {
+                k: round(v["mean_us"] * v["count"] / 1000, 3)
+                for k, v in stages.items()},
+            "stage_counts": {k: v["count"] for k, v in stages.items()},
+            "note": ("stage totals span the warmup pass too (compiles "
+                     "land in its enqueue) — compare stages against "
+                     "each other, not against wall_s"),
+        }), flush=True)
+        storage.close()
+
+
 def main():
+    if "--host-stages" in sys.argv:
+        host_stages()
+        return
     print(f"platform={jax.devices()[0].platform} S={S} B_flat={B_FLAT} "
           f"K={K} B={B} reps={REPS}", flush=True)
     rng = np.random.default_rng(0)

@@ -26,18 +26,26 @@ any device is touched); standalone:  python bench/sharded_scaling.py
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
-import jax
+# Force 8 virtual CPU devices BEFORE jax initializes: XLA_FLAGS works on
+# every jax this repo meets; newer jax also exposes jax_num_cpu_devices
+# (tried below for belt and braces — on jax 0.4.x the option does not
+# exist and the env flag alone provides the mesh).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
-jax.config.update("jax_platforms", "cpu")
-import jax.extend  # noqa: E402
+import jax  # noqa: E402
 
-jax.extend.backend.clear_backends()
-jax.config.update("jax_num_cpu_devices", 8)
-
-import os  # noqa: E402
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import numpy as np  # noqa: E402
 
@@ -48,7 +56,8 @@ from ratelimiter_tpu.engine.state import LimiterTable  # noqa: E402
 from ratelimiter_tpu.storage import TpuBatchedStorage  # noqa: E402
 
 
-def run(n_shards: int, num_slots: int, key_ids, batch, subbatches) -> dict:
+def run(n_shards: int, num_slots: int, key_ids, batch, subbatches,
+        str_keys=None) -> dict:
     cfg = RateLimitConfig(max_permits=100, window_ms=60_000, refill_rate=50.0)
     clock = lambda: 100_000  # noqa: E731 — frozen: identical decisions per point
     if n_shards == 1:
@@ -83,6 +92,25 @@ def run(n_shards: int, num_slots: int, key_ids, batch, subbatches) -> dict:
         wall = time.perf_counter() - t0
         storage.stream_stats = None
         runs.append((wall, stats))
+    str_point = None
+    if str_keys is not None:
+        # END-TO-END string keys through the same engine (r6: the
+        # sharded path hashes each chunk once and routes by fingerprint;
+        # 1-shard runs the single-device string fast path) — tracked per
+        # round so the str-vs-int gap and its scaling are in the
+        # artifact, not just the single-device numbers.
+        storage.acquire_stream_strs("tb", lid, str_keys)  # warm shapes
+        str_walls = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            storage.acquire_stream_strs("tb", lid, str_keys)
+            str_walls.append(time.perf_counter() - t0)
+        best = min(str_walls)
+        str_point = {
+            "decisions": len(str_keys),
+            "walls_s": [round(w, 4) for w in str_walls],
+            "decisions_per_sec": round(len(str_keys) / best, 1),
+        }
     storage.close()
     runs.sort(key=lambda r: r[0])
     walls = [round(w, 4) for w, _ in runs]
@@ -124,6 +152,7 @@ def run(n_shards: int, num_slots: int, key_ids, batch, subbatches) -> dict:
         "best_decisions_per_sec": round(len(key_ids) / walls[0], 1),
         "allowed": int(allowed.sum()),
         "phase": phase,
+        "str_end_to_end": str_point,
     }
 
 
@@ -134,8 +163,14 @@ def main() -> None:
     rng = np.random.default_rng(7)
     num_keys, n = 1_000_000, 1 << 22
     key_ids = (rng.zipf(1.1, size=n).astype(np.int64) % num_keys)
+    # String end-to-end rides the same sweep on a half-size stream over a
+    # disjoint key population sized so ints + strs fit the slot table
+    # without eviction thrash (ints <= 1M uniques, strs <= 512K).
+    str_keys = [f"k{i}" for i in
+                (key_ids[:n // 2] % 500_000)]
     out = {"mesh": "virtual-cpu-8", "num_keys": num_keys,
-           "points": [run(s, 1 << 21, key_ids, 1 << 14, 4)
+           "points": [run(s, 1 << 21, key_ids, 1 << 14, 4,
+                          str_keys=str_keys)
                       for s in (1, 2, 4, 8)]}
     print(json.dumps(out))
 

@@ -415,25 +415,30 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
       misses[nm++] = j;
     }
     // Stage 2: misses probe/insert the main table in arrival order.
-    // Hit-only chunks take a two-phase path: 2a resolves every miss's
-    // table position (home bucket prefetched in stage 1) while issuing
-    // prefetches for the strict-LRU relink neighbors and the slot
-    // scratch that 2b will touch — the relink is up to 3 random DRAM
-    // accesses that a serial loop pays at full latency per request
-    // (the 10M-key uniform walk measured ~198 ns/request, VERDICT r3
-    // #3); overlapping them across the chunk is the fix.  Any miss
-    // needing an insert/eviction makes the WHOLE chunk fall back to
-    // the serial probe_or_insert: erase_at's backward shift relocates
-    // entries, so positions recorded before an insert can go stale.
+    // 2a resolves every miss's table position (home bucket prefetched
+    // in stage 1) while issuing prefetches for the strict-LRU relink
+    // neighbors and the slot scratch that 2b will touch — the relink
+    // is up to 3 random DRAM accesses that a serial loop pays at full
+    // latency per request (the 10M-key uniform walk measured
+    // ~198 ns/request, VERDICT r3 #3); overlapping them across the
+    // chunk is the fix.  Recorded positions stay valid across pure
+    // INSERTS (linear-probe insert fills an empty bucket and never
+    // relocates existing entries) — only an EVICTION's backward-shift
+    // erase can move entries, so 2b keeps using the staged positions
+    // until the first eviction of the chunk and re-probes after (the
+    // r5 code fell back to fully serial probe_or_insert for the WHOLE
+    // chunk on any insert, which made first-touch churn passes lose
+    // every prefetch the staged path buys — the scenario-4
+    // churn-vs-steady gap).
     int32_t hitpos[kChunk];
-    bool chunk_serial = false;
+    bool has_insert = false;
     const uint32_t g32 = gen32(ix);
     for (int64_t k = 0; k < nm; k++) {
       const int64_t j = misses[k];
       int32_t pos = find(ix, h1s[j], h2s[j]);
       hitpos[k] = pos;
       if (pos < 0) {
-        chunk_serial = true;
+        has_insert = true;
         continue;
       }
       const Entry& e = ix->table[pos];
@@ -445,14 +450,29 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
       }
       __builtin_prefetch(&scratch[e.slot], 1, 1);
     }
-    if (!chunk_serial && ix->lru_head >= 0)
+    if (ix->lru_head >= 0)
       __builtin_prefetch(&ix->table[ix->lru_head], 1, 1);
+    if (has_insert) {
+      // First-touch staging: the inserts of this chunk will pop the
+      // free-list tail in order (as long as no eviction interleaves),
+      // so prefetch those slots' batch scratch + back-pointer lines
+      // now; a wrong guess (eviction path taken instead) is harmless.
+      const int64_t fs = static_cast<int64_t>(ix->free_slots.size());
+      int64_t taken = 0;
+      for (int64_t k = 0; k < nm && taken < fs; k++) {
+        if (hitpos[k] >= 0) continue;
+        int32_t s = ix->free_slots[fs - 1 - taken++];
+        __builtin_prefetch(&scratch[s], 1, 1);
+        __builtin_prefetch(&ix->entry_of_slot[s], 1, 1);
+      }
+    }
+    bool positions_valid = true;
     for (int64_t k = 0; k < nm; k++) {
       const int64_t j = misses[k];
       const int64_t i = base + j;
       int32_t slot;
       int64_t ev;
-      if (!chunk_serial) {
+      if (hitpos[k] >= 0 && positions_valid) {
         Entry& e = ix->table[hitpos[k]];
         if (e.gen != g32) {
           e.gen = g32;
@@ -462,6 +482,9 @@ inline int64_t assign_batch_uniques(Index* ix, int64_t n, int32_t rank_bits,
         ev = -1;
       } else {
         ev = probe_or_insert(ix, h1s[j], h2s[j], &slot);
+        // An eviction ran erase_at (backward shift relocates entries):
+        // staged positions recorded in 2a may now be stale.
+        if (ev >= 0) positions_valid = false;
       }
       out_evicted[i] = static_cast<int32_t>(ev);
       if (ev == -2) {  // assignment failed: deny lane, not a unique
@@ -588,6 +611,149 @@ int64_t rl_index_assign_bytes_uniques(
         hash_bytes(data + offsets[i], offsets[i + 1] - offsets[i], lid_seed,
                    h1, h2);
       });
+}
+
+// Unique-compaction assign for PRECOMPUTED fingerprints — the native
+// string fast path: the CPython-API hasher (str_pack.cpp:
+// rl_strlist_hash_fp) emits (h1, h2) straight from the interned UTF-8
+// buffers, and this walk consumes them with zero byte copies.  The
+// fingerprints are bit-identical to hash_bytes over the same UTF-8, so
+// this path interoperates with every bytes/scalar entry point.  The
+// (0,0) reservation guard is applied here too so raw callers can't
+// alias the empty sentinel.
+int64_t rl_index_assign_fps_uniques(
+    void* h, const uint64_t* h1s, const uint64_t* h2s, int64_t n,
+    int32_t rank_bits, uint32_t* out_uwords, int32_t* out_uidx,
+    int32_t* out_rank, int32_t* out_evicted) {
+  return assign_batch_uniques(static_cast<Index*>(h), n, rank_bits,
+                              out_uwords, out_uidx, out_rank, out_evicted,
+                              [&](int64_t i, uint64_t& h1, uint64_t& h2) {
+                                h1 = h1s[i];
+                                h2 = h2s[i] |
+                                     (h1 == 0 && h2s[i] == 0 ? 1 : 0);
+                              });
+}
+
+// Batch fingerprint hashing for packed byte keys (no table access): the
+// fallback producer for the fingerprint paths when the CPython hasher
+// is unavailable, and the router's input for sharded string streams.
+// Bit-identical to the hash the assign walks compute internally.
+void rl_hash_bytes_batch(const uint8_t* data, const int64_t* offsets,
+                         int64_t n, uint64_t seed, uint64_t* out_h1,
+                         uint64_t* out_h2) {
+  for (int64_t i = 0; i < n; i++) {
+    hash_bytes(data + offsets[i], offsets[i + 1] - offsets[i], seed,
+               out_h1[i], out_h2[i]);
+  }
+}
+
+// Shard routing from precomputed fingerprints (string streams): shard =
+// h1 % n_shards plus the same stable counting sort as rl_shard_route,
+// so each shard's requests become one contiguous slice in arrival
+// order.  Must agree with parallel/sharded.py:shard_of_key's string
+// branch (which computes the same h1 scalar-side).
+void rl_route_hashes(const uint64_t* h1s, int64_t n, int32_t n_shards,
+                     int32_t* out_shard, int64_t* out_order,
+                     int64_t* out_counts) {
+  for (int32_t s = 0; s < n_shards; s++) out_counts[s] = 0;
+  const uint64_t ns = static_cast<uint64_t>(n_shards);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = static_cast<int32_t>(h1s[i] % ns);
+    out_shard[i] = s;
+    out_counts[s]++;
+  }
+  std::vector<int64_t> off(n_shards);
+  int64_t acc = 0;
+  for (int32_t s = 0; s < n_shards; s++) {
+    off[s] = acc;
+    acc += out_counts[s];
+  }
+  for (int64_t i = 0; i < n; i++) out_order[off[out_shard[i]]++] = i;
+}
+
+// Fused route + gather (r6): same as rl_shard_route but the second
+// pass also emits the keys in shard-sorted order — on the 1-core bench
+// host the separate numpy fancy-gather was a whole extra memory pass
+// per chunk.
+void rl_shard_route2(const int64_t* keys, int64_t n, int32_t n_shards,
+                     int32_t* out_shard, int64_t* out_order,
+                     int64_t* out_counts, int64_t* out_keys_sorted) {
+  for (int32_t s = 0; s < n_shards; s++) out_counts[s] = 0;
+  const uint64_t ns = static_cast<uint64_t>(n_shards);
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t x = static_cast<uint64_t>(keys[i]) + 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    x = x ^ (x >> 31);
+    int32_t s = static_cast<int32_t>(x % ns);
+    out_shard[i] = s;
+    out_counts[s]++;
+  }
+  std::vector<int64_t> off(n_shards);
+  int64_t acc = 0;
+  for (int32_t s = 0; s < n_shards; s++) {
+    off[s] = acc;
+    acc += out_counts[s];
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int64_t p = off[out_shard[i]]++;
+    out_order[p] = i;
+    out_keys_sorted[p] = keys[i];
+  }
+}
+
+// Fused fingerprint route + gather (string streams): shard = h1 %
+// n_shards, emitting both fingerprint streams shard-sorted alongside
+// the stable order.
+void rl_route_hashes2(const uint64_t* h1s, const uint64_t* h2s,
+                      int64_t n, int32_t n_shards, int32_t* out_shard,
+                      int64_t* out_order, int64_t* out_counts,
+                      uint64_t* out_h1_sorted, uint64_t* out_h2_sorted) {
+  for (int32_t s = 0; s < n_shards; s++) out_counts[s] = 0;
+  const uint64_t ns = static_cast<uint64_t>(n_shards);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = static_cast<int32_t>(h1s[i] % ns);
+    out_shard[i] = s;
+    out_counts[s]++;
+  }
+  std::vector<int64_t> off(n_shards);
+  int64_t acc = 0;
+  for (int32_t s = 0; s < n_shards; s++) {
+    off[s] = acc;
+    acc += out_counts[s];
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int64_t p = off[out_shard[i]]++;
+    out_order[p] = i;
+    out_h1_sorted[p] = h1s[i];
+    out_h2_sorted[p] = h2s[i];
+  }
+}
+
+// Relay decision reconstruction SCATTERED to caller positions (r6):
+// out[pos[i]] = rank[i] < counts[uidx[i]].  The sharded drain used to
+// materialize the decisions densely and then numpy-fancy-scatter them
+// into the output — two memory passes fused into one here.
+void rl_relay_decide_pos(const uint8_t* counts, int32_t counts_width,
+                         const int32_t* uidx, const int32_t* rank,
+                         const int64_t* pos, int64_t n,
+                         uint8_t* out, int64_t* out_allowed) {
+  int64_t allowed = 0;
+  if (counts_width == 1) {
+    for (int64_t i = 0; i < n; i++) {
+      uint8_t a = rank[i] < static_cast<int32_t>(counts[uidx[i]]);
+      out[pos[i]] = a;
+      allowed += a;
+    }
+  } else {
+    const uint16_t* c16 = reinterpret_cast<const uint16_t*>(counts);
+    for (int64_t i = 0; i < n; i++) {
+      uint8_t a = rank[i] < static_cast<int32_t>(c16[uidx[i]]);
+      out[pos[i]] = a;
+      allowed += a;
+    }
+  }
+  *out_allowed = allowed;
 }
 
 // Scalar lookups (no assignment). Return slot or -1.

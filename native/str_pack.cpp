@@ -79,4 +79,51 @@ int32_t rl_strlist_pack2(PyObject* seq, uint8_t* buf, int64_t* offs,
   return 0;
 }
 
+// Fingerprint hashing straight off the list: one pass computes the
+// 128-bit FNV fingerprints the slot index keys on, reading each str's
+// interned UTF-8 buffer in place — no join, no byte copy, no offsets
+// array.  MUST stay bit-identical to slot_index.cpp:hash_bytes (the
+// fingerprints interoperate with every bytes/scalar entry point and
+// with checkpoints); the mixing below is a verbatim copy, covered by
+// tests/test_native_index.py parity tests.
+//
+// ``start``/``n`` window the list so stream chunking never slices the
+// (multi-million-entry) Python list: the storage passes the whole list
+// plus a window and zero per-key Python objects are created.
+// Returns 0, or -1 on type errors / out-of-range windows (the list can
+// shrink between calls — bounds are re-checked here).
+static inline void fp_mix(uint64_t& h, uint64_t x) {
+  h ^= x;
+  h *= 0x100000001b3ULL;
+}
+
+int32_t rl_strlist_hash_fp(PyObject* seq, int64_t start, int64_t n,
+                           uint64_t seed, uint64_t* out_h1,
+                           uint64_t* out_h2) {
+  if (!PyList_Check(seq) || start < 0 || n < 0) return -1;
+  if (start + n > static_cast<int64_t>(PyList_GET_SIZE(seq))) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    PyObject* it = PyList_GET_ITEM(seq, start + i);
+    if (!PyUnicode_Check(it)) return -1;
+    Py_ssize_t len;
+    const char* p = PyUnicode_AsUTF8AndSize(it, &len);
+    if (p == nullptr) {
+      PyErr_Clear();
+      return -1;
+    }
+    uint64_t h1 = 0xcbf29ce484222325ULL ^ seed;
+    uint64_t h2 = 0x84222325cbf29ce4ULL ^ (seed * 0x9e3779b97f4a7c15ULL);
+    const uint8_t* b = reinterpret_cast<const uint8_t*>(p);
+    for (Py_ssize_t j = 0; j < len; j++) {
+      fp_mix(h1, b[j]);
+      h2 = (h2 ^ (b[j] + 0x9e3779b97f4a7c15ULL + (h2 << 6) + (h2 >> 2)));
+    }
+    h2 = h2 * 0xff51afd7ed558ccdULL + static_cast<uint64_t>(len);
+    if (h1 == 0 && h2 == 0) h2 = 1;  // reserve (0,0) for "empty"
+    out_h1[i] = h1;
+    out_h2[i] = h2;
+  }
+  return 0;
+}
+
 }  // extern "C"

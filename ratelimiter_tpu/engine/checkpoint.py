@@ -47,17 +47,24 @@ FORMAT_VERSION = 2
 SUPPORTED_VERSIONS = (1, 2)
 
 # Identity of the key->shard routing hash used by sharded indexes
-# (parallel/sharded.py:shard_of_key): crc32-of-repr for string keys,
-# splitmix64 for int keys.  Stored in sharded index dumps so a restore into
-# a binary with a different routing function fails loudly instead of
+# (parallel/sharded.py:shard_of_key): FNV-fingerprint h1 for string/bytes
+# keys (r6 — lets the batched string stream hash once and both route and
+# assign from the result), splitmix64 for int keys, crc32-of-repr for
+# exotic key types.  Stored in sharded index dumps so a restore into a
+# binary with a different routing function fails loudly instead of
 # silently orphaning entries.
-SHARD_HASH_VERSION = "crc32-repr/splitmix64-v1"
+SHARD_HASH_VERSION = "fp-fnv/splitmix64-v2"
 # Sharded dumps written before the shard_hash field existed were produced by
-# binaries that routed int user keys via crc32-of-repr (strings routed the
-# same as today).  A missing field therefore marks the LEGACY hash, not the
-# current one — restoring a legacy dump with int user keys under the current
-# splitmix64 routing would silently orphan every int-key entry.
+# binaries that routed int user keys via crc32-of-repr.  A missing field
+# therefore marks the LEGACY hash, not the current one — restoring a legacy
+# dump with int user keys under the current splitmix64 routing would
+# silently orphan every int-key entry.
 LEGACY_SHARD_HASH = "crc32-repr-v0"
+# Dumps under these hashes restore iff every entry already sits where the
+# CURRENT hash routes its key (divergence-proof placement check below):
+# v0 legacy, and v1 (whose string keys routed by crc32-of-repr — int keys
+# route identically in v1 and v2, so int-only v1 dumps restore clean).
+PLACEMENT_CHECK_HASHES = (LEGACY_SHARD_HASH, "crc32-repr/splitmix64-v1")
 
 
 def snapshot_engine_state(engine, index_dump: Optional[Dict] = None) -> Dict:
@@ -520,18 +527,18 @@ def restore_slot_indexes(storage, dump: Dict) -> None:
         if payload.get("kind") == "sharded" and hasattr(index, "_sub"):
             stored_hash = payload.get("shard_hash", LEGACY_SHARD_HASH)
             if stored_hash != SHARD_HASH_VERSION:
-                # A dump written under a different routing hash restores
-                # safely only if every entry already sits where the CURRENT
-                # hash routes its key (true for legacy string keys — crc32
-                # of repr then and now).  Checking placement directly is
-                # divergence-proof: it needs no model of what the old hash
-                # did, so legacy int/bool keys (which routed differently)
-                # fail it, and any entry that happens to match routes —
-                # and therefore resolves — correctly.
+                # A dump written under a different KNOWN routing hash
+                # restores safely only if every entry already sits where
+                # the CURRENT hash routes its key.  Checking placement
+                # directly is divergence-proof: it needs no model of what
+                # the old hash did — any entry whose old placement matches
+                # the current routing resolves correctly, and everything
+                # else fails loudly (e.g. v0 int/bool keys, v1 string
+                # keys, both of which routed differently than today).
                 from ratelimiter_tpu.parallel.sharded import shard_of_key
 
                 sps = index.slots_per_shard
-                ok = stored_hash == LEGACY_SHARD_HASH and all(
+                ok = stored_hash in PLACEMENT_CHECK_HASHES and all(
                     shard_of_key(tuple(key) if isinstance(key, list)
                                  else key, index.n_shards) == gslot // sps
                     for key, gslot in entries)

@@ -51,10 +51,18 @@ from ratelimiter_tpu.ops.token_bucket import (
 )
 
 _MIN_BATCH = 256
+# Micro-batch floor (r6): interactive traffic through the micro-batcher
+# produces 1-100-request batches, and padding them to 256 lanes made the
+# device step ~0.7 ms on the CPU backend — most of the local-SLO p50 miss
+# (BENCH_r05 latency_slo_local: p50 1558 us vs the 1000 us target).
+# Small batches now bucket at {32, 64, 128} before joining the pow2
+# ladder; three extra compile shapes, device step cost proportional to
+# lanes.  Streams never see these shapes (their chunks are >= 2^19).
+_MICRO_FLOOR = 32
 
 
 def _bucket_size(n: int) -> int:
-    size = _MIN_BATCH
+    size = _MICRO_FLOOR
     while size < n:
         size *= 2
     return size
@@ -613,6 +621,21 @@ class DeviceEngine:
                 self.sw_packed = self.sw_packed.at[idx].set(vals)
             else:
                 self.tb_packed = self.tb_packed.at[idx].set(vals)
+
+    def warm_micro_shapes(self, algos=("sw", "tb")) -> None:
+        """Pre-compile the dedicated small-shape step (the _MICRO_FLOOR
+        bucket) so an interactive deployment's first micro-batch doesn't
+        pay its XLA compile inside a caller's latency budget.  The warm
+        batch is one padding lane (slot -1): every kernel masks it out
+        and the journal filters it, so no state or replication traffic
+        is touched."""
+        for algo in algos:
+            if algo == "sw":
+                self.sw_acquire_drain(
+                    self.sw_acquire_dispatch([-1], [0], [1], 0), 1)
+            else:
+                self.tb_acquire_drain(
+                    self.tb_acquire_dispatch([-1], [0], [1], 0), 1)
 
     def block_until_ready(self) -> None:
         with self._lock:

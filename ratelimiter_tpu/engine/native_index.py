@@ -167,6 +167,34 @@ def _bind(lib) -> None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
     except AttributeError:
         pass
+    # Optional (r6): the fingerprint string fast path + hash routing.
+    # Stale prebuilt .so => callers fall back to the packed-bytes path.
+    try:
+        lib.rl_index_assign_fps_uniques.restype = ctypes.c_int64
+        lib.rl_index_assign_fps_uniques.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_hash_bytes_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_route_hashes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_shard_route2.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.rl_route_hashes2.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.rl_relay_decide_pos.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
+    except AttributeError:
+        pass
 
 
 def native_available() -> bool:
@@ -211,6 +239,15 @@ def _load_strpack():
             lib.rl_strlist_pack2.argtypes = [
                 ctypes.py_object, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_int64]
+            # Optional (r6): windowed fingerprint hashing — a stale
+            # prebuilt libstrpack without it must not lose pack2.
+            try:
+                lib.rl_strlist_hash_fp.restype = ctypes.c_int32
+                lib.rl_strlist_hash_fp.argtypes = [
+                    ctypes.py_object, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+            except AttributeError:
+                pass
         except Exception:  # noqa: BLE001 — optional fast path only
             _strpack_failed = True
             return None
@@ -271,6 +308,172 @@ def _pack_str_keys(keys):
     offs[0] = 0
     np.cumsum(lens, out=offs[1:])
     return packed, offs
+
+
+_FNV_OFF1 = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_U64 = (1 << 64) - 1
+
+
+def fnv_fingerprint_h1(data: bytes, seed: int) -> int:
+    """Python mirror of the h1 stream of native/slot_index.cpp:
+    hash_bytes — the fingerprint the string shard router keys on.  Used
+    by scalar paths (parallel/sharded.py:shard_of_key) so scalar and
+    batched string traffic always agree on a key's shard; parity with
+    the C implementation is pinned by tests/test_native_index.py."""
+    h = (_FNV_OFF1 ^ (seed & _U64)) & _U64
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _U64
+    return h
+
+
+# Per-thread fingerprint scratch: the hash arrays are consumed within
+# the same call that fills them (assign / route), so one grow-only pair
+# per thread removes the 16 B/key allocation from every stream chunk.
+_fp_tls = threading.local()
+
+
+def _fp_scratch(n: int):
+    h1 = getattr(_fp_tls, "h1", None)
+    if h1 is None or len(h1) < n:
+        _fp_tls.h1 = h1 = np.empty(max(n, 1024), dtype=np.uint64)
+        _fp_tls.h2 = np.empty(max(n, 1024), dtype=np.uint64)
+    return h1, _fp_tls.h2
+
+
+def str_hash_available() -> bool:
+    """Whether hash_str_keys has a native producer (either the CPython
+    hasher or packed-bytes hashing through the index library)."""
+    lib = _load_library()
+    if lib is None or not hasattr(lib, "rl_hash_bytes_batch"):
+        return False
+    return True
+
+
+def hash_str_keys(keys, seed: int, start: int = 0,
+                  count: int | None = None):
+    """128-bit fingerprints for a window of a string-key batch, with no
+    per-key Python objects: (h1 u64[n], h2 u64[n]) views into per-thread
+    scratch (consume before the next call on the same thread), or None
+    when no native producer exists.
+
+    Fast path: one CPython-API pass over the list window
+    (str_pack.cpp:rl_strlist_hash_fp) — hashes straight off each str's
+    interned UTF-8 buffer, no join/copy/offsets.  Fallback: the numpy
+    packer + rl_hash_bytes_batch (handles bytes keys and non-list
+    sequences).  Both produce fingerprints bit-identical to every other
+    index entry path."""
+    n = (len(keys) - start) if count is None else count
+    if n < 0:
+        return None
+    h1, h2 = _fp_scratch(n)
+    sp = _load_strpack() if isinstance(keys, list) else None
+    if sp is not None and hasattr(sp, "rl_strlist_hash_fp"):
+        if sp.rl_strlist_hash_fp(keys, start, n, seed & _U64,
+                                 h1.ctypes.data, h2.ctypes.data) == 0:
+            return h1[:n], h2[:n]
+    lib = _load_library()
+    if lib is None or not hasattr(lib, "rl_hash_bytes_batch"):
+        return None
+    sub = keys[start:start + n]
+    packed, offs = _pack_str_keys(
+        sub if isinstance(sub, list) else list(sub))
+    lib.rl_hash_bytes_batch(packed.ctypes.data if len(packed) else 0,
+                            offs.ctypes.data, n, seed & _U64,
+                            h1.ctypes.data, h2.ctypes.data)
+    return h1[:n], h2[:n]
+
+
+def shard_route_gather(key_ids: np.ndarray, n_shards: int):
+    """Fused shard routing + key gather: (shard i32[n], order i64[n],
+    counts i64[n_shards], keys_sorted i64[n]) in one C pass — the
+    separate numpy fancy-gather of the sorted keys was a whole extra
+    memory pass per chunk on 1-core hosts.  None off-native (callers
+    fall back to shard_route/_route_chunk + numpy gather)."""
+    lib = _load_library()
+    if lib is None or not hasattr(lib, "rl_shard_route2"):
+        return None
+    key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+    n = len(key_ids)
+    shard = np.empty(n, dtype=np.int32)
+    order = np.empty(n, dtype=np.int64)
+    counts = np.empty(n_shards, dtype=np.int64)
+    kst = np.empty(n, dtype=np.int64)
+    lib.rl_shard_route2(key_ids.ctypes.data, n, int(n_shards),
+                        shard.ctypes.data, order.ctypes.data,
+                        counts.ctypes.data, kst.ctypes.data)
+    return shard, order, counts, kst
+
+
+def route_hashes_gather(h1: np.ndarray, h2: np.ndarray, n_shards: int):
+    """Fused fingerprint routing + gather: (shard, order, counts,
+    h1_sorted, h2_sorted) in one C pass; numpy fallback bit-identical."""
+    n = len(h1)
+    lib = _load_library()
+    if lib is not None and hasattr(lib, "rl_route_hashes2"):
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+        shard = np.empty(n, dtype=np.int32)
+        order = np.empty(n, dtype=np.int64)
+        counts = np.empty(n_shards, dtype=np.int64)
+        h1s = np.empty(n, dtype=np.uint64)
+        h2s = np.empty(n, dtype=np.uint64)
+        lib.rl_route_hashes2(h1.ctypes.data, h2.ctypes.data, n,
+                             int(n_shards), shard.ctypes.data,
+                             order.ctypes.data, counts.ctypes.data,
+                             h1s.ctypes.data, h2s.ctypes.data)
+        return shard, order, counts, h1s, h2s
+    shard, order, counts = route_hashes(h1, n_shards)
+    return shard, order, counts, h1[order], h2[order]
+
+
+def relay_decide_pos(counts: np.ndarray, uidx: np.ndarray,
+                     rank: np.ndarray, pos: np.ndarray,
+                     out: np.ndarray) -> int:
+    """Scattered relay decision reconstruction: ``out[pos[i]] = rank[i]
+    < counts[uidx[i]]`` in one C pass (``out`` a C-contiguous bool
+    view), returning the allowed count — fuses the dense reconstruction
+    + numpy fancy-scatter the sharded drain used to pay as two memory
+    passes.  Falls back to the two-pass numpy route off-native."""
+    lib = _load_library()
+    n = len(uidx)
+    if (lib is not None and hasattr(lib, "rl_relay_decide_pos")
+            and counts.dtype.itemsize <= 2 and out.flags["C_CONTIGUOUS"]
+            and out.dtype == np.bool_):
+        counts = np.ascontiguousarray(counts)
+        uidx = np.ascontiguousarray(uidx, dtype=np.int32)
+        rank = np.ascontiguousarray(rank, dtype=np.int32)
+        pos = np.ascontiguousarray(pos, dtype=np.int64)
+        allowed = np.empty(1, dtype=np.int64)
+        lib.rl_relay_decide_pos(
+            counts.ctypes.data, counts.dtype.itemsize, uidx.ctypes.data,
+            rank.ctypes.data, pos.ctypes.data, n, out.ctypes.data,
+            allowed.ctypes.data)
+        return int(allowed[0])
+    got = relay_decide(counts, uidx, rank)
+    out[pos] = got
+    return int(got.sum())
+
+
+def route_hashes(h1: np.ndarray, n_shards: int):
+    """(shard i32[n], stable order i64[n], counts i64[n_shards]) from
+    precomputed fingerprints: shard = h1 % n_shards + stable counting
+    sort, one C pass (numpy fallback bit-identical)."""
+    n = len(h1)
+    lib = _load_library()
+    if lib is not None and hasattr(lib, "rl_route_hashes"):
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        shard = np.empty(n, dtype=np.int32)
+        order = np.empty(n, dtype=np.int64)
+        counts = np.empty(n_shards, dtype=np.int64)
+        lib.rl_route_hashes(h1.ctypes.data, n, int(n_shards),
+                            shard.ctypes.data, order.ctypes.data,
+                            counts.ctypes.data)
+        return shard, order, counts
+    shard = (h1 % np.uint64(n_shards)).astype(np.int32)
+    order = np.argsort(shard, kind="stable")
+    return shard, order, np.bincount(
+        shard, minlength=n_shards).astype(np.int64)
 
 
 def relay_decide(counts: np.ndarray, uidx: np.ndarray,
@@ -668,19 +871,73 @@ class NativeSlotIndex:
                                     pending_clears=out_ev[out_ev >= 0])
         return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
 
+    def assign_batch_fps_uniques(self, h1: np.ndarray, h2: np.ndarray,
+                                 rank_bits: int,
+                                 pinned: Optional[Set[int]] = None,
+                                 hold_pins: bool = False):
+        """Unique-compaction assign for PRECOMPUTED fingerprints — the
+        sharded/partitioned string streams hash once, route by h1, and
+        feed each sub-index its slice here.  Identical semantics to the
+        bytes-keyed uniques assign on the same fingerprints."""
+        if not hasattr(self._lib, "rl_index_assign_fps_uniques"):
+            raise RuntimeError("stale native library: rebuild native/ "
+                               "(rl_index_assign_fps_uniques missing)")
+        h1 = np.ascontiguousarray(h1, dtype=np.uint64)
+        h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+        n = len(h1)
+        uwords = np.empty(n, dtype=np.uint32)
+        uidx = np.empty(n, dtype=np.int32)
+        rank = np.empty(n, dtype=np.int32)
+        out_ev = np.empty(n, dtype=np.int32)
+        with self._lock, self._pinned(pinned):
+            u = self._lib.rl_index_assign_fps_uniques(
+                self._h, h1.ctypes.data, h2.ctypes.data, n,
+                int(rank_bits), uwords.ctypes.data, uidx.ctypes.data,
+                rank.ctypes.data, out_ev.ctypes.data)
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
+                uslots = (uwords[:u] >> np.uint32(rank_bits + 1)).astype(
+                    np.int32)
+                self._lib.rl_index_pin_batch(
+                    self._h, np.ascontiguousarray(uslots).ctypes.data, u)
+        if failed:
+            raise SlotCapacityError("slot capacity exhausted (all pinned)",
+                                    pending_clears=out_ev[out_ev >= 0])
+        return uwords[:u], uidx, rank, out_ev[out_ev >= 0]
+
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
                                   pinned: Optional[Set[int]] = None,
-                                  hold_pins: bool = False):
+                                  hold_pins: bool = False,
+                                  start: int = 0,
+                                  count: int | None = None):
+        """String-key uniques assign: pack -> hash -> slot walk with zero
+        per-key Python objects.  ``start``/``count`` window the key
+        sequence so stream chunking never slices a multi-million-entry
+        list (the r5 path copied each chunk's slice).  Fast path: one
+        CPython hash pass (fingerprints straight off the interned UTF-8
+        buffers) feeding the fingerprint walk; fallback: the packed-bytes
+        walk, bit-identical."""
         import time as _time
 
+        n = (len(keys) - start) if count is None else count
         t_p0 = _time.perf_counter()
-        packed, offs = _pack_str_keys(keys)
-        # Exposed for the stream loop's per-chunk phase lanes (pack vs
-        # hash+walk — VERDICT r4 #7); the caller reads it before it
-        # submits the next chunk's prefetch, so it always refers to the
-        # chunk just assigned.
+        fp = (hash_str_keys(keys, lid, start, n)
+              if hasattr(self._lib, "rl_index_assign_fps_uniques")
+              else None)
+        if fp is not None:
+            # Exposed for the stream loop's per-chunk phase lanes (pack
+            # vs hash+walk — VERDICT r4 #7); the caller reads it before
+            # it submits the next chunk's prefetch, so it always refers
+            # to the chunk just assigned.
+            self.str_pack_s = _time.perf_counter() - t_p0
+            return self.assign_batch_fps_uniques(
+                fp[0], fp[1], rank_bits, pinned=pinned,
+                hold_pins=hold_pins)
+        sub = keys if (start == 0 and n == len(keys)) else keys[
+            start:start + n]
+        packed, offs = _pack_str_keys(
+            sub if isinstance(sub, list) else list(sub))
         self.str_pack_s = _time.perf_counter() - t_p0
-        n = len(keys)
         uwords = np.empty(n, dtype=np.uint32)
         uidx = np.empty(n, dtype=np.int32)
         rank = np.empty(n, dtype=np.int32)
@@ -745,8 +1002,10 @@ class NativeSlotIndex:
         return out
 
     def assign_batch_fps(self, h1: np.ndarray, h2: np.ndarray,
-                         pinned: Optional[Set[int]] = None):
-        """Assign slots for raw fingerprints (flat-to-flat rebalance import).
+                         pinned: Optional[Set[int]] = None,
+                         hold_pins: bool = False):
+        """Assign slots for raw fingerprints (flat-to-flat rebalance
+        import, and the string fast path once the keys are hashed).
         Returns (slots i32[n], evictions i32[k])."""
         h1 = np.ascontiguousarray(h1, dtype=np.uint64)
         h2 = np.ascontiguousarray(h2, dtype=np.uint64)
@@ -757,19 +1016,46 @@ class NativeSlotIndex:
             self._lib.rl_index_assign_fps(
                 self._h, h1.ctypes.data, h2.ctypes.data, n,
                 out_slots.ctypes.data, out_ev.ctypes.data)
-        if (out_ev == -2).any():
+            failed = bool((out_ev == -2).any())
+            if hold_pins and not failed:  # see assign_batch_ints
+                self._lib.rl_index_pin_batch(
+                    self._h, out_slots.ctypes.data, n)
+        if failed:
             raise SlotCapacityError("slot capacity exhausted (all pinned)",
                                     pending_clears=out_ev[out_ev >= 0])
         return out_slots, out_ev[out_ev >= 0]
 
     def assign_batch_strs(self, keys, lid: int,
                           pinned: Optional[Set[int]] = None,
-                          hold_pins: bool = False):
-        """Assign slots for a string key batch in one C call."""
-        packed, offs = _pack_str_keys(keys)
-        n = len(keys)
+                          hold_pins: bool = False,
+                          start: int = 0, count: int | None = None):
+        """Assign slots for a string key batch in one C call (fingerprint
+        fast path when the CPython hasher is available; windowed like
+        assign_batch_strs_uniques)."""
+        n = (len(keys) - start) if count is None else count
+        fp = hash_str_keys(keys, lid, start, n)
         out_slots = np.empty(n, dtype=np.int32)
         out_ev = np.empty(n, dtype=np.int32)
+        if fp is not None:
+            h1 = np.ascontiguousarray(fp[0], dtype=np.uint64)
+            h2 = np.ascontiguousarray(fp[1], dtype=np.uint64)
+            with self._lock, self._pinned(pinned):
+                self._lib.rl_index_assign_fps(
+                    self._h, h1.ctypes.data, h2.ctypes.data, n,
+                    out_slots.ctypes.data, out_ev.ctypes.data)
+                failed = bool((out_ev == -2).any())
+                if hold_pins and not failed:  # see assign_batch_ints
+                    self._lib.rl_index_pin_batch(
+                        self._h, out_slots.ctypes.data, n)
+            if failed:
+                raise SlotCapacityError(
+                    "slot capacity exhausted (all pinned)",
+                    pending_clears=out_ev[out_ev >= 0])
+            return out_slots, out_ev[out_ev >= 0]
+        sub = keys if (start == 0 and n == len(keys)) else keys[
+            start:start + n]
+        packed, offs = _pack_str_keys(
+            sub if isinstance(sub, list) else list(sub))
         with self._lock, self._pinned(pinned):
             self._lib.rl_index_assign_bytes(
                 self._h, packed.ctypes.data if len(packed) else 0,

@@ -271,11 +271,40 @@ class PartitionedSlotIndex:
         return self._scatter_merge(len(keys), parts_pos, results, "uniques",
                                    rank_bits)
 
-    # Strings: partition routing needs per-key hashing host-side anyway,
-    # so the parallel win is smaller; route by the same shard_of_key the
-    # scalar path uses — INCLUDING the lid in the routed key, exactly as
-    # storage's scalar assign((lid, key)) does, so both paths agree on a
-    # key's partition — and still fan the C calls out.
+    # Strings: hash the whole window ONCE natively (fingerprints straight
+    # off the interned UTF-8 buffers), route by h1 — the exact quantity
+    # shard_of_key's string branch computes scalar-side, so both paths
+    # agree on a key's partition — and feed each partition its
+    # fingerprint slice: the per-partition walks then do zero hashing.
+    # Fallback (no native hasher): the r5 per-key Python routing loop.
+    def _parallel_strs_fp(self, keys, lid, pinned, run_fp, start, n,
+                          unpin_of=None):
+        from ratelimiter_tpu.engine.native_index import (
+            hash_str_keys,
+            route_hashes,
+        )
+
+        fp = hash_str_keys(keys, lid, start, n)
+        if fp is None:
+            return None
+        h1, h2 = fp
+        part, order, counts = route_hashes(h1, self.n_parts)
+        offs = np.zeros(self.n_parts + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        h1st, h2st = h1[order], h2[order]
+        parts_pos = [order[offs[p]:offs[p + 1]]
+                     for p in range(self.n_parts)]
+        futs = []
+        for p, pos in enumerate(parts_pos):
+            if not len(pos):
+                futs.append(None)
+                continue
+            lo, hi = int(offs[p]), int(offs[p + 1])
+            futs.append(self._pool.submit(
+                run_fp, p, h1st[lo:hi], h2st[lo:hi],
+                self._local_pins(pinned, p)))
+        return parts_pos, self._collect(futs, unpin_of)
+
     def _parallel_strs(self, keys, lid, pinned, run, unpin_of=None):
         parts = np.fromiter(
             (_part_of_key((lid, k), self.n_parts) for k in keys),
@@ -292,30 +321,68 @@ class PartitionedSlotIndex:
 
     def assign_batch_strs(self, keys, lid: int,
                           pinned: Optional[Set[int]] = None,
-                          hold_pins: bool = False):
+                          hold_pins: bool = False,
+                          start: int = 0, count: int | None = None):
+        n = (len(keys) - start) if count is None else count
+
+        def run_fp(p, h1, h2, pins):
+            return self._parts[p].assign_batch_fps(
+                h1, h2, pinned=pins, hold_pins=hold_pins)
+
+        unpin = (lambda res: res[0]) if hold_pins else None
+        r = self._parallel_strs_fp(keys, lid, pinned, run_fp,
+                                   start, n, unpin_of=unpin)
+        if r is not None:
+            parts_pos, results = r
+            slots, clears = self._scatter_merge(
+                n, parts_pos, results, "slots")
+            return slots, np.asarray(clears, dtype=np.int32)
+
+        sub_keys = keys if (start == 0 and n == len(keys)) else keys[
+            start:start + n]
+
         def run(p, sub, pins):
             return self._parts[p].assign_batch_strs(
                 sub, lid, pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel_strs(
-            keys, lid, pinned, run,
-            unpin_of=(lambda res: res[0]) if hold_pins else None)
-        slots, clears = self._scatter_merge(len(keys), parts_pos, results,
+            sub_keys, lid, pinned, run, unpin_of=unpin)
+        slots, clears = self._scatter_merge(n, parts_pos, results,
                                             "slots")
         return slots, np.asarray(clears, dtype=np.int32)
 
     def assign_batch_strs_uniques(self, keys, lid: int, rank_bits: int,
                                   pinned: Optional[Set[int]] = None,
-                                  hold_pins: bool = False):
+                                  hold_pins: bool = False,
+                                  start: int = 0,
+                                  count: int | None = None):
+        n = (len(keys) - start) if count is None else count
+        unpin = (lambda res: (res[0] >> np.uint32(rank_bits + 1))
+                 .astype(np.int32)) if hold_pins else None
+
+        def run_fp(p, h1, h2, pins):
+            return self._parts[p].assign_batch_fps_uniques(
+                h1, h2, rank_bits, pinned=pins, hold_pins=hold_pins)
+
+        if all(hasattr(s, "assign_batch_fps_uniques")
+               for s in self._parts):
+            r = self._parallel_strs_fp(keys, lid, pinned, run_fp,
+                                       start, n, unpin_of=unpin)
+            if r is not None:
+                parts_pos, results = r
+                return self._scatter_merge(n, parts_pos, results,
+                                           "uniques", rank_bits)
+
+        sub_keys = keys if (start == 0 and n == len(keys)) else keys[
+            start:start + n]
+
         def run(p, sub, pins):
             return self._parts[p].assign_batch_strs_uniques(
                 sub, lid, rank_bits, pinned=pins, hold_pins=hold_pins)
 
         parts_pos, results = self._parallel_strs(
-            keys, lid, pinned, run,
-            unpin_of=(lambda res: (res[0] >> np.uint32(rank_bits + 1))
-                      .astype(np.int32)) if hold_pins else None)
-        return self._scatter_merge(len(keys), parts_pos, results, "uniques",
+            sub_keys, lid, pinned, run, unpin_of=unpin)
+        return self._scatter_merge(n, parts_pos, results, "uniques",
                                    rank_bits)
 
     # -- fingerprint enumeration (checkpoint/restore) --------------------------

@@ -209,15 +209,142 @@ def _pallas_supported() -> bool:
     return _pallas_ok
 
 
+# ---------------------------------------------------------------------------
+# Measured micro-batch election (r6).
+#
+# BENCH_r05's A/B put the Pallas solver at x0.91 of the XLA path on the
+# micro-batch traffic it exists to serve — a supported kernel is not
+# necessarily a WINNING kernel, and which one wins varies by device
+# generation and toolchain.  Mirroring the words-vs-digest election
+# pattern, the auto dispatcher now runs a one-time timed A/B at a
+# representative micro-batch shape (duplicate segments, batcher-bucket
+# lanes) and disables the Pallas path when XLA wins; the verdict is
+# disk-cached per (platform, device kind) next to the compile cache,
+# like engine/device_rates.py.  RATELIMITER_PALLAS_ELECT=on|off|auto
+# overrides (on = always use Pallas when supported — the r5 behavior;
+# off = never; auto = measure).  Interpret mode skips the election (it
+# exists to exercise the kernel, not to win).
+_ELECT_ENV = "RATELIMITER_PALLAS_ELECT"
+_ELECT_MARGIN = 1.05  # Pallas keeps the path unless XLA clearly wins
+_elect_verdict: bool | None = None
+
+
+def _elect_cache_path():
+    try:
+        base = jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001
+        base = None
+    if not base:
+        from ratelimiter_tpu.utils.compile_cache import default_cache_dir
+
+        base = default_cache_dir()
+    try:
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", dev.platform)
+    except Exception:  # noqa: BLE001
+        return None
+    safe = "".join(ch if ch.isalnum() else "_" for ch in kind)[:40]
+    return os.path.join(base, f"pallas_elect_{dev.platform}_{safe}.json")
+
+
+def _measure_micro_ab() -> dict:
+    """Best-of-5 wall of one micro-batch solve, Pallas vs XLA, at the
+    shape the kernel serves (8192 lanes, 4-deep segments)."""
+    import time
+
+    import numpy as np
+
+    n = 8192
+    rng = np.random.default_rng(17)
+    seg = np.sort(rng.integers(0, n // 4, n))
+    first = np.ones(n, dtype=bool)
+    first[1:] = seg[1:] != seg[:-1]
+    u = jnp.asarray(rng.integers(0, 100, n).astype(np.int64))
+    w = jnp.asarray(rng.integers(1, 5, n).astype(np.int64))
+    first_j = jnp.asarray(first)
+
+    def run_pallas(u, w, first):
+        sf = seg_first_index(first)
+        u32 = jnp.clip(u, -1, SAT - 1).astype(jnp.int32)
+        w32 = jnp.clip(w, 0, SAT).astype(jnp.int32)
+        return pallas_solve(u32, w32, sf,
+                            interpret=_PALLAS_INTERPRET).astype(jnp.int64)
+
+    def run_xla(u, w, first):
+        return _xla.solve_threshold_recurrence(u, w, first)
+
+    def best_of(fn):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(u, w, first_j))  # compile + settle
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(u, w, first_j))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return {"pallas_s": best_of(run_pallas), "xla_s": best_of(run_xla),
+            "lanes": n}
+
+
+def _micro_election() -> bool:
+    """True when the Pallas solver should serve micro-batches on this
+    device (measured; cached in-process and on disk)."""
+    global _elect_verdict
+    if _elect_verdict is not None:
+        return _elect_verdict
+    policy = os.environ.get(_ELECT_ENV, "auto").lower()
+    if policy in ("on", "always", "1"):
+        _elect_verdict = True
+        return True
+    if policy in ("off", "never", "0"):
+        _elect_verdict = False
+        return False
+    if _PALLAS_INTERPRET:
+        _elect_verdict = True  # tests drive the kernel on purpose
+        return True
+    import json
+
+    path = _elect_cache_path()
+    if path and os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            _elect_verdict = bool(data["micro_win"])
+            return _elect_verdict
+        except Exception:  # noqa: BLE001 — corrupt cache: re-measure
+            pass
+    try:
+        ab = _measure_micro_ab()
+        verdict = ab["pallas_s"] <= _ELECT_MARGIN * ab["xla_s"]
+    except Exception:  # noqa: BLE001 — measurement failed: keep Pallas
+        _elect_verdict = True
+        return True
+    _elect_verdict = verdict
+    if path:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(dict(ab, micro_win=verdict), fh)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — disk cache is best-effort
+            pass
+    return verdict
+
+
 def settle() -> bool:
-    """Resolve the support probe eagerly (engine init calls this before
-    any step kernel compiles — a probe firing lazily inside another
-    program's lowering would nest remote compiles).  Respects the
-    RATELIMITER_PALLAS kill switch: disabled means no Pallas compile at
-    all."""
+    """Resolve the support probe (and the micro-batch election) eagerly
+    — engine init calls this before any step kernel compiles; a probe
+    firing lazily inside another program's lowering would nest remote
+    compiles.  Respects the RATELIMITER_PALLAS kill switch: disabled
+    means no Pallas compile at all.  Returns whether the Pallas solver
+    will actually SERVE (supported AND elected)."""
     if not _PALLAS_FLAG:
         return False
-    return _pallas_supported()
+    if not _pallas_supported():
+        return False
+    return _micro_election()
 
 
 def solve_threshold_recurrence_auto(u, w, first, shift: int = 0):
@@ -231,7 +358,7 @@ def solve_threshold_recurrence_auto(u, w, first, shift: int = 0):
     Sliding window uses shift=0.
     """
     if (_PALLAS_FLAG and u.shape[0] <= _PALLAS_MAX_LANES
-            and _pallas_supported()):
+            and _pallas_supported() and _micro_election()):
         u_s = jnp.right_shift(u, shift) if shift else u
         w_s = jnp.right_shift(w, shift) if shift else w
         # Thresholds clip BELOW the saturation ceiling so a saturated

@@ -91,11 +91,26 @@ def shard_of_int_keys(key_ids, n_shards: int):
 def shard_of_key(key, n_shards: int) -> int:
     """Deterministic, process-independent key -> shard hash, so a multi-host
     router and this engine always agree.  Int user keys use the vectorizable
-    splitmix hash (same as the stream path); everything else uses crc32 of
-    the repr."""
+    splitmix hash (same as the int stream path).  String/bytes user keys
+    route by the h1 stream of their index FINGERPRINT (r6): the same hash
+    the slot index keys on, so the batched string stream can hash a chunk
+    once natively and both route and assign from the result — scalar calls
+    compute the identical h1 here in Python.  Everything else (exotic key
+    types, which have no batch path) keeps crc32-of-repr.
+
+    The string branch changed from crc32-of-repr in r6; sharded checkpoint
+    dumps carry a shard-hash version so a dump written under the old
+    routing is refused (or placement-checked) instead of silently
+    orphaning entries (engine/checkpoint.py:SHARD_HASH_VERSION)."""
     user = key[1] if isinstance(key, tuple) and len(key) == 2 else key
     if isinstance(user, (int, np.integer)):
         return int(shard_of_int_keys(np.asarray([user]), n_shards)[0])
+    lid = key[0] if isinstance(key, tuple) and len(key) == 2 else 0
+    if isinstance(user, (str, bytes)) and isinstance(lid, (int, np.integer)):
+        from ratelimiter_tpu.engine.native_index import fnv_fingerprint_h1
+
+        data = user.encode() if isinstance(user, str) else user
+        return fnv_fingerprint_h1(data, int(lid)) % n_shards
     return zlib.crc32(repr(key).encode()) % n_shards
 
 
@@ -124,6 +139,16 @@ class ShardedSlotIndex:
         # The sharded stream path needs per-shard vectorized assignment.
         self.supports_batch_ints = all(
             hasattr(s, "assign_batch_ints") for s in self._sub)
+        # The sharded STRING stream additionally needs native fingerprint
+        # hashing (hash once -> route by h1 -> per-shard fps assign; the
+        # h1 routing is what shard_of_key's string branch computes
+        # scalar-side, so both paths agree on a key's shard).
+        from ratelimiter_tpu.engine.native_index import str_hash_available
+
+        self.supports_batch_strs = (
+            str_hash_available()
+            and all(hasattr(s, "assign_batch_fps_uniques")
+                    for s in self._sub))
 
     def _split(self, global_slot: int):
         return divmod(global_slot, self.slots_per_shard)

@@ -86,6 +86,14 @@ else
   fail=1
 fi
 
+echo "running sharded perf smoke (CPU, 2 virtual shards >= 0.9x of 1)..."
+if timeout -k 10 600 python bench/perf_smoke.py; then
+  echo "  ok  sharded perf smoke"
+else
+  echo "  FAILED  sharded perf smoke (scaling inversion)"
+  fail=1
+fi
+
 if [[ "${RUN_SLOW:-0}" == "1" ]]; then
   echo "running slow failover + overload + outage soaks (RUN_SLOW=1)..."
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
