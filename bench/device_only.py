@@ -241,6 +241,58 @@ def main() -> None:
                                    make_tb_packed(num_slots))
     out["digest_unsorted"] = measure(digest_chain(uslots_shuf, False),
                                      make_tb_packed(num_slots))
+
+    # Fused Pallas relay step (ops/pallas/relay_step.py): the same
+    # sorted digest traffic through the single-pass gather+update+
+    # scatter kernel, directly comparable to digest_sorted (composed
+    # XLA + presorted sweep) and to the relay words step.
+    from ratelimiter_tpu.ops.pallas import election as pallas_election
+    from ratelimiter_tpu.ops.pallas import relay_step as fused_relay
+
+    out["relay_fused_live"] = bool(fused_relay.settle())
+    if fused_relay.enabled((num_slots, 4), B, rb):
+        uw_f = jnp.asarray((uslots_sorted << np.uint32(rb + 1))
+                           | np.uint32(1 << 1))
+
+        def fused_chain(K):
+            def run(packed, now0):
+                def body(i, carry):
+                    packed, acc = carry
+                    packed, counts = fused_relay.tb_relay_counts_fused(
+                        packed, tarr, uw_f, lid_dev, now0 + i,
+                        rank_bits=rb,
+                        interpret=fused_relay.interpret_mode())
+                    return packed, acc + jnp.sum(counts.astype(jnp.int64))
+                packed, acc = jax.lax.fori_loop(0, K, body,
+                                                (packed, jnp.int64(0)))
+                return packed, acc
+            return jax.jit(run, donate_argnums=0)
+
+        out["digest_fused"] = measure(fused_chain, make_tb_packed(num_slots))
+
+    # Per-path election records + the elected-never-slower gate
+    # (VERDICT #7): the backend the engine actually dispatches for the
+    # sorted relay/digest step must not be measurably slower than the
+    # XLA path on this device.  1.10 margin absorbs run-to-run noise;
+    # a real inversion (an election serving a slower kernel) fails the
+    # bench loudly.
+    out["pallas_elections"] = pallas_election.report()
+    serves_fused = out["relay_fused_live"] and "digest_fused" in out
+    elected = out["digest_fused"] if serves_fused else out["digest_sorted"]
+    out["relay_election_check"] = {
+        "elected_backend": "pallas_fused" if serves_fused else "xla",
+        "elected_ns_per_unique": elected["ns_per_decision"],
+        "xla_sorted_ns_per_unique": out["digest_sorted"][
+            "ns_per_decision"],
+        "xla_relay_words_ns_per_lane": out["relay"]["ns_per_decision"],
+        "ok": bool(elected["ns_per_decision"]
+                   <= 1.10 * out["digest_sorted"]["ns_per_decision"]),
+    }
+    assert out["relay_election_check"]["ok"], (
+        f"elected relay step {elected['ns_per_decision']} ns/unique is "
+        f"slower than the XLA sorted digest "
+        f"{out['digest_sorted']['ns_per_decision']} ns/unique — the "
+        f"per-path election served a losing backend")
     print(json.dumps(out))
 
 

@@ -1,4 +1,4 @@
-"""Fast sharded-scaling perf smoke (CPU, small shapes) — CI guard.
+"""Fast perf smokes (CPU, small shapes) — CI guards.
 
 ISSUE r6: the virtual-mesh scaling curve silently anti-scaled for two
 rounds (19.5M/s at 1 shard -> 4.3M/s at 8 in BENCH_r05) because nothing
@@ -16,10 +16,19 @@ lost pipeline overlap), not 5% jitter.  The stream is the headline
 shape scaled down (4M Zipf decisions over 1M keys: multi-chunk, so the
 pipelined prepare actually overlaps).
 
-Prints one JSON line; exit code 1 on inversion.  Run from the repo
+ISSUE r7 adds a RELAY-ELECTION smoke (interpret-safe, also its own
+subprocess): on a CPU backend no Pallas relay path may be elected (the
+fused kernel is TPU-or-interpret only), the engine's elected sorted
+digest dispatch must not run measurably slower than the raw XLA step
+it wraps, and every disk-cached per-path election artifact
+(pallas_elect_*.json) must be self-consistent — the recorded verdict
+must equal what its own recorded A/B times imply, so an election can
+never silently pin a measured-slower backend.
+
+Prints one JSON line; exit code 1 on any violation.  Run from the repo
 root (verify.sh invokes it):  python bench/perf_smoke.py
-With --point N it runs a single N-shard point and prints its
-decisions/s (the subprocess mode).
+With --point N it runs a single N-shard point; with --relay-election
+it runs the election smoke (the subprocess modes).
 """
 
 from __future__ import annotations
@@ -82,9 +91,111 @@ def run_point(n_shards: int) -> None:
                       "decisions_per_sec": len(key_ids) / best}))
 
 
+def run_relay_election() -> None:
+    """Relay-election smoke: elected path never slower than XLA on this
+    (CPU) backend, and cached election artifacts self-consistent."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("RATELIMITER_RATE_PROBE", "0")
+
+    import functools
+    import glob
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, _REPO)
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.ops import relay
+    from ratelimiter_tpu.ops.pallas import election, relay_step
+    from ratelimiter_tpu.utils.compile_cache import (
+        default_cache_dir,
+        enable_compile_cache,
+    )
+
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
+    out = {"smoke": "relay_election"}
+
+    # 1. The fused Pallas path must not be live on a plain CPU backend.
+    table = LimiterTable()
+    lid = table.register(RateLimitConfig(
+        max_permits=20, window_ms=60_000, refill_rate=5.0))
+    eng = DeviceEngine(num_slots=1 << 15, table=table)
+    fused_live = eng._relay_fused_ok("tb", 1 << 14)
+    interpret = relay_step.interpret_mode()
+    out["fused_live_on_cpu"] = bool(fused_live)
+    out["interpret_override"] = bool(interpret)
+    ok_live = interpret or not fused_live
+
+    # 2. The elected dispatch (whatever the engine chose) must not be
+    # slower than the raw XLA digest step on identical traffic.  Same
+    # computation either way on CPU, so the generous 1.5x margin only
+    # catches a structural mistake (e.g. interpret-mode Pallas leaking
+    # into a non-test process).
+    rb = eng.rank_bits
+    u = 1 << 14
+    slots = np.arange(u, dtype=np.uint32) * ((1 << 15) // u)
+    uw = (slots << np.uint32(rb + 1)) | np.uint32(2)
+    raw = jax.jit(functools.partial(
+        relay.tb_relay_counts, rank_bits=rb, out_dtype=jnp.uint8))
+    state = jnp.array(eng.tb_packed)
+    tarr = table.device_arrays
+
+    def best_of(fn, reps=5):
+        fn()  # warm/compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_elected = best_of(lambda: np.asarray(eng.tb_relay_counts_dispatch(
+        uw, np.int32(lid), 1_000_000, np.uint8, slots_sorted=True)))
+    t_xla = best_of(lambda: np.asarray(raw(
+        state, tarr, jnp.asarray(uw), jnp.int32(lid),
+        jnp.int64(1_000_000))[1]))
+    out["elected_s"] = round(t_elected, 6)
+    out["xla_s"] = round(t_xla, 6)
+    ok_speed = t_elected <= 1.5 * t_xla
+
+    # 3. Cached election artifacts: verdict == what the recorded A/B
+    # implies.  (env-off/interpret records carry no timings — skipped.)
+    bad_records = []
+    base = jax.config.jax_compilation_cache_dir or default_cache_dir()
+    for path in sorted(glob.glob(os.path.join(
+            base, "pallas_elect_*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except Exception:  # noqa: BLE001 — corrupt artifact: re-measured
+            continue
+        if "pallas_s" not in rec or "xla_s" not in rec:
+            continue
+        margin = float(rec.get("margin", election.DEFAULT_MARGIN))
+        implied = rec["pallas_s"] <= margin * rec["xla_s"]
+        if bool(rec.get("elected", rec.get("micro_win"))) != implied:
+            bad_records.append(os.path.basename(path))
+    out["election_artifacts_checked"] = len(
+        glob.glob(os.path.join(base, "pallas_elect_*.json")))
+    out["inconsistent_artifacts"] = bad_records
+    out["ok"] = bool(ok_live and ok_speed and not bad_records)
+    print(json.dumps(out))
+    if not out["ok"]:
+        print(f"RELAY ELECTION SMOKE FAILED: live_ok={ok_live} "
+              f"speed_ok={ok_speed} bad={bad_records}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> int:
     if "--point" in sys.argv:
         run_point(int(sys.argv[sys.argv.index("--point") + 1]))
+        return 0
+    if "--relay-election" in sys.argv:
+        run_relay_election()
         return 0
     dps = {}
     for s in (1, 2):
@@ -99,6 +210,16 @@ def main() -> int:
             "decisions_per_sec"]
     ratio = dps[2] / dps[1]
     ok = ratio >= MARGIN
+    # Relay-election smoke (its own subprocess: the engine + election
+    # caches must resolve fresh, exactly as a service boot would).
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--relay-election"],
+        capture_output=True, timeout=540, text=True, cwd=_REPO)
+    relay_ok = proc.returncode == 0 and bool(proc.stdout.strip())
+    try:
+        relay_out = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 — crash before the JSON line
+        relay_out = {"error": proc.stderr[-400:]}
     print(json.dumps({
         "smoke": "sharded_scaling_2shard",
         "dps_1shard": round(dps[1], 1),
@@ -106,10 +227,16 @@ def main() -> int:
         "ratio": round(ratio, 3),
         "margin": MARGIN,
         "ok": ok,
+        "relay_election": relay_out,
     }))
     if not ok:
         print(f"PERF SMOKE FAILED: 2-shard throughput {ratio:.2f}x of "
               f"1 shard (< {MARGIN}x) — sharded dispatch regressed",
+              file=sys.stderr)
+        return 1
+    if not relay_ok:
+        print(f"PERF SMOKE FAILED: relay election smoke "
+              f"rc={proc.returncode} stderr={proc.stderr[-400:]!r}",
               file=sys.stderr)
         return 1
     return 0

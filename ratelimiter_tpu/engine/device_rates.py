@@ -134,6 +134,7 @@ def _probe() -> Dict[str, float]:
         return max(dt - rtt_s, 1e-6) / (k_steps * lanes)
 
     from ratelimiter_tpu.ops.pallas import block_scatter
+    from ratelimiter_tpu.ops.pallas import relay_step as fused_relay
 
     rates = {
         "s_per_lane": measure(relay_step),
@@ -143,6 +144,20 @@ def _probe() -> Dict[str, float]:
         rates["s_per_unique_sorted"] = measure(digest_step(uw_sorted, True))
     else:  # sorted sweep can't engage on this backend: same cost
         rates["s_per_unique_sorted"] = rates["s_per_unique_unsorted"]
+    # Fused Pallas relay step (per-path election; ops/pallas/relay_step):
+    # when it is elected on this device the engine's sorted digest
+    # dispatch actually RUNS it, so the sorted rate the stream elections
+    # charge must be the better of the two — both raw rates stay
+    # recorded so BENCH_DETAIL shows what the election saw.
+    if fused_relay.enabled((num_slots, 4), lanes, rb):
+        def fused_step(packed, now):
+            return fused_relay.tb_relay_counts_fused(
+                packed, tarr, uw_sorted, lid_dev, now, rank_bits=rb,
+                interpret=fused_relay.interpret_mode())
+
+        rates["s_per_unique_fused"] = measure(fused_step)
+        rates["s_per_unique_sorted"] = min(rates["s_per_unique_sorted"],
+                                           rates["s_per_unique_fused"])
     return rates
 
 

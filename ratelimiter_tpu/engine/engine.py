@@ -352,6 +352,15 @@ class DeviceEngine:
 
         return relay_ops.counts_dtype(self.table.max_permits_registered)
 
+    def _relay_fused_ok(self, algo: str, u_padded: int) -> bool:
+        """Whether a scalar-lid sorted digest dispatch of ``u_padded``
+        lanes takes the fused Pallas relay step (geometry + probe +
+        measured election; ops/pallas/relay_step.py)."""
+        from ratelimiter_tpu.ops.pallas import relay_step
+
+        shape = (self.sw_packed if algo == "sw" else self.tb_packed).shape
+        return relay_step.enabled(shape, u_padded, self.rank_bits)
+
     # -- weighted relay dispatch (ops/relay.py:*_relay_weighted) ---------------
     def sw_weighted_dispatch(self, uwords, perms_rank, roff, lid,
                              now_ms, r_steps):
@@ -531,18 +540,34 @@ class DeviceEngine:
                                slots_sorted=False):
         """uwords uint32[U] (slot | clamped count; padding 0xFFFFFFFF);
         returns a lazy out_dtype[U] per-unique allowed-count handle.
-        ``slots_sorted`` (host sorted the uniques by slot): the scatter
-        runs as the dense presorted block sweep."""
+        ``slots_sorted`` (host sorted the uniques by slot): the step runs
+        the FUSED Pallas relay kernel (ops/pallas/relay_step.py — one
+        memory-resident gather+update+scatter pass) when the measured
+        per-path election picked it on this device, else the composed
+        XLA step with the dense presorted block sweep."""
         self._mark_words(algo, uwords)
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
-        key = (algo, out_dtype().dtype.name, bool(slots_sorted))
+        fused = bool(slots_sorted) and np.ndim(lids) == 0 and (
+            self._relay_fused_ok(algo, len(uwords)))
+        key = (algo, out_dtype().dtype.name,
+               "fused" if fused else bool(slots_sorted))
         fn = self._relay_counts.get(key)
         if fn is None:
-            base = sw_relay_counts if algo == "sw" else tb_relay_counts
-            fn = jax.jit(functools.partial(
-                base, rank_bits=self.rank_bits, out_dtype=jdt,
-                slots_sorted=bool(slots_sorted)),
-                donate_argnums=0)
+            if fused:
+                from ratelimiter_tpu.ops.pallas import relay_step
+
+                base = (relay_step.sw_relay_counts_fused if algo == "sw"
+                        else relay_step.tb_relay_counts_fused)
+                fn = jax.jit(functools.partial(
+                    base, rank_bits=self.rank_bits, out_dtype=jdt,
+                    interpret=relay_step.interpret_mode()),
+                    donate_argnums=0)
+            else:
+                base = sw_relay_counts if algo == "sw" else tb_relay_counts
+                fn = jax.jit(functools.partial(
+                    base, rank_bits=self.rank_bits, out_dtype=jdt,
+                    slots_sorted=bool(slots_sorted)),
+                    donate_argnums=0)
             self._relay_counts[key] = fn
         uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
         if np.ndim(lids) == 0:

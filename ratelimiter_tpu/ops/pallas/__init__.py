@@ -1,14 +1,23 @@
-"""Pallas TPU kernels (probe-gated, XLA fallbacks, decisions identical)."""
+"""Pallas TPU kernels (probe-gated, XLA fallbacks, decisions identical).
+
+Every kernel here is gated twice: a one-time correctness PROBE (tiny
+differential against the XLA truth — any lowering failure or mismatch
+means permanent fallback) and a one-time measured ELECTION
+(ops/pallas/election.py — a supported kernel that measures slower than
+the XLA path it replaces does not serve).  ``settle_all()`` resolves
+both eagerly at engine init; ``election_report()`` exposes the verdicts
+for BENCH_DETAIL and the perf-smoke consistency gate.
+"""
 
 
 def settle_all() -> None:
-    """Resolve every kernel's support probe eagerly.
+    """Resolve every kernel's support probe (and election) eagerly.
 
     Engines call this at init, before any step kernel compiles: a probe
     firing lazily inside another program's lowering nests a remote
     compile some toolchains cannot serve, and the resulting failure
     would stick as a permanent silent fallback.  Each module's settle()
-    honors its own kill switch, and both no-op off-TPU (the interpret
+    honors its own kill switch, and all no-op off-TPU (the interpret
     overrides still probe lazily by design — interpret lowering nests
     fine).
     """
@@ -17,7 +26,18 @@ def settle_all() -> None:
     if jax.default_backend() != "tpu":
         return
     from ratelimiter_tpu.ops.pallas import block_scatter
+    from ratelimiter_tpu.ops.pallas import relay_step
     from ratelimiter_tpu.ops.pallas import solver
 
     block_scatter.settle()
     solver.settle()
+    relay_step.settle()
+
+
+def election_report() -> dict:
+    """Per-path election verdicts + measurements resolved so far (see
+    ops/pallas/election.py).  Paths that never probed (e.g. CPU runs)
+    are simply absent."""
+    from ratelimiter_tpu.ops.pallas import election
+
+    return election.report()

@@ -236,17 +236,74 @@ def _probe() -> bool:
     return _probe_ok
 
 
+def _measure_ab() -> dict:
+    """Timed A/B of the dense sweep vs XLA's drop-mode scatter at a
+    representative sorted-unique digest shape (chained inside one jit,
+    one fetched checksum — the device_rates.py method)."""
+    import time
+
+    s_rows, b, k_steps = 1 << 17, 1 << 15, 8
+    rng = np.random.default_rng(3)
+    slots = np.sort(rng.choice(s_rows, size=b, replace=False)
+                    ).astype(np.int32)
+    mask = np.ones(b, dtype=bool)
+    slots_j, mask_j = jnp.asarray(slots), jnp.asarray(mask)
+    rows = jnp.asarray(rng.integers(-(1 << 30), 1 << 30, (b, 4), np.int32))
+
+    def xla_scatter(state, rows):
+        widx = jnp.where(mask_j, slots_j, jnp.int32(s_rows))
+        return state.at[widx].set(rows, mode="drop")
+
+    def pallas_scatter(state, rows):
+        return scatter_rows_presorted(state, slots_j, mask_j, rows,
+                                      interpret=_INTERPRET)
+
+    def best_of(fn):
+        import functools as ft
+
+        @ft.partial(jax.jit, donate_argnums=0)
+        def chain(state, rows):
+            def body(i, st):
+                return fn(st, rows + i.astype(jnp.int32))
+
+            st = jax.lax.fori_loop(0, k_steps, body, state)
+            return st, jnp.sum(st[:8].astype(jnp.int64))
+
+        st, acc = chain(jnp.zeros((s_rows, 4), jnp.int32), rows)
+        int(np.asarray(acc))  # compile + settle
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st, acc = chain(st, rows)
+            int(np.asarray(acc))
+            best = min(best, time.perf_counter() - t0)
+        return best / k_steps
+
+    return {"pallas_s": best_of(pallas_scatter),
+            "xla_s": best_of(xla_scatter),
+            "updates": b, "state_rows": s_rows}
+
+
+def _elected() -> bool:
+    """Measured per-path election (ops/pallas/election.py): the sweep
+    only serves where it beats XLA's per-index scatter on THIS device."""
+    from ratelimiter_tpu.ops.pallas import election
+
+    return election.measured_election("block_scatter", _measure_ab,
+                                      interpret=_INTERPRET)
+
+
 def settle() -> bool:
-    """Resolve the support probe eagerly (engine init calls this before
-    any step kernel compiles — a probe firing lazily inside another
-    program's lowering would nest remote compiles).  Respects the
-    RATELIMITER_BLOCK_SCATTER kill switch: disabled means no Pallas
-    compile at all."""
+    """Resolve the support probe (and the measured election) eagerly
+    (engine init calls this before any step kernel compiles — a probe
+    firing lazily inside another program's lowering would nest remote
+    compiles).  Respects the RATELIMITER_BLOCK_SCATTER kill switch:
+    disabled means no Pallas compile at all."""
     if not _FLAG:
         return False
     if not (_INTERPRET or jax.default_backend() == "tpu"):
         return False
-    return _probe()
+    return _probe() and _elected()
 
 
 def enabled(state_shape, batch: int) -> bool:
@@ -254,4 +311,4 @@ def enabled(state_shape, batch: int) -> bool:
         return False
     if not (_INTERPRET or jax.default_backend() == "tpu"):
         return False
-    return _probe()
+    return _probe() and _elected()

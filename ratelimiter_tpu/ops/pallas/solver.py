@@ -210,41 +210,20 @@ def _pallas_supported() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Measured micro-batch election (r6).
+# Measured micro-batch election (r6; generalized into
+# ops/pallas/election.py in r7 — this module keeps only its measure
+# function and delegates the verdict/caching/override machinery).
 #
 # BENCH_r05's A/B put the Pallas solver at x0.91 of the XLA path on the
 # micro-batch traffic it exists to serve — a supported kernel is not
 # necessarily a WINNING kernel, and which one wins varies by device
-# generation and toolchain.  Mirroring the words-vs-digest election
-# pattern, the auto dispatcher now runs a one-time timed A/B at a
-# representative micro-batch shape (duplicate segments, batcher-bucket
-# lanes) and disables the Pallas path when XLA wins; the verdict is
-# disk-cached per (platform, device kind) next to the compile cache,
-# like engine/device_rates.py.  RATELIMITER_PALLAS_ELECT=on|off|auto
-# overrides (on = always use Pallas when supported — the r5 behavior;
-# off = never; auto = measure).  Interpret mode skips the election (it
-# exists to exercise the kernel, not to win).
-_ELECT_ENV = "RATELIMITER_PALLAS_ELECT"
-_ELECT_MARGIN = 1.05  # Pallas keeps the path unless XLA clearly wins
-_elect_verdict: bool | None = None
-
-
-def _elect_cache_path():
-    try:
-        base = jax.config.jax_compilation_cache_dir
-    except Exception:  # noqa: BLE001
-        base = None
-    if not base:
-        from ratelimiter_tpu.utils.compile_cache import default_cache_dir
-
-        base = default_cache_dir()
-    try:
-        dev = jax.devices()[0]
-        kind = getattr(dev, "device_kind", dev.platform)
-    except Exception:  # noqa: BLE001
-        return None
-    safe = "".join(ch if ch.isalnum() else "_" for ch in kind)[:40]
-    return os.path.join(base, f"pallas_elect_{dev.platform}_{safe}.json")
+# generation and toolchain.  The auto dispatcher runs a one-time timed
+# A/B at a representative micro-batch shape (duplicate segments,
+# batcher-bucket lanes) and disables the Pallas path when XLA wins; the
+# verdict is disk-cached per (platform, device kind, path) next to the
+# compile cache.  RATELIMITER_PALLAS_ELECT=on|off|auto overrides (per
+# path: RATELIMITER_PALLAS_ELECT_MICRO).  Interpret mode skips the
+# election (it exists to exercise the kernel, not to win).
 
 
 def _measure_micro_ab() -> dict:
@@ -289,48 +268,12 @@ def _measure_micro_ab() -> dict:
 
 def _micro_election() -> bool:
     """True when the Pallas solver should serve micro-batches on this
-    device (measured; cached in-process and on disk)."""
-    global _elect_verdict
-    if _elect_verdict is not None:
-        return _elect_verdict
-    policy = os.environ.get(_ELECT_ENV, "auto").lower()
-    if policy in ("on", "always", "1"):
-        _elect_verdict = True
-        return True
-    if policy in ("off", "never", "0"):
-        _elect_verdict = False
-        return False
-    if _PALLAS_INTERPRET:
-        _elect_verdict = True  # tests drive the kernel on purpose
-        return True
-    import json
+    device (measured; cached in-process and on disk by the shared
+    per-path election — ops/pallas/election.py, path ``micro``)."""
+    from ratelimiter_tpu.ops.pallas import election
 
-    path = _elect_cache_path()
-    if path and os.path.exists(path):
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                data = json.load(fh)
-            _elect_verdict = bool(data["micro_win"])
-            return _elect_verdict
-        except Exception:  # noqa: BLE001 — corrupt cache: re-measure
-            pass
-    try:
-        ab = _measure_micro_ab()
-        verdict = ab["pallas_s"] <= _ELECT_MARGIN * ab["xla_s"]
-    except Exception:  # noqa: BLE001 — measurement failed: keep Pallas
-        _elect_verdict = True
-        return True
-    _elect_verdict = verdict
-    if path:
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(dict(ab, micro_win=verdict), fh)
-            os.replace(tmp, path)
-        except Exception:  # noqa: BLE001 — disk cache is best-effort
-            pass
-    return verdict
+    return election.measured_election("micro", _measure_micro_ab,
+                                      interpret=_PALLAS_INTERPRET)
 
 
 def settle() -> bool:

@@ -22,7 +22,12 @@ speak it in ~30 lines:
 Requests may be pipelined: a client can write N frames before reading N
 responses (the provided ``SidecarClient.acquire_batch`` does exactly this),
 which amortizes syscalls the way Redis pipelining does
-(the reference leans on the same trick for INCR+PEXPIRE).
+(the reference leans on the same trick for INCR+PEXPIRE).  The server
+honors the pipelining on the decision path: every TRY_ACQUIRE frame of
+a read burst is SUBMITTED to the micro-batcher before any is resolved
+(``TpuBatchedStorage.acquire_async``), so a 64-deep pipeline coalesces
+into one device flush instead of paying 64 sequential batcher round
+trips — responses still return in request order.
 
 Limiters are registered server-side by name -> (algo, config); clients
 address them by the integer id returned at registration (distributed via
@@ -47,6 +52,10 @@ OP_PING = 4
 
 _REQ_BODY = struct.Struct("<BII")    # op, lid, permits (after the u32 len)
 _RESP = struct.Struct("<IBBq")       # len, status, allowed, remaining
+
+
+def _mk_resp(status: int, allowed: int, remaining: int) -> bytes:
+    return _RESP.pack(_RESP.size - 4, status, allowed, remaining)
 
 ERR_UNKNOWN_OP = 1
 ERR_UNKNOWN_LIMITER = 2
@@ -95,17 +104,21 @@ class SidecarServer:
                     if not chunk:
                         return
                     buf += chunk
-                    responses = []
+                    # Two-phase: submit every decision frame of this
+                    # read burst (futures), THEN resolve in order — the
+                    # whole pipeline lands in one micro-batch flush.
+                    pending = []
                     while len(buf) >= 4:
                         (length,) = struct.unpack_from("<I", buf)
                         if len(buf) < 4 + length:
                             break
                         frame = buf[4:4 + length]
                         buf = buf[4 + length:]
-                        responses.append(outer._handle_frame(frame))
-                    if responses:
+                        pending.append(outer._begin_frame(frame))
+                    if pending:
                         try:
-                            sock.sendall(b"".join(responses))
+                            sock.sendall(b"".join(
+                                outer._finish_frame(p) for p in pending))
                         except OSError:
                             return
 
@@ -151,9 +164,40 @@ class SidecarServer:
                 pass
 
     # -- frame handling -------------------------------------------------------
+    def _begin_frame(self, frame: bytes):
+        """Phase 1 of a pipelined burst: TRY_ACQUIRE frames are submitted
+        to the micro-batcher and return their FUTURE; everything else
+        (and every error) resolves immediately to response bytes."""
+        try:
+            op, lid, permits = _REQ_BODY.unpack_from(frame)
+            if op == OP_TRY_ACQUIRE:
+                entry = self._limiters.get(lid)
+                if entry is None:
+                    return _mk_resp(1, 0, ERR_UNKNOWN_LIMITER)
+                acquire_async = getattr(self.storage, "acquire_async",
+                                        None)
+                if acquire_async is not None:
+                    key = frame[_REQ_BODY.size:].decode()
+                    return acquire_async(entry[0], lid, key,
+                                         max(int(permits), 1))
+        except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
+            return _mk_resp(1, 0, ERR_INTERNAL)
+        return self._handle_frame(frame)
+
+    @staticmethod
+    def _finish_frame(item) -> bytes:
+        """Phase 2: resolve a submitted future (or pass bytes through)."""
+        if isinstance(item, bytes):
+            return item
+        try:
+            out = item.result()
+            remaining = int(out.get("remaining", out.get("cache_value", 0)))
+            return _mk_resp(0, 1 if out["allowed"] else 0, remaining)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return _mk_resp(1, 0, ERR_INTERNAL)
+
     def _handle_frame(self, frame: bytes) -> bytes:
-        def resp(status: int, allowed: int, remaining: int) -> bytes:
-            return _RESP.pack(_RESP.size - 4, status, allowed, remaining)
+        resp = _mk_resp
 
         try:
             op, lid, permits = _REQ_BODY.unpack_from(frame)

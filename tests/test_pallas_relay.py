@@ -1,0 +1,274 @@
+"""Differential tests for the fused Pallas relay-step kernel
+(ops/pallas/relay_step.py), driven in interpret mode on CPU.
+
+The kernel must be BIT-identical to the composed-XLA digest step (and
+therefore to semantics/oracle.py, which the composed step is already
+differentially tested against) for both algorithms, across rank_bits
+and counts dtypes, through clear interleavings, and at the engine
+dispatch layer where the per-path election selects it.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.state import LimiterTable
+from ratelimiter_tpu.ops import relay
+from ratelimiter_tpu.ops.pallas import election
+from ratelimiter_tpu.ops.pallas import relay_step as rs
+from ratelimiter_tpu.ops.sliding_window import make_sw_packed
+from ratelimiter_tpu.ops.token_bucket import make_tb_packed
+
+
+@pytest.fixture()
+def fused_interpret(monkeypatch):
+    """Force the fused path live on CPU: interpret-mode kernel, fresh
+    probe, fresh election (interpret elects unconditionally)."""
+    monkeypatch.setattr(rs, "_INTERPRET", True)
+    monkeypatch.setattr(rs, "_probe_ok", None)
+    election.reset_for_tests()
+    yield
+    election.reset_for_tests()
+
+
+def _sorted_uwords(rng, s_rows, u, n_real, rank_bits, max_count=8,
+                   clamp_some=False):
+    slots = np.sort(rng.choice(s_rows, size=n_real,
+                               replace=False)).astype(np.uint32)
+    cmax = (1 << rank_bits) - 1
+    counts = rng.integers(1, min(max_count, cmax) + 1,
+                          n_real).astype(np.uint32)
+    if clamp_some and n_real > 2:
+        counts[rng.integers(0, n_real, 2)] = cmax
+    uw = np.full(u, 0xFFFFFFFF, dtype=np.uint32)
+    uw[:n_real] = (slots << np.uint32(rank_bits + 1)) | (
+        counts << np.uint32(1))
+    return uw, slots, counts
+
+
+@pytest.mark.parametrize("algo", ["tb", "sw"])
+@pytest.mark.parametrize("s_rows,out_np", [
+    (512, np.uint8),      # rank_bits 21 — the supported ceiling
+    (1024, np.uint16),    # uint16 counts wire format
+    (4096, np.uint8),     # rank_bits 18, multi-block windows
+])
+def test_fused_matches_xla_digest(algo, s_rows, out_np):
+    """Multi-step randomized differential: identical counts AND state
+    vs the composed-XLA step, across geometries and counts dtypes,
+    including clamp-sentinel counts and padding tails."""
+    rng = np.random.default_rng(19 + s_rows)
+    rb = 31 - int(s_rows).bit_length()
+    table = LimiterTable()
+    lid = jnp.int32(table.register(RateLimitConfig(
+        max_permits=min(9, (1 << rb) - 2), window_ms=900,
+        refill_rate=4.0)))
+    tarr = table.device_arrays
+    jdt = jnp.uint8 if out_np == np.uint8 else jnp.uint16
+    ref_fn = jax.jit(functools.partial(
+        relay.tb_relay_counts if algo == "tb" else relay.sw_relay_counts,
+        rank_bits=rb, out_dtype=jdt))
+    fused_fn = jax.jit(functools.partial(
+        rs.tb_relay_counts_fused if algo == "tb"
+        else rs.sw_relay_counts_fused,
+        rank_bits=rb, out_dtype=jdt, interpret=True))
+    make = make_tb_packed if algo == "tb" else make_sw_packed
+    st_r, st_f = make(s_rows), make(s_rows)
+    now = 1
+    for step in range(6):
+        now += int(rng.integers(0, 1300))
+        u = 512 if s_rows == 512 else int(rng.choice([512, 1024]))
+        uw, _, _ = _sorted_uwords(rng, s_rows, u,
+                                  int(rng.integers(1, u)), rb,
+                                  clamp_some=step % 2 == 0)
+        uw_j = jnp.asarray(uw)
+        st_r, want = ref_fn(st_r, tarr, uw_j, lid, jnp.int64(now))
+        st_f, got = fused_fn(st_f, tarr, uw_j, lid, jnp.int64(now))
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
+                                      err_msg=f"{algo} step {step}")
+        np.testing.assert_array_equal(np.asarray(st_r), np.asarray(st_f),
+                                      err_msg=f"{algo} state {step}")
+
+
+@pytest.mark.parametrize("algo", ["tb", "sw"])
+def test_fused_matches_oracle_with_clears(algo, fused_interpret):
+    """Engine-dispatch soak against the executable oracle with clear
+    interleavings: keys map 1:1 to slots, duplicate-heavy batches, and
+    slots cleared mid-stream (reset semantics) — every decision must
+    match semantics/oracle.py exactly, through the ELECTED fused path."""
+    import random
+
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+    from ratelimiter_tpu.semantics import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+
+    s_rows = 1 << 12
+    table = LimiterTable()
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+        oracle = SlidingWindowOracle(cfg)
+    else:
+        cfg = RateLimitConfig(max_permits=8, window_ms=1500,
+                              refill_rate=5.0)
+        oracle = TokenBucketOracle(cfg)
+    lid = table.register(cfg)
+    eng = DeviceEngine(num_slots=s_rows, table=table)
+    assert eng._relay_fused_ok(algo, 4096), "fused path not elected"
+    rb = eng.rank_bits
+    dispatch = (eng.sw_relay_counts_dispatch if algo == "sw"
+                else eng.tb_relay_counts_dispatch)
+    clear = eng.sw_clear if algo == "sw" else eng.tb_clear
+    rng = np.random.default_rng(29)
+    pyrng = random.Random(29)
+    now = 3_000_000
+    for step in range(10):
+        now += pyrng.randrange(0, 900)
+        keys = rng.integers(0, 40, 500)  # key == slot (identity index)
+        order, uidx0, rank = {}, np.empty(500, np.int32), np.empty(
+            500, np.int32)
+        counts: dict = {}
+        for i, k in enumerate(keys):
+            if k not in order:
+                order[k] = len(order)
+            r = counts.get(k, 0)
+            counts[k] = r + 1
+            uidx0[i] = order[k]
+            rank[i] = r
+        uslots = np.asarray(sorted(order), dtype=np.uint32)
+        ucnt = np.asarray([counts[s] for s in uslots], dtype=np.uint32)
+        # uidx into the SORTED unique lane (the wire order).
+        pos_of = {s: j for j, s in enumerate(uslots)}
+        uidx = np.asarray([pos_of[k] for k in keys], dtype=np.int32)
+        uw = np.full(4096, 0xFFFFFFFF, dtype=np.uint32)
+        uw[:len(uslots)] = ((uslots << np.uint32(rb + 1))
+                            | (ucnt << np.uint32(1)))
+        got_counts = np.asarray(dispatch(uw, np.int32(lid), now,
+                                         np.uint8, slots_sorted=True))
+        got = rank < got_counts[:len(uslots)].astype(np.int32)[uidx]
+        for j, k in enumerate(keys):
+            want = oracle.try_acquire(f"k{k}", 1, now).allowed
+            assert got[j] == want, (algo, step, j, int(k))
+        if pyrng.random() < 0.5:
+            victims = [int(pyrng.choice(list(keys))) for _ in range(3)]
+            clear(victims)
+            for v in victims:
+                oracle.reset(f"k{v}", now)
+
+
+def test_fused_election_gates_dispatch(monkeypatch):
+    """Election env overrides must flip the engine's backend choice:
+    _ELECT off => composed XLA even when the kernel is live; on CPU
+    without interpret the fused path must never be live at all."""
+    from ratelimiter_tpu.engine.engine import DeviceEngine
+
+    table = LimiterTable()
+    table.register(RateLimitConfig(max_permits=9, window_ms=1000,
+                                   refill_rate=4.0))
+    eng = DeviceEngine(num_slots=1 << 12, table=table)
+    # Plain CPU: not live (platform gate, before any probe/election).
+    assert not eng._relay_fused_ok("tb", 4096)
+    # Interpret forced but election forced OFF: still not live.
+    monkeypatch.setattr(rs, "_INTERPRET", True)
+    monkeypatch.setattr(rs, "_probe_ok", None)
+    monkeypatch.setenv("RATELIMITER_PALLAS_ELECT_RELAY_FUSED", "off")
+    election.reset_for_tests()
+    try:
+        assert not eng._relay_fused_ok("tb", 4096)
+    finally:
+        election.reset_for_tests()
+    # Geometry gates regardless of election: unpadded/odd lanes, tiny
+    # tables, oversized rank_bits.
+    assert not rs.supported((1 << 12, 4), 1000, 10)    # batch % T != 0
+    assert not rs.supported((1 << 12, 4), 256, 10)     # batch < 2T
+    assert not rs.supported((100, 4), 4096, 10)        # rows % T != 0
+    assert not rs.supported((1 << 12, 4), 4096, 22)    # rank_bits > 21
+
+
+def test_election_record_consistency(monkeypatch, tmp_path):
+    """A measured election must persist a record whose verdict matches
+    its own A/B times, and the disk cache must round-trip."""
+    calls = {"n": 0}
+
+    def fake_measure():
+        calls["n"] += 1
+        return {"pallas_s": 2.0, "xla_s": 1.0}   # XLA clearly wins
+
+    monkeypatch.setattr(election, "_cache_path",
+                        lambda name: str(tmp_path / f"{name}.json"))
+    election.reset_for_tests()
+    try:
+        assert election.measured_election("t_path", fake_measure) is False
+        rec = election.report()["t_path"]
+        assert rec["elected"] == (
+            rec["pallas_s"] <= rec["margin"] * rec["xla_s"])
+        # Second resolve: in-process cache, no re-measure.
+        assert election.measured_election("t_path", fake_measure) is False
+        assert calls["n"] == 1
+        # Fresh process simulation: disk cache serves the verdict.
+        election.reset_for_tests()
+        assert election.measured_election("t_path", fake_measure) is False
+        assert calls["n"] == 1
+        assert election.report()["t_path"]["source"] == "disk_cache"
+    finally:
+        election.reset_for_tests()
+
+
+@pytest.mark.parametrize("algo", ["tb", "sw"])
+def test_storage_stream_fused_matches_unfused(monkeypatch, algo,
+                                              fused_interpret):
+    """Storage-level parity: the relay stream with the fused kernel
+    elected must decide exactly like a storage running the composed
+    path on the same stream (sorted digest chunks, pins + evictions +
+    clears exercised by the real index)."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.engine.native_index import native_available
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    if not native_available():
+        pytest.skip("needs the native index (sort_uniques)")
+    monkeypatch.setattr(tpu_mod, "_SORT_UNIQUES_MIN", 1 << 9)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 1 << 12)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 1 << 12)
+    now = [4_000_000]
+    rng = np.random.default_rng(31)
+    st_f = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+    else:
+        cfg = RateLimitConfig(max_permits=9, window_ms=1200,
+                              refill_rate=4.0)
+    lid_f = st_f.register_limiter(algo, cfg)
+    assert st_f.engine._relay_fused_ok(algo, 1 << 12)
+    # The reference storage: fused disabled at its engine (instance
+    # shadow — both engines share the module-level interpret override).
+    st_r = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    lid_r = st_r.register_limiter(algo, cfg)
+    st_r.engine._relay_fused_ok = lambda algo, u: False
+    try:
+        for rep in range(3):
+            # Duplicate-heavy so the digest mode is elected; > 512
+            # uniques so the sorted path engages.
+            ids = rng.integers(0, 1500, 1 << 12)
+            a = st_f.acquire_stream_ids(algo, lid_f, ids, None)
+            b = st_r.acquire_stream_ids(algo, lid_r, ids, None)
+            np.testing.assert_array_equal(a, b, err_msg=f"rep {rep}")
+            if rep == 1:
+                k = int(ids[0])
+                st_f.reset_key(algo, lid_f, k)
+                st_r.reset_key(algo, lid_r, k)
+            now[0] += 533
+        # The fused jit must actually have served (not a vacuous pass).
+        assert any(len(k) > 2 and k[2] == "fused"
+                   for k in st_f.engine._relay_counts), (
+            "fused path never engaged in the stream")
+    finally:
+        st_f.close()
+        st_r.close()

@@ -16,6 +16,51 @@ pytestmark = pytest.mark.skipif(not native_available(),
                                 reason="native index unavailable")
 
 
+def test_auto_host_parallel_election(monkeypatch):
+    """r7: TpuBatchedStorage auto-elects host_parallel=min(cores, 8)
+    for large single-device tables; explicit kwargs always win; small
+    tables, few cores, and checkpointable deployments stay single-LRU."""
+    import ratelimiter_tpu.storage.tpu as tpu_mod
+    from ratelimiter_tpu.engine.partitioned import PartitionedSlotIndex
+
+    def with_cores(n):
+        monkeypatch.setattr(tpu_mod.os, "sched_getaffinity",
+                            lambda pid: set(range(n)), raising=False)
+
+    with_cores(6)
+    st = TpuBatchedStorage(num_slots=1 << 16)
+    # 6 does not divide 2^16: the election walks down to 4 partitions.
+    assert st._host_parallel == 4
+    assert isinstance(st._index["tb"], PartitionedSlotIndex)
+    st.close()
+    # Explicit kwarg wins — both directions.
+    st = TpuBatchedStorage(num_slots=1 << 16, host_parallel=0)
+    assert st._host_parallel == 0
+    st.close()
+    st = TpuBatchedStorage(num_slots=1 << 16, host_parallel=2)
+    assert st._host_parallel == 2
+    assert st._index["tb"].n_parts == 2
+    st.close()
+    # Cores capped at 8; non-dividing counts walk down.
+    with_cores(64)
+    st = TpuBatchedStorage(num_slots=1 << 16)
+    assert st._host_parallel == 8
+    st.close()
+    # Small tables and <= 2 cores stay single-LRU.
+    st = TpuBatchedStorage(num_slots=1 << 12)
+    assert st._host_parallel == 0
+    st.close()
+    with_cores(2)
+    st = TpuBatchedStorage(num_slots=1 << 16)
+    assert st._host_parallel == 0
+    st.close()
+    # Checkpointable keeps the enumerable Python index.
+    with_cores(6)
+    st = TpuBatchedStorage(num_slots=1 << 16, checkpointable=True)
+    assert st._host_parallel == 0
+    st.close()
+
+
 def test_partitioned_stream_matches_plain():
     now = [9_000_000]
     st_p = TpuBatchedStorage(num_slots=1 << 12, host_parallel=4,

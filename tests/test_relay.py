@@ -152,6 +152,68 @@ def test_relay_clamped_ranks(table, algo, lid):
     np.testing.assert_array_equal(truth_state[:1], np.asarray(st_c)[:1])
 
 
+@pytest.mark.parametrize("algo,lid", [("sw", 1), ("tb", 2), ("tb", 3)])
+def test_relay_digest_both_backends_match_flat(table, algo, lid,
+                                               monkeypatch):
+    """The digest parity of test_relay_matches_flat, run on BOTH digest
+    backends: the composed-XLA step and the fused Pallas relay kernel
+    (interpret mode, elected through the real engine dispatch).  Both
+    must reproduce the sorted flat step bit-for-bit and leave identical
+    state."""
+    from ratelimiter_tpu.ops.pallas import election
+    from ratelimiter_tpu.ops.pallas import relay_step as rs
+
+    monkeypatch.setattr(rs, "_INTERPRET", True)
+    monkeypatch.setattr(rs, "_probe_ok", None)
+    election.reset_for_tests()
+    try:
+        rng = np.random.default_rng(11)
+        num_slots = 512  # fused floor: >= 2 Pallas blocks
+        e_flat = DeviceEngine(num_slots=num_slots, table=table)
+        e_xla = DeviceEngine(num_slots=num_slots, table=table)
+        e_fused = DeviceEngine(num_slots=num_slots, table=table)
+        e_xla._relay_fused_ok = lambda algo, u: False  # force composed
+        assert e_fused._relay_fused_ok(algo, num_slots)
+        rb = e_fused.rank_bits
+        dispatch_of = {
+            e_xla: (e_xla.sw_relay_counts_dispatch if algo == "sw"
+                    else e_xla.tb_relay_counts_dispatch),
+            e_fused: (e_fused.sw_relay_counts_dispatch if algo == "sw"
+                      else e_fused.tb_relay_counts_dispatch),
+        }
+
+        def digest_sorted(engine, slots, now):
+            rank, uidx, order, counts = _truth_structure(slots)
+            perm = np.argsort(order)
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(len(perm))
+            clamp = (1 << rb) - 1
+            uw = np.full(num_slots, 0xFFFFFFFF, dtype=np.uint32)
+            uw[:len(order)] = (
+                (order[perm].astype(np.uint32) << np.uint32(rb + 1))
+                | (np.minimum(counts[perm], clamp).astype(np.uint32)
+                   << np.uint32(1)))
+            out = np.asarray(dispatch_of[engine](
+                uw, np.int32(lid), now, np.uint8, slots_sorted=True))
+            return rank < out[:len(order)].astype(np.int32)[inv[uidx]]
+
+        for now in (1_000_000, 1_000_123, 1_000_750, 1_004_000):
+            slots = rng.integers(0, 9, 240).astype(np.int32)
+            a = _flat(e_flat, algo, slots, lid, now)
+            b = digest_sorted(e_xla, slots, now)
+            c = digest_sorted(e_fused, slots, now)
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(a, c)
+            np.testing.assert_array_equal(
+                _state(e_flat, algo), _state(e_xla, algo))
+            np.testing.assert_array_equal(
+                _state(e_flat, algo), _state(e_fused, algo))
+        assert any(len(k) > 2 and k[2] == "fused"
+                   for k in e_fused._relay_counts)
+    finally:
+        election.reset_for_tests()
+
+
 def test_relay_usable_gate():
     """A policy whose max_permits exceeds the clamp must disable relay."""
     t = LimiterTable()

@@ -86,11 +86,20 @@ else
   fail=1
 fi
 
-echo "running sharded perf smoke (CPU, 2 virtual shards >= 0.9x of 1)..."
-if timeout -k 10 600 python bench/perf_smoke.py; then
-  echo "  ok  sharded perf smoke"
+echo "regenerating CAPABILITIES.md test/LoC counts..."
+if python bench/gen_capabilities.py; then
+  echo "  ok  capability counts"
 else
-  echo "  FAILED  sharded perf smoke (scaling inversion)"
+  echo "  FAILED  capability count generation"
+  fail=1
+fi
+
+echo "running perf smokes (sharded scaling >= 0.9x + relay election)..."
+if timeout -k 10 900 python bench/perf_smoke.py; then
+  echo "  ok  perf smokes"
+else
+  echo "  FAILED  perf smokes (scaling inversion or election picked a"
+  echo "          measured-slower relay backend)"
   fail=1
 fi
 
