@@ -105,6 +105,7 @@ class MicroBatcher:
         self.deadline_total = 0       # queued requests expired pre-dispatch
         self.max_depth_seen = 0       # high-water mark of any algo queue
         self.last_shed_s = 0.0        # monotonic stamp of the last shed
+        self.abandoned_total = 0      # queued requests withdrawn via forget()
         self._shed_counter = (
             meter_registry.counter(
                 "ratelimiter.overload.shed",
@@ -214,6 +215,37 @@ class MicroBatcher:
         """Slots referenced by queued requests (pin set for eviction)."""
         with self._cv:
             return set(self._pending[algo].slots)
+
+    def forget(self, futures) -> int:
+        """Withdraw still-QUEUED requests whose futures the caller has
+        abandoned (e.g. a sidecar connection died mid-burst): they are
+        removed from the pending queue and cancelled, so a dead client's
+        frames stop consuming device capacity and their slots stop
+        pinning eviction.  Requests already dispatched are untouched —
+        their futures resolve normally (the caller must still consume
+        those).  Returns the number withdrawn."""
+        targets = set(futures)
+        removed: List[Future] = []
+        with self._cv:
+            for pend in self._pending.values():
+                if not pend.futures or targets.isdisjoint(pend.futures):
+                    continue
+                keep = [i for i, f in enumerate(pend.futures)
+                        if f not in targets]
+                removed.extend(f for f in pend.futures if f in targets)
+                for name in ("slots", "lids", "permits", "futures",
+                             "deadlines"):
+                    vals = getattr(pend, name)
+                    setattr(pend, name, [vals[i] for i in keep])
+                if not pend.slots and not pend.clears:
+                    # An empty queue must not keep waking the flusher.
+                    pend.born = None
+            for fut in removed:
+                self._waiters.discard(fut)
+        for fut in removed:
+            fut.cancel()
+        self.abandoned_total += len(removed)
+        return len(removed)
 
     # -- flushing -------------------------------------------------------------
     def _take(self, algo: str) -> _Pending | None:

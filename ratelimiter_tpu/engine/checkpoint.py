@@ -33,6 +33,7 @@ import json
 import os
 import tempfile
 import time
+import zlib
 from typing import Dict, Optional
 
 import numpy as np
@@ -43,8 +44,32 @@ import numpy as np
 # field set, so v1 checkpoints load in v2 binaries (the extra tb_deadline
 # array is ignored); v2 checkpoints refuse to load in v1 binaries via the
 # version check rather than failing on a missing array.
-FORMAT_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+# v3 adds integrity: per-array CRC32s + a manifest checksum over
+# index.json itself (a bit-flipped or torn dump must refuse to restore
+# with a typed CheckpointCorruptError, not silently hand stale/garbage
+# counters to live traffic).  v1/v2 dumps predate the checksums and
+# still restore (nothing to verify).
+FORMAT_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
+
+
+class CheckpointCorruptError(ValueError):
+    """The checkpoint failed integrity verification (bit flip, torn
+    write, truncated state.npz): restore refuses rather than loading
+    corrupted counters."""
+
+
+def _array_crc(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _manifest_crc(meta: Dict) -> int:
+    """CRC of the canonical JSON of the manifest (everything except the
+    stored checksum itself) — json.dumps(sort_keys=True) is stable
+    across the dump/load round trip, independent of file formatting."""
+    canon = json.dumps({k: v for k, v in meta.items()
+                        if k != "manifest_crc"}, sort_keys=True)
+    return zlib.crc32(canon.encode()) & 0xFFFFFFFF
 
 # Identity of the key->shard routing hash used by sharded indexes
 # (parallel/sharded.py:shard_of_key): FNV-fingerprint h1 for string/bytes
@@ -140,6 +165,12 @@ def save_checkpoint(path: str, engine, index_dump: Optional[Dict] = None) -> Non
         arrays.update({f"tb_{k}": v for k, v in snap["tb"].items()})
         snap["meta"]["index"] = _detach_index_arrays(
             snap["meta"].get("index", {}), arrays)
+        # Integrity (v3): per-array CRC32s, then a manifest checksum over
+        # the final metadata so a flipped byte in index.json itself is
+        # also caught at load.
+        snap["meta"]["checksums"] = {
+            name: _array_crc(arr) for name, arr in arrays.items()}
+        snap["meta"]["manifest_crc"] = _manifest_crc(snap["meta"])
         np.savez(os.path.join(tmp, "state.npz"), **arrays)
         with open(os.path.join(tmp, "index.json"), "w") as fh:
             json.dump(snap["meta"], fh)
@@ -164,7 +195,35 @@ def load_checkpoint(path: str) -> Dict:
         meta = json.load(fh)
     if meta.get("format") not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported checkpoint format: {meta.get('format')}")
-    data = dict(np.load(os.path.join(path, "state.npz")))
+    verify = meta.get("format", 0) >= 3
+    if verify:
+        stored = meta.get("manifest_crc")
+        if stored is None or _manifest_crc(meta) != int(stored):
+            raise CheckpointCorruptError(
+                f"checkpoint manifest checksum mismatch in {path}/"
+                "index.json: the manifest is corrupted or was edited — "
+                "refusing to restore")
+    try:
+        # dict() forces every lazily-loaded array out of the zip, so a
+        # truncated/torn state.npz fails HERE, typed, not mid-restore.
+        data = dict(np.load(os.path.join(path, "state.npz")))
+    except CheckpointCorruptError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — torn/truncated archive
+        raise CheckpointCorruptError(
+            f"checkpoint state.npz in {path} is unreadable (torn or "
+            f"truncated write?): {exc}") from exc
+    if verify:
+        for name, crc in meta.get("checksums", {}).items():
+            if name not in data:
+                raise CheckpointCorruptError(
+                    f"checkpoint array {name!r} listed in the manifest is "
+                    f"missing from state.npz in {path}")
+            if _array_crc(data[name]) != int(crc):
+                raise CheckpointCorruptError(
+                    f"checkpoint array {name!r} failed its CRC32 in "
+                    f"{path} (bit flip or torn write) — refusing to "
+                    "restore")
     meta["index"] = _attach_index_arrays(meta.get("index", {}), data)
     return {"meta": meta, "arrays": data}
 

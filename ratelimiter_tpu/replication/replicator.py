@@ -71,6 +71,14 @@ class Replicator:
     def ship_now(self) -> int:
         """Cut an epoch and ship it; returns frames shipped (0 = clean)."""
         with self._ship_lock:
+            # A sink that reconnected since the last cycle may be talking
+            # to a RESTARTED standby with empty state: re-baseline with a
+            # full frame before shipping more deltas into a gap.
+            consume = getattr(self.sink, "consume_reconnected", None)
+            if consume is not None and consume():
+                _log.warning("replication link reconnected; re-baselining "
+                             "with a full frame")
+                self.log.request_full()
             frames = self.log.cut()
             if self._m_lag is not None:
                 self._m_lag.set(self.log.last_cut_lag_ms)

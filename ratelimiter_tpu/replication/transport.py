@@ -15,10 +15,12 @@ chaos drill) then exercises the exact bytes the TCP path ships.
 
 from __future__ import annotations
 
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 ACK_OK = 0
 ACK_ERROR = 1
@@ -74,39 +76,92 @@ class FrameArchive:
 
 
 class SocketSink:
-    """Primary-side TCP sender with per-frame acks.
+    """Primary-side TCP sender with per-frame acks and bounded retry.
 
-    Connects lazily and reconnects on the next send after a failure, so
-    a standby restart does not wedge the replicator permanently.
+    Connects lazily.  A broken pipe (standby restart, flaky link) does
+    NOT error the ship cycle immediately: ``send`` retries the frame up
+    to ``max_retries`` times with capped exponential backoff + jitter,
+    reconnecting each time — a blip never errors out of the replication
+    thread, only a sustained outage does (and the replicator's existing
+    failure path then re-marks + requests a full frame).  Frames are
+    idempotent (absolute rows, monotonic epochs), so a retry after a
+    lost ack can only re-apply what the standby already holds.
+
+    Any reconnect raises :meth:`consume_reconnected` once: the standby
+    behind the fresh connection may be a RESTARTED process with empty
+    state, so the replicator re-baselines with a ``full`` frame on its
+    next cycle instead of shipping deltas into a void (the receiver's
+    gap detection would catch it anyway — the full frame makes recovery
+    immediate rather than promoted-blocked).
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 max_retries: int = 4, backoff_ms: float = 50.0,
+                 backoff_cap_ms: float = 2000.0, seed: int = 0):
         self.host = host
         self.port = int(port)
         self.timeout = float(timeout)
+        self.max_retries = int(max_retries)
+        self.backoff_ms = float(backoff_ms)
+        self.backoff_cap_ms = float(backoff_cap_ms)
+        self.reconnects = 0
+        self._rng = random.Random(seed)
         self._sock: socket.socket | None = None
+        self._ever_connected = False
+        self._reconnected = False
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ever_connected:
+            self._reconnected = True
+            self.reconnects += 1
+        self._ever_connected = True
         return sock
 
-    def send(self, data: bytes) -> None:
+    def consume_reconnected(self) -> bool:
+        """True once per reconnect since the last call — the replicator
+        re-baselines with a full frame when it sees it."""
         with self._lock:
-            try:
-                if self._sock is None:
-                    self._sock = self._connect()
-                self._sock.sendall(_LEN.pack(len(data)) + data)
-                ack = self._recv_exact(1)
-            except OSError:
-                self._drop()
-                raise
-            if ack[0] != ACK_OK:
-                self._drop()
-                raise ConnectionError(
-                    f"standby rejected replication frame (ack={ack[0]})")
+            seen = self._reconnected
+            self._reconnected = False
+            return seen
+
+    def send(self, data: bytes) -> None:
+        payload = _LEN.pack(len(data)) + data
+        with self._lock:
+            last_exc: OSError | None = None
+            for attempt in range(self.max_retries + 1):
+                if attempt:
+                    delay_ms = min(self.backoff_cap_ms,
+                                   self.backoff_ms * (2 ** (attempt - 1)))
+                    # Jitter in [0.5x, 1.5x): reconnect stampedes from
+                    # many primaries must not synchronize.
+                    time.sleep(delay_ms * (0.5 + self._rng.random())
+                               / 1000.0)
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.sendall(payload)
+                    ack = self._recv_exact(1)
+                except OSError as exc:
+                    self._drop()
+                    last_exc = exc
+                    continue
+                if ack[0] != ACK_OK:
+                    # The standby REJECTED the frame (geometry mismatch,
+                    # decode error) — not a link fault; retrying the same
+                    # bytes cannot help.  Let the replicator's failure
+                    # path re-mark and re-baseline.
+                    self._drop()
+                    raise ConnectionError(
+                        f"standby rejected replication frame (ack={ack[0]})")
+                return
+            raise ConnectionError(
+                f"replication link to {self.host}:{self.port} down after "
+                f"{self.max_retries + 1} attempts") from last_exc
 
     def _recv_exact(self, n: int) -> bytes:
         buf = b""

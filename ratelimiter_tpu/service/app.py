@@ -50,6 +50,74 @@ def _now_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+def health_payload(ctx: AppContext) -> dict:
+    """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
+
+    - DOWN: the backend is unavailable (or the breaker is open with no
+      degraded fallback and fail-open off) — only DOWN returns 503.
+    - DEGRADED: the breaker is open/half-open; decisions are served by
+      the degraded host limiter (or fail-open).
+    - SHEDDING: admission control shed requests within the health
+      window — the micro-batcher's queue bound / deadline sheds AND the
+      sidecar's per-connection pipeline sheds both count (the TCP front
+      door participates in the same state machine as the HTTP tier).
+    - UP: everything on the device path.
+
+    Module-level so drills can evaluate the state machine without an
+    HTTP server in the loop.
+    """
+    try:
+        storage_up = bool(ctx.storage.is_available())
+    except Exception:  # noqa: BLE001 — an erroring health probe is DOWN
+        storage_up = False
+    breaker = getattr(ctx, "breaker", None)
+    batcher = getattr(ctx.storage, "_batcher", None)
+    sidecar = getattr(ctx, "sidecar", None)
+    payload: dict = {"storage": {"available": storage_up}}
+    shedding = False
+    window_s = ctx.props.get_float(
+        "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
+
+    def _recent(stamp: float) -> bool:
+        return stamp > 0 and (time.monotonic() - stamp) <= window_s
+
+    if batcher is not None:
+        shedding = _recent(float(getattr(batcher, "last_shed_s", 0.0)))
+        payload["overload"] = {
+            "queue_depth": batcher.queue_depth(),
+            "max_pending": batcher.max_pending,
+            "shed_total": batcher.shed_total,
+            "deadline_expired_total": batcher.deadline_total,
+        }
+    if sidecar is not None:
+        shedding = shedding or _recent(
+            float(getattr(sidecar, "last_shed_s", 0.0)))
+        payload["sidecar"] = {
+            "connections": sidecar.connections(),
+            "in_flight": sidecar.inflight(),
+            "malformed_total": sidecar.malformed_total,
+            "idle_closed_total": sidecar.idle_closed_total,
+            "pipeline_shed_total": sidecar.pipeline_shed_total,
+            "refused_total": sidecar.refused_total,
+        }
+    if breaker is not None:
+        payload["breaker"] = breaker.status()
+        if breaker.fallback is not None:
+            payload["degraded"] = {
+                "touched_keys": len(breaker.fallback.touched())}
+    if breaker is not None and breaker.state != "closed":
+        degraded_serving = (breaker.fallback is not None
+                            or ctx.fail_open)
+        payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
+    elif not storage_up:
+        payload["status"] = "DOWN"
+    elif shedding:
+        payload["status"] = "SHEDDING"
+    else:
+        payload["status"] = "UP"
+    return payload
+
+
 class RateLimiterHandler(BaseHTTPRequestHandler):
     ctx: AppContext  # injected by make_server
 
@@ -183,52 +251,7 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
 
     # -- health state machine -------------------------------------------------
     def _health_payload(self) -> dict:
-        """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
-
-        - DOWN: the backend is unavailable (or the breaker is open with no
-          degraded fallback and fail-open off) — only DOWN returns 503.
-        - DEGRADED: the breaker is open/half-open; decisions are served by
-          the degraded host limiter (or fail-open).
-        - SHEDDING: admission control shed requests within the health
-          window; the service is healthy but at capacity.
-        - UP: everything on the device path.
-        """
-        ctx = self.ctx
-        try:
-            storage_up = bool(ctx.storage.is_available())
-        except Exception:  # noqa: BLE001 — an erroring health probe is DOWN
-            storage_up = False
-        breaker = getattr(ctx, "breaker", None)
-        batcher = getattr(ctx.storage, "_batcher", None)
-        payload: dict = {"storage": {"available": storage_up}}
-        shedding = False
-        if batcher is not None:
-            window_s = ctx.props.get_float(
-                "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
-            last = float(getattr(batcher, "last_shed_s", 0.0))
-            shedding = last > 0 and (time.monotonic() - last) <= window_s
-            payload["overload"] = {
-                "queue_depth": batcher.queue_depth(),
-                "max_pending": batcher.max_pending,
-                "shed_total": batcher.shed_total,
-                "deadline_expired_total": batcher.deadline_total,
-            }
-        if breaker is not None:
-            payload["breaker"] = breaker.status()
-            if breaker.fallback is not None:
-                payload["degraded"] = {
-                    "touched_keys": len(breaker.fallback.touched())}
-        if breaker is not None and breaker.state != "closed":
-            degraded_serving = (breaker.fallback is not None
-                                or ctx.fail_open)
-            payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
-        elif not storage_up:
-            payload["status"] = "DOWN"
-        elif shedding:
-            payload["status"] = "SHEDDING"
-        else:
-            payload["status"] = "UP"
-        return payload
+        return health_payload(self.ctx)
 
     # -- endpoint bodies ------------------------------------------------------
     def _get_data(self):

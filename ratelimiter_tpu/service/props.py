@@ -59,6 +59,24 @@ DEFAULTS = {
     # max_keys bounds the last-seen-counter snapshot cache.
     "ratelimiter.degraded.enabled": "true",
     "ratelimiter.degraded.max_keys": "65536",
+    # Decision sidecar (service/sidecar.py): binary TCP ingress funneling
+    # every connection into the shared micro-batcher.  OFF by default —
+    # when enabled, build_app starts it next to the HTTP tier on
+    # sidecar.port.  The hardening bounds (0 disables each): frame/key
+    # size caps answered in-protocol with BAD_FRAME, per-connection
+    # pipeline cap shed with a typed retry-after status, global
+    # connection limit, idle/read deadlines (slowloris), the bound on
+    # waiting for a wedged batch, and the graceful-drain budget of stop().
+    "ratelimiter.sidecar.enabled": "false",
+    "ratelimiter.sidecar.port": "7400",
+    "ratelimiter.sidecar.max_frame_bytes": "4096",
+    "ratelimiter.sidecar.max_key_bytes": "1024",
+    "ratelimiter.sidecar.max_pipeline": "1024",
+    "ratelimiter.sidecar.max_connections": "1024",
+    "ratelimiter.sidecar.idle_timeout_ms": "60000",
+    "ratelimiter.sidecar.read_timeout_ms": "5000",
+    "ratelimiter.sidecar.resolve_timeout_ms": "30000",
+    "ratelimiter.sidecar.drain_timeout_ms": "1000",
     # Shard the slot array over all visible devices when > 1.
     "parallel.shard": "auto",
     # Compile hot dispatch shapes at boot (moves 40-90s/shape jit stalls
@@ -101,17 +119,26 @@ _INT_KEYS = (
     "batcher.max_inflight", "storage.retry.max_retries",
     "replication.listen_port", "ratelimiter.overload.max_pending",
     "breaker.failure_threshold", "breaker.half_open_probes",
-    "ratelimiter.degraded.max_keys",
+    "ratelimiter.degraded.max_keys", "ratelimiter.sidecar.port",
+    "ratelimiter.sidecar.max_frame_bytes",
+    "ratelimiter.sidecar.max_key_bytes",
+    "ratelimiter.sidecar.max_pipeline",
+    "ratelimiter.sidecar.max_connections",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
     "storage.retry.delay_ms", "replication.interval_ms",
     "ratelimiter.overload.deadline_ms",
     "ratelimiter.overload.shed_health_window_ms", "breaker.open_ms",
+    "ratelimiter.sidecar.idle_timeout_ms",
+    "ratelimiter.sidecar.read_timeout_ms",
+    "ratelimiter.sidecar.resolve_timeout_ms",
+    "ratelimiter.sidecar.drain_timeout_ms",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
     "link.probe.enabled", "breaker.enabled", "ratelimiter.degraded.enabled",
+    "ratelimiter.sidecar.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

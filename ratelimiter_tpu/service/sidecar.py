@@ -7,24 +7,66 @@ server funnels every connection into the shared micro-batcher, so requests
 from *all* clients coalesce into the same device batches — the many-clients
 /one-authority topology of Redis, with the TPU engine as the authority.
 
-Wire format (little-endian), deliberately RESP-simple so any language can
-speak it in ~30 lines:
+Wire format v2 (little-endian), deliberately RESP-simple so any language
+can speak it in ~30 lines:
 
-  request  :=  u32 len | u8 op | u32 limiter_id | u32 permits | key bytes
+  request  :=  u32 len | u8 op | u32 a | u32 b | key bytes
   response :=  u32 len | u8 status | u8 allowed | i64 remaining
 
-  op: 1 = TRY_ACQUIRE   (allowed + remaining hint)
-      2 = AVAILABLE     (remaining permits; allowed unused)
-      3 = RESET         (admin)
+  op: 1 = TRY_ACQUIRE   (a=limiter id, b=permits; allowed + remaining hint)
+      2 = AVAILABLE     (a=limiter id; remaining permits; allowed unused)
+      3 = RESET         (a=limiter id; admin)
       4 = PING          (health; allowed=1 when storage is up)
-  status: 0 = ok, 1 = error (remaining carries an errno)
+      5 = HELLO         (v2 handshake: a=client protocol version, b=flags;
+                         response: allowed=negotiated version,
+                         remaining=server max frame bytes)
+  status: 0 = OK
+          1 = ERROR          (generic; remaining carries an errno — the only
+                              error status v1 clients ever see)
+          2 = SHED           (admission control refused the frame; remaining
+                              carries a retry-after hint in ms)
+          3 = SHUTTING_DOWN  (server is draining; reconnect elsewhere)
+          4 = BAD_FRAME      (malformed frame, answered in-protocol;
+                              remaining carries an errno)
+
+**Versioning.**  A v2 client's first frame is HELLO; the server answers
+with the negotiated version and its frame-size cap, and from then on may
+use the typed v2 statuses.  A v1 client never sends HELLO — the server
+serves it unchanged, downgrading every v2-only status to the generic
+``ERROR`` (status 1) with a matching errno, so old clients keep their
+"status != 0 means error" contract and never desync.
+
+**Ingress hardening.**  Every byte on the wire is untrusted:
+
+- frames are validated (max frame length, max key length, UTF-8 key,
+  short-frame and unknown-op checks) and violations are answered with a
+  typed ``BAD_FRAME`` status *in protocol* — the length prefix keeps the
+  stream in sync, so one bad frame never kills the connection.  A frame
+  DECLARING more than ``max_frame_bytes`` is rejected immediately and its
+  payload is discarded as it streams (never buffered), so a hostile
+  length prefix cannot balloon memory;
+- per-connection deadlines: ``idle_timeout_ms`` between requests and the
+  stricter ``read_timeout_ms`` once a frame has started (slowloris — a
+  half-written frame must not pin a handler thread), enforced by socket
+  timeouts on both reads and writes (a client that stops reading its
+  responses hits the same bound);
+- per-connection pipeline cap: at most ``max_pipeline`` decision frames
+  in flight per connection; excess frames are shed with the typed
+  ``SHED`` status + retry-after hint (mirroring the micro-batcher's
+  ``queue_full`` admission control, which the sidecar also relays);
+- a global ``max_connections`` bound (excess accepts are closed);
+- graceful drain: ``stop()`` first marks the server draining — in-flight
+  frames resolve, new decision frames answer ``SHUTTING_DOWN`` — and
+  only then tears connections down.  A client that disconnects mid-burst
+  never leaks a batcher future: still-queued frames are withdrawn from
+  the batcher (``MicroBatcher.forget``) and dispatched ones are consumed
+  via done-callbacks.
 
 Requests may be pipelined: a client can write N frames before reading N
 responses (the provided ``SidecarClient.acquire_batch`` does exactly this),
-which amortizes syscalls the way Redis pipelining does
-(the reference leans on the same trick for INCR+PEXPIRE).  The server
-honors the pipelining on the decision path: every TRY_ACQUIRE frame of
-a read burst is SUBMITTED to the micro-batcher before any is resolved
+which amortizes syscalls the way Redis pipelining does.  The server honors
+the pipelining on the decision path: every TRY_ACQUIRE frame of a read
+burst is SUBMITTED to the micro-batcher before any is resolved
 (``TpuBatchedStorage.acquire_async``), so a 64-deep pipeline coalesces
 into one device flush instead of paying 64 sequential batcher round
 trips — responses still return in request order.
@@ -40,46 +82,149 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Dict, Optional, Sequence, Tuple
+import time
+from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
 from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("service.sidecar")
 
 OP_TRY_ACQUIRE = 1
 OP_AVAILABLE = 2
 OP_RESET = 3
 OP_PING = 4
+OP_HELLO = 5
 
-_REQ_BODY = struct.Struct("<BII")    # op, lid, permits (after the u32 len)
+PROTOCOL_VERSION = 2
+
+ST_OK = 0
+ST_ERROR = 1
+ST_SHED = 2
+ST_SHUTTING_DOWN = 3
+ST_BAD_FRAME = 4
+
+ERR_UNKNOWN_OP = 1
+ERR_UNKNOWN_LIMITER = 2
+ERR_INTERNAL = 3
+ERR_SHORT_FRAME = 4
+ERR_KEY_TOO_LONG = 5
+ERR_FRAME_TOO_LONG = 6
+ERR_OVERLOADED = 7
+ERR_SHUTTING_DOWN = 8
+ERR_BAD_KEY = 9
+
+_REQ_BODY = struct.Struct("<BII")    # op, a, b (after the u32 len)
 _RESP = struct.Struct("<IBBq")       # len, status, allowed, remaining
+
+# v2-only statuses carry these errnos when downgraded for a v1 client.
+_V1_ERRNO = {ST_SHED: ERR_OVERLOADED, ST_SHUTTING_DOWN: ERR_SHUTTING_DOWN}
 
 
 def _mk_resp(status: int, allowed: int, remaining: int) -> bytes:
     return _RESP.pack(_RESP.size - 4, status, allowed, remaining)
 
-ERR_UNKNOWN_OP = 1
-ERR_UNKNOWN_LIMITER = 2
-ERR_INTERNAL = 3
+
+def _consume_future(fut) -> None:
+    """Retrieve an abandoned future's outcome so nothing stays orphaned
+    (attached as a done-callback; fires immediately if already done)."""
+    try:
+        if not fut.cancelled():
+            fut.exception()
+    except (CancelledError, Exception):  # noqa: BLE001 — consumption only
+        pass
+
+
+class _ConnState:
+    """Per-connection protocol state (owned by one handler thread)."""
+
+    __slots__ = ("version", "buf", "skip", "pending")
+
+    def __init__(self):
+        self.version = 1       # until a HELLO negotiates up
+        self.buf = b""         # unparsed wire bytes
+        self.skip = 0          # bytes of an oversized frame left to discard
+        self.pending: List = []  # current burst: response bytes | futures
 
 
 class SidecarServer:
-    """Threaded TCP server over a TpuBatchedStorage."""
+    """Threaded TCP server over a TpuBatchedStorage.
+
+    All hardening bounds accept 0/None to disable (the library default is
+    hardened; ``service/props.py`` exposes them as ``ratelimiter.sidecar.*``).
+    """
 
     def __init__(self, storage: TpuBatchedStorage, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, *,
+                 meter_registry=None,
+                 max_frame_bytes: int = 4096,
+                 max_key_bytes: int = 1024,
+                 max_pipeline: int = 1024,
+                 max_connections: int = 1024,
+                 idle_timeout_ms: float = 60_000.0,
+                 read_timeout_ms: float = 5_000.0,
+                 resolve_timeout_ms: float = 30_000.0,
+                 drain_timeout_ms: float = 1_000.0):
         self.storage = storage
+        self.max_frame_bytes = int(max_frame_bytes or 0)
+        self.max_key_bytes = int(max_key_bytes or 0)
+        self.max_pipeline = int(max_pipeline or 0)
+        self.max_connections = int(max_connections or 0)
+        self.idle_timeout_s = float(idle_timeout_ms or 0.0) / 1000.0
+        self.read_timeout_s = float(read_timeout_ms or 0.0) / 1000.0
+        self.resolve_timeout_s = float(resolve_timeout_ms or 0.0) / 1000.0
+        self.drain_timeout_s = float(drain_timeout_ms or 0.0) / 1000.0
         self._limiters: Dict[int, Tuple[str, RateLimitConfig]] = {}
         self._conns: set = set()
         self._conn_lock = threading.Lock()
         self._stopped = False
+        self._draining = False
+        self._inflight = 0           # submitted-unresolved decision futures
+        # Plain counters (always on — drills read them without a registry).
+        self.malformed_total = 0
+        self.idle_closed_total = 0
+        self.pipeline_shed_total = 0
+        self.drained_total = 0       # frames answered SHUTTING_DOWN
+        self.refused_total = 0       # accepts over max_connections
+        self.futures_abandoned = 0   # futures a dead client left behind
+        self.last_shed_s = 0.0       # monotonic stamp of the last shed
+        reg = meter_registry
+        self._m_conns = (reg.gauge(
+            "ratelimiter.sidecar.connections",
+            "Open sidecar connections") if reg is not None else None)
+        self._m_malformed = (reg.counter(
+            "ratelimiter.sidecar.malformed",
+            "Malformed sidecar frames answered with BAD_FRAME")
+            if reg is not None else None)
+        self._m_idle = (reg.counter(
+            "ratelimiter.sidecar.idle_closed",
+            "Sidecar connections closed by idle/read deadline")
+            if reg is not None else None)
+        self._m_shed = (reg.counter(
+            "ratelimiter.sidecar.pipeline_shed",
+            "Sidecar frames shed by the per-connection pipeline cap")
+            if reg is not None else None)
+        self._m_drained = (reg.counter(
+            "ratelimiter.sidecar.drained",
+            "Sidecar frames answered SHUTTING_DOWN during drain")
+            if reg is not None else None)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def setup(self):
+                self.accepted = False
                 with outer._conn_lock:
-                    if outer._stopped:
-                        # Accepted in the shutdown race window: close now
-                        # rather than serving from a closed storage.
+                    over = (outer.max_connections
+                            and len(outer._conns) >= outer.max_connections)
+                    if outer._stopped or over:
+                        if over and not outer._stopped:
+                            outer.refused_total += 1
+                        # Refused (limit) or accepted in the shutdown race
+                        # window: close now rather than serving.
                         try:
                             self.request.shutdown(socket.SHUT_RDWR)
                         except OSError:
@@ -87,40 +232,19 @@ class SidecarServer:
                         self.request.close()
                         return
                     outer._conns.add(self.request)
+                    self.accepted = True
+                    if outer._m_conns is not None:
+                        outer._m_conns.set(len(outer._conns))
 
             def finish(self):
                 with outer._conn_lock:
                     outer._conns.discard(self.request)
+                    if outer._m_conns is not None:
+                        outer._m_conns.set(len(outer._conns))
 
             def handle(self):
-                sock: socket.socket = self.request
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                buf = b""
-                while True:
-                    try:
-                        chunk = sock.recv(65536)
-                    except OSError:
-                        return
-                    if not chunk:
-                        return
-                    buf += chunk
-                    # Two-phase: submit every decision frame of this
-                    # read burst (futures), THEN resolve in order — the
-                    # whole pipeline lands in one micro-batch flush.
-                    pending = []
-                    while len(buf) >= 4:
-                        (length,) = struct.unpack_from("<I", buf)
-                        if len(buf) < 4 + length:
-                            break
-                        frame = buf[4:4 + length]
-                        buf = buf[4 + length:]
-                        pending.append(outer._begin_frame(frame))
-                    if pending:
-                        try:
-                            sock.sendall(b"".join(
-                                outer._finish_frame(p) for p in pending))
-                        except OSError:
-                            return
+                if self.accepted:
+                    outer._serve_conn(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -131,28 +255,81 @@ class SidecarServer:
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="sidecar", daemon=True)
 
+    @classmethod
+    def from_props(cls, storage, props, meter_registry=None,
+                   host: str = "0.0.0.0") -> "SidecarServer":
+        """Build from ``ratelimiter.sidecar.*`` properties."""
+        g_int, g_float = props.get_int, props.get_float
+        return cls(
+            storage, host=host,
+            port=g_int("ratelimiter.sidecar.port", 7400),
+            meter_registry=meter_registry,
+            max_frame_bytes=g_int("ratelimiter.sidecar.max_frame_bytes", 4096),
+            max_key_bytes=g_int("ratelimiter.sidecar.max_key_bytes", 1024),
+            max_pipeline=g_int("ratelimiter.sidecar.max_pipeline", 1024),
+            max_connections=g_int("ratelimiter.sidecar.max_connections", 1024),
+            idle_timeout_ms=g_float(
+                "ratelimiter.sidecar.idle_timeout_ms", 60_000.0),
+            read_timeout_ms=g_float(
+                "ratelimiter.sidecar.read_timeout_ms", 5_000.0),
+            resolve_timeout_ms=g_float(
+                "ratelimiter.sidecar.resolve_timeout_ms", 30_000.0),
+            drain_timeout_ms=g_float(
+                "ratelimiter.sidecar.drain_timeout_ms", 1_000.0),
+        )
+
     # -- limiter registry -----------------------------------------------------
     def register(self, algo: str, config: RateLimitConfig) -> int:
         lid = self.storage.register_limiter(algo, config)
         self._limiters[lid] = (algo, config)
         return lid
 
+    def expose(self, lid: int, algo: str, config: RateLimitConfig) -> int:
+        """Expose an ALREADY-registered limiter (e.g. the HTTP tier's) to
+        sidecar clients under its existing id — both front doors then
+        decide against the same device counters."""
+        self._limiters[int(lid)] = (algo, config)
+        return int(lid)
+
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "SidecarServer":
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def inflight(self) -> int:
+        """Submitted-unresolved decision frames across all connections."""
+        with self._conn_lock:
+            return self._inflight
+
+    def connections(self) -> int:
+        with self._conn_lock:
+            return len(self._conns)
+
+    def stop(self, drain_timeout_s: float | None = None) -> None:
+        """Graceful drain, then hard stop.
+
+        Drain phase: new decision frames are answered ``SHUTTING_DOWN``
+        while every already-submitted frame resolves normally — bounded
+        by ``drain_timeout_s`` (default from the constructor).  Hard
+        phase: the listener stops and every accepted connection is shut
+        down, so no zombie handler thread answers clients from a closed
+        storage."""
+        self._draining = True
+        budget = (self.drain_timeout_s if drain_timeout_s is None
+                  else float(drain_timeout_s))
+        deadline = time.monotonic() + max(budget, 0.0)
+        while time.monotonic() < deadline:
+            if self.inflight() == 0:
+                break
+            time.sleep(0.005)
         self._server.shutdown()
         self._server.server_close()
-        # Close ACCEPTED connections too: a stopped sidecar must not leave
-        # zombie handler threads answering clients from a closed storage
-        # (clients would see protocol errors instead of a dead connection
-        # and never reconnect).
         with self._conn_lock:
             self._stopped = True
             conns = list(self._conns)
             self._conns.clear()
+            if self._m_conns is not None:
+                self._m_conns.set(0)
         for sock in conns:
             try:
                 sock.shutdown(socket.SHUT_RDWR)
@@ -163,64 +340,246 @@ class SidecarServer:
             except OSError:
                 pass
 
+    # -- accounting helpers ---------------------------------------------------
+    def _count_malformed(self) -> None:
+        self.malformed_total += 1
+        if self._m_malformed is not None:
+            self._m_malformed.increment()
+
+    def _count_idle_closed(self) -> None:
+        self.idle_closed_total += 1
+        if self._m_idle is not None:
+            self._m_idle.increment()
+
+    def _count_pipeline_shed(self) -> None:
+        self.pipeline_shed_total += 1
+        self.last_shed_s = time.monotonic()
+        if self._m_shed is not None:
+            self._m_shed.increment()
+
+    def _count_drained(self) -> None:
+        self.drained_total += 1
+        if self._m_drained is not None:
+            self._m_drained.increment()
+
+    def _track_submit(self, n: int) -> None:
+        with self._conn_lock:
+            self._inflight += n
+
+    # -- connection loop ------------------------------------------------------
+    def _serve_conn(self, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        st = _ConnState()
+        try:
+            self._conn_loop(sock, st)
+        finally:
+            self._abandon_pending(st)
+
+    def _conn_loop(self, sock: socket.socket, st: _ConnState) -> None:
+        while True:
+            # Idle deadline between requests; the stricter read deadline
+            # once a frame has started (st.buf holds a partial frame, or
+            # an oversized frame is still being discarded) — a half
+            # frame must not pin this thread (slowloris).
+            mid_frame = bool(st.buf) or st.skip > 0
+            timeout = self.read_timeout_s if mid_frame else self.idle_timeout_s
+            sock.settimeout(timeout if timeout > 0 else None)
+            try:
+                chunk = sock.recv(65536)
+            except socket.timeout:
+                self._count_idle_closed()
+                return
+            except OSError:
+                return
+            if not chunk:
+                return
+            if st.skip:
+                # Discard an oversized frame's payload as it streams —
+                # never buffered, already answered BAD_FRAME.
+                n = min(st.skip, len(chunk))
+                st.skip -= n
+                chunk = chunk[n:]
+                if not chunk:
+                    continue
+            st.buf += chunk
+            # Two-phase pipelined burst: submit every decision frame of
+            # this read burst (futures), THEN resolve in order — the
+            # whole pipeline lands in one micro-batch flush.
+            self._parse_burst(st)
+            if st.pending:
+                out = b"".join(
+                    self._finish_frame(p, st) for p in st.pending)
+                st.pending = []
+                try:
+                    sock.sendall(out)
+                except (socket.timeout, OSError):
+                    return
+
+    def _parse_burst(self, st: _ConnState) -> None:
+        while len(st.buf) >= 4:
+            (length,) = struct.unpack_from("<I", st.buf)
+            if self.max_frame_bytes and length > self.max_frame_bytes:
+                # Hostile/corrupt length prefix: answer in-protocol and
+                # discard exactly `length` bytes so the stream stays in
+                # sync without ever buffering the oversized payload.
+                self._count_malformed()
+                st.pending.append(self._resp(
+                    st, ST_BAD_FRAME, 0, ERR_FRAME_TOO_LONG))
+                have = len(st.buf) - 4
+                if have >= length:
+                    st.buf = st.buf[4 + length:]
+                else:
+                    st.skip = length - have
+                    st.buf = b""
+                continue
+            if len(st.buf) < 4 + length:
+                break
+            frame = st.buf[4:4 + length]
+            st.buf = st.buf[4 + length:]
+            st.pending.append(self._begin_frame(frame, st))
+
     # -- frame handling -------------------------------------------------------
-    def _begin_frame(self, frame: bytes):
+    def _resp(self, st: _ConnState, status: int, allowed: int,
+              remaining: int) -> bytes:
+        """Version-aware response: v2-only statuses downgrade to the
+        generic v1 ERROR (status 1) with a matching errno so v1 clients
+        keep their status!=0-means-error contract."""
+        if st.version < 2 and status > ST_ERROR:
+            if status in _V1_ERRNO:
+                remaining = _V1_ERRNO[status]
+            status = ST_ERROR
+        return _mk_resp(status, allowed, remaining)
+
+    def _begin_frame(self, frame: bytes, st: _ConnState):
         """Phase 1 of a pipelined burst: TRY_ACQUIRE frames are submitted
         to the micro-batcher and return their FUTURE; everything else
-        (and every error) resolves immediately to response bytes."""
+        (and every validation failure) resolves immediately to bytes."""
+        resp = self._resp
+        if len(frame) < _REQ_BODY.size:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
         try:
-            op, lid, permits = _REQ_BODY.unpack_from(frame)
+            op, a, b = _REQ_BODY.unpack_from(frame)
+            key_bytes = frame[_REQ_BODY.size:]
+            if self.max_key_bytes and len(key_bytes) > self.max_key_bytes:
+                self._count_malformed()
+                return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
+            if op == OP_HELLO:
+                st.version = PROTOCOL_VERSION if a >= 2 else 1
+                return _mk_resp(ST_OK, st.version, self.max_frame_bytes)
+            if op == OP_PING:
+                if self._draining:
+                    return resp(st, ST_OK, 0, 0)
+                return resp(st, ST_OK,
+                            1 if self.storage.is_available() else 0, 0)
+            if op not in (OP_TRY_ACQUIRE, OP_AVAILABLE, OP_RESET):
+                self._count_malformed()
+                return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
+            if self._draining:
+                self._count_drained()
+                return resp(st, ST_SHUTTING_DOWN, 0, 0)
+            try:
+                key = key_bytes.decode()
+            except UnicodeDecodeError:
+                self._count_malformed()
+                return resp(st, ST_BAD_FRAME, 0, ERR_BAD_KEY)
+            entry = self._limiters.get(a)
+            if entry is None:
+                return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
+            algo, _cfg = entry
             if op == OP_TRY_ACQUIRE:
-                entry = self._limiters.get(lid)
-                if entry is None:
-                    return _mk_resp(1, 0, ERR_UNKNOWN_LIMITER)
-                acquire_async = getattr(self.storage, "acquire_async",
-                                        None)
-                if acquire_async is not None:
-                    key = frame[_REQ_BODY.size:].decode()
-                    return acquire_async(entry[0], lid, key,
-                                         max(int(permits), 1))
+                return self._begin_acquire(st, algo, a, key,
+                                           max(int(b), 1))
+            if op == OP_AVAILABLE:
+                avail = int(self.storage.available_many(algo, a, [key])[0])
+                return resp(st, ST_OK, 0, avail)
+            # OP_RESET
+            self.storage.reset_key(algo, a, key)
+            return resp(st, ST_OK, 1, 0)
         except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
-            return _mk_resp(1, 0, ERR_INTERNAL)
-        return self._handle_frame(frame)
+            return resp(st, ST_ERROR, 0, ERR_INTERNAL)
 
-    @staticmethod
-    def _finish_frame(item) -> bytes:
+    def _begin_acquire(self, st: _ConnState, algo: str, lid: int, key: str,
+                       permits: int):
+        """Submit one decision frame, enforcing the pipeline cap and
+        relaying the batcher's own admission control in-protocol."""
+        n_inflight = sum(1 for p in st.pending if not isinstance(p, bytes))
+        if self.max_pipeline and n_inflight >= self.max_pipeline:
+            self._count_pipeline_shed()
+            # The burst drains within roughly one micro-batch flush; the
+            # hint mirrors the batcher's queue_full estimate.
+            batcher = getattr(self.storage, "_batcher", None)
+            hint = max(getattr(batcher, "max_delay_s", 0.001) * 1000.0, 1.0)
+            return self._resp(st, ST_SHED, 0, int(hint))
+        acquire_async = getattr(self.storage, "acquire_async", None)
+        try:
+            if acquire_async is not None:
+                fut = acquire_async(algo, lid, key, permits)
+                self._track_submit(1)
+                return fut
+            out = self.storage.acquire(algo, lid, key, permits)
+            remaining = int(out.get("remaining", out.get("cache_value", 0)))
+            return self._resp(st, ST_OK, 1 if out["allowed"] else 0,
+                              remaining)
+        except OverloadedError as exc:
+            return self._resp(st, ST_SHED, 0,
+                              max(int(exc.retry_after_ms), 1))
+        except ShutdownError:
+            return self._resp(st, ST_SHUTTING_DOWN, 0, 0)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
+
+    def _finish_frame(self, item, st: _ConnState) -> bytes:
         """Phase 2: resolve a submitted future (or pass bytes through)."""
         if isinstance(item, bytes):
             return item
         try:
-            out = item.result()
+            out = item.result(
+                timeout=self.resolve_timeout_s or None)
             remaining = int(out.get("remaining", out.get("cache_value", 0)))
-            return _mk_resp(0, 1 if out["allowed"] else 0, remaining)
+            return self._resp(st, ST_OK, 1 if out["allowed"] else 0,
+                              remaining)
+        except OverloadedError as exc:
+            return self._resp(st, ST_SHED, 0,
+                              max(int(exc.retry_after_ms), 1))
+        except ShutdownError:
+            return self._resp(st, ST_SHUTTING_DOWN, 0, 0)
+        except _FutureTimeout:
+            # The batch never resolved within the bound (wedged device):
+            # answer in-protocol and make sure the future is consumed
+            # whenever it does land — never leave this thread pinned.
+            item.add_done_callback(_consume_future)
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
         except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
-            return _mk_resp(1, 0, ERR_INTERNAL)
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
+        finally:
+            self._track_submit(-1)
 
-    def _handle_frame(self, frame: bytes) -> bytes:
-        resp = _mk_resp
+    def _abandon_pending(self, st: _ConnState) -> None:
+        """The connection died mid-burst: no batcher future may leak.
 
-        try:
-            op, lid, permits = _REQ_BODY.unpack_from(frame)
-            key = frame[_REQ_BODY.size:].decode()
-            if op == OP_PING:
-                return resp(0, 1 if self.storage.is_available() else 0, 0)
-            entry = self._limiters.get(lid)
-            if entry is None:
-                return resp(1, 0, ERR_UNKNOWN_LIMITER)
-            algo, _cfg = entry
-            if op == OP_TRY_ACQUIRE:
-                out = self.storage.acquire(algo, lid, key, max(int(permits), 1))
-                remaining = int(out.get("remaining", out.get("cache_value", 0)))
-                return resp(0, 1 if out["allowed"] else 0, remaining)
-            if op == OP_AVAILABLE:
-                avail = int(self.storage.available_many(algo, lid, [key])[0])
-                return resp(0, 0, avail)
-            if op == OP_RESET:
-                self.storage.reset_key(algo, lid, key)
-                return resp(0, 1, 0)
-            return resp(1, 0, ERR_UNKNOWN_OP)
-        except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
-            return resp(1, 0, ERR_INTERNAL)
+        Still-queued frames are WITHDRAWN from the batcher (they stop
+        consuming device capacity and their slots stop pinning eviction);
+        frames already dispatched resolve normally and are consumed via a
+        done-callback."""
+        futs = [p for p in st.pending if not isinstance(p, bytes)]
+        st.pending = []
+        if not futs:
+            return
+        batcher = getattr(self.storage, "_batcher", None)
+        withdrawn = 0
+        if batcher is not None and hasattr(batcher, "forget"):
+            try:
+                withdrawn = batcher.forget(futs)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+        for fut in futs:
+            fut.add_done_callback(_consume_future)
+        self.futures_abandoned += len(futs)
+        self._track_submit(-len(futs))
+        if withdrawn:
+            log.debug("withdrew %d queued frame(s) of a dead connection",
+                      withdrawn)
 
 
 class SidecarSendError(ConnectionError):
@@ -230,13 +589,40 @@ class SidecarSendError(ConnectionError):
     executed the request before dying, so replay risks double-charging."""
 
 
-class SidecarClient:
-    """Minimal pipelining client (reference for other-language ports)."""
+class SidecarShedError(RuntimeError):
+    """The server shed the request (pipeline cap or batcher admission
+    control); retry after ``retry_after_ms``."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, retry_after_ms: float = 0.0):
+        super().__init__(
+            f"sidecar shed the request; retry after {retry_after_ms} ms")
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class SidecarClient:
+    """Minimal pipelining client (reference for other-language ports).
+
+    Speaks protocol v2 by default: sends HELLO at connect and records the
+    negotiated version + the server's frame cap.  ``protocol=1`` skips
+    the handshake (byte-compatible with the pre-v2 client); a v1 server
+    answering HELLO with an error also downgrades the client to v1.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 protocol: int = PROTOCOL_VERSION):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rbuf = b""
+        self.server_version = 1
+        self.server_max_frame = 0
+        if protocol >= 2:
+            # The HELLO response carries the negotiated version in the
+            # `allowed` byte — read it raw (no bool coercion).
+            self._send(self._frame(OP_HELLO, PROTOCOL_VERSION, 0, ""))
+            status, version, max_frame = self._read_raw()
+            if status == ST_OK and version:
+                self.server_version = int(version)
+                self.server_max_frame = int(max_frame)
 
     def _send(self, payload: bytes) -> None:
         try:
@@ -253,6 +639,18 @@ class SidecarClient:
         body = struct.pack("<BII", op, lid, permits) + key.encode()
         return struct.pack("<I", len(body)) + body
 
+    def _read_raw(self) -> Tuple[int, int, int]:
+        """One response with raw integer fields (the HELLO reply packs
+        the negotiated version into the `allowed` byte)."""
+        while len(self._rbuf) < _RESP.size:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        _, status, allowed, remaining = _RESP.unpack_from(self._rbuf)
+        self._rbuf = self._rbuf[_RESP.size:]
+        return status, allowed, remaining
+
     def _read_responses(self, n: int):
         out = []
         while len(out) < n:
@@ -266,12 +664,22 @@ class SidecarClient:
             out.append((status, bool(allowed), remaining))
         return out
 
+    @staticmethod
+    def _check(status: int, remaining: int) -> None:
+        if status == ST_OK:
+            return
+        if status == ST_SHED:
+            raise SidecarShedError(retry_after_ms=remaining)
+        if status == ST_SHUTTING_DOWN:
+            raise SidecarShedError(retry_after_ms=1000.0)
+        raise RuntimeError(f"sidecar error (status={status}, "
+                           f"errno={remaining})")
+
     # -- API ------------------------------------------------------------------
     def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
         self._send(self._frame(OP_TRY_ACQUIRE, lid, permits, key))
-        status, allowed, _ = self._read_responses(1)[0]
-        if status:
-            raise RuntimeError("sidecar error")
+        status, allowed, remaining = self._read_responses(1)[0]
+        self._check(status, remaining)
         return allowed
 
     def acquire_batch(
@@ -288,8 +696,7 @@ class SidecarClient:
     def available(self, lid: int, key: str) -> int:
         self._send(self._frame(OP_AVAILABLE, lid, 0, key))
         status, _, remaining = self._read_responses(1)[0]
-        if status:
-            raise RuntimeError("sidecar error")
+        self._check(status, remaining)
         return remaining
 
     def reset(self, lid: int, key: str) -> None:

@@ -73,8 +73,13 @@ class AppContext:
     # The CircuitBreakerStorage layer (None when breaker.enabled=false or
     # the storage was injected) — the health state machine reads it.
     breaker: object = None
+    # The TCP decision sidecar (ratelimiter.sidecar.enabled) — the health
+    # state machine folds its shed/connection stats in.
+    sidecar: object = None
 
     def close(self) -> None:
+        if self.sidecar is not None:
+            self.sidecar.stop()
         if self.replication is not None:
             self.replication.close()
         self.storage.close()
@@ -199,6 +204,29 @@ def _maybe_breaker(storage: RateLimitStorage, props: AppProperties,
     return breaker, breaker
 
 
+def _maybe_sidecar(storage: RateLimitStorage, props: AppProperties,
+                   registry: MeterRegistry):
+    """Config-gated TCP decision sidecar (OFF by default).
+
+    Attaches to the RAW device-batched storage — the sidecar's pipelined
+    ``acquire_async`` path needs the micro-batcher, and its per-frame
+    admission control composes with (not under) the breaker/retry
+    wrappers that serve the HTTP tier."""
+    if not props.get_bool("ratelimiter.sidecar.enabled", False):
+        return None
+    if not getattr(storage, "supports_device_batching", False):
+        import logging
+
+        logging.getLogger("ratelimiter").warning(
+            "ratelimiter.sidecar.enabled but the %s backend has no "
+            "batched decision protocol; sidecar disabled",
+            type(storage).__name__)
+        return None
+    from ratelimiter_tpu.service.sidecar import SidecarServer
+
+    return SidecarServer.from_props(storage, props, registry).start()
+
+
 def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
     """Per-op retry around the (possibly chaos-wrapped) backend — the
     RedisRateLimitStorage.java:155-178 analog, composed so transient faults
@@ -281,10 +309,12 @@ def build_app(props: AppProperties | None = None,
     storage = storage or build_storage(props, meter_registry=registry)
     replication = None
     breaker = None
+    sidecar = None
     if own_storage:
         # Replication attaches to the RAW TPU storage (the journal hooks
         # the engine), before the chaos/retry wrappers compose around it.
         replication = _maybe_replication(storage, props, registry)
+        sidecar = _maybe_sidecar(storage, props, registry)
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
@@ -329,6 +359,17 @@ def build_app(props: AppProperties | None = None,
             registry,
         ),
     }
+    if sidecar is not None:
+        # Expose the HTTP tier's limiters to sidecar clients under their
+        # existing lids — both front doors share the same device
+        # counters per key (ids are distributed via config, like the
+        # reference's named Spring beans; see /actuator/health.sidecar).
+        for name, limiter in limiters.items():
+            lid = getattr(limiter, "_lid", None)
+            if lid is not None:
+                algo = "tb" if isinstance(limiter, TokenBucketRateLimiter) \
+                    else "sw"
+                sidecar.expose(lid, algo, limiter._config)
     return AppContext(
         props=props,
         storage=storage,
@@ -337,4 +378,5 @@ def build_app(props: AppProperties | None = None,
         fail_open=props.get_bool("ratelimiter.fail_open", True),
         replication=replication,
         breaker=breaker,
+        sidecar=sidecar,
     )

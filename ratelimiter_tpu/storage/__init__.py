@@ -1,6 +1,6 @@
 from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.breaker import CircuitBreakerStorage
-from ratelimiter_tpu.storage.chaos import FaultInjectingStorage
+from ratelimiter_tpu.storage.chaos import FaultInjectingProxy, FaultInjectingStorage
 from ratelimiter_tpu.storage.degraded import DegradedHostLimiter
 from ratelimiter_tpu.storage.errors import (
     CircuitOpenError,
@@ -15,6 +15,7 @@ __all__ = [
     "CircuitBreakerStorage",
     "CircuitOpenError",
     "DegradedHostLimiter",
+    "FaultInjectingProxy",
     "FaultInjectingStorage",
     "RateLimitStorage",
     "InMemoryStorage",

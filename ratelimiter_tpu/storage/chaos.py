@@ -112,6 +112,418 @@ FaultInjectingStorage.__abstractmethods__ = frozenset()
 
 
 # ---------------------------------------------------------------------------
+# Network fault injection (sidecar ingress chaos)
+# ---------------------------------------------------------------------------
+
+
+class FaultInjectingProxy:
+    """TCP man-in-the-middle for ingress chaos (service/sidecar.py).
+
+    Listens on a local port and forwards each connection to a target
+    server, injecting network faults into the CLIENT->SERVER direction on
+    a configured schedule.  Fault classes (``set_fault``):
+
+    - ``None``        — transparent passthrough (baseline),
+    - ``"truncate"``  — forward only the first ``after`` bytes, then
+      swallow everything else (the server holds a half-written frame
+      until its read deadline fires — the slowloris shape),
+    - ``"delay"``     — forward in 1-byte pieces with ``delay_ms`` sleeps
+      (a slow writer that keeps the frame perpetually almost-done),
+    - ``"garbage"``   — after ``after`` forwarded bytes, inject ``n``
+      seeded-random bytes into the stream (framing corruption), then keep
+      forwarding,
+    - ``"kill"``      — abruptly close both sides after ``after``
+      forwarded bytes (a client dying mid-pipeline).
+
+    The fault mode is snapshotted per connection at accept time, so a
+    drill can flip modes between waves without racing live pumps.
+    Server->client bytes always pass through untouched — the proxy
+    attacks the ingress, not the client.
+    """
+
+    def __init__(self, target_port: int, target_host: str = "127.0.0.1",
+                 host: str = "127.0.0.1", port: int = 0, seed: int = 0):
+        import socket
+        import socketserver
+
+        self.target = (target_host, int(target_port))
+        self._rng = random.Random(seed)
+        self._fault: tuple = (None, {})
+        self._lock = threading.Lock()
+        self.connections = 0
+        self.faults_injected = 0
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                with outer._lock:
+                    mode, params = outer._fault
+                    outer.connections += 1
+                    garbage = (bytes(outer._rng.randrange(256)
+                                     for _ in range(params.get("n", 64)))
+                               if mode == "garbage" else b"")
+                try:
+                    up = socket.create_connection(outer.target, timeout=10.0)
+                except OSError:
+                    return
+                down = threading.Thread(
+                    target=outer._pump_down, args=(up, self.request),
+                    daemon=True)
+                down.start()
+                try:
+                    outer._pump_up(self.request, up, mode, params, garbage)
+                finally:
+                    for s in (up, self.request):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    down.join(timeout=2.0)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="chaos-proxy",
+            daemon=True)
+
+    # -- control surface ------------------------------------------------------
+    def set_fault(self, mode: str | None, **params) -> None:
+        """Set the fault class applied to NEW connections.
+
+        ``after``: client bytes forwarded before the fault engages
+        (default 0); ``n``: garbage byte count; ``delay_ms``: per-byte
+        delay for ``"delay"``."""
+        if mode not in (None, "truncate", "delay", "garbage", "kill"):
+            raise ValueError(f"unknown fault mode: {mode!r}")
+        with self._lock:
+            self._fault = (mode, dict(params))
+
+    def start(self) -> "FaultInjectingProxy":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- pumps ----------------------------------------------------------------
+    def _pump_down(self, up, client) -> None:
+        """Server->client passthrough until either side dies."""
+        while True:
+            try:
+                chunk = up.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                try:
+                    client.shutdown(1)  # SHUT_WR: flush EOF downstream
+                except OSError:
+                    pass
+                return
+            try:
+                client.sendall(chunk)
+            except OSError:
+                return
+
+    def _pump_up(self, client, up, mode, params, garbage: bytes) -> None:
+        """Client->server with the configured fault applied."""
+        after = int(params.get("after", 0))
+        delay_s = float(params.get("delay_ms", 20.0)) / 1000.0
+        forwarded = 0
+        injected = False
+        while True:
+            try:
+                chunk = client.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            if mode == "kill" and forwarded + len(chunk) >= after:
+                cut = max(after - forwarded, 0)
+                try:
+                    if cut:
+                        up.sendall(chunk[:cut])
+                except OSError:
+                    return
+                with self._lock:
+                    self.faults_injected += 1
+                return  # handler's finally closes both sides abruptly
+            if mode == "truncate":
+                if forwarded >= after:
+                    continue  # swallow: server waits on a half frame
+                chunk = chunk[:max(after - forwarded, 0)]
+                if forwarded + len(chunk) >= after:
+                    with self._lock:
+                        self.faults_injected += 1
+            if mode == "garbage" and not injected \
+                    and forwarded + len(chunk) >= after:
+                cut = max(after - forwarded, 0)
+                chunk = chunk[:cut] + garbage + chunk[cut:]
+                injected = True
+                with self._lock:
+                    self.faults_injected += 1
+            try:
+                if mode == "delay":
+                    for i in range(len(chunk)):
+                        up.sendall(chunk[i:i + 1])
+                        time.sleep(delay_s)
+                else:
+                    up.sendall(chunk)
+            except OSError:
+                return
+            forwarded += len(chunk)
+
+
+# ---------------------------------------------------------------------------
+# Ingress drill (sidecar under network faults, differential vs the oracle)
+# ---------------------------------------------------------------------------
+
+def ingress_drill(
+    num_slots: int = 1024,
+    n_keys: int = 32,
+    waves: int = 3,
+    pipeline: int = 12,
+    max_pipeline: int = 16,
+    read_timeout_ms: float = 300.0,
+    seed: int = 0,
+    registry=None,
+) -> dict:
+    """Deterministic sidecar-ingress chaos drill.
+
+    Runs the hardened sidecar (protocol v2, tight frame/pipeline/deadline
+    bounds) over a controlled-clock ``TpuBatchedStorage`` and attacks it
+    with every fault class — malformed frames sent directly, plus
+    truncate / garbage / kill-mid-pipeline through a
+    :class:`FaultInjectingProxy` — while a healthy v2 client keeps making
+    pipelined decisions that are checked BIT-IDENTICAL against
+    ``semantics/oracle.py``.  Proves, per the ISSUE contract:
+
+    - the server stays up under every fault class (PING works, later
+      decisions still exact);
+    - malformed frames are answered in-protocol with ``BAD_FRAME`` (the
+      attacking connection survives and can still make valid decisions);
+    - a slow/truncated frame trips the read deadline instead of pinning
+      a handler thread;
+    - a client killed mid-pipeline leaks nothing: batcher queue depth and
+      the unresolved-waiter set return to baseline (abandoned futures are
+      withdrawn or consumed), and handler threads are reaped;
+    - pipeline overflow is shed with the typed retry-after status;
+    - the health state machine's inputs transition as PR 2 defines:
+      shedding is visible via ``last_shed_s`` within the health window
+      and clears after it.
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import socket as socket_mod
+    import struct
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.service import sidecar as sc
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = random.Random(seed)
+    clock = {"t": 1_753_000_000_000}
+    # max_inflight=1 pins the drain pool at one worker so the end-of-drill
+    # thread-leak check compares like with like.
+    storage = TpuBatchedStorage(num_slots=num_slots, max_delay_ms=0.2,
+                                max_inflight=1,
+                                clock_ms=lambda: clock["t"])
+    server = sc.SidecarServer(
+        storage, host="127.0.0.1", meter_registry=registry,
+        max_frame_bytes=512, max_key_bytes=64,
+        max_pipeline=max_pipeline, max_connections=64,
+        idle_timeout_ms=5_000.0, read_timeout_ms=read_timeout_ms,
+        drain_timeout_ms=500.0).start()
+    report = {"decisions": 0, "mismatches": 0, "faults": [],
+              "shed": 0, "malformed_answered": 0}
+    proxy = FaultInjectingProxy(server.port, seed=seed).start()
+    try:
+        cfg_sw = RateLimitConfig(max_permits=10, window_ms=2000,
+                                 enable_local_cache=False)
+        cfg_tb = RateLimitConfig(max_permits=20, window_ms=2000,
+                                 refill_rate=8.0)
+        lid_sw = server.register("sw", cfg_sw)
+        lid_tb = server.register("tb", cfg_tb)
+        # The attacker gets its own limiter so its mutations never touch
+        # the oracle-tracked keyspace.
+        lid_atk = server.register("tb", RateLimitConfig(
+            max_permits=1000, window_ms=60_000, refill_rate=100.0))
+        oracle_sw = SlidingWindowOracle(cfg_sw)
+        oracle_tb = TokenBucketOracle(cfg_tb)
+        healthy = sc.SidecarClient("127.0.0.1", server.port)
+        assert healthy.server_version == 2, "v2 handshake failed"
+
+        def healthy_wave() -> None:
+            """Pipelined decisions on the DIRECT path, oracle-checked."""
+            clock["t"] += rng.choice([3, 17, 250, 999, 2000])
+            now = clock["t"]
+            keys = [f"u{rng.randrange(n_keys)}" for _ in range(pipeline)]
+            perms = [rng.choice([1, 1, 2, 5]) for _ in range(pipeline)]
+            for lid, oracle in ((lid_sw, oracle_sw), (lid_tb, oracle_tb)):
+                got = healthy.acquire_batch(lid, keys, perms)
+                for j, (status, allowed, rem) in enumerate(got):
+                    assert status == sc.ST_OK, (lid, j, status)
+                    d = oracle.try_acquire(keys[j], perms[j], now)
+                    report["decisions"] += 1
+                    if allowed != d.allowed or (
+                            lid == lid_tb and int(rem) != d.remaining_hint):
+                        report["mismatches"] += 1
+
+        def frame(op, a, b, key_bytes=b""):
+            body = struct.pack("<BII", op, a, b) + key_bytes
+            return struct.pack("<I", len(body)) + body
+
+        # Baselines: warm one wave, then record thread/queue levels.
+        healthy_wave()
+        base_threads = threading.active_count()
+        batcher = storage._batcher
+        assert batcher.queue_depth() == 0
+
+        # -- fault 1: malformed frames, sent directly --------------------
+        atk = sc.SidecarClient("127.0.0.1", server.port)
+        declared = 100_000  # far over max_frame_bytes=512
+        bad = [
+            frame(1, lid_atk, 1, b"x" * 128),             # key too long
+            struct.pack("<I", 4) + b"abc\x00",            # short frame
+            frame(42, lid_atk, 1, b"k"),                  # unknown op
+            frame(1, lid_atk, 1, b"\xff\xfe\xff"),        # invalid UTF-8 key
+            struct.pack("<I", declared) + b"\x00" * declared,  # oversized
+        ]
+        # The oversized frame's declared payload is discarded as it
+        # streams (never buffered) and the stream stays in sync: a valid
+        # frame directly behind it still decides.
+        atk._send(b"".join(bad))
+        got = atk._read_responses(len(bad))
+        for status, _, errno in got:
+            assert status == sc.ST_BAD_FRAME, got
+            report["malformed_answered"] += 1
+        assert [g[2] for g in got] == [
+            sc.ERR_KEY_TOO_LONG, sc.ERR_SHORT_FRAME, sc.ERR_UNKNOWN_OP,
+            sc.ERR_BAD_KEY, sc.ERR_FRAME_TOO_LONG], got
+        assert atk.try_acquire(lid_atk, "atk-ok") is True
+        atk.close()
+        report["faults"].append("malformed")
+        healthy_wave()
+
+        # -- fault 2: slowloris / truncated frame ------------------------
+        idle_before = server.idle_closed_total
+        slow = socket_mod.create_connection(("127.0.0.1", server.port),
+                                            timeout=5.0)
+        slow.sendall(frame(1, lid_atk, 1, b"half-frame")[:9])  # partial
+        t0 = time.monotonic()
+        got_eof = slow.recv(16)  # server must close within the deadline
+        dt = time.monotonic() - t0
+        assert got_eof == b"", "server answered a half frame?"
+        assert dt < read_timeout_ms / 1000.0 + 2.0, (
+            f"read deadline did not fire in time ({dt:.2f}s)")
+        assert server.idle_closed_total > idle_before
+        slow.close()
+        report["faults"].append("slowloris")
+        healthy_wave()
+
+        # -- fault 3: garbage injection through the proxy ----------------
+        proxy.set_fault("garbage", after=17, n=48)
+        gbg = sc.SidecarClient("127.0.0.1", proxy.port, protocol=1)
+        try:
+            # The injected garbage corrupts this connection's framing;
+            # the server answers in-protocol or the conn dies — either
+            # way the SERVER survives and other clients are unaffected.
+            gbg.acquire_batch(lid_atk, [f"g{i}" for i in range(8)])
+        except (ConnectionError, RuntimeError, socket_mod.timeout):
+            pass
+        finally:
+            gbg.close()
+        report["faults"].append("garbage")
+        healthy_wave()
+
+        # -- fault 4: kill mid-pipeline ----------------------------------
+        proxy.set_fault("kill", after=120)  # dies mid-burst
+        kil = sc.SidecarClient("127.0.0.1", proxy.port, protocol=1)
+        try:
+            kil.acquire_batch(lid_atk, [f"k{i}" for i in range(24)])
+        except (ConnectionError, socket_mod.timeout, OSError):
+            pass
+        finally:
+            kil.close()
+        report["faults"].append("kill_mid_pipeline")
+        healthy_wave()
+
+        # -- pipeline-cap shed: typed retry-after status -----------------
+        # The cap engages when the burst lands in one read; loopback with
+        # TCP_NODELAY delivers an ~800-byte burst in one segment, but a
+        # kernel split would halve it — retry a couple of times before
+        # calling the cap broken.
+        burst = max_pipeline * 2
+        n_ok = n_shed = 0
+        for _ in range(3):
+            got = healthy.acquire_batch(
+                lid_tb, [f"shed-{i}" for i in range(burst)])
+            # Shed frames never reach the device, so the oracle stream is
+            # untouched; ok frames mutate only shed-* keys (not tracked).
+            n_ok = sum(1 for s, _, _ in got if s == sc.ST_OK)
+            n_shed = sum(1 for s, _, _ in got if s == sc.ST_SHED)
+            assert n_ok + n_shed == burst, got
+            if n_shed:
+                break
+        assert n_shed >= 1, "pipeline cap never engaged"
+        for status, _, rem in got:
+            if status == sc.ST_SHED:
+                assert rem > 0, "shed without a retry-after hint"
+        report["shed"] = n_shed
+        # Health machine input (PR 2 state machine): a recent shed reads
+        # as SHEDDING inside the window...
+        assert server.last_shed_s > 0
+        assert (time.monotonic() - server.last_shed_s) <= 5.0
+        healthy_wave()
+
+        # -- convergence: no leaked threads, futures, or queue depth -----
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with batcher._cv:
+                waiters = len(batcher._waiters)
+            if (batcher.queue_depth() == 0 and waiters == 0
+                    and threading.active_count() <= base_threads):
+                break
+            time.sleep(0.05)
+        with batcher._cv:
+            waiters = len(batcher._waiters)
+        assert batcher.queue_depth() == 0, "queue depth did not drain"
+        assert waiters == 0, f"{waiters} batcher future(s) leaked"
+        assert threading.active_count() <= base_threads, (
+            f"handler threads leaked: {threading.active_count()} > "
+            f"baseline {base_threads}")
+        assert storage.is_available(), "server/storage not healthy at end"
+        assert healthy.ping(), "sidecar did not survive the fault classes"
+        healthy.close()
+
+        report["threads"] = threading.active_count()
+        report["idle_closed"] = server.idle_closed_total
+        report["malformed"] = server.malformed_total
+        report["pipeline_shed"] = server.pipeline_shed_total
+        report["futures_abandoned"] = server.futures_abandoned
+        if report["mismatches"]:
+            raise AssertionError(
+                f"healthy decisions diverged from the oracle: {report}")
+        return report
+    finally:
+        proxy.stop()
+        server.stop()
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
 # Failover drill (replication/ — kill the primary mid-soak, promote)
 # ---------------------------------------------------------------------------
 

@@ -655,10 +655,27 @@ class TpuBatchedStorage(RateLimitStorage):
         # both misbehave under clock regression.)
         self._last_stamp = 0
         self._stamp_lock = threading.Lock()
+        # Clock-regression observability: the clamp silently absorbs a
+        # backward wall-clock jump — count each absorbed regression so an
+        # NTP step (or a broken injected clock) is visible in metrics
+        # instead of only as mysteriously-frozen windows.
+        self.backward_clamps = 0
+        self._backward_clamp_counter = (
+            meter_registry.counter(
+                "ratelimiter.time.backward_clamp",
+                "Wall-clock regressions absorbed by the monotonic batch-"
+                "timestamp clamp")
+            if meter_registry is not None else None)
 
         def _stamp() -> int:
             with self._stamp_lock:
-                self._last_stamp = max(self._last_stamp, self._clock_ms())
+                now = self._clock_ms()
+                if now < self._last_stamp:
+                    self.backward_clamps += 1
+                    if self._backward_clamp_counter is not None:
+                        self._backward_clamp_counter.increment()
+                else:
+                    self._last_stamp = now
                 return self._last_stamp
 
         self._monotonic_now = _stamp

@@ -274,3 +274,109 @@ def test_sharded_native_checkpoint_round_trips(tmp_path):
         batch=16, subbatches=1)
     dst.close()
     assert got.tolist() == [False, True, True, False]
+
+
+# ---------------------------------------------------------------------------
+# Integrity (format v3): per-array CRC32s + manifest checksum
+# ---------------------------------------------------------------------------
+
+def _small_checkpoint(tmp_path, tag="ckpt"):
+    clock = FakeClock()
+    cfg = RateLimitConfig(max_permits=15, window_ms=2000,
+                          enable_local_cache=False)
+    storage = TpuBatchedStorage(num_slots=128, max_delay_ms=0.1,
+                                clock_ms=clock, checkpointable=True)
+    sw = SlidingWindowRateLimiter(storage, cfg, MeterRegistry(),
+                                  clock_ms=clock)
+    for i in range(20):
+        sw.try_acquire(f"u{i % 6}")
+    path = str(tmp_path / tag)
+    storage.save_checkpoint(path)
+    storage.close()
+    return path, cfg
+
+
+def test_checkpoint_bit_flip_refused(tmp_path):
+    """A single flipped byte in state.npz fails the per-array CRC32 (or
+    the zip layer) with the typed corruption error."""
+    import os
+
+    from ratelimiter_tpu.engine.checkpoint import (
+        CheckpointCorruptError,
+        load_checkpoint,
+    )
+
+    path, _ = _small_checkpoint(tmp_path)
+    npz = os.path.join(path, "state.npz")
+    blob = bytearray(open(npz, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(npz, "wb") as fh:
+        fh.write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+
+
+def test_checkpoint_truncated_npz_refused(tmp_path):
+    """A torn write (truncated state.npz) is refused with the typed
+    error, not a random zip/numpy traceback mid-restore."""
+    import os
+
+    from ratelimiter_tpu.engine.checkpoint import (
+        CheckpointCorruptError,
+        load_checkpoint,
+    )
+
+    path, _ = _small_checkpoint(tmp_path)
+    npz = os.path.join(path, "state.npz")
+    blob = open(npz, "rb").read()
+    with open(npz, "wb") as fh:
+        fh.write(blob[: len(blob) // 3])
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+
+
+def test_checkpoint_manifest_tamper_refused(tmp_path):
+    """Editing index.json (even a metadata field) breaks the manifest
+    checksum."""
+    import json
+    import os
+
+    from ratelimiter_tpu.engine.checkpoint import (
+        CheckpointCorruptError,
+        load_checkpoint,
+    )
+
+    path, _ = _small_checkpoint(tmp_path)
+    idx = os.path.join(path, "index.json")
+    meta = json.load(open(idx))
+    meta["num_slots"] = 999  # a geometry lie the checksum must catch
+    with open(idx, "w") as fh:
+        json.dump(meta, fh)
+    with pytest.raises(CheckpointCorruptError, match="manifest"):
+        load_checkpoint(path)
+
+
+def test_checkpoint_older_format_still_restores(tmp_path):
+    """A v2 dump (no checksums) predates integrity and must still load —
+    and restore into a live storage."""
+    import json
+    import os
+
+    from ratelimiter_tpu.engine.checkpoint import load_checkpoint
+
+    path, cfg = _small_checkpoint(tmp_path)
+    idx = os.path.join(path, "index.json")
+    meta = json.load(open(idx))
+    meta["format"] = 2
+    meta.pop("checksums", None)
+    meta.pop("manifest_crc", None)
+    with open(idx, "w") as fh:
+        json.dump(meta, fh)
+    assert load_checkpoint(path)["meta"]["format"] == 2
+
+    clock = FakeClock()
+    storage = TpuBatchedStorage(num_slots=128, max_delay_ms=0.1,
+                                clock_ms=clock, checkpointable=True)
+    SlidingWindowRateLimiter(storage, cfg, MeterRegistry(), clock_ms=clock)
+    storage.restore_checkpoint(path)
+    storage.close()

@@ -474,6 +474,94 @@ def test_tcp_transport_failover_vs_oracle():
     promoted.close()
 
 
+def test_socket_sink_reconnects_and_rebaselines_after_link_drop():
+    """Drop-the-link chaos: the standby dies mid-stream and a NEW (empty)
+    standby comes up on the same port.  The sink must reconnect with its
+    capped backoff instead of erroring out of the replication thread
+    (``Replicator.errors`` stays 0), and the replicator must re-baseline
+    the restarted standby with a full frame on the next cycle."""
+    clock, primary, standby = make_pair()
+    cfg = RateLimitConfig(max_permits=12, window_ms=1500,
+                          enable_local_cache=False)
+    lid = primary.register_limiter("sw", cfg)
+    log = ReplicationLog(primary)
+    receiver1 = StandbyReceiver(standby)
+    server1 = ReplicationServer(receiver1, host="127.0.0.1").start()
+    sink = SocketSink("127.0.0.1", server1.port, max_retries=8,
+                      backoff_ms=5.0, backoff_cap_ms=50.0)
+    repl = Replicator(log, sink)
+    rng = random.Random(5)
+    standby2 = None
+    server2 = None
+
+    def wave():
+        clock["t"] += rng.choice([3, 700, 1500])
+        keys = [f"t{rng.randrange(16)}" for _ in range(20)]
+        primary.acquire_many("sw", [lid] * 20, keys, [1] * 20)
+
+    import threading
+    import time as time_mod
+
+    boot = {}
+    # The restarted standby's storage is built up front (jax array init
+    # can take seconds on CPU); only the port BIND is delayed, so the
+    # backoff loop's worst case stays well inside max_retries.
+    standby2 = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+
+    def restart_standby_later(port, delay_s):
+        time_mod.sleep(delay_s)
+        boot["receiver"] = StandbyReceiver(standby2)
+        boot["server"] = ReplicationServer(
+            boot["receiver"], host="127.0.0.1", port=port).start()
+
+    try:
+        wave()
+        assert repl.ship_now() > 0
+        assert receiver1.consistent
+
+        # Drop the link: cut the established connection and kill the
+        # standby process (listener + storage).
+        sink._drop()
+        server1.stop()
+        standby.close()
+        # A restarted, EMPTY standby binds the same port — but only
+        # AFTER the sink has started retrying, so the capped-backoff
+        # loop is what carries the cycle through the outage.
+        t = threading.Thread(target=restart_standby_later,
+                             args=(server1.port, 0.1), daemon=True)
+        t.start()
+
+        # The next cycle hits connection-refused, backs off, reconnects
+        # once the standby is back, and delivers — no error escapes the
+        # ship cycle.
+        wave()
+        assert repl.ship_now() > 0
+        t.join(timeout=5.0)
+        server2 = boot["server"]
+        receiver2 = boot["receiver"]
+        assert sink.reconnects >= 1
+        assert repl.errors == 0
+        # The delta landed past the restarted standby's epoch 0: gap —
+        # refuses promotion...
+        assert not receiver2.consistent
+        with pytest.raises(ReplicationStateError):
+            receiver2.promote()
+
+        # ...until the next cycle re-baselines with a full frame
+        # (triggered by the consumed reconnect flag).
+        wave()
+        assert repl.ship_now() > 0
+        assert receiver2.consistent
+        assert repl.errors == 0
+    finally:
+        primary.close()
+        sink.close()
+        if server2 is not None:
+            server2.stop()
+        if standby2 is not None:
+            standby2.close()
+
+
 # ---------------------------------------------------------------------------
 # Service wiring & metrics exposure
 # ---------------------------------------------------------------------------

@@ -99,3 +99,136 @@ def test_error_paths(sidecar):
     with pytest.raises(RuntimeError):
         client.try_acquire(9999, "nobody")  # unknown limiter id
     client.close()
+
+
+# ---------------------------------------------------------------------------
+# Protocol v2: handshake, downgrade, edge frames (all answered in-protocol)
+# ---------------------------------------------------------------------------
+
+def test_v2_handshake_negotiates(sidecar):
+    server, _ = sidecar
+    client = SidecarClient("127.0.0.1", server.port)
+    assert client.server_version == 2
+    assert client.server_max_frame == server.max_frame_bytes
+    client.close()
+
+
+def test_v1_client_interoperates_unchanged(sidecar):
+    """A v1 client (no HELLO) runs the full op set against the v2 server —
+    the handshake-downgrade contract."""
+    server, clock = sidecar
+    lid = server.register("sw", RateLimitConfig(
+        max_permits=3, window_ms=60_000, enable_local_cache=False))
+    client = SidecarClient("127.0.0.1", server.port, protocol=1)
+    assert client.server_version == 1  # never handshook
+    assert client.ping()
+    clock.t = (T0 // 60_000) * 60_000
+    assert [client.try_acquire(lid, "v1user") for _ in range(5)] == \
+        [True, True, True, False, False]
+    assert client.available(lid, "v1user") == 0
+    client.reset(lid, "v1user")
+    assert client.try_acquire(lid, "v1user")
+    with pytest.raises(RuntimeError):
+        client.try_acquire(9999, "nobody")
+    client.close()
+
+
+def test_edge_frames_answered_in_protocol(sidecar):
+    """Zero-length key, max-length key, permits=0, unknown limiter, and a
+    malformed frame — all answered in-protocol on ONE connection, which
+    keeps working afterwards (no teardown, no handler exception)."""
+    import struct
+
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    client = SidecarClient("127.0.0.1", server.port)
+
+    # zero-length key: a legal key (one shared bucket).
+    assert client.try_acquire(lid, "") is True
+    # max-length key: exactly at the bound.
+    big = "k" * server.max_key_bytes
+    assert client.try_acquire(lid, big) is True
+    # one byte over: BAD_FRAME, in-protocol.
+    with pytest.raises(RuntimeError):
+        client.try_acquire(lid, big + "k")
+    # permits=0 clamps to 1 (documented v1 behavior, kept).
+    assert client.try_acquire(lid, "zero", permits=0) is True
+    # unknown limiter id: typed error, connection lives.
+    with pytest.raises(RuntimeError):
+        client.try_acquire(9999, "nobody")
+    # short frame (length < body header): BAD_FRAME with errno.
+    client._send(struct.pack("<I", 3) + b"abc")
+    status, _, errno = client._read_raw()
+    assert (status, errno) == (sc.ST_BAD_FRAME, sc.ERR_SHORT_FRAME)
+    # ... and the connection still decides afterwards.
+    assert client.try_acquire(lid, "after-the-storm") is True
+    client.close()
+
+
+def test_oversized_declared_frame_stays_in_sync(sidecar):
+    """A frame declaring more than max_frame_bytes is answered BAD_FRAME
+    and its payload discarded as it streams — the next frame decides."""
+    import struct
+
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    client = SidecarClient("127.0.0.1", server.port)
+    declared = server.max_frame_bytes + 1000
+    client._send(struct.pack("<I", declared) + b"\x00" * declared)
+    status, _, errno = client._read_raw()
+    assert (status, errno) == (sc.ST_BAD_FRAME, sc.ERR_FRAME_TOO_LONG)
+    assert client.try_acquire(lid, "still-alive") is True
+    assert server.malformed_total >= 1
+    client.close()
+
+
+def test_graceful_drain_on_stop():
+    """stop() drains: new decision frames answer SHUTTING_DOWN (typed for
+    v2 clients) instead of a dead socket."""
+    from ratelimiter_tpu.service import sidecar as sc
+
+    clock = FakeClock()
+    storage = TpuBatchedStorage(num_slots=256, max_delay_ms=0.2,
+                                clock_ms=clock)
+    server = SidecarServer(storage, host="127.0.0.1",
+                           drain_timeout_ms=200.0).start()
+    try:
+        lid = server.register("tb", RateLimitConfig(
+            max_permits=10, window_ms=60_000, refill_rate=5.0))
+        client = SidecarClient("127.0.0.1", server.port)
+        assert client.try_acquire(lid, "pre-drain") is True
+        server._draining = True  # what stop() sets first
+        got = client.acquire_batch(lid, ["a", "b"])
+        assert all(s == sc.ST_SHUTTING_DOWN for s, _, _ in got)
+        assert server.drained_total == 2
+        client.close()
+    finally:
+        server.stop()
+        storage.close()
+
+
+def test_global_connection_limit():
+    clock = FakeClock()
+    storage = TpuBatchedStorage(num_slots=256, max_delay_ms=0.2,
+                                clock_ms=clock)
+    server = SidecarServer(storage, host="127.0.0.1",
+                           max_connections=2).start()
+    try:
+        a = SidecarClient("127.0.0.1", server.port)
+        b = SidecarClient("127.0.0.1", server.port)
+        # The third connection is refused: handshake gets EOF.
+        with pytest.raises(ConnectionError):
+            SidecarClient("127.0.0.1", server.port, timeout=2.0)
+        assert server.refused_total == 1
+        assert a.ping() and b.ping()  # accepted conns unaffected
+        a.close()
+        b.close()
+    finally:
+        server.stop()
+        storage.close()

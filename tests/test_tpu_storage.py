@@ -553,3 +553,45 @@ def test_drain_set_error_propagation_and_backpressure():
         assert blocked >= 0.15, blocked  # the cap actually held
     finally:
         pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# Clock-regression clamp observability
+# ---------------------------------------------------------------------------
+
+def test_backward_clock_clamped_and_counted():
+    """A wall clock stepping backwards (NTP) is absorbed by the monotonic
+    stamp clamp — and now COUNTED in ratelimiter.time.backward_clamp so
+    the event is observable instead of silent."""
+    clock = FakeClock()
+    registry = MeterRegistry()
+    storage = TpuBatchedStorage(num_slots=64, max_delay_ms=0.1,
+                                clock_ms=clock, meter_registry=registry)
+    try:
+        meter = registry.counter("ratelimiter.time.backward_clamp")
+        assert storage._monotonic_now() == T0
+        clock.t = T0 - 5_000  # NTP step backwards
+        assert storage._monotonic_now() == T0  # clamped, not regressed
+        assert storage.backward_clamps == 1
+        assert meter.count() == 1
+        clock.t = T0 - 1  # still behind: every regressed read counts
+        assert storage._monotonic_now() == T0
+        assert storage.backward_clamps == 2
+        assert meter.count() == 2
+        clock.t = T0 + 7
+        assert storage._monotonic_now() == T0 + 7  # clock caught up
+        assert storage.backward_clamps == 2
+
+        # Decisions keep flowing at the clamped stamp: a regressed batch
+        # must not roll windows backwards or zero live counts.
+        lid = storage.register_limiter("sw", RateLimitConfig(
+            max_permits=3, window_ms=60_000, enable_local_cache=False))
+        clock.t = ((T0 + 7) // 60_000) * 60_000 + 120_000  # fresh window
+        allowed = [storage.acquire("sw", lid, "ntp", 1)["allowed"]
+                   for _ in range(3)]
+        clock.t -= 90_000  # regress past a window boundary
+        denied = storage.acquire("sw", lid, "ntp", 1)["allowed"]
+        assert allowed == [True, True, True] and not denied
+        assert storage.backward_clamps >= 3
+    finally:
+        storage.close()

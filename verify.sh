@@ -86,6 +86,26 @@ else
   fail=1
 fi
 
+echo "running fast ingress drill (sidecar chaos)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sidecar_chaos.py::test_ingress_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  ingress drill"
+else
+  echo "  FAILED  ingress drill"
+  fail=1
+fi
+
+echo "running hardened sidecar loopback ratio (>= 0.9x unhardened)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/sidecar_loopback.py \
+    --assert-ratio > /dev/null; then
+  echo "  ok  hardened loopback throughput"
+else
+  echo "  FAILED  hardened loopback throughput (ingress hardening costs"
+  echo "          more than 10% of the unhardened baseline)"
+  fail=1
+fi
+
 echo "regenerating CAPABILITIES.md test/LoC counts..."
 if python bench/gen_capabilities.py; then
   echo "  ok  capability counts"
@@ -104,11 +124,12 @@ else
 fi
 
 if [[ "${RUN_SLOW:-0}" == "1" ]]; then
-  echo "running slow failover + overload + outage soaks (RUN_SLOW=1)..."
+  echo "running slow failover + overload + outage + ingress soaks (RUN_SLOW=1)..."
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
       tests/test_replication.py::test_failover_soak_slow \
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
+      tests/test_sidecar_chaos.py::test_ingress_soak_slow \
       -q -m slow -p no:cacheprovider; then
     echo "  ok  slow soaks"
   else
