@@ -474,6 +474,31 @@ def _restore_flat(index, entries) -> None:
                        if s not in used]
 
 
+def dump_shard_slot_indexes(storage, shard: int) -> Dict:
+    """Serialize ONE shard's key->slot sub-indexes (local slot ids) in
+    the same payload shape ``restore_slot_indexes`` accepts on a FLAT
+    storage of ``slots_per_shard`` geometry — the per-shard replication
+    stream's index journal (replication/sharded.py): a per-shard standby
+    is just an ordinary flat standby, so its promotion path is the
+    ordinary ``promote_from_replica``."""
+    out: Dict = {"algos": {}}
+    for algo, index in storage._index.items():
+        if not hasattr(index, "_sub"):
+            raise ValueError("per-shard index dump needs the sharded "
+                             "slot index")
+        sub = index._sub[int(shard)]
+        if hasattr(sub, "dump_fp"):
+            payload = _fp_payload(sub)
+            payload["kind"] = "native_fp"
+            out["algos"][algo] = payload
+        elif hasattr(sub, "_map"):
+            out["algos"][algo] = {"kind": "flat",
+                                  "entries": _dump_flat(sub)}
+        else:
+            raise ValueError("slot sub-index is not enumerable")
+    return out
+
+
 def dump_slot_indexes(storage) -> Dict:
     """Serialize key->slot maps of a TpuBatchedStorage.
 

@@ -148,18 +148,25 @@ class DeviceEngine:
         self._tb_reset = jax.jit(tb_reset_p, donate_argnums=0)
 
     # -- dirty-slot journal hooks (replication) --------------------------------
-    def _mark(self, algo: str, slots) -> None:
+    # Each hook takes the HOST lane array plus (optionally) the same
+    # array already converted for the dispatch: a device journal
+    # (engine/state.py:DeviceSlotJournal) marks from the device copy —
+    # zero extra host work or upload — while the host journal keeps its
+    # numpy path (handing it a device array would force a sync fetch).
+    def _mark(self, algo: str, slots, dev=None) -> None:
         j = self.journal
         if j is not None:
-            j.mark(algo, slots)
+            j.mark(algo, dev if dev is not None
+                   and getattr(j, "device", False) else slots)
 
-    def _mark_words(self, algo: str, words) -> None:
+    def _mark_words(self, algo: str, words, dev=None) -> None:
         """Mark from relay uwords (slot in the high bits; padding words
         decode past num_slots and are filtered by the journal)."""
         j = self.journal
         if j is not None:
-            j.mark(algo, np.asarray(words).astype(np.uint64)
-                   >> np.uint64(self.rank_bits + 1))
+            j.mark_words(algo, dev if dev is not None
+                         and getattr(j, "device", False) else words,
+                         self.rank_bits)
 
     # -- i64 field view (checkpoint/compat) ------------------------------------
     @property
@@ -253,8 +260,9 @@ class DeviceEngine:
         return self._scan_dispatch("tb", slots_kb, lids, permits_kb, now_k)
 
     def _scan_dispatch(self, algo, slots_kb, lids, permits_kb, now_k):
-        self._mark(algo, slots_kb)
+        slots_host = slots_kb
         slots_kb = jnp.asarray(np.ascontiguousarray(slots_kb, dtype=np.int32))
+        self._mark(algo, slots_host, dev=slots_kb)
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
         else:
@@ -287,8 +295,9 @@ class DeviceEngine:
         return self._flat_dispatch("tb", slots, lids, permits, now_ms)
 
     def _flat_dispatch(self, algo, slots, lids, permits, now_ms):
-        self._mark(algo, slots)
+        slots_host = slots
         slots = jnp.asarray(np.ascontiguousarray(slots, dtype=np.int32))
+        self._mark(algo, slots_host, dev=slots)
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
         else:
@@ -331,8 +340,9 @@ class DeviceEngine:
     def _relay_dispatch(self, algo, words, lids, now_ms):
         """words uint32[B] (padding 0xFFFFFFFF); lids scalar or i32[B];
         returns a lazy uint8[B/8] arrival-order allow bitmask handle."""
-        self._mark_words(algo, words)
+        words_host = words
         words = jnp.asarray(np.ascontiguousarray(words, dtype=np.uint32))
+        self._mark_words(algo, words_host, dev=words)
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
         else:
@@ -384,7 +394,9 @@ class DeviceEngine:
             tb_relay_weighted,
         )
 
-        self._mark_words(algo, uwords)
+        uwords_host = uwords
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        self._mark_words(algo, uwords_host, dev=uwords)
         key = (algo, int(r_steps))
         fn = self._relay_weighted.get(key)
         if fn is None:
@@ -393,7 +405,6 @@ class DeviceEngine:
                 base, rank_bits=self.rank_bits, r_steps=int(r_steps)),
                 donate_argnums=0)
             self._relay_weighted[key] = fn
-        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
         perms_rank = jnp.asarray(
             np.ascontiguousarray(perms_rank, dtype=np.uint8))
         roff = jnp.asarray(np.ascontiguousarray(roff, dtype=np.int32))
@@ -504,7 +515,9 @@ class DeviceEngine:
             tb_relay_counts_resident,
         )
 
-        self._mark_words(algo, uwords)
+        uwords_host = uwords
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        self._mark_words(algo, uwords_host, dev=uwords)
 
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
         key = (algo, out_dtype().dtype.name, bool(slots_sorted))
@@ -517,7 +530,6 @@ class DeviceEngine:
                 slots_sorted=bool(slots_sorted)),
                 donate_argnums=(0, 1))
             self._relay_resident[key] = fn
-        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
         delta_slots = jnp.asarray(
             np.ascontiguousarray(delta_slots, dtype=np.int32))
         delta_lids = jnp.asarray(
@@ -545,7 +557,9 @@ class DeviceEngine:
         memory-resident gather+update+scatter pass) when the measured
         per-path election picked it on this device, else the composed
         XLA step with the dense presorted block sweep."""
-        self._mark_words(algo, uwords)
+        uwords_host = uwords
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        self._mark_words(algo, uwords_host, dev=uwords)
         jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
         fused = bool(slots_sorted) and np.ndim(lids) == 0 and (
             self._relay_fused_ok(algo, len(uwords)))
@@ -569,7 +583,6 @@ class DeviceEngine:
                     slots_sorted=bool(slots_sorted)),
                     donate_argnums=0)
             self._relay_counts[key] = fn
-        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
         if np.ndim(lids) == 0:
             lids = jnp.asarray(np.int32(lids))
         else:

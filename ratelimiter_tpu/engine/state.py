@@ -218,6 +218,32 @@ class SlotJournal:
                 if self._oldest_ns is None:
                     self._oldest_ns = time.time_ns()
 
+    def mark_words(self, algo: str, words, rank_bits: int) -> None:
+        """Mark from relay uwords (slot in the high bits; padding words
+        decode past num_slots and are filtered by :meth:`mark`)."""
+        self.mark(algo, np.asarray(words).astype(np.uint64)
+                  >> np.uint64(rank_bits + 1))
+
+    def mark_matrix(self, algo: str, mat, slots_per_shard: int) -> None:
+        """Mark from a sharded (n_shards, ...) LOCAL-slot matrix: local id
+        + shard row offset = global slot (negative lanes are padding)."""
+        m = np.asarray(mat, dtype=np.int64)
+        m = m.reshape(m.shape[0], -1)
+        base = (np.arange(m.shape[0], dtype=np.int64)
+                * slots_per_shard)[:, None]
+        self.mark(algo, np.where(m >= 0, m + base, -1))
+
+    def mark_words_matrix(self, algo: str, wmat, rank_bits: int,
+                          slots_per_shard: int) -> None:
+        """Sharded relay words: per-shard LOCAL slots in the high bits
+        (padding decodes past slots_per_shard and is dropped)."""
+        w = np.asarray(wmat).astype(np.uint64)
+        w = w.reshape(w.shape[0], -1)
+        loc = (w >> np.uint64(rank_bits + 1)).astype(np.int64)
+        base = (np.arange(w.shape[0], dtype=np.int64)
+                * slots_per_shard)[:, None]
+        self.mark(algo, np.where(loc < slots_per_shard, loc + base, -1))
+
     def mark_all(self, algo: str) -> None:
         """Mark every slot dirty (bulk restores/imports, or a full-state
         catch-up frame after a ship failure or a late-joining standby)."""
@@ -253,3 +279,165 @@ class SlotJournal:
         with self._lock:
             return sum(self.num_slots if self._all[a] else int(m.sum())
                        for a, m in self._dirty.items())
+
+
+class DeviceSlotJournal:
+    """Device-resident dirty-slot journal: the touched-slot bitmap lives
+    in device memory and is updated by a tiny jitted scatter riding each
+    dispatch's already-uploaded lane arrays.
+
+    The host ``SlotJournal`` pays an O(batch) numpy pass on the decision
+    path per dispatch (bounds filter + boolean scatter, plus a u64 shift
+    for relay words).  This journal replaces that with one asynchronous
+    device op: the engine hands over the SAME device array the dispatch
+    uploads (relay words, slot lanes, sharded local-slot matrices), so
+    the mark costs one dispatch-call overhead and zero extra host->device
+    bytes — the delta extraction is amortized into the dispatch that
+    already runs.  ``drain`` fetches the bitmap off the decision path
+    (the Replicator thread) and swaps in fresh zeros.
+
+    Same contract as ``SlotJournal``: marks are a superset of mutations
+    (over-marking ships idempotent truth), out-of-range ids (padding -1,
+    relay padding words) are masked out on device, and marks racing a
+    drain land in the next epoch (the bitmap reference swap is under the
+    journal lock).  Which journal serves is a measured election
+    (replication/log.py) with this one preferred; the host journal is
+    the permanent fallback.
+    """
+
+    device = True  # engine hooks pass device-resident arrays when they can
+
+    __slots__ = ("num_slots", "_lock", "_bits", "_all", "_oldest_ns",
+                 "marks", "_fns")
+
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self._lock = threading.Lock()
+        self._bits: Dict[str, jax.Array] = {
+            "sw": jnp.zeros(self.num_slots, dtype=jnp.bool_),
+            "tb": jnp.zeros(self.num_slots, dtype=jnp.bool_),
+        }
+        self._all = {"sw": False, "tb": False}
+        self._oldest_ns: Optional[int] = None
+        self.marks = 0
+        self._fns: Dict[tuple, object] = {}
+
+    # -- jitted mark kernels (cached per static geometry) ---------------------
+    def _fn(self, kind: str, **static):
+        key = (kind,) + tuple(sorted(static.items()))
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        S = self.num_slots
+        if kind == "slots":
+            def mark(bits, arr):
+                s = arr.reshape(-1).astype(jnp.int32)
+                ok = (s >= 0) & (s < S)
+                return bits.at[jnp.clip(s, 0, S - 1)].max(ok)
+        elif kind == "words":
+            rb = static["rank_bits"]
+
+            def mark(bits, arr):
+                s = (arr.reshape(-1) >> jnp.uint32(rb + 1)).astype(jnp.int32)
+                ok = s < S  # padding 0xFFFFFFFF decodes past num_slots
+                return bits.at[jnp.clip(s, 0, S - 1)].max(ok)
+        elif kind == "matrix":
+            sps = static["sps"]
+
+            def mark(bits, arr):
+                m = arr.reshape(arr.shape[0], -1).astype(jnp.int32)
+                base = (jnp.arange(m.shape[0], dtype=jnp.int32)
+                        * sps)[:, None]
+                s = jnp.where(m >= 0, m + base, -1).reshape(-1)
+                ok = (s >= 0) & (s < S)
+                return bits.at[jnp.clip(s, 0, S - 1)].max(ok)
+        elif kind == "words_matrix":
+            rb, sps = static["rank_bits"], static["sps"]
+
+            def mark(bits, arr):
+                w = arr.reshape(arr.shape[0], -1)
+                loc = (w >> jnp.uint32(rb + 1)).astype(jnp.int32)
+                base = (jnp.arange(w.shape[0], dtype=jnp.int32)
+                        * sps)[:, None]
+                ok = loc < sps
+                s = jnp.clip(jnp.where(ok, loc + base, 0),
+                             0, S - 1).reshape(-1)
+                return bits.at[s].max(ok.reshape(-1))
+        else:  # pragma: no cover - internal misuse
+            raise ValueError(kind)
+        fn = jax.jit(mark, donate_argnums=0)
+        self._fns[key] = fn
+        return fn
+
+    @staticmethod
+    def _as_device(arr):
+        if isinstance(arr, jax.Array):
+            return arr
+        a = np.asarray(arr)
+        return None if a.size == 0 else jnp.asarray(a)
+
+    def _apply(self, algo: str, fn, arr) -> None:
+        if arr is None:
+            return
+        with self._lock:
+            self.marks += 1
+            self._bits[algo] = fn(self._bits[algo], arr)
+            if self._oldest_ns is None:
+                self._oldest_ns = time.time_ns()
+
+    # -- mark surface (superset of SlotJournal's) -----------------------------
+    def mark(self, algo: str, slots) -> None:
+        self._apply(algo, self._fn("slots"), self._as_device(slots))
+
+    def mark_words(self, algo: str, words, rank_bits: int) -> None:
+        self._apply(algo, self._fn("words", rank_bits=int(rank_bits)),
+                    self._as_device(words))
+
+    def mark_matrix(self, algo: str, mat, slots_per_shard: int) -> None:
+        self._apply(algo, self._fn("matrix", sps=int(slots_per_shard)),
+                    self._as_device(mat))
+
+    def mark_words_matrix(self, algo: str, wmat, rank_bits: int,
+                          slots_per_shard: int) -> None:
+        self._apply(algo, self._fn("words_matrix", rank_bits=int(rank_bits),
+                                   sps=int(slots_per_shard)),
+                    self._as_device(wmat))
+
+    def mark_all(self, algo: str) -> None:
+        with self._lock:
+            self._all[algo] = True
+            if self._oldest_ns is None:
+                self._oldest_ns = time.time_ns()
+
+    # -- drain (off the decision path) ----------------------------------------
+    def drain(self) -> Tuple[Dict[str, np.ndarray], Optional[int], bool]:
+        """Fetch + swap the bitmaps; same return contract as
+        ``SlotJournal.drain``.  The fetch blocks on any in-flight mark
+        ops for the swapped buffer — marks dispatched after the swap
+        land in the NEXT epoch."""
+        with self._lock:
+            out: Dict[str, np.ndarray] = {}
+            was_all = False
+            for algo in ("sw", "tb"):
+                if self._all[algo]:
+                    out[algo] = np.arange(self.num_slots, dtype=np.int64)
+                    self._all[algo] = False
+                    self._bits[algo] = jnp.zeros(self.num_slots,
+                                                 dtype=jnp.bool_)
+                    was_all = True
+                else:
+                    host = np.asarray(self._bits[algo])
+                    ids = np.nonzero(host)[0].astype(np.int64)
+                    if len(ids):
+                        out[algo] = ids
+                        self._bits[algo] = jnp.zeros(self.num_slots,
+                                                     dtype=jnp.bool_)
+            oldest = self._oldest_ns
+            self._oldest_ns = None
+            return out, oldest, was_all
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(self.num_slots if self._all[a]
+                       else int(jnp.count_nonzero(b))
+                       for a, b in self._bits.items())

@@ -79,6 +79,67 @@ _FLAG = os.environ.get("RATELIMITER_RELAY_FUSED", "1") == "1"
 _INTERPRET = os.environ.get(
     "RATELIMITER_RELAY_FUSED_INTERPRET", "0") == "1"
 _probe_ok: bool | None = None
+# Fallback observability (PR 4 silent-degrade fix): a probe failure on
+# real hardware means the fused kernel silently stops serving — record
+# why, warn ONCE, and surface it via fallback_info() so /actuator/health
+# and the ratelimiter.pallas.fused_fallback gauge can report it.
+_fallback_reason: str | None = None
+_warned = False
+
+
+def _note_fallback(reason: str) -> None:
+    global _fallback_reason, _warned
+    _fallback_reason = reason
+    if not _warned:
+        _warned = True
+        from ratelimiter_tpu.utils.logging import get_logger
+
+        get_logger("pallas.relay_step").warning(
+            "fused Pallas relay step not serving (%s); decisions fall "
+            "back to the composed XLA step — see "
+            "ratelimiter.pallas.fused_fallback and "
+            "pallas.relay_fused_live in /actuator/health", reason)
+
+
+def fallback_info() -> dict:
+    """Live/fallback status of the fused relay step for health payloads
+    and metrics (reads only already-settled state — never triggers a
+    probe or compile).
+
+    ``relay_fused_live`` — the kernel will serve eligible dispatches;
+    ``probe_failed`` — the differential probe failed on this hardware
+    (the silent-degrade trap: supported platform, losing kernel);
+    ``reason`` — why the kernel is not live, when it is not.
+    """
+    import jax
+
+    platform_ok = _INTERPRET or jax.default_backend() == "tpu"
+    elected = None
+    if _probe_ok:
+        from ratelimiter_tpu.ops.pallas import election
+
+        verdict = election.report().get("relay_fused")
+        elected = None if verdict is None else bool(verdict["elected"])
+    live = bool(_FLAG and platform_ok and _probe_ok and elected)
+    reason = None
+    if not live:
+        if not _FLAG:
+            reason = "disabled (RATELIMITER_RELAY_FUSED=0)"
+        elif _probe_ok is False:
+            # The trap this exists for: supported platform, losing
+            # kernel — outranks every other explanation.
+            reason = _fallback_reason or "probe failed"
+        elif not platform_ok:
+            reason = f"platform {jax.default_backend()} (TPU-only kernel)"
+        elif _probe_ok is None:
+            reason = "not probed yet"
+        elif elected is None:
+            reason = "not elected yet"
+        else:
+            reason = "election lost (XLA measured faster)"
+    return {"relay_fused_live": live,
+            "probe_failed": _probe_ok is False,
+            "reason": reason}
 
 _SIGN = -2147483648   # 0x80000000 as i32
 _M16 = 0xFFFF
@@ -573,10 +634,14 @@ def _probe() -> bool:
                     and np.array_equal(np.asarray(want_c),
                                        np.asarray(got_c))):
                 _probe_ok = False
+                _note_fallback(f"probe mismatch ({algo}): fused output "
+                               "diverged from the composed XLA step")
                 return False
         _probe_ok = True
-    except Exception:  # noqa: BLE001 — any lowering failure => fallback
+    except Exception as exc:  # noqa: BLE001 — any lowering failure => fallback
         _probe_ok = False
+        _note_fallback(f"probe error: {type(exc).__name__}: "
+                       f"{str(exc)[:160]}")
     return _probe_ok
 
 

@@ -369,12 +369,22 @@ class ShardedDeviceEngine:
     across all shards by the on-device psum.
     """
 
+    # Per-shard replication (replication/sharded.py): every dispatch path
+    # marks its touched slots (global ids) into an attached journal, so a
+    # ShardedReplicationLog can cut per-shard epoch deltas.  The flat
+    # ReplicationLog refuses this engine — shard streams must ship
+    # independently so one shard can be promoted without the world.
+    supports_replication = True
+
     def __init__(self, slots_per_shard: int, table: LimiterTable, mesh=None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.devices.size
         self.slots_per_shard = int(slots_per_shard)
         self.num_slots = self.n_shards * self.slots_per_shard
         self.table = table
+        # Dirty-slot journal (engine/state.py): None (default) keeps the
+        # hot path at one attribute check per dispatch.
+        self.journal = None
         self._lock = threading.RLock()
         self.last_step_totals = (0, 0)
         # Monotone stamp so concurrent drains (the batcher's drain pool
@@ -410,6 +420,29 @@ class ShardedDeviceEngine:
         self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset_p), donate_argnums=0)
         self._scan_fns = {}
 
+    # -- dirty-slot journal hooks (per-shard replication) ----------------------
+    # Same host/device split as DeviceEngine's hooks: a device journal
+    # marks from the dispatch's own uploaded matrix (one async device op,
+    # zero extra bytes); the host journal gets the host copy.
+    def _mark_mat(self, algo: str, mat, dev=None) -> None:
+        j = self.journal
+        if j is not None:
+            j.mark_matrix(algo, dev if dev is not None
+                          and getattr(j, "device", False) else mat,
+                          self.slots_per_shard)
+
+    def _mark_words_mat(self, algo: str, wmat, dev=None) -> None:
+        j = self.journal
+        if j is not None:
+            j.mark_words_matrix(algo, dev if dev is not None
+                                and getattr(j, "device", False) else wmat,
+                                self.rank_bits, self.slots_per_shard)
+
+    def _mark_global(self, algo: str, slots) -> None:
+        j = self.journal
+        if j is not None:
+            j.mark(algo, slots)
+
     # -- i64 field view (checkpoint/compat) ------------------------------------
     @property
     def sw_state(self):
@@ -417,6 +450,8 @@ class ShardedDeviceEngine:
 
     @sw_state.setter
     def sw_state(self, state) -> None:
+        if self.journal is not None:
+            self.journal.mark_all("sw")
         self.sw_packed = jax.device_put(
             sw_pack_state(type(state)(*(jnp.asarray(f) for f in state))),
             self._state_sharding)
@@ -427,6 +462,8 @@ class ShardedDeviceEngine:
 
     @tb_state.setter
     def tb_state(self, state) -> None:
+        if self.journal is not None:
+            self.journal.mark_all("tb")
         self.tb_packed = jax.device_put(
             tb_pack_state(type(state)(*(jnp.asarray(f) for f in state))),
             self._state_sharding)
@@ -476,7 +513,9 @@ class ShardedDeviceEngine:
         """slots_sb: i32[n_shards, B_local] LOCAL slot ids (-1 padding);
         lids scalar or i32[n_shards, B_local]; permits likewise or None;
         now_ms scalar.  Returns a lazy uint8[n_shards, ceil(B/8)] handle."""
+        slots_host = slots_sb
         slots_sb = jnp.asarray(np.ascontiguousarray(slots_sb, dtype=np.int32))
+        self._mark_mat(algo, slots_host, dev=slots_sb)
         lids_scalar = np.ndim(lids) == 0
         if lids_scalar:
             lids = jnp.asarray(np.int32(lids))
@@ -573,8 +612,10 @@ class ShardedDeviceEngine:
         ids (0xFFFFFFFF padding); lids scalar or i32[n_shards, B_local].
         Returns a lazy (n_shards, B/8) bits or (n_shards, B) counts
         handle."""
+        words_host = words_sb
         words_sb = jnp.asarray(
             np.ascontiguousarray(words_sb, dtype=np.uint32))
+        self._mark_words_mat(algo, words_host, dev=words_sb)
         lids_scalar = np.ndim(lids) == 0
         if lids_scalar:
             lids = jnp.asarray(np.int32(lids))
@@ -596,7 +637,9 @@ class ShardedDeviceEngine:
         """slots_skb: i32[n_shards, K, B_local] LOCAL slot ids (-1 padding);
         lids: scalar or i32[n_shards, K, B_local]; permits likewise or None;
         now_k: i64[K].  Returns a lazy uint8[n_shards, K, ceil(B/8)] handle."""
+        slots_host = slots_skb
         slots_skb = jnp.asarray(np.ascontiguousarray(slots_skb, dtype=np.int32))
+        self._mark_mat(algo, slots_host, dev=slots_skb)
         lids_scalar = np.ndim(lids) == 0
         if lids_scalar:
             lids = jnp.asarray(np.int32(lids))
@@ -655,6 +698,7 @@ class ShardedDeviceEngine:
     # that lets the micro-batcher pipeline fetches against dispatches) ------
     def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
+        self._mark_mat("sw", mat)
         with self._lock:
             new_state, out, totals = self._sw_step(
                 self.sw_packed, self.table.device_arrays,
@@ -688,6 +732,7 @@ class ShardedDeviceEngine:
 
     def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
+        self._mark_mat("tb", mat)
         with self._lock:
             new_state, out, totals = self._tb_step(
                 self.tb_packed, self.table.device_arrays,
@@ -734,25 +779,40 @@ class ShardedDeviceEngine:
 
     def sw_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
+        self._mark_mat("sw", mat)
         with self._lock:
             self.sw_packed = self._sw_reset(self.sw_packed, jnp.asarray(mat))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
+        self._mark_mat("tb", mat)
         with self._lock:
             self.tb_packed = self._tb_reset(self.tb_packed, jnp.asarray(mat))
 
-    # -- raw packed-row access (export/import rebalance) ----------------------
+    # -- raw packed-row access (export/import rebalance; replication cuts) ----
     def read_rows(self, algo: str, slots) -> np.ndarray:
+        """Packed rows for GLOBAL slot ids — device-side gather, so a
+        per-shard replication cut fetches only its dirty rows instead of
+        round-tripping the whole (n_shards, S_local, L) array.  Inputs
+        are padded to a power of two so cut-to-cut count jitter reuses
+        a handful of gather compilations."""
         slots = np.asarray(slots, dtype=np.int64)
-        shard = slots // self.slots_per_shard
-        local = slots % self.slots_per_shard
+        n = len(slots)
+        if n == 0:
+            packed = self.sw_packed if algo == "sw" else self.tb_packed
+            return np.empty((0, packed.shape[-1]), dtype=np.int32)
+        size = _bucket(n, floor=256)
+        padded = np.zeros(size, dtype=np.int64)
+        padded[:n] = slots
+        shard = jnp.asarray(padded // self.slots_per_shard, dtype=jnp.int32)
+        local = jnp.asarray(padded % self.slots_per_shard, dtype=jnp.int32)
         with self._lock:
             packed = self.sw_packed if algo == "sw" else self.tb_packed
-            host = np.asarray(packed)  # [n_shards, S_local, lanes]
-        return host[shard, local]
+            rows = packed[shard, local]
+        return np.asarray(rows)[:n]
 
     def write_rows(self, algo: str, slots, rows: np.ndarray) -> None:
+        self._mark_global(algo, slots)
         slots = np.asarray(slots, dtype=np.int64)
         shard = jnp.asarray(slots // self.slots_per_shard, dtype=jnp.int32)
         local = jnp.asarray(slots % self.slots_per_shard, dtype=jnp.int32)

@@ -1,28 +1,48 @@
-"""Live state replication & hot-standby failover for the TPU engine.
+"""Live state replication & failover for the TPU engine — shard-aware.
 
 The availability layer Redis AOF/replication gave the reference and the
 device-resident engine lacked: the primary's engine journals dirty slots
-per dispatched batch (engine/state.py:SlotJournal), a ``ReplicationLog``
-coalesces them into epoch-stamped frames (replication/wire.py), an async
-``Replicator`` ships the frames off the decision path, and a
-``StandbyReceiver`` applies them to a shadow engine that can be promoted
-on failover with decisions bit-identical to ``semantics/oracle.py`` for
-every key at or before the last replicated epoch.
+per dispatched batch — a device-resident touched-slot bitmap
+(engine/state.py:DeviceSlotJournal) riding the dispatch's own uploaded
+lanes, elected per device against the host-scatter fallback
+(SlotJournal) — a ``ReplicationLog`` coalesces them into epoch-stamped
+frames (replication/wire.py), an async ``Replicator`` ships the frames
+off the decision path behind a byte-bounded in-flight queue (slow links
+coalesce cuts instead of growing host memory), and a ``StandbyReceiver``
+applies them to a shadow engine that can be promoted on failover with
+decisions bit-identical to ``semantics/oracle.py`` for every key at or
+before the last replicated epoch.
+
+A SHARDED engine replicates per shard (replication/sharded.py): each
+shard ships its own epoch stream into a standby mesh of ordinary flat
+standbys, a dead shard is promoted alone while the surviving shards
+keep serving behind a ``ShardFailoverRouter``, and health reports a
+DEGRADED-shard state instead of DOWN.
 
 Wiring (service/wiring.py) is config-gated and OFF by default:
 
     replication.enabled     = true
     replication.role        = primary | standby
-    replication.target      = standby-host:7401        (primary)
+    replication.target      = standby-host:7401        (flat primary)
+    replication.targets     = h0:7401,h1:7401,...      (sharded primary,
+                                                        one per shard)
     replication.listen_port = 7401                     (standby)
     replication.interval_ms = 200                      (primary)
 """
 
 from ratelimiter_tpu.replication.log import (
     ReplicationLog,
+    device_journal_elected,
     engine_state_fingerprint,
+    make_journal,
 )
 from ratelimiter_tpu.replication.replicator import Replicator
+from ratelimiter_tpu.replication.sharded import (
+    ShardedReplicationLog,
+    ShardedReplicator,
+    ShardFailoverRouter,
+    ShardStandbySet,
+)
 from ratelimiter_tpu.replication.standby import (
     ReplicationStateError,
     StandbyReceiver,
@@ -49,11 +69,17 @@ __all__ = [
     "ReplicationServer",
     "ReplicationStateError",
     "Replicator",
+    "ShardFailoverRouter",
+    "ShardStandbySet",
+    "ShardedReplicationLog",
+    "ShardedReplicator",
     "SocketSink",
     "StandbyReceiver",
     "TeeSink",
     "chunk_frames",
     "decode_frame",
+    "device_journal_elected",
     "encode_frame",
     "engine_state_fingerprint",
+    "make_journal",
 ]

@@ -1,9 +1,23 @@
 """Replication log: dirty-slot deltas coalesced into epoch-stamped frames.
 
-The primary's ``DeviceEngine`` marks every slot a dispatch touches into a
-``SlotJournal`` (engine/state.py) — off the decision path, one boolean
-scatter per batch.  ``ReplicationLog.cut()`` turns the journal's
-accumulated delta into wire frames:
+The primary's engine marks every slot a dispatch touches into a journal —
+off the decision path.  Two journal backends exist (engine/state.py):
+
+- ``DeviceSlotJournal`` (preferred): the touched-slot bitmap lives on the
+  device and is updated by a tiny async scatter over the dispatch's own
+  uploaded lane arrays — the delta extraction rides the dispatch that
+  already runs, and the decision path pays one attribute check plus one
+  enqueue.  ``drain`` fetches the bitmap off the decision path.
+- ``SlotJournal`` (fallback): the original host-side boolean scatter.
+
+Which serves is a measured election (ops/pallas/election.py, path name
+``device_journal``): both journals are timed marking a representative
+batch, and the device pass serves only where it wins — a host where the
+dispatch-call overhead exceeds the numpy scatter keeps the host journal.
+``RATELIMITER_DEVICE_JOURNAL=on|off|auto`` overrides.
+
+``ReplicationLog.cut()`` turns the journal's accumulated delta into wire
+frames:
 
 1. flush the micro-batcher (queued requests dispatch, marking their slots);
 2. drain the journal (atomic swap — marks racing the drain land in the
@@ -23,17 +37,21 @@ pair a new key with its predecessor's row for one epoch — the next cut
 repairs it, and keys whose last mutation precedes the cut are exact,
 which is precisely the "at or before the replicated epoch" guarantee the
 failover drill checks (storage/chaos.py).
+
+The sharded engine is NOT served here: per-shard epochs and standby-mesh
+streams live in replication/sharded.py (``ShardedReplicationLog``).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List
 
 import numpy as np
 
-from ratelimiter_tpu.engine.state import SlotJournal
+from ratelimiter_tpu.engine.state import DeviceSlotJournal, SlotJournal
 from ratelimiter_tpu.replication.wire import DEFAULT_FRAME_BUDGET, chunk_frames
 
 
@@ -41,19 +59,112 @@ def _wall_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+# ---------------------------------------------------------------------------
+# Journal election (device bitmap vs host scatter)
+# ---------------------------------------------------------------------------
+
+_JOURNAL_ENV = "RATELIMITER_DEVICE_JOURNAL"
+
+
+def _measure_journal_ab(num_slots: int = 1 << 16, lanes: int = 1 << 15,
+                        reps: int = 6) -> Dict:
+    """Time both journals marking the same representative batch.
+
+    The device side is timed through a full mark+sync cycle (reps marks,
+    one drain-equivalent fetch) so its async dispatch can't hide compute
+    the host would eventually pay; the host side is the plain numpy
+    scatter.  Keys follow the election module's A/B naming: ``pallas_s``
+    is the device journal, ``xla_s`` the host journal.
+    """
+    import jax.numpy as jnp
+
+    slots = ((np.arange(lanes, dtype=np.int64) * 2654435761)
+             % num_slots).astype(np.int32)
+    dev_arr = jnp.asarray(slots)
+
+    host = SlotJournal(num_slots)
+    host.mark("tb", slots)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        host.mark("tb", slots)
+    host_s = (time.perf_counter() - t0) / reps
+
+    dev = DeviceSlotJournal(num_slots)
+    dev.mark("tb", dev_arr)  # warm (compiles the scatter)
+    dev.drain()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        dev.mark("tb", dev_arr)
+    np.asarray(dev._bits["tb"])  # settle the async chain
+    dev_s = (time.perf_counter() - t0) / reps
+
+    return {"pallas_s": dev_s, "xla_s": host_s,
+            "lanes": lanes, "num_slots": num_slots}
+
+
+def device_journal_elected() -> bool:
+    """Whether the device journal serves on this host/device pair.
+
+    ``RATELIMITER_DEVICE_JOURNAL=on|off`` forces; ``auto`` (default)
+    runs the shared measured election, cached per (platform, device
+    kind) like every Pallas path."""
+    policy = os.environ.get(_JOURNAL_ENV, "auto").lower()
+    if policy in ("on", "always", "1"):
+        return True
+    if policy in ("off", "never", "0"):
+        return False
+    from ratelimiter_tpu.ops.pallas import election
+
+    return election.measured_election("device_journal", _measure_journal_ab)
+
+
+def make_journal(num_slots: int, kind: str = "auto"):
+    """Build the journal a replication log attaches: ``device``,
+    ``host``, or ``auto`` (elected)."""
+    if kind == "device" or (kind == "auto" and device_journal_elected()):
+        return DeviceSlotJournal(num_slots)
+    return SlotJournal(num_slots)
+
+
+def read_rows_padded(engine, algo: str, ids: np.ndarray) -> np.ndarray:
+    """``engine.read_rows`` with the id lane padded to a power of two so
+    cut-to-cut dirty-count jitter reuses a handful of gather shapes
+    instead of compiling one per epoch."""
+    n = len(ids)
+    size = 1 << max(int(n - 1).bit_length(), 8) if n else 0
+    if size <= n:
+        return engine.read_rows(algo, ids)
+    padded = np.concatenate(
+        [ids, np.full(size - n, ids[0] if n else 0, dtype=np.int64)])
+    return engine.read_rows(algo, padded)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Flat (single-device) log
+# ---------------------------------------------------------------------------
+
+
 class ReplicationLog:
     """Owns the primary's journal and cuts epoch-stamped frame batches."""
 
-    def __init__(self, storage, max_frame_bytes: int = DEFAULT_FRAME_BUDGET):
+    def __init__(self, storage, max_frame_bytes: int = DEFAULT_FRAME_BUDGET,
+                 journal_kind: str = "auto"):
         engine = storage.engine
         if not getattr(engine, "supports_replication", False):
             raise ValueError(
-                "replication requires the single-device DeviceEngine "
-                "(the sharded engine is not journaled yet)")
+                "replication requires a journaled engine "
+                "(this backend has none)")
+        if hasattr(engine, "n_shards"):
+            raise ValueError(
+                "the sharded engine replicates per shard — use "
+                "replication.sharded.ShardedReplicationLog so one shard "
+                "can be promoted without the world")
         self.storage = storage
         self.engine = engine
         self.max_frame_bytes = int(max_frame_bytes)
-        self.journal = SlotJournal(engine.num_slots)
+        self.journal = make_journal(engine.num_slots, journal_kind)
+        self.journal_kind = ("device" if getattr(self.journal, "device",
+                                                 False) else "host")
         engine.journal = self.journal
         self.epoch = 0
         self._full_pending = True  # first cut bootstraps the standby
@@ -86,7 +197,7 @@ class ReplicationLog:
             for algo, ids in deltas_ids.items():
                 deltas[algo] = {
                     "slots": ids,
-                    "rows": self.engine.read_rows(algo, ids),
+                    "rows": read_rows_padded(self.engine, algo, ids),
                 }
             from ratelimiter_tpu.engine.checkpoint import (
                 _limiter_table_dump,

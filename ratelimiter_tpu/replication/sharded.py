@@ -1,0 +1,480 @@
+"""Shard-aware replication: per-shard epochs, standby mesh, single-shard
+failover.
+
+The flat pipeline (log.py / replicator.py / standby.py) replicates a
+single-device engine as one stream; a sharded deployment must not — a
+whole-world standby forces whole-world promotion, exactly the "when two
+is worse than one" failure mode at datacenter scale.  Here each shard of
+a ``ShardedDeviceEngine`` ships its OWN delta stream:
+
+- ``ShardedReplicationLog`` owns one journal over the global slot space
+  (device bitmap preferred, like the flat log) and cuts per-shard
+  epochs: the drained dirty set is bucketed by ``slot //
+  slots_per_shard``, and shard q's frames carry LOCAL slot ids, shard
+  q's key->slot sub-index journal, and ``num_slots = slots_per_shard``
+  — so a per-shard standby is an ORDINARY flat standby of
+  ``slots_per_shard`` geometry running the ordinary
+  ``StandbyReceiver``.  Nothing standby-side is shard-special, which is
+  what keeps promotion the already-proven flat path.
+- ``ShardedReplicator`` ships every shard's stream on one cadence with
+  per-shard failure isolation: a dead link to standby q re-marks only
+  q's delta and full-requests only q — the other shards' streams never
+  stall.
+- ``ShardStandbySet`` is the standby mesh: N flat storages + receivers,
+  one per shard.
+- ``ShardFailoverRouter`` is the serving façade after a shard failure:
+  requests route by the SAME key->shard hash the engine uses; a failed
+  shard's keys are denied (bounded under-admission, counted) until its
+  standby is promoted, then served by the promoted flat storage while
+  the surviving shards keep serving from the primary — the
+  DEGRADED-shard state the health machinery reports instead of DOWN.
+
+``storage/chaos.py:shard_failover_drill`` proves the contract: kill one
+shard of N mid-Zipf-stream, promote only it, decisions bit-identical to
+``semantics/oracle.py`` after promotion while survivors never stop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ratelimiter_tpu.replication.log import make_journal, read_rows_padded
+from ratelimiter_tpu.replication.wire import (
+    DEFAULT_FRAME_BUDGET,
+    chunk_frames,
+    encode_frame,
+)
+from ratelimiter_tpu.utils.logging import get_logger
+
+_log = get_logger("replication.sharded")
+
+
+def _wall_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class ShardedReplicationLog:
+    """Per-shard epoch cuts over one global dirty-slot journal."""
+
+    def __init__(self, storage, max_frame_bytes: int = DEFAULT_FRAME_BUDGET,
+                 journal_kind: str = "auto"):
+        engine = storage.engine
+        if not hasattr(engine, "n_shards"):
+            raise ValueError(
+                "ShardedReplicationLog requires the sharded engine; use "
+                "ReplicationLog for a single-device one")
+        self.storage = storage
+        self.engine = engine
+        self.n_shards = int(engine.n_shards)
+        self.slots_per_shard = int(engine.slots_per_shard)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.journal = make_journal(engine.num_slots, journal_kind)
+        self.journal_kind = ("device" if getattr(self.journal, "device",
+                                                 False) else "host")
+        engine.journal = self.journal
+        self.epochs = [0] * self.n_shards
+        self._full_pending = [True] * self.n_shards  # bootstrap each shard
+        # Drained-but-not-yet-cut dirty ids per shard per algo (global).
+        self._pending: List[Dict[str, List[np.ndarray]]] = [
+            {"sw": [], "tb": []} for _ in range(self.n_shards)]
+        self._lock = threading.Lock()
+        self.last_cut_lag_ms = 0.0
+
+    # -- journal plumbing ------------------------------------------------------
+    def _drain_into_pending(self) -> None:
+        """Drain the global journal and bucket the dirty ids by shard
+        (caller holds the lock)."""
+        deltas, oldest_ns, was_all = self.journal.drain()
+        if was_all:
+            # A whole-state mark (bulk restore/import) dirties every
+            # shard completely: their next cuts must ship as FULL frames
+            # so the receivers re-baseline instead of seeing a partial
+            # overlay.
+            for q in range(self.n_shards):
+                self._full_pending[q] = True
+        for algo, ids in deltas.items():
+            shard = ids // self.slots_per_shard
+            for q in np.unique(shard):
+                self._pending[int(q)][algo].append(ids[shard == q])
+        if oldest_ns is not None:
+            self.last_cut_lag_ms = (time.time_ns() - oldest_ns) / 1e6
+        else:
+            self.last_cut_lag_ms = 0.0
+
+    def request_full(self, shard: Optional[int] = None) -> None:
+        """Re-baseline one shard's stream (or all of them)."""
+        with self._lock:
+            shards = range(self.n_shards) if shard is None else [int(shard)]
+            for q in shards:
+                self._full_pending[q] = True
+
+    def cut_shard(self, shard: int) -> List[Dict]:
+        """Cut one epoch for one shard; frames carry LOCAL slot ids and
+        the shard's sub-index journal (empty when nothing changed)."""
+        q = int(shard)
+        sps = self.slots_per_shard
+        with self._lock:
+            self.storage.flush()
+            self._drain_into_pending()
+            full = self._full_pending[q]
+            if full:
+                # A full frame must carry the complete shard state.
+                base = np.arange(q * sps, (q + 1) * sps, dtype=np.int64)
+                for algo in ("sw", "tb"):
+                    self._pending[q][algo] = [base]
+            deltas = {}
+            for algo in ("sw", "tb"):
+                chunks = self._pending[q][algo]
+                if not chunks:
+                    continue
+                self._pending[q][algo] = []
+                ids = (chunks[0] if len(chunks) == 1
+                       else np.unique(np.concatenate(chunks)))
+                deltas[algo] = {
+                    "slots": ids - q * sps,  # LOCAL: standby geometry
+                    "rows": read_rows_padded(self.engine, algo, ids),
+                }
+            if not deltas and not full:
+                return []
+            from ratelimiter_tpu.engine.checkpoint import (
+                _limiter_table_dump,
+                dump_shard_slot_indexes,
+            )
+
+            index_dump = dump_shard_slot_indexes(self.storage, q)
+            limiters = _limiter_table_dump(self.storage)
+            self.epochs[q] += 1
+            self._full_pending[q] = False
+            frames = chunk_frames(self.epochs[q], _wall_ms(), sps, deltas,
+                                  index_dump, limiters, full=full,
+                                  max_bytes=self.max_frame_bytes)
+            for f in frames:
+                f["shard"] = q
+                f["n_shards"] = self.n_shards
+            return frames
+
+    def cut_all(self) -> Dict[int, List[Dict]]:
+        return {q: self.cut_shard(q) for q in range(self.n_shards)}
+
+    def remark(self, shard: int, frames: List[Dict]) -> None:
+        """Re-journal a failed ship's slots (frames carry LOCAL ids)."""
+        base = int(shard) * self.slots_per_shard
+        for frame in frames:
+            for algo, payload in frame.get("algos", {}).items():
+                self.journal.mark(algo, np.asarray(payload["slots"],
+                                                   dtype=np.int64) + base)
+
+    def pending(self) -> int:
+        with self._lock:
+            queued = sum(len(a) for p in self._pending
+                         for algo_chunks in p.values()
+                         for a in algo_chunks)
+            return queued + self.journal.pending()
+
+    def detach(self) -> None:
+        self.engine.journal = None
+
+
+class ShardedReplicator:
+    """Ships every shard's epoch stream; failures isolate per shard.
+
+    ``sinks`` maps shard -> sink (one standby link per shard — the
+    standby mesh).  One cadence thread cuts and ships all shards; a
+    shard whose sink fails gets its delta re-marked and its next frame
+    full, while the other shards' streams continue unharmed this cycle.
+    """
+
+    def __init__(self, log: ShardedReplicationLog, sinks: Dict[int, object],
+                 interval_ms: float = 200.0, registry=None):
+        self.log = log
+        self.sinks = dict(sinks)
+        missing = set(range(log.n_shards)) - set(self.sinks)
+        if missing:
+            raise ValueError(f"no sink for shard(s) {sorted(missing)}")
+        self.interval_ms = float(interval_ms)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._ship_lock = threading.Lock()
+        self.frames_shipped = 0
+        self.bytes_shipped = 0
+        self.errors = 0
+        self.shard_errors = [0] * log.n_shards
+        self._shard_last_error: List[Optional[str]] = [None] * log.n_shards
+        if registry is not None:
+            self._m_lag = registry.gauge(
+                "ratelimiter.replication.lag_ms",
+                "Age (ms) of the oldest unreplicated mutation at the "
+                "last epoch cut")
+            self._m_frames = registry.counter(
+                "ratelimiter.replication.frames",
+                "Replication frames shipped to the standby")
+            self._m_bytes = registry.counter(
+                "ratelimiter.replication.bytes",
+                "Encoded replication bytes shipped")
+            self._m_errors = registry.counter(
+                "ratelimiter.replication.errors",
+                "Replication ship failures (frames re-marked, next "
+                "frame full)")
+        else:
+            self._m_lag = self._m_frames = None
+            self._m_bytes = self._m_errors = None
+
+    def ship_now(self) -> int:
+        """One synchronous cycle over every shard; returns frames
+        shipped.  Per-shard failures are isolated (counted, re-marked,
+        full-requested) — the cycle always completes."""
+        shipped = 0
+        with self._ship_lock:
+            for q in range(self.log.n_shards):
+                shipped += self._ship_shard(q)
+            if self._m_lag is not None:
+                self._m_lag.set(self.log.last_cut_lag_ms)
+        return shipped
+
+    def _ship_shard(self, q: int) -> int:
+        sink = self.sinks[q]
+        consume = getattr(sink, "consume_reconnected", None)
+        if consume is not None and consume():
+            _log.warning("shard %d replication link reconnected; "
+                         "re-baselining with a full frame", q)
+            self.log.request_full(q)
+        frames = self.log.cut_shard(q)
+        if not frames:
+            return 0
+        shipped = 0
+        try:
+            for frame in frames:
+                data = encode_frame(frame)
+                sink.send(data)
+                shipped += 1
+                self.frames_shipped += 1
+                self.bytes_shipped += len(data)
+                if self._m_frames is not None:
+                    self._m_frames.increment()
+                    self._m_bytes.add(len(data))
+            self._shard_last_error[q] = None
+        except Exception as exc:  # noqa: BLE001 — isolate to this shard
+            self.errors += 1
+            self.shard_errors[q] += 1
+            self._shard_last_error[q] = str(exc)[:200]
+            if self._m_errors is not None:
+                self._m_errors.increment()
+            self.log.remark(q, frames[shipped:])
+            self.log.request_full(q)
+            _log.warning("shard %d replication ship failed: %s (delta "
+                         "re-marked; next frame full)", q, exc)
+        return shipped
+
+    def start(self) -> "ShardedReplicator":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sharded-replicator", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.ship_now()
+            except Exception as exc:  # noqa: BLE001 — loop survives
+                _log.warning("sharded replication cycle failed: %s", exc)
+
+    def stop(self, final_ship: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_ship:
+            try:
+                self.ship_now()
+            except Exception as exc:  # noqa: BLE001 — best effort
+                _log.warning("final sharded ship failed: %s", exc)
+        self._stop.clear()
+
+    def close(self) -> None:
+        self.stop()
+        self.log.detach()
+        for sink in self.sinks.values():
+            if hasattr(sink, "close"):
+                sink.close()
+
+    def lag_ms(self) -> float:
+        return self.log.last_cut_lag_ms
+
+    def shard_status(self) -> Dict[int, Dict]:
+        return {q: {"epoch": self.log.epochs[q],
+                    "errors": self.shard_errors[q],
+                    "last_error": self._shard_last_error[q]}
+                for q in range(self.log.n_shards)}
+
+
+class ShardStandbySet:
+    """The standby mesh: one flat same-geometry storage + receiver per
+    shard.  ``storage_factory()`` builds one ``slots_per_shard`` flat
+    storage (the caller owns clocks/config)."""
+
+    def __init__(self, n_shards: int, storage_factory: Callable[[], object],
+                 registry=None):
+        self.n_shards = int(n_shards)
+        from ratelimiter_tpu.replication.standby import StandbyReceiver
+
+        self.storages = [storage_factory() for _ in range(self.n_shards)]
+        self.receivers = [StandbyReceiver(s, registry=registry)
+                          for s in self.storages]
+
+    def in_process_sinks(self) -> Dict[int, object]:
+        from ratelimiter_tpu.replication.transport import InProcessSink
+
+        return {q: InProcessSink(rx) for q, rx in enumerate(self.receivers)}
+
+    def promote(self, shard: int, force: bool = False):
+        """Promote ONE shard's standby; returns its (flat) storage."""
+        return self.receivers[int(shard)].promote(force=force)
+
+    def close(self, except_shards: tuple = ()) -> None:
+        for q, storage in enumerate(self.storages):
+            if q not in except_shards:
+                storage.close()
+
+
+class ShardFailoverRouter:
+    """Serving façade over a sharded primary plus promoted replacements.
+
+    Routes by the engine's own key->shard hash.  A shard marked failed
+    is DENIED (fail-closed, counted — bounded under-admission during the
+    promotion window) until ``install_replacement`` hands its keys to a
+    promoted flat storage; every other shard keeps serving from the
+    primary throughout.  ``shard_health()`` feeds the health state
+    machine's DEGRADED-shard reporting (service/app.py)."""
+
+    def __init__(self, primary):
+        engine = primary.engine
+        if not hasattr(engine, "n_shards"):
+            raise ValueError("ShardFailoverRouter wraps a sharded storage")
+        self.primary = primary
+        self.n_shards = int(engine.n_shards)
+        self.replacements: Dict[int, object] = {}
+        self.failed: set = set()
+        self.unavailable_denies = 0
+        self._lock = threading.Lock()
+
+    # -- failover control ------------------------------------------------------
+    def fail_shard(self, shard: int) -> None:
+        with self._lock:
+            self.failed.add(int(shard))
+
+    def install_replacement(self, shard: int, storage) -> None:
+        """Hand a failed shard's keyspace to a promoted flat storage."""
+        with self._lock:
+            self.replacements[int(shard)] = storage
+            self.failed.discard(int(shard))
+
+    def shard_health(self) -> Dict[int, str]:
+        with self._lock:
+            return {q: ("failed" if q in self.failed
+                        else "promoted" if q in self.replacements
+                        else "active")
+                    for q in range(self.n_shards)}
+
+    def degraded_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(self.failed | set(self.replacements))
+
+    # -- routed decision surface ----------------------------------------------
+    def _shard_of_keys(self, lids, keys) -> np.ndarray:
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        return np.asarray([shard_of_key((int(l), k), self.n_shards)
+                           for l, k in zip(lids, keys)], dtype=np.int64)
+
+    def _backend(self, q: int):
+        with self._lock:
+            if q in self.failed:
+                return None
+            return self.replacements.get(q, self.primary)
+
+    def acquire_many(self, algo, lid_per_req, keys, permits):
+        shard = self._shard_of_keys(lid_per_req, keys)
+        lids = np.asarray(lid_per_req)
+        perms = np.asarray(permits)
+        keys = list(keys)
+        out: Dict[str, np.ndarray] = {}
+        for q in np.unique(shard):
+            idx = np.nonzero(shard == q)[0]
+            backend = self._backend(int(q))
+            if backend is None:
+                # Promotion window: fail closed (deny) — bounded
+                # under-admission, never unbounded over-admission.
+                with self._lock:
+                    self.unavailable_denies += len(idx)
+                res = {"allowed": np.zeros(len(idx), dtype=bool)}
+            else:
+                res = backend.acquire_many(
+                    algo, [int(lids[i]) for i in idx],
+                    [keys[i] for i in idx], [int(perms[i]) for i in idx])
+            for name, vals in res.items():
+                if name not in out:
+                    out[name] = np.zeros(len(keys),
+                                         dtype=np.asarray(vals).dtype)
+                out[name][idx] = vals
+        return out
+
+    def acquire_stream_ids(self, algo, lid, key_ids, permits=None, **kw):
+        from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+        key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+        shard = shard_of_int_keys(key_ids, self.n_shards)
+        out = np.zeros(len(key_ids), dtype=bool)
+        with self._lock:
+            routed = bool(self.failed or self.replacements)
+        if not routed:
+            return self.primary.acquire_stream_ids(algo, lid, key_ids,
+                                                   permits=permits, **kw)
+        special = sorted(self.failed | set(self.replacements))
+        mask_special = np.isin(shard, special)
+        live_idx = np.nonzero(~mask_special)[0]
+        if len(live_idx):
+            out[live_idx] = self.primary.acquire_stream_ids(
+                algo, lid, key_ids[live_idx],
+                permits=None if permits is None else permits[live_idx],
+                **kw)
+        for q in special:
+            idx = np.nonzero(shard == q)[0]
+            if not len(idx):
+                continue
+            backend = self._backend(q)
+            if backend is None:
+                with self._lock:
+                    self.unavailable_denies += len(idx)
+                continue  # denied: out already False
+            out[idx] = backend.acquire_stream_ids(
+                algo, lid, key_ids[idx],
+                permits=None if permits is None else permits[idx], **kw)
+        return out
+
+    # -- passthrough plumbing --------------------------------------------------
+    def is_available(self) -> bool:
+        """Health probe: the primary must answer (a single failed shard
+        is DEGRADED via :meth:`shard_health`, not unavailable)."""
+        try:
+            return bool(self.primary.is_available())
+        except Exception:  # noqa: BLE001 — erroring probe = unavailable
+            return False
+
+    def flush(self) -> None:
+        self.primary.flush()
+        with self._lock:
+            reps = list(self.replacements.values())
+        for r in reps:
+            r.flush()
+
+    def close(self) -> None:
+        self.primary.close()
+        with self._lock:
+            reps = list(self.replacements.values())
+        for r in reps:
+            r.close()

@@ -16,7 +16,13 @@ Epoch accounting: frames must arrive in epoch order with no gaps.  A gap
 (lost frames, a restarted primary) marks the receiver INCONSISTENT — it
 keeps applying rows (they only ever move the shadow closer to the
 primary) but refuses to promote until a ``full`` frame re-baselines the
-stream.  The ``epoch_gap`` counter makes the event observable.
+stream.  The ``epoch_gap`` counter makes the event observable.  A STALE
+delta frame (epoch at or before the newest applied — reordered or
+duplicated delivery) is REFUSED outright: its rows are older truth and
+applying them would regress newer state; the receiver counts it in
+``reordered``, goes inconsistent, and waits for a full frame.  Full
+frames always apply — they carry complete current state and re-baseline
+unconditionally (including a restarted primary whose epochs reset).
 
 ``promote()`` is failover: rebuild the key->slot index from the last
 replicated journal frame (``TpuBatchedStorage.promote_from_replica``),
@@ -52,6 +58,7 @@ class StandbyReceiver:
         self._index_dump: Optional[Dict] = None
         self._lock = threading.Lock()
         self._frames_applied = 0
+        self.reordered = 0
         if registry is not None:
             self._applied_epoch = registry.gauge(
                 "ratelimiter.replication.applied_epoch",
@@ -63,8 +70,13 @@ class StandbyReceiver:
             self._failovers = registry.counter(
                 "ratelimiter.replication.failovers",
                 "Standby promotions executed")
+            self._reordered = registry.counter(
+                "ratelimiter.replication.reordered",
+                "Stale/reordered delta frames refused (stream "
+                "inconsistent until the next full frame)")
         else:
             self._applied_epoch = self._gaps = self._failovers = None
+            self._reordered = None
 
     # -- frame application ----------------------------------------------------
     def apply_bytes(self, data: bytes) -> None:
@@ -81,6 +93,16 @@ class StandbyReceiver:
             if frame.get("full") and frame.get("seq", 0) == 0:
                 # A full frame re-baselines the stream unconditionally.
                 self.consistent = True
+            elif epoch <= self.last_epoch and not frame.get("full"):
+                # Stale delta (reordered/duplicated delivery): its rows
+                # are OLDER truth — applying them would regress state the
+                # newer epochs already wrote.  Refuse the frame, mark the
+                # stream inconsistent, wait for a full re-baseline.
+                self.consistent = False
+                self.reordered += 1
+                if self._reordered is not None:
+                    self._reordered.increment()
+                return
             elif epoch > self.last_epoch + 1 and not frame.get("full"):
                 self.consistent = False
                 if self._gaps is not None:

@@ -50,18 +50,40 @@ def _now_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+def _find_shard_health(storage):
+    """Walk the storage wrapper chain (retry -> breaker -> chaos -> ...)
+    for a ``shard_health()`` surface (replication/sharded.py's failover
+    router)."""
+    seen = set()
+    while storage is not None and id(storage) not in seen:
+        seen.add(id(storage))
+        fn = getattr(storage, "shard_health", None)
+        if callable(fn):
+            return fn
+        storage = getattr(storage, "_inner", None)
+    return None
+
+
 def health_payload(ctx: AppContext) -> dict:
     """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
 
     - DOWN: the backend is unavailable (or the breaker is open with no
       degraded fallback and fail-open off) — only DOWN returns 503.
     - DEGRADED: the breaker is open/half-open; decisions are served by
-      the degraded host limiter (or fail-open).
+      the degraded host limiter (or fail-open).  ALSO: a sharded
+      deployment with a failed or promoted-replacement shard — the
+      surviving shards keep serving, so a single dead shard is a
+      DEGRADED-shard state, never DOWN.
     - SHEDDING: admission control shed requests within the health
       window — the micro-batcher's queue bound / deadline sheds AND the
       sidecar's per-connection pipeline sheds both count (the TCP front
       door participates in the same state machine as the HTTP tier).
     - UP: everything on the device path.
+
+    The payload also carries the fused Pallas relay kernel's live/
+    fallback state (``pallas.relay_fused_live``): a probe failure on
+    real hardware silently reverts the headline dispatch to composed
+    XLA, and this is where that shows up.
 
     Module-level so drills can evaluate the state machine without an
     HTTP server in the loop.
@@ -74,6 +96,22 @@ def health_payload(ctx: AppContext) -> dict:
     batcher = getattr(ctx.storage, "_batcher", None)
     sidecar = getattr(ctx, "sidecar", None)
     payload: dict = {"storage": {"available": storage_up}}
+    from ratelimiter_tpu.ops.pallas import relay_step
+
+    pallas = relay_step.fallback_info()
+    payload["pallas"] = pallas
+    if ctx.registry is not None:
+        ctx.registry.gauge(
+            "ratelimiter.pallas.fused_fallback",
+            "1 when the fused relay kernel's differential probe failed "
+            "on this hardware (serving composed XLA instead)",
+        ).set(1.0 if pallas["probe_failed"] else 0.0)
+    degraded_shards = []
+    shard_health_fn = _find_shard_health(ctx.storage)
+    if shard_health_fn is not None:
+        shards = shard_health_fn()
+        payload["shards"] = {str(q): v for q, v in shards.items()}
+        degraded_shards = [q for q, v in shards.items() if v != "active"]
     shedding = False
     window_s = ctx.props.get_float(
         "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
@@ -111,6 +149,10 @@ def health_payload(ctx: AppContext) -> dict:
         payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
     elif not storage_up:
         payload["status"] = "DOWN"
+    elif degraded_shards:
+        # One shard failed or running on a promoted replacement while
+        # the survivors serve: degraded capacity, not an outage.
+        payload["status"] = "DEGRADED"
     elif shedding:
         payload["status"] = "SHEDDING"
     else:

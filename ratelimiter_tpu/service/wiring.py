@@ -43,11 +43,20 @@ class ReplicationHandle:
     def status(self) -> Dict:
         out = {"role": self.role}
         if self.replicator is not None:
-            out.update(epoch=self.replicator.log.epoch,
-                       lag_ms=self.replicator.lag_ms(),
+            log = self.replicator.log
+            if hasattr(log, "epochs"):  # sharded: per-shard epoch streams
+                out.update(epochs=list(log.epochs),
+                           shards=self.replicator.shard_status(),
+                           journal=log.journal_kind)
+            else:
+                out.update(epoch=log.epoch,
+                           journal=getattr(log, "journal_kind", "host"))
+            out.update(lag_ms=self.replicator.lag_ms(),
                        frames_shipped=self.replicator.frames_shipped,
                        bytes_shipped=self.replicator.bytes_shipped,
                        errors=self.replicator.errors)
+            if hasattr(self.replicator, "coalesced"):
+                out["coalesced"] = self.replicator.coalesced
         if self.receiver is not None:
             out.update(applied_epoch=self.receiver.last_epoch,
                        consistent=self.receiver.consistent,
@@ -251,6 +260,12 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
     listener); ``replication.role=standby`` starts the frame listener
     on ``replication.listen_port`` over this storage — which then idles
     as a shadow until an operator (or orchestrator) promotes it.
+
+    A SHARDED primary (parallel/sharded.py engine) replicates per
+    shard: ``replication.targets`` lists one standby ``host:port`` per
+    shard (comma-separated, shard order) and each shard ships its own
+    epoch stream to an ordinary flat standby of ``slots_per_shard``
+    geometry — promotion replaces one shard, never the world.
     """
     if not props.get_bool("replication.enabled", False):
         return None
@@ -267,12 +282,36 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
         ReplicationLog,
         ReplicationServer,
         Replicator,
+        ShardedReplicationLog,
+        ShardedReplicator,
         SocketSink,
         StandbyReceiver,
     )
 
     role = (props.get("replication.role") or "primary").lower()
     if role == "primary":
+        engine = storage.engine
+        if hasattr(engine, "n_shards"):
+            targets = (props.get("replication.targets")
+                       or props.get("replication.target") or "")
+            parts = [t.strip() for t in targets.split(",") if t.strip()]
+            if len(parts) != engine.n_shards:
+                logger.warning(
+                    "sharded replication needs one replication.targets "
+                    "entry per shard (%d given, %d shards); replication "
+                    "disabled", len(parts), engine.n_shards)
+                return None
+            sinks = {}
+            for q, part in enumerate(parts):
+                host, _, port = part.rpartition(":")
+                sinks[q] = SocketSink(host or "127.0.0.1", int(port))
+            repl = ShardedReplicator(
+                ShardedReplicationLog(storage), sinks,
+                interval_ms=props.get_float("replication.interval_ms",
+                                            200.0),
+                registry=registry,
+            ).start()
+            return ReplicationHandle(role="primary", replicator=repl)
         target = props.get("replication.target")
         if not target:
             logger.warning("replication.role=primary without "
@@ -318,6 +357,17 @@ def build_app(props: AppProperties | None = None,
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
+        # Fused-kernel fallback gauge at boot (the PR 4 silent-degrade
+        # fix): the engine's settle_all() has resolved the probe by now,
+        # so a probe failure on real hardware is visible from the first
+        # scrape, not only after the first health hit.
+        from ratelimiter_tpu.ops.pallas import relay_step
+
+        registry.gauge(
+            "ratelimiter.pallas.fused_fallback",
+            "1 when the fused relay kernel's differential probe failed "
+            "on this hardware (serving composed XLA instead)",
+        ).set(1.0 if relay_step.fallback_info()["probe_failed"] else 0.0)
         # Boot-time link probe (r5): feeds the streaming loops' chunk-plan
         # and wire-format elections.  Best-effort — a backend without a
         # device link (memory) or a probe failure leaves the loops on the

@@ -118,13 +118,26 @@ class CircuitBreakerStorage(RateLimitStorage):
 
     def status(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "state": self._state,
                 "consecutive_failures": self._consecutive,
                 "opened_total": self.opened_total,
                 "resyncs_total": self.resyncs_total,
                 "degraded_fallback": self.fallback is not None,
             }
+        # Shard-aware backend (replication/sharded.py failover router):
+        # surface the per-shard serving state so a single failed shard
+        # reads as DEGRADED capacity behind a closed breaker, not DOWN.
+        shard_health = getattr(self._inner, "shard_health", None)
+        if callable(shard_health):
+            try:
+                shards = shard_health()
+                out["shards"] = {str(q): v for q, v in shards.items()}
+                out["degraded_shards"] = sorted(
+                    str(q) for q, v in shards.items() if v != "active")
+            except Exception:  # noqa: BLE001 — status stays best-effort
+                pass
+        return out
 
     def trip(self) -> None:
         """Force-open (ops/test hook): behave as if the threshold tripped."""

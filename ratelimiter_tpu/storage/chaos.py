@@ -663,6 +663,253 @@ def failover_drill(
 
 
 # ---------------------------------------------------------------------------
+# Shard failover drill (kill ONE shard of N mid-Zipf-stream, promote only it)
+# ---------------------------------------------------------------------------
+
+def shard_failover_drill(
+    n_shards: int = 4,
+    slots_per_shard: int = 512,
+    n_keys: int = 96,
+    waves: int = 5,
+    kill_after_wave: int = 3,
+    post_waves: int = 3,
+    stream_n: int = 1536,
+    batch: int = 32,
+    kill_shard: int | None = None,
+    seed: int = 0,
+    registry=None,
+    background_interval_ms: float | None = None,
+    journal_kind: str = "auto",
+) -> dict:
+    """Deterministic ONE-shard-of-N failover drill, differential vs the
+    oracle — the per-shard HA contract of shard-aware replication
+    (replication/sharded.py).
+
+    Topology: a sharded primary (``n_shards`` CPU-mesh shards) under a
+    controlled clock, one flat same-geometry standby per shard (the
+    standby mesh), per-shard epoch streams through the full frame
+    pipeline.  Traffic is a Zipf int-key token-bucket stream (the
+    headline shape, via ``acquire_stream_ids``) plus string-key
+    sliding-window batches, every decision checked bit-exact against
+    ``semantics/oracle.py``.
+
+    After ``kill_after_wave`` waves the drill ships a final
+    deterministic epoch for every shard, then runs one LOSS wave of
+    victim-shard-only traffic that is never replicated, kills the
+    victim shard (``ShardFailoverRouter.fail_shard``), and proves:
+
+    - **survivors never stop**: a full traffic wave runs DURING the
+      promotion window on the surviving shards, bit-identical to the
+      oracle, while victim-shard requests are denied fail-closed
+      (counted — bounded UNDER-admission, never unbounded over-
+      admission);
+    - **loss is bounded**: the loss wave's per-key admissions never
+      exceed the policy ceiling (the over-admission bound of the
+      promotion window: state the dead shard admitted but never
+      replicated);
+    - **single-shard promotion is exact**: after promoting ONLY the
+      victim's standby (per-shard ``full`` re-baseline + index rebuilt
+      from that shard's fingerprint journal through
+      ``promote_from_replica``), every post-failover decision — victim
+      keys on the promoted flat storage, survivor keys still on the
+      primary — is bit-identical to the oracle;
+    - the health surface reports the DEGRADED-shard state (router
+      ``shard_health``), not DOWN.
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import random
+
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys, shard_of_key
+    from ratelimiter_tpu.replication import (
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = random.Random(seed)
+    nrng = np.random.default_rng(seed)
+    clock = {"t": 1_753_000_000_000}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    primary = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    router = ShardFailoverRouter(primary)
+    cfg_tb = RateLimitConfig(max_permits=25, window_ms=2000,
+                             refill_rate=8.0)
+    cfg_sw = RateLimitConfig(max_permits=15, window_ms=2000,
+                             enable_local_cache=False)
+    lid_tb = primary.register_limiter("tb", cfg_tb)
+    lid_sw = primary.register_limiter("sw", cfg_sw)
+    mesh_set = ShardStandbySet(
+        n_shards,
+        lambda: TpuBatchedStorage(num_slots=slots_per_shard,
+                                  clock_ms=lambda: clock["t"]),
+        registry=registry)
+    log = ShardedReplicationLog(primary, journal_kind=journal_kind)
+    repl = ShardedReplicator(log, mesh_set.in_process_sinks(),
+                             registry=registry,
+                             interval_ms=background_interval_ms or 200.0)
+    if background_interval_ms:
+        repl.start()
+
+    oracle_tb = TokenBucketOracle(cfg_tb)
+    oracle_sw = SlidingWindowOracle(cfg_sw)
+    report = {"decisions": 0, "mismatches": 0, "frames": 0,
+              "loss_wave_decisions": 0, "loss_wave_admitted": 0,
+              "window_decisions": 0, "window_denied": 0,
+              "journal_kind": log.journal_kind}
+
+    # Key population and victim selection: int keys route by the
+    # splitmix hash; the victim is the shard owning the most keys (the
+    # worst single-shard blast radius), unless the caller pinned one.
+    key_shard = shard_of_int_keys(np.arange(n_keys, dtype=np.int64),
+                                  n_shards)
+    victim = (int(np.bincount(key_shard, minlength=n_shards).argmax())
+              if kill_shard is None else int(kill_shard))
+    sw_keys = [f"u{i}" for i in range(n_keys)]
+    sw_shard = np.asarray([shard_of_key((lid_sw, k), n_shards)
+                           for k in sw_keys])
+
+    def zipf_keys(n):
+        return (nrng.zipf(1.3, size=n) - 1) % n_keys
+
+    def tb_wave(backend, keys, check=True):
+        clock["t"] += rng.choice([1, 7, 250, 999, 2000, 2001])
+        now = clock["t"]
+        out = backend.acquire_stream_ids("tb", lid_tb,
+                                         np.asarray(keys, dtype=np.int64))
+        admitted = int(out.sum())
+        if check:
+            for k, got in zip(keys, out):
+                d = oracle_tb.try_acquire(int(k), 1, now)
+                report["decisions"] += 1
+                if bool(got) != d.allowed:
+                    report["mismatches"] += 1
+        return admitted, len(out)
+
+    def sw_wave(backend, idx_keys, check=True):
+        clock["t"] += rng.choice([1, 7, 250, 999])
+        now = clock["t"]
+        keys = [sw_keys[i] for i in idx_keys]
+        perms = [rng.choice([1, 1, 2, 5]) for _ in keys]
+        out = backend.acquire_many("sw", [lid_sw] * len(keys), keys, perms)
+        if check:
+            for j, k in enumerate(keys):
+                d = oracle_sw.try_acquire(k, perms[j], now)
+                report["decisions"] += 1
+                if (bool(out["allowed"][j]) != d.allowed
+                        or int(out["observed"][j]) != d.observed):
+                    report["mismatches"] += 1
+
+    victim_tb_keys = np.nonzero(key_shard == victim)[0].astype(np.int64)
+    survivor_tb_keys = np.nonzero(key_shard != victim)[0].astype(np.int64)
+    survivor_sw_idx = np.nonzero(sw_shard != victim)[0]
+    assert len(victim_tb_keys) and len(survivor_tb_keys), (
+        "degenerate key split; raise n_keys")
+
+    try:
+        # Phase 1: healthy sharded soak, replicated per shard.
+        for _ in range(max(kill_after_wave, 1)):
+            tb_wave(router, zipf_keys(stream_n))
+            sw_wave(router, [rng.randrange(n_keys) for _ in range(batch)])
+            if not background_interval_ms:
+                report["frames"] += repl.ship_now()
+        if background_interval_ms:
+            repl.stop()
+        # Final deterministic epoch for EVERY shard: everything up to
+        # here survives the kill.
+        report["frames"] += repl.ship_now()
+        report["promoted_epoch"] = log.epochs[victim]
+
+        # Loss wave: victim-shard-only mutations after the last
+        # replicated epoch — they die with the shard.  Checked against a
+        # throwaway oracle copy (proves the primary still decided
+        # correctly) but NEVER applied to the main oracle: the promoted
+        # standby won't know them, by contract.
+        import copy
+
+        loss_oracle = copy.deepcopy(oracle_tb)
+        clock["t"] += rng.choice([1, 7, 250])
+        now = clock["t"]
+        loss_keys = victim_tb_keys[
+            nrng.integers(0, len(victim_tb_keys), size=min(stream_n, 512))]
+        out = primary.acquire_stream_ids(
+            "tb", lid_tb, np.asarray(loss_keys, dtype=np.int64))
+        per_key_admitted: dict = {}
+        for k, got in zip(loss_keys, out):
+            d = loss_oracle.try_acquire(int(k), 1, now)
+            report["loss_wave_decisions"] += 1
+            if bool(got) != d.allowed:
+                report["mismatches"] += 1
+            if got:
+                per_key_admitted[int(k)] = per_key_admitted.get(int(k),
+                                                                0) + 1
+        report["loss_wave_admitted"] = int(out.sum())
+        # Bounded over-admission: what the dead shard admitted but never
+        # replicated is capped per key by the policy ceiling.
+        over = {k: c for k, c in per_key_admitted.items()
+                if c > cfg_tb.max_permits}
+        assert not over, f"loss-wave admissions exceeded the ceiling: {over}"
+    finally:
+        repl.stop()
+
+    # The kill: shard `victim` is gone.  Its standby survives.
+    router.fail_shard(victim)
+    health = router.shard_health()
+    assert health[victim] == "failed" and all(
+        v == "active" for q, v in health.items() if q != victim), health
+
+    # Promotion window: survivors keep serving (bit-identical), victim
+    # requests are denied fail-closed and counted.
+    pre = report["decisions"]
+    tb_wave(router, survivor_tb_keys[
+        nrng.integers(0, len(survivor_tb_keys), size=min(stream_n, 512))])
+    sw_wave(router, [int(survivor_sw_idx[rng.randrange(
+        len(survivor_sw_idx))]) for _ in range(batch)])
+    report["window_decisions"] = report["decisions"] - pre
+    denied_before = router.unavailable_denies
+    probe = victim_tb_keys[:8]
+    got = router.acquire_stream_ids("tb", lid_tb, probe)
+    assert not got.any(), "failed shard served during the window"
+    report["window_denied"] = router.unavailable_denies - denied_before
+    assert report["window_denied"] == len(probe)
+
+    # Promote ONLY the victim's standby and route its keys there.
+    promoted = mesh_set.promote(victim)
+    router.install_replacement(victim, promoted)
+    health = router.shard_health()
+    assert health[victim] == "promoted", health
+
+    # Post-failover: full mixed traffic through the router — victim keys
+    # on the promoted flat storage, survivors on the primary — all
+    # bit-identical to the oracle.
+    for _ in range(post_waves):
+        tb_wave(router, zipf_keys(stream_n))
+        sw_wave(router, [rng.randrange(n_keys) for _ in range(batch)])
+
+    report["victim_shard"] = victim
+    report["shard_health"] = router.shard_health()
+    router.close()  # closes primary + promoted replacement
+    mesh_set.close(except_shards=(victim,))
+    if report["mismatches"]:
+        raise AssertionError(
+            f"shard failover drill diverged from the oracle: {report}")
+    return report
+
+
+# ---------------------------------------------------------------------------
 # Sustained-outage drill (breaker open -> degraded -> resync -> bit-identical)
 # ---------------------------------------------------------------------------
 

@@ -32,6 +32,16 @@ class CircuitOpenError(StorageException):
     """
 
 
+class PromotionInProgressError(StorageException):
+    """A standby promotion is rebuilding this storage's key->slot index.
+
+    Decisions are REFUSED for the promotion window rather than risking a
+    half-applied index routing a key into another key's replicated row
+    (replication/standby.py).  Transient and retryable: the window is
+    one index restore, after which the storage serves normally.
+    """
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Linear-backoff retry (RedisRateLimitStorage.java:19-20,155-178).

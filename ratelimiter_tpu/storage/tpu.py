@@ -558,6 +558,9 @@ class TpuBatchedStorage(RateLimitStorage):
         self._host_parallel = (int(host_parallel)
                                if host_parallel and host_parallel > 1 else 0)
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
+        # Standby-promotion window flag: decisions are refused (typed,
+        # retryable) while promote_from_replica swaps the indexes.
+        self._promoting = False
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
         # The native index checkpoints at fingerprint level by default;
@@ -788,6 +791,7 @@ class TpuBatchedStorage(RateLimitStorage):
         permits: Sequence[int],
     ) -> Dict[str, np.ndarray]:
         """Whole-batch synchronous decision (the vectorized/bench path)."""
+        self._check_not_promoting()
         index = self._index[algo]
         lid0 = lid_per_req[0] if lid_per_req else 0
         uniform_lid = all(l == lid0 for l in lid_per_req)
@@ -839,6 +843,7 @@ class TpuBatchedStorage(RateLimitStorage):
         Integer user/tenant ids skip string hashing entirely: one C call for
         slot assignment, one device dispatch for the decisions.
         """
+        self._check_not_promoting()
         index = self._index[algo]
         if hasattr(index, "assign_batch_ints"):
             self._batcher.flush()
@@ -914,6 +919,7 @@ class TpuBatchedStorage(RateLimitStorage):
         means one permit per request (the permits upload is skipped; the
         device materializes ones).  Returns bool[n] allowed.
         """
+        self._check_not_promoting()
         multi_lid = np.ndim(lid) != 0
         if multi_lid:
             lid_arr = np.ascontiguousarray(lid, dtype=np.int64)
@@ -2865,13 +2871,23 @@ class TpuBatchedStorage(RateLimitStorage):
         the first digest-multi dispatch must re-upload tenant ids.
         After this returns the storage serves decisions bit-identical
         to the oracle for every key at or before the replicated epoch.
+
+        A decision racing the restore must never see a half-applied
+        index (it could assign a fresh slot that collides with another
+        key's replicated row): the promotion window REFUSES decisions
+        with the typed, retryable ``PromotionInProgressError`` — the
+        window is one index restore, microseconds to low milliseconds.
         """
         from ratelimiter_tpu.engine import checkpoint as ckpt
 
-        self._batcher.flush()
-        ckpt.restore_slot_indexes(self, index_dump)
-        self._lid_known.clear()
-        self.engine.block_until_ready()
+        self._promoting = True
+        try:
+            self._batcher.flush()
+            ckpt.restore_slot_indexes(self, index_dump)
+            self._lid_known.clear()
+            self.engine.block_until_ready()
+        finally:
+            self._promoting = False
 
     def export_keys(self) -> Dict:
         """Geometry-free export of all live per-key state (the rebalance
@@ -3016,8 +3032,22 @@ class TpuBatchedStorage(RateLimitStorage):
         return pool
 
     # ------------------------------------------------------------------------
+    def _check_not_promoting(self) -> None:
+        """Refuse decisions while a standby promotion is swapping the
+        key->slot indexes (one attribute check on the hot path; see
+        :meth:`promote_from_replica`)."""
+        if self._promoting:
+            from ratelimiter_tpu.storage.errors import (
+                PromotionInProgressError,
+            )
+
+            raise PromotionInProgressError(
+                "standby promotion in progress: the key->slot index is "
+                "being rebuilt; retry after the promotion window")
+
     def _assign_slot(self, algo: str, lid: int, key: str,
                      hold_pin: bool = False) -> int:
+        self._check_not_promoting()
         index = self._index[algo]
         pinned = self._batcher.pending_slots(algo)
         slot, evicted = index.assign((lid, key), pinned=pinned,

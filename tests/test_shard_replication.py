@@ -1,0 +1,548 @@
+"""Shard-aware replication & device-side delta extraction (PR 6).
+
+Layers under test, bottom-up:
+
+- the device-resident dirty-slot journal (engine/state.py:
+  DeviceSlotJournal) marks every engine path and drains identically to
+  the host journal;
+- the journal election (replication/log.py) honors forcing overrides;
+- standby hardening: stale/reordered delta frames are refused, never
+  applied (rows must not regress), promotion refuses or serializes
+  against racing dispatches;
+- replicator backpressure: a stalled standby link bounds host memory
+  and coalesces cuts (the ``ratelimiter.replication.coalesced`` metric);
+- per-shard replication: each shard's stream converges its own flat
+  standby bit for bit; a ship failure on one shard never stalls the
+  others; one-shard-of-N failover is bit-identical to the oracle while
+  survivors keep serving (the chaos drill);
+- health surface: DEGRADED-shard state + fused-relay fallback info.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.engine.state import (
+    DeviceSlotJournal,
+    LimiterTable,
+    SlotJournal,
+)
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+from ratelimiter_tpu.replication import (
+    InProcessSink,
+    ReplicationLog,
+    ReplicationStateError,
+    Replicator,
+    ShardFailoverRouter,
+    ShardStandbySet,
+    ShardedReplicationLog,
+    ShardedReplicator,
+    StandbyReceiver,
+    engine_state_fingerprint,
+)
+from ratelimiter_tpu.storage import TpuBatchedStorage
+
+T0 = 1_753_000_000_000
+
+
+def make_sharded_primary(n_shards=4, slots_per_shard=128, clock=None):
+    clock = clock if clock is not None else {"t": T0}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    storage = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    return clock, storage
+
+
+# ---------------------------------------------------------------------------
+# Device journal
+# ---------------------------------------------------------------------------
+
+def test_device_journal_parity_with_host():
+    """Same marks in, same drain out — the two journals are drop-in."""
+    host, dev = SlotJournal(64), DeviceSlotJournal(64)
+    for j in (host, dev):
+        j.mark("sw", [3, 5, 5, -1, 999])       # padding/out-of-range dropped
+        j.mark("tb", np.array([7], dtype=np.int32))
+        # relay words: slot in the high bits (rank_bits=10)
+        words = (np.array([9, 12], dtype=np.uint64) << np.uint64(11))
+        j.mark_words("tb", words.astype(np.uint32), 10)
+        # sharded matrices: 2 shards x 32 local slots
+        j.mark_matrix("sw", np.array([[1, -1], [4, 2]]), 32)
+        j.mark_words_matrix(
+            "sw", (np.array([[6], [0xFFFFFFFF >> 11]], dtype=np.uint64)
+                   << np.uint64(11)).astype(np.uint32), 10, 32)
+    assert host.pending() == dev.pending() > 0
+    d_host, _, _ = host.drain()
+    d_dev, oldest, was_all = dev.drain()
+    assert oldest is not None and not was_all
+    for algo in ("sw", "tb"):
+        np.testing.assert_array_equal(sorted(d_host[algo].tolist()),
+                                      sorted(d_dev[algo].tolist()))
+    # drained: empty until new marks
+    d2, oldest2, _ = dev.drain()
+    assert d2 == {} and oldest2 is None
+    dev.mark_all("tb")
+    d3, _, was_all = dev.drain()
+    assert was_all and len(d3["tb"]) == 64 and "sw" not in d3
+
+
+def test_device_journal_accepts_device_arrays():
+    import jax.numpy as jnp
+
+    j = DeviceSlotJournal(32)
+    j.mark("sw", jnp.asarray(np.array([1, 2, 31], dtype=np.int32)))
+    d, _, _ = j.drain()
+    assert sorted(d["sw"].tolist()) == [1, 2, 31]
+
+
+def test_engine_paths_mark_device_journal():
+    """Every storage decision path leaves its slots dirty in the DEVICE
+    journal (mirror of the host-journal coverage test)."""
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    log = ReplicationLog(storage, journal_kind="device")
+    assert log.journal_kind == "device"
+    j = log.journal
+    lid = storage.register_limiter("tb", RateLimitConfig(
+        max_permits=50, window_ms=2000, refill_rate=10.0))
+    lid_sw = storage.register_limiter("sw", RateLimitConfig(
+        max_permits=20, window_ms=2000, enable_local_cache=False))
+    storage.acquire_many("tb", [lid] * 4, ["a", "b", "c", "d"], [1] * 4)
+    storage.acquire("sw", lid_sw, "z", 1)
+    storage.flush()
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 4 and len(deltas["sw"]) >= 1
+    keys = np.asarray([1, 2, 3, 1, 2, 9, 9, 9], dtype=np.int64)
+    storage.acquire_stream_ids("tb", lid, keys)                      # relay
+    storage.acquire_stream_ids("tb", lid, keys,
+                               permits=np.full(8, 2))                # weighted
+    storage.flush()
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 4
+    storage.reset_key("tb", lid, "a")
+    deltas, _, _ = j.drain()
+    assert len(deltas["tb"]) >= 1
+    storage.close()
+
+
+def test_flat_replication_device_journal_converges():
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=100, window_ms=1000, refill_rate=50.0))
+    log = ReplicationLog(primary, journal_kind="device")
+    repl = Replicator(log, InProcessSink(StandbyReceiver(standby)))
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        clock["t"] += 137
+        primary.acquire_stream_ids("tb", lid,
+                                   rng.integers(0, 300, size=2048))
+        repl.ship_now()
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    primary.close()
+    standby.close()
+
+
+def test_journal_election_env_override(monkeypatch):
+    from ratelimiter_tpu.replication.log import device_journal_elected
+
+    monkeypatch.setenv("RATELIMITER_DEVICE_JOURNAL", "on")
+    assert device_journal_elected() is True
+    monkeypatch.setenv("RATELIMITER_DEVICE_JOURNAL", "off")
+    assert device_journal_elected() is False
+
+
+def test_log_engine_kind_guards():
+    clock, sharded = make_sharded_primary()
+    with pytest.raises(ValueError, match="sharded"):
+        ReplicationLog(sharded)
+    flat = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    with pytest.raises(ValueError, match="sharded engine"):
+        ShardedReplicationLog(flat)
+    sharded.close()
+    flat.close()
+
+
+# ---------------------------------------------------------------------------
+# Standby hardening: reordering + promotion races
+# ---------------------------------------------------------------------------
+
+def test_standby_refuses_reordered_and_stale_frames():
+    registry = MeterRegistry()
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=40, window_ms=1000, refill_rate=10.0))
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby, registry=registry)
+
+    def traffic():
+        clock["t"] += 77
+        primary.acquire_many("tb", [lid] * 8,
+                             [f"g{i}" for i in range(8)], [1] * 8)
+
+    traffic()
+    epoch1 = log.cut()                      # full bootstrap
+    traffic()
+    epoch2 = log.cut()
+    traffic()
+    epoch3 = log.cut()
+    for f in epoch1:
+        receiver.apply(f)
+    assert receiver.consistent
+    for f in epoch3:                        # delivered ahead of epoch 2
+        receiver.apply(f)
+    assert not receiver.consistent          # gap observed
+    fp_before = engine_state_fingerprint(standby.engine)
+    for f in epoch2:                        # late/reordered: must be refused
+        receiver.apply(f)
+    fp_after = engine_state_fingerprint(standby.engine)
+    # The stale frame's rows were NOT applied: epoch 3's newer rows
+    # survive untouched.
+    np.testing.assert_array_equal(fp_before["tb"], fp_after["tb"])
+    assert receiver.reordered >= 1
+    assert registry.scrape()["ratelimiter.replication.reordered"] >= 1.0
+    assert not receiver.consistent
+    with pytest.raises(ReplicationStateError):
+        receiver.promote()
+
+    # A full frame heals the stream; state converges; promotion serves.
+    log.request_full()
+    for f in log.cut():
+        receiver.apply(f)
+    assert receiver.consistent
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    receiver.promote()
+    primary.close()
+    standby.close()
+
+
+def test_promotion_refuses_racing_dispatch(monkeypatch):
+    """A decision racing promote_from_replica gets the typed retryable
+    refusal, never a half-applied index."""
+    from ratelimiter_tpu.engine import checkpoint as ckpt
+    from ratelimiter_tpu.storage.errors import PromotionInProgressError
+
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=40, window_ms=1000, refill_rate=10.0))
+    clock["t"] += 5
+    primary.acquire_many("tb", [lid] * 4, list("abcd"), [1] * 4)
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby)
+    for f in log.cut():
+        receiver.apply(f)
+
+    in_restore = threading.Event()
+    release = threading.Event()
+    real_restore = ckpt.restore_slot_indexes
+
+    def slow_restore(storage, dump):
+        in_restore.set()
+        assert release.wait(5.0)
+        return real_restore(storage, dump)
+
+    monkeypatch.setattr(ckpt, "restore_slot_indexes", slow_restore)
+    promoted_box = {}
+    t = threading.Thread(
+        target=lambda: promoted_box.update(p=receiver.promote()),
+        daemon=True)
+    t.start()
+    assert in_restore.wait(5.0)
+    # Mid-promotion: every decision surface refuses with the typed error.
+    with pytest.raises(PromotionInProgressError):
+        standby.acquire("tb", lid, "x", 1)
+    with pytest.raises(PromotionInProgressError):
+        standby.acquire_many("tb", [lid], ["x"], [1])
+    with pytest.raises(PromotionInProgressError):
+        standby.acquire_many_ids("tb", lid, np.array([1]), np.array([1]))
+    with pytest.raises(PromotionInProgressError):
+        standby.acquire_stream_ids("tb", lid, np.array([1]))
+    release.set()
+    t.join(timeout=5.0)
+    assert promoted_box["p"] is standby
+    # After the window the promoted storage serves normally.
+    out = standby.acquire_many("tb", [lid] * 2, ["a", "new"], [1, 1])
+    assert len(out["allowed"]) == 2
+    primary.close()
+    standby.close()
+
+
+# ---------------------------------------------------------------------------
+# Replicator backpressure
+# ---------------------------------------------------------------------------
+
+class GatedSink:
+    """Blocks sends until released; then feeds an InProcessSink."""
+
+    def __init__(self, receiver):
+        self.inner = InProcessSink(receiver)
+        self.gate = threading.Event()
+
+    def send(self, data):
+        assert self.gate.wait(30.0), "test gate never released"
+        self.inner.send(data)
+
+
+def test_replicator_backpressure_bounds_memory_and_coalesces():
+    registry = MeterRegistry()
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=512, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=100, window_ms=1000, refill_rate=50.0))
+    log = ReplicationLog(primary)
+    sink = GatedSink(StandbyReceiver(standby))
+    # Tiny byte bound: the FIRST queued epoch saturates it, so every
+    # later cut must coalesce instead of growing the queue.
+    repl = Replicator(log, sink, interval_ms=5.0, registry=registry,
+                      max_queue_bytes=1024).start()
+    rng = np.random.default_rng(5)
+    deadline = time.monotonic() + 20.0
+    while repl.coalesced < 3 and time.monotonic() < deadline:
+        clock["t"] += 50
+        primary.acquire_stream_ids("tb", lid, rng.integers(0, 400, 512))
+        time.sleep(0.01)
+    assert repl.coalesced >= 3, "stalled link never coalesced cuts"
+    # Bounded: at most ONE epoch is in flight past the byte bound.
+    assert repl.queue_bytes() <= 1024 + 8 * (1 << 20)
+    assert registry.scrape()["ratelimiter.replication.coalesced"] >= 3.0
+    # Heal the link: the stream drains and the standby converges.
+    sink.gate.set()
+    clock["t"] += 50
+    primary.acquire_many("tb", [lid] * 4, list("wxyz"), [1] * 4)
+    repl.stop(final_ship=True)
+    fp_p = engine_state_fingerprint(primary.engine)
+    fp_s = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+    primary.close()
+    standby.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-shard replication
+# ---------------------------------------------------------------------------
+
+def test_sharded_replication_converges_each_shard():
+    clock, primary = make_sharded_primary(n_shards=4, slots_per_shard=128)
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=100, window_ms=1000, refill_rate=50.0))
+    log = ShardedReplicationLog(primary)
+    mesh_set = ShardStandbySet(
+        4, lambda: TpuBatchedStorage(num_slots=128,
+                                     clock_ms=lambda: clock["t"]))
+    repl = ShardedReplicator(log, mesh_set.in_process_sinks())
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        clock["t"] += 137
+        primary.acquire_stream_ids("tb", lid, rng.integers(0, 300, 2048))
+        repl.ship_now()
+    host_tb = np.asarray(primary.engine.tb_packed)  # [n_sh, sps, lanes]
+    for q in range(4):
+        fp_q = engine_state_fingerprint(mesh_set.storages[q].engine)
+        np.testing.assert_array_equal(host_tb[q], fp_q["tb"])
+    assert all(e >= 1 for e in log.epochs)
+    primary.close()
+    mesh_set.close()
+
+
+def test_sharded_ship_failure_isolated_to_one_shard():
+    clock, primary = make_sharded_primary(n_shards=4, slots_per_shard=128)
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=100, window_ms=1000, refill_rate=50.0))
+    log = ShardedReplicationLog(primary)
+    mesh_set = ShardStandbySet(
+        4, lambda: TpuBatchedStorage(num_slots=128,
+                                     clock_ms=lambda: clock["t"]))
+    sinks = mesh_set.in_process_sinks()
+
+    class FlakySink:
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = False
+
+        def send(self, data):
+            if self.fail:
+                raise ConnectionError("standby 1 unreachable")
+            self.inner.send(data)
+
+    sinks[1] = FlakySink(sinks[1])
+    repl = ShardedReplicator(log, sinks)
+    clock["t"] += 9
+    primary.acquire_stream_ids(
+        "tb", lid, np.arange(400, dtype=np.int64))
+    sinks[1].fail = True
+    repl.ship_now()  # shard 1 fails, the others ship
+    assert repl.shard_errors[1] >= 1
+    assert sum(repl.shard_errors) == repl.shard_errors[1]
+    host_tb = np.asarray(primary.engine.tb_packed)
+    for q in (0, 2, 3):
+        fp_q = engine_state_fingerprint(mesh_set.storages[q].engine)
+        np.testing.assert_array_equal(host_tb[q], fp_q["tb"])
+    # Shard 1's standby is behind and inconsistent-on-gap; healing the
+    # link re-baselines it with a full frame on the next cycle.
+    sinks[1].fail = False
+    clock["t"] += 9
+    primary.acquire_stream_ids("tb", lid, np.arange(50, dtype=np.int64))
+    repl.ship_now()
+    host_tb = np.asarray(primary.engine.tb_packed)
+    fp1 = engine_state_fingerprint(mesh_set.storages[1].engine)
+    np.testing.assert_array_equal(host_tb[1], fp1["tb"])
+    assert mesh_set.receivers[1].consistent
+    primary.close()
+    mesh_set.close()
+
+
+def test_shard_failover_drill_fast():
+    from ratelimiter_tpu.storage.chaos import shard_failover_drill
+
+    registry = MeterRegistry()
+    report = shard_failover_drill(
+        n_shards=4, slots_per_shard=256, n_keys=64, waves=4,
+        kill_after_wave=2, post_waves=2, stream_n=768, batch=24,
+        registry=registry)
+    assert report["mismatches"] == 0
+    assert report["decisions"] > 1000
+    assert report["loss_wave_decisions"] > 0    # the kill WAS mid-stream
+    assert report["window_decisions"] > 0       # survivors kept serving
+    assert report["window_denied"] > 0          # victim failed closed
+    meters = registry.scrape()
+    assert meters["ratelimiter.replication.failovers"] == 1.0
+    assert meters["ratelimiter.replication.epoch_gap"] == 0.0
+
+
+@pytest.mark.slow
+def test_shard_failover_soak_slow():
+    """Bigger drill with the ASYNC per-shard replicator running mid-soak
+    (the production shape)."""
+    from ratelimiter_tpu.storage.chaos import shard_failover_drill
+
+    registry = MeterRegistry()
+    report = shard_failover_drill(
+        n_shards=8, slots_per_shard=512, n_keys=192, waves=8,
+        kill_after_wave=6, post_waves=4, stream_n=4096, batch=64,
+        registry=registry, background_interval_ms=20.0)
+    assert report["mismatches"] == 0
+    assert report["decisions"] > 10000
+    assert registry.scrape()["ratelimiter.replication.failovers"] == 1.0
+
+
+def test_wiring_sharded_primary_targets_over_tcp():
+    """`replication.targets` wires one SocketSink per shard; status
+    exposes per-shard epochs; each flat standby converges its shard."""
+    from ratelimiter_tpu.replication import ReplicationServer
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import _maybe_replication
+
+    clock, primary = make_sharded_primary(n_shards=2, slots_per_shard=128)
+    registry = MeterRegistry()
+    standbys = [TpuBatchedStorage(num_slots=128,
+                                  clock_ms=lambda: clock["t"])
+                for _ in range(2)]
+    receivers = [StandbyReceiver(s) for s in standbys]
+    servers = [ReplicationServer(r, host="127.0.0.1").start()
+               for r in receivers]
+    handle = _maybe_replication(primary, AppProperties({
+        "replication.enabled": "true", "replication.role": "primary",
+        "replication.targets": ",".join(
+            f"127.0.0.1:{s.port}" for s in servers),
+        "replication.interval_ms": "10000"}), registry)
+    assert handle is not None and handle.role == "primary"
+    try:
+        lid = primary.register_limiter("tb", RateLimitConfig(
+            max_permits=25, window_ms=1000, refill_rate=10.0))
+        clock["t"] += 9
+        primary.acquire_stream_ids("tb", lid,
+                                   np.arange(100, dtype=np.int64))
+        handle.replicator.ship_now()
+        status = handle.status()
+        assert status["epochs"] == [1, 1]
+        assert set(status["shards"]) == {0, 1}
+        host_tb = np.asarray(primary.engine.tb_packed)
+        for q in (0, 1):
+            fp = engine_state_fingerprint(standbys[q].engine)
+            np.testing.assert_array_equal(host_tb[q], fp["tb"])
+    finally:
+        handle.close()
+        for s in servers:
+            s.stop()
+        primary.close()
+        for st in standbys:
+            st.close()
+
+
+# ---------------------------------------------------------------------------
+# Health surface: DEGRADED-shard state + fused-relay fallback info
+# ---------------------------------------------------------------------------
+
+def test_router_health_degraded_not_down():
+    from ratelimiter_tpu.service.app import health_payload
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import AppContext
+
+    clock, primary = make_sharded_primary(n_shards=4, slots_per_shard=128)
+    router = ShardFailoverRouter(primary)
+    registry = MeterRegistry()
+    ctx = AppContext(props=AppProperties({}), storage=router,
+                     registry=registry, limiters={}, fail_open=True)
+    payload = health_payload(ctx)
+    assert payload["status"] == "UP"
+    assert payload["shards"] == {str(q): "active" for q in range(4)}
+    assert "relay_fused_live" in payload["pallas"]  # CPU: not live, stated
+    assert payload["pallas"]["relay_fused_live"] is False
+
+    router.fail_shard(2)
+    payload = health_payload(ctx)
+    assert payload["status"] == "DEGRADED"         # NOT DOWN
+    assert payload["shards"]["2"] == "failed"
+    # The fused-fallback gauge is exported on scrape.
+    assert "ratelimiter.pallas.fused_fallback" in registry.scrape()
+    router.close()
+
+
+def test_breaker_status_surfaces_shard_health():
+    from ratelimiter_tpu.storage.breaker import CircuitBreakerStorage
+
+    clock, primary = make_sharded_primary(n_shards=4, slots_per_shard=128)
+    router = ShardFailoverRouter(primary)
+    breaker = CircuitBreakerStorage(router)
+    router.fail_shard(1)
+    status = breaker.status()
+    assert status["degraded_shards"] == ["1"]
+    assert status["shards"]["1"] == "failed"
+    router.close()
+
+
+def test_relay_fused_fallback_info():
+    from ratelimiter_tpu.ops.pallas import relay_step
+
+    info = relay_step.fallback_info()
+    assert info["relay_fused_live"] is False       # CPU backend
+    assert info["probe_failed"] in (False, True)
+    assert info["reason"]
+    # Simulate the real-hardware trap: a failed probe must be loudly
+    # attributable (module state is restored after).
+    saved = (relay_step._probe_ok, relay_step._fallback_reason,
+             relay_step._warned)
+    try:
+        relay_step._probe_ok = False
+        relay_step._note_fallback("probe mismatch (tb): test")
+        info = relay_step.fallback_info()
+        assert info["probe_failed"] is True
+        assert "probe mismatch" in info["reason"]
+    finally:
+        (relay_step._probe_ok, relay_step._fallback_reason,
+         relay_step._warned) = saved

@@ -75,6 +75,27 @@ else
   fail=1
 fi
 
+echo "running fast one-shard-of-N failover drill (shard-aware replication)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_shard_replication.py::test_shard_failover_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  shard failover drill"
+else
+  echo "  FAILED  shard failover drill"
+  fail=1
+fi
+
+echo "running replication overhead gate (elected journal <= 2% of hot path)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
+    bench/replication_overhead.py --n 2097152 --rounds 5 \
+    --assert-budget 0.02 > /dev/null; then
+  echo "  ok  replication overhead budget"
+else
+  echo "  FAILED  replication overhead budget (journal marks cost more"
+  echo "          than 2% of the headline decision path)"
+  fail=1
+fi
+
 echo "running fast overload + breaker chaos drills..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_overload.py::test_overload_drill_fast \
@@ -127,6 +148,7 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
   echo "running slow failover + overload + outage + ingress soaks (RUN_SLOW=1)..."
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
       tests/test_replication.py::test_failover_soak_slow \
+      tests/test_shard_replication.py::test_shard_failover_soak_slow \
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
       tests/test_sidecar_chaos.py::test_ingress_soak_slow \
