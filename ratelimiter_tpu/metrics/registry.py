@@ -71,51 +71,101 @@ class Gauge:
 
 
 class Timer:
-    """Latency recorder with percentile snapshots.
+    """Latency recorder: fixed log2-bucket histogram with interpolated
+    percentile snapshots.
 
     Implements the ``ratelimiter.storage.latency`` histogram the reference
-    documents but never ships (ARCHITECTURE.md:172-185). Keeps a bounded
-    reservoir of recent samples (microseconds).
+    documents but never ships (ARCHITECTURE.md:172-185).  Bucket ``i``
+    counts samples in ``(2^(i-1), 2^i]`` microseconds (bucket 0 holds
+    ``<= 1 us``; the last bucket is unbounded), so
+
+    - ``record_us`` is O(1) and lock-free — one bit_length plus three
+      in-place adds.  CPython's GIL makes each add a read-modify-write
+      that can lose a count under extreme contention, which is an
+      accepted trade for a hot path that previously took a lock per
+      sample;
+    - ``snapshot`` walks 64 fixed counters instead of sorting an up-to-
+      64Ki reservoir under the recorder's lock.
+
+    Percentiles interpolate linearly inside the target bucket at rank
+    ``p * n`` (the Prometheus ``histogram_quantile`` convention), which
+    also removes the old reservoir's index bias: ``int(p * len)``
+    returned the element *after* the p-quantile on small sample sets.
+
+    ``max_samples`` is accepted for back-compat and ignored (there is no
+    reservoir to bound).
     """
 
-    __slots__ = ("name", "description", "_samples", "_count", "_total_us", "_lock", "_max_samples")
+    __slots__ = ("name", "description", "_counts", "_count", "_total_us")
 
-    def __init__(self, name: str, description: str = "", max_samples: int = 65536):
+    #: Number of log2 buckets; bucket N_BUCKETS-1 is unbounded (+Inf).
+    N_BUCKETS = 64
+
+    def __init__(self, name: str, description: str = "",
+                 max_samples: int = 0):
         self.name = name
         self.description = description
-        self._samples: List[float] = []
+        self._counts = [0] * self.N_BUCKETS
         self._count = 0
         self._total_us = 0.0
-        self._max_samples = max_samples
-        self._lock = threading.Lock()
 
     def record_us(self, micros: float) -> None:
-        with self._lock:
-            self._count += 1
-            self._total_us += micros
-            if len(self._samples) < self._max_samples:
-                self._samples.append(micros)
-            else:
-                # Simple reservoir: overwrite pseudo-randomly by count.
-                self._samples[self._count % self._max_samples] = micros
+        if micros > 1.0:
+            # ceil(micros) - 1, then bit_length: value v lands in the
+            # bucket whose range (2^(i-1), 2^i] contains it.
+            idx = (-int(-micros) - 1).bit_length()
+            if idx >= self.N_BUCKETS:
+                idx = self.N_BUCKETS - 1
+        else:
+            idx = 0
+        self._counts[idx] += 1
+        self._count += 1
+        self._total_us += micros
+
+    # -- raw surfaces (Prometheus exposition; observability/prometheus.py) --
+    def bucket_bounds_us(self) -> List[float]:
+        """Inclusive upper bound of each bucket in us; last is +Inf."""
+        return [float(1 << i) for i in range(self.N_BUCKETS - 1)] + [
+            float("inf")]
+
+    def bucket_counts(self) -> List[int]:
+        return list(self._counts)
+
+    def count(self) -> int:
+        return self._count
+
+    def total_us(self) -> float:
+        return self._total_us
+
+    def _quantile(self, counts: List[int], n: int, p: float) -> float:
+        rank = p * n
+        cum = 0
+        value = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            lo = float(1 << (i - 1)) if i else 0.0
+            # The unbounded last bucket interpolates over one octave.
+            hi = float(1 << i) if i < self.N_BUCKETS - 1 else 2.0 * lo
+            value = lo + (hi - lo) * min((rank - cum) / c, 1.0)
+            if cum + c >= rank:
+                return value
+            cum += c
+        return value
 
     def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            n = self._count
-            total = self._total_us
-            samples = sorted(self._samples)
-        if not samples:
-            return {"count": 0, "mean_us": 0.0, "p50_us": 0.0, "p95_us": 0.0, "p99_us": 0.0}
-
-        def pct(p: float) -> float:
-            return samples[min(len(samples) - 1, int(p * len(samples)))]
-
+        counts = list(self._counts)
+        n = sum(counts)
+        total = self._total_us
+        if n == 0:
+            return {"count": 0, "mean_us": 0.0, "p50_us": 0.0,
+                    "p95_us": 0.0, "p99_us": 0.0}
         return {
             "count": n,
-            "mean_us": total / max(1, n),
-            "p50_us": pct(0.50),
-            "p95_us": pct(0.95),
-            "p99_us": pct(0.99),
+            "mean_us": total / n,
+            "p50_us": self._quantile(counts, n, 0.50),
+            "p95_us": self._quantile(counts, n, 0.95),
+            "p99_us": self._quantile(counts, n, 0.99),
         }
 
 
@@ -156,6 +206,13 @@ class MeterRegistry:
             if not isinstance(meter, Timer):
                 raise TypeError(f"meter {name!r} already registered as {type(meter).__name__}")
             return meter
+
+    def meters(self) -> Dict[str, object]:
+        """The live meter objects by name (a copy of the map, not the
+        meters) — the Prometheus renderer needs bucket-level access that
+        ``scrape()``'s value view flattens away."""
+        with self._lock:
+            return dict(self._meters)
 
     def scrape(self) -> Dict[str, object]:
         """All meter values, for the /actuator/metrics endpoint."""

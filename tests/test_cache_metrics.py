@@ -66,6 +66,52 @@ def test_timer_percentiles():
         t.record_us(float(v))
     snap = t.snapshot()
     assert snap["count"] == 100
-    assert 45 <= snap["p50_us"] <= 55
-    assert 94 <= snap["p95_us"] <= 100
+    # Bucket-interpolated: rank 50 falls in the (32, 64] bucket, which
+    # holds samples 33..64 — 32 + 32 * (50 - 32) / 32 = 50 exactly.
+    assert snap["p50_us"] == 50.0
+    # p95/p99 land in the (64, 128] bucket (36 samples, 65..100): the
+    # interpolation overshoots the true value but stays in-bucket.
+    assert 64.0 < snap["p95_us"] <= 128.0
+    assert snap["p95_us"] <= snap["p99_us"] <= 128.0
     assert abs(snap["mean_us"] - 50.5) < 1e-9
+
+
+def test_timer_quantile_small_sample_bias():
+    """The old reservoir snapshot indexed ``samples[int(p * len)]``,
+    which returns the element *after* the p-quantile on small sets:
+    p50 of four samples read samples[2].  The bucket interpolation at
+    rank ``p * n`` must not inherit that bias — exact values below are
+    hand-computed from the bucket bounds."""
+    t = MeterRegistry().timer("t")
+    # Four samples in four distinct buckets: 1 -> [0,1], 2 -> (1,2],
+    # 4 -> (2,4], 8 -> (4,8].
+    for v in (1.0, 2.0, 4.0, 8.0):
+        t.record_us(v)
+    # rank = 0.5 * 4 = 2: cum hits 2 inside the (1,2] bucket ->
+    # 1 + (2-1) * (2-1)/1 = 2.0 (the old code would have answered 4.0,
+    # the element after the median).
+    assert t.snapshot()["p50_us"] == 2.0
+
+    t2 = MeterRegistry().timer("t2")
+    for _ in range(100):
+        t2.record_us(100.0)  # all in (64, 128]
+    snap = t2.snapshot()
+    # rank 50 of 100 identical samples: 64 + 64 * 50/100 = 96 exactly.
+    assert snap["p50_us"] == 96.0
+    assert snap["mean_us"] == 100.0
+
+
+def test_timer_bucket_surfaces():
+    t = MeterRegistry().timer("t")
+    for v in (0.5, 1.0, 3.0, 100.0, 1e19):
+        t.record_us(v)
+    counts = t.bucket_counts()
+    bounds = t.bucket_bounds_us()
+    assert len(counts) == len(bounds) == t.N_BUCKETS
+    assert bounds[-1] == float("inf")
+    assert sum(counts) == t.count() == 5
+    assert counts[0] == 2          # 0.5 and 1.0 in [0, 1]
+    assert counts[2] == 1          # 3.0 in (2, 4]
+    assert counts[7] == 1          # 100.0 in (64, 128]
+    assert counts[-1] == 1         # 1e19 > 2^63 clamps into the +Inf bucket
+    assert abs(t.total_us() - (0.5 + 1 + 3 + 100 + 1e19)) < 1e4
