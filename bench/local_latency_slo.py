@@ -87,7 +87,27 @@ def main() -> None:
     res["device"] = "cpu-in-process"
     res["target_p99_ms"] = 1.0
     res["meets_target"] = bool(lat["p99_us"] < 1000.0)
+    # Per-stage decomposition from the request-lifecycle histograms
+    # (observability/trace.py): where each request's milliseconds went —
+    # queue wait / batch assembly / device step / resolve.  ROADMAP
+    # item 3's gate reads queue_wait from exactly this surface.
+    stages = {}
+    scrape = storage.registry.scrape()
+    for name in ("queue_wait", "assembly", "device", "resolve", "total"):
+        snap = scrape.get(f"ratelimiter.latency.{name}")
+        if snap and snap["count"]:
+            stages[name] = {
+                "p50_ms": round(snap["p50_us"] / 1000.0, 3),
+                "p99_ms": round(snap["p99_us"] / 1000.0, 3),
+                "mean_ms": round(snap["mean_us"] / 1000.0, 3),
+                "count": int(snap["count"]),
+            }
+    print("per-stage decomposition (p50 / p99 ms):", file=sys.stderr)
+    for name, row in stages.items():
+        print(f"  {name:<10} {row['p50_ms']:>8.3f} {row['p99_ms']:>8.3f}",
+              file=sys.stderr)
     res["decomposition"] = {
+        "stages": stages,
         "batcher_max_delay_ms": 0.3,
         "single_acquire_ms": round(acquire_ms, 3),
         "device_step_16_lanes_ms": round(step_ms, 3),

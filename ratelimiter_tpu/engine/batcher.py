@@ -62,7 +62,11 @@ log = get_logger("engine.batcher")
 
 class _Pending:
     __slots__ = ("slots", "lids", "permits", "futures", "deadlines",
-                 "clears", "born")
+                 "t_sub", "clears", "born")
+
+    #: Parallel per-request lists that shed/forget filtering must keep
+    #: in lockstep.
+    LANES = ("slots", "lids", "permits", "futures", "deadlines", "t_sub")
 
     def __init__(self):
         self.slots: List[int] = []
@@ -70,6 +74,7 @@ class _Pending:
         self.permits: List[int] = []
         self.futures: List[Future] = []
         self.deadlines: List[float] = []  # monotonic queue deadlines (inf=none)
+        self.t_sub: List[float] = []      # perf_counter at submit (tracing)
         self.clears: List[int] = []
         self.born: float | None = None  # monotonic time of oldest request
 
@@ -88,11 +93,19 @@ class MicroBatcher:
         max_pending: int = 0,
         deadline_ms: float = 0.0,
         meter_registry=None,
+        tracer=None,
+        recorder=None,
     ):
         self._dispatch = dispatch
         # Without a drain fn the dispatch result IS the output dict
         # (synchronous mode — tests and simple backends).
         self._drain = drain or {}
+        # Request-lifecycle tracing (observability/trace.py): stages are
+        # stamped regardless (one perf_counter per submit) and observed
+        # only when a tracer is attached.  The flight recorder gets one
+        # coalesced event per shed burst (not per shed request).
+        self._tracer = tracer
+        self._recorder = recorder
         self._clear = clear
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
@@ -171,6 +184,10 @@ class MicroBatcher:
                 self.last_shed_s = time.monotonic()
                 if self._shed_counter is not None:
                     self._shed_counter.increment()
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "overload.shed", coalesce_ms=1000.0,
+                        reason="queue_full", depth=len(pend.slots))
                 # The queue drains one max_batch per dispatch cycle; a
                 # rough cycle estimate keeps the hint cheap and honest.
                 cycles = max(len(pend.slots) / max(self.max_batch, 1), 1.0)
@@ -189,6 +206,7 @@ class MicroBatcher:
             pend.deadlines.append(
                 time.monotonic() + budget / 1000.0 if budget and budget > 0
                 else math.inf)
+            pend.t_sub.append(time.perf_counter())
             if len(pend.slots) > self.max_depth_seen:
                 self.max_depth_seen = len(pend.slots)
             self._waiters.add(fut)
@@ -233,8 +251,7 @@ class MicroBatcher:
                 keep = [i for i, f in enumerate(pend.futures)
                         if f not in targets]
                 removed.extend(f for f in pend.futures if f in targets)
-                for name in ("slots", "lids", "permits", "futures",
-                             "deadlines"):
+                for name in _Pending.LANES:
                     vals = getattr(pend, name)
                     setattr(pend, name, [vals[i] for i in keep])
                 if not pend.slots and not pend.clears:
@@ -276,11 +293,19 @@ class MicroBatcher:
         with self._cv:
             self._waiters.discard(fut)
 
-    def _resolve(self, algo: str, handle, futures: List[Future]) -> None:
-        """Fetch a dispatched batch's results and resolve its futures."""
+    def _resolve(self, algo: str, handle, futures: List[Future],
+                 stamps=None) -> None:
+        """Fetch a dispatched batch's results and resolve its futures.
+
+        ``stamps`` is the lifecycle-tracing tuple ``(t_sub_list, t_take,
+        t_disp)``; the drain adds the device-done and resolved stamps
+        and hands the batch to the tracer AFTER every waiter resolved
+        (observability stays off the caller's critical path)."""
+        out = None
         try:
             drain = self._drain.get(algo)
             out = drain(handle, len(futures)) if drain else handle
+            t_dev = time.perf_counter()
             for i, fut in enumerate(futures):
                 if not fut.done():  # close() may have failed it already
                     fut.set_result({k: v[i] for k, v in out.items()})
@@ -288,15 +313,25 @@ class MicroBatcher:
             for fut in futures:
                 if not fut.done():
                     fut.set_exception(exc)
+        else:
+            if self._tracer is not None and stamps is not None:
+                t_subs, t_take, t_disp = stamps
+                try:
+                    self._tracer.observe_batch(
+                        algo, out, t_subs, t_take, t_disp, t_dev,
+                        time.perf_counter())
+                except Exception:  # noqa: BLE001 — tracing must not fail waiters
+                    log.exception("latency tracer failed (ignored)")
         finally:
             self._finish(futures)
 
-    def _enqueue_drain(self, algo: str, handle, futures: List[Future]) -> None:
+    def _enqueue_drain(self, algo: str, handle, futures: List[Future],
+                       stamps=None) -> None:
         self._inflight_sem.acquire()  # backpressure on the device queue
 
         def job():
             try:
-                self._resolve(algo, handle, futures)
+                self._resolve(algo, handle, futures, stamps)
             finally:
                 self._inflight_sem.release()
 
@@ -327,10 +362,13 @@ class MicroBatcher:
         self.last_shed_s = now
         if self._deadline_counter is not None:
             self._deadline_counter.add(n)
+        if self._recorder is not None:
+            self._recorder.record("overload.shed", coalesce_ms=1000.0,
+                                  reason="deadline", count=n)
         log.warning("shed %d queued request(s): queue deadline exceeded "
                     "before dispatch%s", n,
                     " (watchdog)" if in_queue else "")
-        for name in ("slots", "lids", "permits", "futures", "deadlines"):
+        for name in _Pending.LANES:
             vals = getattr(pend, name)
             setattr(pend, name, [vals[i] for i in keep])
         exc = OverloadedError(
@@ -344,6 +382,7 @@ class MicroBatcher:
             if pend is None:
                 continue
             self._shed_expired(pend, time.monotonic())
+            t_take = time.perf_counter()  # assembly starts (tracing)
             try:
                 if pend.clears:
                     self._clear[algo](pend.clears)
@@ -352,7 +391,9 @@ class MicroBatcher:
                               algo, len(pend.slots), len(pend.clears))
                     handle = self._dispatch[algo](
                         pend.slots, pend.lids, pend.permits)
-                    self._enqueue_drain(algo, handle, pend.futures)
+                    self._enqueue_drain(
+                        algo, handle, pend.futures,
+                        (pend.t_sub, t_take, time.perf_counter()))
             except Exception as exc:  # noqa: BLE001 — fail every waiter
                 log.warning("dispatch failed algo=%s batch=%d: %s",
                             algo, len(pend.slots), exc)

@@ -1,0 +1,30 @@
+"""Observability subsystem (ARCHITECTURE §13).
+
+Four layers over the metrics registry the service already carries:
+
+- request-lifecycle tracing (``trace.LatencyTracer``): monotonic stage
+  timestamps stamped at enqueue -> batch-assembly -> device-step ->
+  resolve, aggregated into the ``ratelimiter.latency.*`` histograms,
+  with optional 1-in-N full-trace sampling into the enriched
+  ``DecisionTrace`` ring;
+- log2-bucket histograms (``metrics/registry.Timer``) — O(1) record,
+  no sort on scrape;
+- Prometheus text exposition (``prometheus.render``) at
+  ``GET /actuator/prometheus``;
+- the flight recorder (``flightrecorder.FlightRecorder``): a bounded
+  structured-event ring that subsystems append to at state transitions,
+  plus an anomaly hook that snapshots the stage breakdown of any
+  dispatch over the SLO threshold; ``GET /actuator/flightrecorder``.
+
+The whole layer is CI-gated at <= 2% of the headline decision stream
+(``bench/observability_overhead.py --assert-budget 0.02`` in verify.sh).
+"""
+
+from ratelimiter_tpu.observability.flightrecorder import (  # noqa: F401
+    FlightRecorder,
+    flight_recorder,
+)
+from ratelimiter_tpu.observability.prometheus import (  # noqa: F401
+    render as render_prometheus,
+)
+from ratelimiter_tpu.observability.trace import LatencyTracer  # noqa: F401

@@ -1,0 +1,102 @@
+"""Prometheus text exposition (format 0.0.4) over the MeterRegistry.
+
+Mapping (Micrometer-convention names like ``ratelimiter.storage.latency``
+sanitize to ``ratelimiter_storage_latency``):
+
+- ``Counter`` -> ``# TYPE <name>_total counter`` + one sample,
+- ``Gauge``   -> ``# TYPE <name> gauge`` + one sample,
+- ``Timer``   -> ``# TYPE <name>_seconds histogram``: cumulative
+  ``_bucket{le="..."}`` lines from the log2 buckets (converted us ->
+  seconds, the Prometheus base unit), ``_sum`` and ``_count``.  Bucket
+  lines stop at the highest non-empty bucket; the mandatory
+  ``le="+Inf"`` line always carries the full count.
+
+HELP text escapes ``\\`` and newlines per the exposition format.  The
+golden test (tests/test_observability.py) pins the exact output shape;
+bucket monotonicity and ``_sum``/``_count`` consistency are asserted
+over a live registry scrape.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ratelimiter_tpu.metrics.registry import Counter, Gauge, Timer
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    # Integral values print without a trailing .0 — bucket counts are
+    # counts; +Inf/NaN spellings follow the exposition format.
+    if value != value:
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _le(bound_us: float) -> str:
+    if bound_us == float("inf"):
+        return "+Inf"
+    return _fmt(bound_us / 1e6)
+
+
+def render(registry) -> str:
+    """The full exposition document for ``GET /actuator/prometheus``."""
+    lines: List[str] = []
+    meters = registry.meters()
+    for name in sorted(meters):
+        meter = meters[name]
+        base = _metric_name(name)
+        help_text = _escape_help(meter.description or name)
+        if isinstance(meter, Counter):
+            lines.append(f"# HELP {base}_total {help_text}")
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {_fmt(meter.count())}")
+        elif isinstance(meter, Gauge):
+            lines.append(f"# HELP {base} {help_text}")
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base} {_fmt(meter.value())}")
+        elif isinstance(meter, Timer):
+            lines.extend(_render_timer(base, help_text, meter))
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def _render_timer(base: str, help_text: str, timer: Timer) -> List[str]:
+    name = f"{base}_seconds"
+    counts = timer.bucket_counts()
+    bounds = timer.bucket_bounds_us()
+    total = sum(counts)
+    # Highest non-empty bucket bounds the emitted ladder (64 lines of
+    # zeros per timer would dominate the document); +Inf always closes.
+    top = max((i for i, c in enumerate(counts) if c), default=-1)
+    lines = [f"# HELP {name} {help_text}",
+             f"# TYPE {name} histogram"]
+    cum = 0
+    for i in range(min(top + 1, len(bounds) - 1)):
+        cum += counts[i]
+        lines.append(
+            f'{name}_bucket{{le="{_le(bounds[i])}"}} {cum}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+    lines.append(f"{name}_sum {_fmt(timer.total_us() / 1e6)}")
+    lines.append(f"{name}_count {total}")
+    return lines

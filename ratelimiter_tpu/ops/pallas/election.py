@@ -92,6 +92,35 @@ def measured_election(
     hit = _verdicts.get(path_name)
     if hit is not None:
         return bool(hit["elected"])
+    try:
+        return _resolve_verdict(path_name, measure, margin, interpret)
+    finally:
+        _note_verdict(path_name)
+
+
+def _note_verdict(path_name: str) -> None:
+    """Every freshly-resolved election verdict lands in the flight
+    recorder — a losing kernel silently reverting to XLA is exactly the
+    kind of transition an operator reconstructs timelines from."""
+    v = _verdicts.get(path_name)
+    if v is None:
+        return
+    try:
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record(
+            "pallas.election", path=path_name,
+            elected=bool(v.get("elected")), source=str(v.get("source")))
+    except Exception:  # noqa: BLE001 — observability must not break elections
+        pass
+
+
+def _resolve_verdict(
+    path_name: str,
+    measure: Callable[[], Dict],
+    margin: float,
+    interpret: bool,
+) -> bool:
     policy = _policy(path_name)
     if policy in ("on", "always", "1"):
         _verdicts[path_name] = {"elected": True, "source": "env_on"}

@@ -90,6 +90,12 @@ _warned = False
 def _note_fallback(reason: str) -> None:
     global _fallback_reason, _warned
     _fallback_reason = reason
+    try:
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record("pallas.fused_fallback", reason=reason)
+    except Exception:  # noqa: BLE001 — observability must not break serving
+        pass
     if not _warned:
         _warned = True
         from ratelimiter_tpu.utils.logging import get_logger

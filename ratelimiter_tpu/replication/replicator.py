@@ -192,6 +192,10 @@ class Replicator:
             self.coalesced += 1
             if self._m_coalesced is not None:
                 self._m_coalesced.increment()
+            from ratelimiter_tpu.observability import flight_recorder
+
+            flight_recorder().record("replication.coalesced",
+                                     coalesce_ms=2000.0)
             return
         with self._cut_lock:
             consume = getattr(self.sink, "consume_reconnected", None)

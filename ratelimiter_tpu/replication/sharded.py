@@ -366,12 +366,18 @@ class ShardFailoverRouter:
     def fail_shard(self, shard: int) -> None:
         with self._lock:
             self.failed.add(int(shard))
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record("shard.failed", shard=int(shard))
 
     def install_replacement(self, shard: int, storage) -> None:
         """Hand a failed shard's keyspace to a promoted flat storage."""
         with self._lock:
             self.replacements[int(shard)] = storage
             self.failed.discard(int(shard))
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record("shard.promoted", shard=int(shard))
 
     def shard_health(self) -> Dict[int, str]:
         with self._lock:

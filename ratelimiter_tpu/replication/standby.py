@@ -102,6 +102,11 @@ class StandbyReceiver:
                 self.reordered += 1
                 if self._reordered is not None:
                     self._reordered.increment()
+                from ratelimiter_tpu.observability import flight_recorder
+
+                flight_recorder().record(
+                    "replication.reordered", coalesce_ms=1000.0,
+                    epoch=epoch, applied_epoch=self.last_epoch)
                 return
             elif epoch > self.last_epoch + 1 and not frame.get("full"):
                 self.consistent = False
@@ -165,6 +170,10 @@ class StandbyReceiver:
             self.promoted = True
             if self._failovers is not None:
                 self._failovers.increment()
+            from ratelimiter_tpu.observability import flight_recorder
+
+            flight_recorder().record("replication.promote",
+                                     epoch=self.last_epoch, forced=force)
             return self.storage
 
     @property

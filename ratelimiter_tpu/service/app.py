@@ -157,6 +157,11 @@ def health_payload(ctx: AppContext) -> dict:
         payload["status"] = "SHEDDING"
     else:
         payload["status"] = "UP"
+    recorder = getattr(ctx, "recorder", None)
+    if recorder is not None:
+        # Only transitions land in the flight recorder's timeline —
+        # a steady-state health poll records nothing.
+        recorder.record_transition("health", payload["status"])
     return payload
 
 
@@ -250,6 +255,14 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
                               payload)
         if self.path == "/actuator/metrics":
             return self._json(200, {"meters": self.ctx.registry.scrape()})
+        if self.path == "/actuator/prometheus":
+            return self._prometheus()
+        if self.path.startswith("/actuator/flightrecorder"):
+            recorder = self.ctx.recorder
+            if recorder is None:
+                return self._json(200, {"total_events": 0, "events": [],
+                                        "anomalies": []})
+            return self._json(200, recorder.snapshot())
         if self.path == "/actuator/replication":
             repl = self.ctx.replication
             if repl is None:
@@ -261,6 +274,17 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
                 return self._json(200, {"total_dispatches": 0, "recent": []})
             return self._json(200, trace.snapshot())
         self._json(404, {"error": "not found"})
+
+    def _prometheus(self):
+        """Prometheus text exposition over every registered meter."""
+        from ratelimiter_tpu.observability import prometheus
+
+        body = prometheus.render(self.ctx.registry).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", prometheus.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_POST(self):
         if self.path == "/api/login":

@@ -77,6 +77,15 @@ DEFAULTS = {
     "ratelimiter.sidecar.read_timeout_ms": "5000",
     "ratelimiter.sidecar.resolve_timeout_ms": "30000",
     "ratelimiter.sidecar.drain_timeout_ms": "1000",
+    # Observability (observability/, ARCHITECTURE §13).  trace_sample:
+    # record one full per-request lifecycle trace per ~N requests into
+    # the enriched /actuator/trace ring (0 = off).  slo_ms: any dispatch
+    # slower than this snapshots its stage breakdown + recent flight-
+    # recorder events as an anomaly (0 = off).  flight_capacity: bound
+    # on the structured-event ring behind /actuator/flightrecorder.
+    "ratelimiter.obs.trace_sample": "0",
+    "ratelimiter.obs.slo_ms": "0",
+    "ratelimiter.obs.flight_capacity": "1024",
     # Shard the slot array over all visible devices when > 1.
     "parallel.shard": "auto",
     # Compile hot dispatch shapes at boot (moves 40-90s/shape jit stalls
@@ -124,6 +133,8 @@ _INT_KEYS = (
     "ratelimiter.sidecar.max_key_bytes",
     "ratelimiter.sidecar.max_pipeline",
     "ratelimiter.sidecar.max_connections",
+    "ratelimiter.obs.trace_sample",
+    "ratelimiter.obs.flight_capacity",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -134,6 +145,7 @@ _FLOAT_KEYS = (
     "ratelimiter.sidecar.read_timeout_ms",
     "ratelimiter.sidecar.resolve_timeout_ms",
     "ratelimiter.sidecar.drain_timeout_ms",
+    "ratelimiter.obs.slo_ms",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",

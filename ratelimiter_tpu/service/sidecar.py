@@ -356,6 +356,10 @@ class SidecarServer:
         self.last_shed_s = time.monotonic()
         if self._m_shed is not None:
             self._m_shed.increment()
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record("overload.shed", coalesce_ms=1000.0,
+                                 reason="sidecar_pipeline")
 
     def _count_drained(self) -> None:
         self.drained_total += 1

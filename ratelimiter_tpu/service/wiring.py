@@ -85,6 +85,9 @@ class AppContext:
     # The TCP decision sidecar (ratelimiter.sidecar.enabled) — the health
     # state machine folds its shed/connection stats in.
     sidecar: object = None
+    # The flight recorder behind GET /actuator/flightrecorder (the
+    # process-global instance unless a test injected one).
+    recorder: object = None
 
     def close(self) -> None:
         if self.sidecar is not None:
@@ -169,6 +172,10 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
                 "ratelimiter.overload.deadline_ms", 1000.0),
             engine=engine,
             meter_registry=meter_registry,
+            # Observability (ARCHITECTURE §13): 1-in-N full-trace
+            # sampling + the slow-dispatch anomaly threshold.
+            trace_sample=props.get_int("ratelimiter.obs.trace_sample", 0),
+            obs_slo_ms=props.get_float("ratelimiter.obs.slo_ms", 0.0),
         )
     raise ValueError(f"unknown storage.backend: {backend!r}")
 
@@ -344,6 +351,16 @@ def build_app(props: AppProperties | None = None,
     setup_logging(props)
     enable_compile_cache(props.get("jax.cache.dir"))
     registry = MeterRegistry()
+    # Flight recorder (observability/flightrecorder.py): the process-
+    # global ring every subsystem appends state transitions to; sized +
+    # SLO-armed from config here, served at /actuator/flightrecorder.
+    from ratelimiter_tpu.observability import flight_recorder
+
+    recorder = flight_recorder()
+    recorder.resize(props.get_int("ratelimiter.obs.flight_capacity", 1024))
+    slo_ms = props.get_float("ratelimiter.obs.slo_ms", 0.0)
+    if slo_ms > 0:
+        recorder.set_slo_ms(slo_ms)
     own_storage = storage is None
     storage = storage or build_storage(props, meter_registry=registry)
     replication = None
@@ -429,4 +446,5 @@ def build_app(props: AppProperties | None = None,
         replication=replication,
         breaker=breaker,
         sidecar=sidecar,
+        recorder=recorder,
     )

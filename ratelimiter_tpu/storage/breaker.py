@@ -79,7 +79,13 @@ class CircuitBreakerStorage(RateLimitStorage):
         clock_ms: Callable[[], int] = _wall_clock_ms,
         fallback=None,
         registry=None,
+        recorder=None,
     ):
+        if recorder is None:
+            from ratelimiter_tpu.observability import flight_recorder
+
+            recorder = flight_recorder()
+        self._recorder = recorder
         self._inner = inner
         self.failure_threshold = max(int(failure_threshold), 1)
         self.open_ms = float(open_ms)
@@ -157,6 +163,9 @@ class CircuitBreakerStorage(RateLimitStorage):
         if self._opened_counter is not None:
             self._opened_counter.increment()
         self._set_gauge_locked()
+        self._recorder.record(
+            "breaker.open", consecutive_failures=self._consecutive,
+            degraded=self.fallback is not None)
         log.warning("circuit breaker OPEN for %.0f ms (%d consecutive "
                     "failures); decisions %s", self.open_ms,
                     self._consecutive,
@@ -174,6 +183,7 @@ class CircuitBreakerStorage(RateLimitStorage):
                     self._probe_budget = self.half_open_probes
                     self._probe_successes = 0
                     self._set_gauge_locked()
+                    self._recorder.record("breaker.half_open")
                     log.info("circuit breaker HALF_OPEN: probing backend")
                 else:
                     return "open"
@@ -193,6 +203,7 @@ class CircuitBreakerStorage(RateLimitStorage):
                     self._state = CLOSED
                     self._set_gauge_locked()
                     resync = True
+                    self._recorder.record("breaker.close")
                     log.info("circuit breaker CLOSED: backend recovered")
         if resync:
             self._resync()
@@ -261,6 +272,7 @@ class CircuitBreakerStorage(RateLimitStorage):
             return
         fb.clear_state()
         self.resyncs_total += 1
+        self._recorder.record("breaker.resync", keys=len(touched))
         if touched:
             log.info("resynced %d degraded key(s) onto the device",
                      len(touched))

@@ -738,6 +738,10 @@ def shard_failover_drill(
     )
     from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
+    from ratelimiter_tpu.observability import flight_recorder
+
+    frec = flight_recorder()
+    fmark = frec.mark()
     rng = random.Random(seed)
     nrng = np.random.default_rng(seed)
     clock = {"t": 1_753_000_000_000}
@@ -899,6 +903,22 @@ def shard_failover_drill(
         tb_wave(router, zipf_keys(stream_n))
         sw_wave(router, [rng.randrange(n_keys) for _ in range(batch)])
 
+    # Flight-recorder timeline (ARCHITECTURE §13): the failover must
+    # read back as kill -> promote -> serving replacement, in order,
+    # all naming the victim shard.
+    events = [e for e in frec.events(since=fmark)
+              if e["kind"] in ("shard.failed", "replication.promote",
+                               "shard.promoted")]
+    kinds = [e["kind"] for e in events]
+    timeline = iter(kinds)
+    assert all(k in timeline for k in (
+        "shard.failed", "replication.promote", "shard.promoted")), (
+        f"flight recorder missed the failover timeline: {kinds}")
+    for e in events:
+        if "shard" in e:
+            assert e["shard"] == victim, e
+    report["flight_timeline"] = kinds
+
     report["victim_shard"] = victim
     report["shard_health"] = router.shard_health()
     router.close()  # closes primary + promoted replacement
@@ -968,6 +988,10 @@ def outage_drill(
     from ratelimiter_tpu.storage.retry import RetryingStorage
     from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
+    from ratelimiter_tpu.observability import flight_recorder
+
+    frec = flight_recorder()
+    fmark = frec.mark()
     rng = random.Random(seed)
     clock = {"t": 1_753_000_000_000}
     inner = TpuBatchedStorage(num_slots=num_slots, clock_ms=lambda: clock["t"])
@@ -1092,6 +1116,17 @@ def outage_drill(
             wave()
         assert report["mismatches"] == 0, (
             f"post-resync decisions diverged from the oracle: {report}")
+
+        # Flight-recorder timeline (ARCHITECTURE §13): the outage must
+        # read back as open -> half_open -> close -> resync, in order.
+        kinds = [e["kind"] for e in frec.events(kind="breaker",
+                                                since=fmark)]
+        timeline = iter(kinds)
+        assert all(k in timeline for k in (
+            "breaker.open", "breaker.half_open", "breaker.close",
+            "breaker.resync")), (
+            f"flight recorder missed the outage timeline: {kinds}")
+        report["flight_timeline"] = kinds
     finally:
         storage.close()
     return report

@@ -32,7 +32,11 @@ class DecisionTrace:
         self._total = 0
         self._lock = threading.Lock()
 
-    def record(self, algo: str, batch: int, allowed: int, latency_us: float) -> None:
+    def record(self, algo: str, batch: int, allowed: int, latency_us: float,
+               **extra) -> None:
+        """One dispatch record; ``extra`` enriches it (observability
+        layer: ``path`` — micro/relay/flat/relay_sharded/... — ``shard``,
+        and a sampled per-request ``stages_us`` breakdown)."""
         entry = {
             "t_ms": time.time_ns() // 1_000_000,
             "algo": algo,
@@ -40,6 +44,8 @@ class DecisionTrace:
             "allowed": allowed,
             "latency_us": round(latency_us, 1),
         }
+        if extra:
+            entry.update(extra)
         with self._lock:
             self._records[self._next] = entry
             self._next = (self._next + 1) % self._capacity

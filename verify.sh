@@ -96,6 +96,17 @@ else
   fail=1
 fi
 
+echo "running observability overhead gate (full layer <= 2% of hot path)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
+    bench/observability_overhead.py --n 2097152 --rounds 5 \
+    --assert-budget 0.02 > /dev/null; then
+  echo "  ok  observability overhead budget"
+else
+  echo "  FAILED  observability overhead budget (stage timers + trace +"
+  echo "          flight recorder cost more than 2% of the headline stream)"
+  fail=1
+fi
+
 echo "running fast overload + breaker chaos drills..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_overload.py::test_overload_drill_fast \
