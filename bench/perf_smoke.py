@@ -3,18 +3,23 @@
 ISSUE r6: the virtual-mesh scaling curve silently anti-scaled for two
 rounds (19.5M/s at 1 shard -> 4.3M/s at 8 in BENCH_r05) because nothing
 failed when the sharding machinery regressed.  This smoke runs the TB
-Zipf stream at 1 and 2 virtual shards and asserts the 2-shard
-throughput is at least 0.9x of 1 shard — a scaling INVERSION fails CI
-loudly instead of waiting for the next full bench round.
+Zipf stream at EVERY shard count of the virtual mesh (1/2/4/8) and
+asserts MONOTONICITY (ISSUE r8): each point must reach at least
+``MARGIN`` x the next-smaller point, and 8 shards at least
+``MARGIN_END`` x of 1 shard — a scaling inversion anywhere on the
+curve fails CI loudly instead of waiting for the next full bench
+round.  (The pre-r8 smoke only checked 2 shards, which is exactly why
+the 4- and 8-shard inversions lived for two rounds.)
 
 Each point runs in its OWN subprocess (matching bench.py's discipline:
 backend state, donated-buffer history, and virtual-device count must
 not leak between points), with one full warmup pass and best-of-3
 timed passes; the 0.9 margin absorbs CI timer noise — the threshold is
 meant to catch structural regressions (a serialized per-shard walk, a
-lost pipeline overlap), not 5% jitter.  The stream is the headline
-shape scaled down (4M Zipf decisions over 1M keys: multi-chunk, so the
-pipelined prepare actually overlaps).
+lost pipeline overlap, a reintroduced cross-shard barrier), not 5%
+jitter.  The stream is the headline shape scaled down (4M Zipf
+decisions over 1M keys: multi-chunk, so the per-shard pipelines
+actually overlap).
 
 ISSUE r7 adds a RELAY-ELECTION smoke (interpret-safe, also its own
 subprocess): on a CPU backend no Pallas relay path may be elected (the
@@ -39,7 +44,11 @@ import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: Each shard count must reach MARGIN x the next-smaller count.
 MARGIN = 0.9
+#: ...and the full curve must not sag: 8 shards vs 1 shard.
+MARGIN_END = 0.95
+POINTS = (1, 2, 4, 8)
 
 
 def run_point(n_shards: int) -> None:
@@ -47,7 +56,7 @@ def run_point(n_shards: int) -> None:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2").strip()
+            flags + " --xla_force_host_platform_device_count=8").strip()
     os.environ.setdefault("RATELIMITER_RATE_PROBE", "0")
 
     import time
@@ -198,7 +207,7 @@ def main() -> int:
         run_relay_election()
         return 0
     dps = {}
-    for s in (1, 2):
+    for s in POINTS:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--point", str(s)],
             capture_output=True, timeout=540, text=True, cwd=_REPO)
@@ -208,8 +217,11 @@ def main() -> int:
             return 1
         dps[s] = json.loads(proc.stdout.strip().splitlines()[-1])[
             "decisions_per_sec"]
-    ratio = dps[2] / dps[1]
-    ok = ratio >= MARGIN
+    ratios = {f"{b}v{a}": dps[b] / dps[a]
+              for a, b in zip(POINTS, POINTS[1:])}
+    end_ratio = dps[POINTS[-1]] / dps[POINTS[0]]
+    ok = (all(r >= MARGIN for r in ratios.values())
+          and end_ratio >= MARGIN_END)
     # Relay-election smoke (its own subprocess: the engine + election
     # caches must resolve fresh, exactly as a service boot would).
     proc = subprocess.run(
@@ -221,17 +233,20 @@ def main() -> int:
     except Exception:  # noqa: BLE001 — crash before the JSON line
         relay_out = {"error": proc.stderr[-400:]}
     print(json.dumps({
-        "smoke": "sharded_scaling_2shard",
-        "dps_1shard": round(dps[1], 1),
-        "dps_2shard": round(dps[2], 1),
-        "ratio": round(ratio, 3),
+        "smoke": "sharded_scaling_monotonic",
+        "dps": {str(s): round(dps[s], 1) for s in POINTS},
+        "ratios": {k: round(r, 3) for k, r in ratios.items()},
+        "end_ratio_8v1": round(end_ratio, 3),
         "margin": MARGIN,
+        "margin_end": MARGIN_END,
         "ok": ok,
         "relay_election": relay_out,
     }))
     if not ok:
-        print(f"PERF SMOKE FAILED: 2-shard throughput {ratio:.2f}x of "
-              f"1 shard (< {MARGIN}x) — sharded dispatch regressed",
+        print(f"PERF SMOKE FAILED: sharded scaling not monotone — "
+              f"ratios={ {k: round(r, 2) for k, r in ratios.items()} } "
+              f"(each must be >= {MARGIN}), 8v1={end_ratio:.2f} "
+              f"(must be >= {MARGIN_END}) — sharded dispatch regressed",
               file=sys.stderr)
         return 1
     if not relay_ok:

@@ -5,8 +5,8 @@ slot table and reports decisions/s per shard count (VERDICT r1 #7: the
 multi-chip story needs a measured slope, not just a compile proof).
 
 On the virtual mesh every "device" is a slice of ONE host CPU, so the
-slope here measures the sharding machinery's overhead (host routing,
-shard_map dispatch, per-shard padding), not parallel speedup — the
+slope here measures the sharding machinery's overhead (routing,
+dispatch bookkeeping, per-shard padding), not parallel speedup — the
 speedup model for a real v5e slice is in ARCHITECTURE.md (each shard
 executes its slice of every dispatch concurrently; per-chip cost follows
 the single-chip cost model at B/n_shards batch rows).  Two r3 fixes
@@ -15,9 +15,15 @@ warmup pass (one-super-batch warmup left XLA compiles inside the timed
 region — they were most of the recorded r2 "overhead") and O(n) C
 routing (rl_shard_route: hash + stable counting sort in one pass,
 replacing a numpy hash + argsort that was 60% of the warm chunk cost).
-The residual 8-shard gap on this host is the per-shard C index calls
-serializing on ONE core (they run on a pool and release the GIL — real
-multi-core hosts overlap them) plus 8-device dispatch bookkeeping.
+r8 removed the remaining inversion (BENCH_r05: 19.5M -> 4.3M/s from
+1 -> 8 shards): the per-chunk mesh-wide shard_map dispatch — every
+shard barriered on the slowest sibling's layout, the multi-device
+launch rendezvoused all devices, lanes padded to the busiest shard —
+was replaced by fully independent per-shard pipelines (storage/tpu.py
+``_stream_relay_sharded`` + ``_ShardLane``; per-shard single-device
+dispatches via ``ShardedDeviceEngine.relay_shard_dispatch``), with
+routing electable onto the mesh (``build_route_count``).  The gate for
+this curve staying monotone is bench/perf_smoke.py in verify.sh.
 
 Invoked by bench.py in a subprocess (it must force the CPU backend before
 any device is touched); standalone:  python bench/sharded_scaling.py
@@ -78,12 +84,14 @@ def run(n_shards: int, num_slots: int, key_ids, batch, subbatches,
     # the timed region and dominated the r2 "sharded overhead").
     storage.acquire_stream_ids("tb", lid, key_ids, None,
                                batch=batch, subbatches=subbatches)
-    # >=4 reps per point with median + spread recorded (VERDICT r4 #6:
+    # >=6 reps per point with median + spread recorded (VERDICT r4 #6:
     # the r4 single-best points were noisy and non-monotonic, and the
     # artifact gave a reader no way to tell machine noise from a real
-    # regression).
+    # regression; r8 bumped 4 -> 6 reps — per-rep noise on a shared
+    # 1-core container is ~±8%, and the monotonicity claim reads off
+    # the medians).
     runs = []
-    for _ in range(4):
+    for _ in range(6):
         storage.stream_stats = stats = []
         t0 = time.perf_counter()
         allowed = storage.acquire_stream_ids("tb", lid, key_ids, None,
@@ -121,6 +129,8 @@ def run(n_shards: int, num_slots: int, key_ids, batch, subbatches,
             "chunks": len(med_stats),
             "assign_s": round(sum(r.get("assign_s", 0)
                                   for r in med_stats), 4),
+            "route_s": round(sum(r.get("route_s", 0)
+                                 for r in med_stats), 4),
             "host_s": round(sum(r.get("host_s", 0) for r in med_stats), 4),
             "fetch_s": round(sum(r.get("fetch_s", 0)
                                  for r in med_stats), 4),

@@ -234,6 +234,19 @@ class MicroBatcher:
         with self._cv:
             return set(self._pending[algo].slots)
 
+    def pending_slots_sharded(self, algo: str,
+                              slots_per_shard: int) -> Dict[int, Set[int]]:
+        """Queued-request slots as ``{shard: {local slot}}`` — the pin
+        sets the per-shard stream pipelines hand each lane, computed in
+        one pass under the cv instead of a global set re-split per
+        shard per chunk."""
+        out: Dict[int, Set[int]] = {}
+        with self._cv:
+            for g in self._pending[algo].slots:
+                out.setdefault(g // slots_per_shard,
+                               set()).add(g % slots_per_shard)
+        return out
+
     def forget(self, futures) -> int:
         """Withdraw still-QUEUED requests whose futures the caller has
         abandoned (e.g. a sidecar connection died mid-burst): they are

@@ -29,6 +29,16 @@ size — scraped at ``/actuator/trace``.
 **Anomaly hook.**  A batch whose oldest request exceeded the flight
 recorder's SLO threshold snapshots its stage breakdown plus recent ring
 events (``FlightRecorder.note_dispatch``).
+
+**Stream dispatch routes.**  The streaming loops bypass the batcher, so
+their lifecycle lives in the ``ratelimiter.stream.*`` stage timers
+(route/pack/index/layout/enqueue/fetch — per shard on the sharded path)
+instead of the histograms above; every stream dispatch still records
+its route into the same ``DecisionTrace`` ring (``relay|digest``,
+``flat``, ``sharded|digest`` / ``sharded|words`` with its shard id, …)
+and feeds the same slow-dispatch anomaly hook, so one
+``/actuator/trace`` read shows which path — micro, flat, or a specific
+shard's lane — a slow decision took (ARCHITECTURE §6c, §13).
 """
 
 from __future__ import annotations

@@ -17,6 +17,7 @@ ICI-free code; the same program runs unchanged on the CPU test mesh.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import zlib
@@ -86,6 +87,60 @@ def shard_of_int_keys(key_ids, n_shards: int):
         x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         x = x ^ (x >> np.uint64(31))
     return (x % np.uint64(n_shards)).astype(np.int64)
+
+
+def _splitmix64_device(x):
+    """The splitmix64 finalizer as device math (u64 lanes) — must stay
+    bit-identical to :func:`shard_of_int_keys` and to the C router
+    (native/slot_index.cpp:rl_shard_route*): the route-and-count pass
+    below bins by it, and host and device routing MUST agree on every
+    key's shard (tests/test_sharded.py pins the parity)."""
+    x = x.astype(jnp.uint64)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def build_route_count(mesh, n_shards: int, int_keys: bool):
+    """shard_map route-and-count pass: bin a replicated key chunk by the
+    deterministic shard hash ON THE MESH (r8, ROADMAP item 1).
+
+    Each shard receives the whole chunk (one replicated upload — on a
+    real slice the broadcast rides ICI, where bandwidth is free relative
+    to the host), hashes it (splitmix64 for int keys; string keys arrive
+    pre-hashed as their fingerprint h1 stream, exactly what
+    ``shard_of_key``'s string branch computes), and emits
+
+    - ``counts`` i32[n_shards] — how many of the chunk's keys it owns,
+    - ``pos``   i32[n_shards, n] — the arrival-order positions of its
+      own keys, compacted left, ``-1`` padding (so the all-one-shard
+      edge case is representable: one full row, seven empty ones).
+
+    The host turns ``pos`` rows back into the exact (shard, order,
+    counts) contract of the C router (``rl_shard_route2``); parity is
+    pinned bit-for-bit by tests.  Which router serves is a measured
+    election (storage layer) — on a CPU container the host C pass wins
+    (the "device" shares its core); on a real slice the device does the
+    O(n) binning where the mesh is real.
+    """
+
+    def local_route(keys):
+        idx = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int32)
+        h = (_splitmix64_device(keys) if int_keys
+             else keys.astype(jnp.uint64))
+        mine = (h % jnp.uint64(n_shards)).astype(jnp.int32) == idx
+        cnt = jnp.sum(mine.astype(jnp.int32))
+        pos = jnp.nonzero(mine, size=keys.shape[0],
+                          fill_value=-1)[0].astype(jnp.int32)
+        return cnt[None], pos[None]
+
+    return shard_map(
+        local_route,
+        mesh=mesh,
+        in_specs=(P(),),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
 
 
 def shard_of_key(key, n_shards: int) -> int:
@@ -367,6 +422,23 @@ class ShardedDeviceEngine:
     host-side routing scatters each request to its shard's row and unscatters
     the results.  Exposes ``last_step_totals`` = (allowed, total) aggregated
     across all shards by the on-device psum.
+
+    **Per-shard state parts (r8).**  The canonical state is a LIST of
+    single-device arrays, one ``(1, S_local, L)`` part committed to each
+    mesh device; the mesh-wide ``(n_shards, S_local, L)`` array every
+    shard_map path consumes is assembled on demand with
+    ``jax.make_array_from_single_device_arrays`` (zero-copy metadata)
+    and cached until a part changes.  That representation is what makes
+    the per-shard stream pipelines possible: ``relay_shard_dispatch``
+    runs ONE shard's relay step as an independent single-device XLA
+    execution on that shard's own device — no mesh collective, no
+    multi-device launch rendezvous, no waiting for sibling shards'
+    layouts — so shard A can be assembling chunk N+1 while shard B's
+    chunk N is still in flight.  Locking: each shard has its own lock;
+    whole-mesh operations (the shard_map dispatch/peek/clear paths,
+    read/write_rows, state (re)assembly) take every shard lock in
+    ascending order, so a per-shard dispatch never races a global step
+    and lock order is deadlock-free.
     """
 
     # Per-shard replication (replication/sharded.py): every dispatch path
@@ -394,16 +466,31 @@ class ShardedDeviceEngine:
         self._totals_seen = 0
 
         self._state_sharding = NamedSharding(self.mesh, P(SHARD_AXIS, None, None))
+        self._devices = list(self.mesh.devices.flat)
+        # Per-shard locks (r8): per-shard dispatch/clear take ONLY their
+        # shard's lock; every whole-mesh path takes all of them ascending
+        # via _exclusive().  RLocks so the packed-property assembly can
+        # run inside an already-exclusive section.
+        self._shard_locks = [threading.RLock() for _ in range(self.n_shards)]
+        # Per-device colocated copies of the limiter table (keyed by the
+        # TableArrays instance, which is rebuilt on any config change) so
+        # per-shard dispatches never re-ship the table per call.
+        self._table_parts: tuple = (None, {})
+        self._route_fns: dict = {}
 
-        def zeros(lanes):
-            return jax.device_put(
-                jnp.zeros((self.n_shards, self.slots_per_shard, lanes),
-                          dtype=jnp.int32),
-                self._state_sharding)
+        def zero_parts(lanes):
+            return [
+                jax.device_put(
+                    jnp.zeros((1, self.slots_per_shard, lanes),
+                              dtype=jnp.int32), d)
+                for d in self._devices
+            ]
 
-        # Packed-resident per-shard state (same codec as DeviceEngine).
-        self.sw_packed = zeros(6)
-        self.tb_packed = zeros(4)
+        # Packed-resident per-shard state (same codec as DeviceEngine),
+        # held as canonical single-device parts + a lazily assembled
+        # mesh-wide view.
+        self._parts = {"sw": zero_parts(6), "tb": zero_parts(4)}
+        self._packed_cache = {"sw": None, "tb": None}
 
         # Settle the Pallas probes before any shard_map step compiles
         # (same reason as DeviceEngine: a probe firing lazily inside
@@ -419,6 +506,214 @@ class ShardedDeviceEngine:
         self._sw_reset = jax.jit(build_sharded_reset(self.mesh, sw_reset_p), donate_argnums=0)
         self._tb_reset = jax.jit(build_sharded_reset(self.mesh, tb_reset_p), donate_argnums=0)
         self._scan_fns = {}
+
+    # -- per-shard state parts (r8) --------------------------------------------
+    @contextlib.contextmanager
+    def _exclusive(self):
+        """Hold every shard lock (ascending = deadlock-free against the
+        per-shard paths, which take exactly one)."""
+        for lk in self._shard_locks:
+            lk.acquire()
+        try:
+            yield
+        finally:
+            for lk in reversed(self._shard_locks):
+                lk.release()
+
+    def _assembled(self, algo: str):
+        """The mesh-wide (n_shards, S_local, L) view of the per-shard
+        parts — zero-copy assembly, cached until a part changes."""
+        with self._exclusive():
+            arr = self._packed_cache[algo]
+            if arr is None:
+                parts = self._parts[algo]
+                shape = (self.n_shards,) + tuple(parts[0].shape[1:])
+                arr = jax.make_array_from_single_device_arrays(
+                    shape, self._state_sharding, list(parts))
+                self._packed_cache[algo] = arr
+            return arr
+
+    def _set_packed(self, algo: str, arr) -> None:
+        """Decompose a mesh-sharded result back into canonical parts
+        (zero-copy: each addressable shard IS the part)."""
+        with self._exclusive():
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[0].start)
+            self._parts[algo] = [s.data for s in shards]
+            self._packed_cache[algo] = arr
+
+    @property
+    def sw_packed(self):
+        return self._assembled("sw")
+
+    @sw_packed.setter
+    def sw_packed(self, arr) -> None:
+        self._set_packed("sw", arr)
+
+    @property
+    def tb_packed(self):
+        return self._assembled("tb")
+
+    @tb_packed.setter
+    def tb_packed(self, arr) -> None:
+        self._set_packed("tb", arr)
+
+    def _table_for(self, shard: int):
+        """Colocated table arrays for one shard's device (cache keyed by
+        the TableArrays instance — any registration rebuilds it).  Called
+        BEFORE taking the shard lock (it takes the engine lock; lock
+        order is engine > shard)."""
+        src = self.table.device_arrays
+        with self._lock:
+            cache_src, per_dev = self._table_parts
+            if cache_src is not src:
+                per_dev = {}
+                self._table_parts = (src, per_dev)
+            tab = per_dev.get(shard)
+            if tab is None:
+                tab = jax.device_put(src, self._devices[shard])
+                per_dev[shard] = tab
+            return tab
+
+    def _shard_relay_fn(self, algo: str, flavor: str, lids_scalar: bool,
+                        out_dtype):
+        from ratelimiter_tpu.ops import relay as relay_ops
+
+        key = ("shard_relay", algo, flavor, lids_scalar,
+               None if out_dtype is None else np.dtype(out_dtype).name)
+        fn = self._scan_fns.get(key)
+        if fn is None:
+            if flavor == "bits":
+                base = (relay_ops.sw_relay_bits if algo == "sw"
+                        else relay_ops.tb_relay_bits)
+                local = functools.partial(base, rank_bits=self.rank_bits)
+            else:
+                base = (relay_ops.sw_relay_counts if algo == "sw"
+                        else relay_ops.tb_relay_counts)
+                jdt = (jnp.uint8 if np.dtype(out_dtype) == np.uint8
+                       else jnp.uint16)
+                local = functools.partial(base, rank_bits=self.rank_bits,
+                                          out_dtype=jdt)
+
+            def stepped(state, table, words, lids, now):
+                st, out = local(state[0], table, words, lids, now)
+                return st[None], out
+
+            fn = jax.jit(stepped, donate_argnums=0)
+            self._scan_fns[key] = fn
+        return fn
+
+    def relay_shard_dispatch(self, algo: str, shard: int, flavor: str,
+                             words, lids, now_ms: int, out_dtype=None):
+        """ONE shard's relay step as an independent single-device XLA
+        execution on that shard's own device (r8) — the per-shard stream
+        pipelines' dispatch.  ``words`` carries LOCAL slot ids in the
+        same word layout as the mesh-wide relay (``rank_bits``); padding
+        is 0xFFFFFFFF.  Only this shard's lock is held: sibling shards
+        dispatch, drain and assemble concurrently.  Returns the lazy
+        per-shard handle (uint8 bits or per-unique counts)."""
+        self._mark_words_shard(algo, shard, words)
+        dev = self._devices[shard]
+        words_dev = jax.device_put(
+            np.ascontiguousarray(words, dtype=np.uint32), dev)
+        lids_scalar = np.ndim(lids) == 0
+        if lids_scalar:
+            lids_dev = jnp.asarray(np.int32(lids))
+        else:
+            lids_dev = jax.device_put(
+                np.ascontiguousarray(lids, dtype=np.int32), dev)
+        fn = self._shard_relay_fn(algo, flavor, lids_scalar, out_dtype)
+        tab = self._table_for(shard)
+        now = jnp.int64(now_ms)
+        with self._shard_locks[shard]:
+            # Donation invalidates the assembled view's buffer for this
+            # shard — drop the cache before the step.
+            self._packed_cache[algo] = None
+            state, out = fn(self._parts[algo][shard], tab, words_dev,
+                            lids_dev, now)
+            self._parts[algo][shard] = state
+        return out
+
+    def clear_shard(self, algo: str, shard: int, local_slots) -> None:
+        """Zero LOCAL slots on one shard's device — the per-shard stream
+        pipelines' eviction-clear path.  Stream order is the caller's
+        job (each shard pipeline is a FIFO, so a shard's clears land
+        before the dispatch that reuses the slots, with no cross-shard
+        barrier)."""
+        local_slots = np.asarray(list(local_slots), dtype=np.int32)
+        if not len(local_slots):
+            return
+        j = self.journal
+        if j is not None:
+            j.mark(algo, local_slots.astype(np.int64)
+                   + shard * self.slots_per_shard)
+        padded = np.full(_bucket(len(local_slots), floor=64), -1,
+                         dtype=np.int32)
+        padded[:len(local_slots)] = local_slots
+        key = ("shard_reset", algo)
+        fn = self._scan_fns.get(key)
+        if fn is None:
+            reset_fn = sw_reset_p if algo == "sw" else tb_reset_p
+
+            def reset1(state, slots):
+                return reset_fn(state[0], slots)[None]
+
+            fn = jax.jit(reset1, donate_argnums=0)
+            self._scan_fns[key] = fn
+        slots_dev = jax.device_put(padded, self._devices[shard])
+        with self._shard_locks[shard]:
+            self._packed_cache[algo] = None
+            self._parts[algo][shard] = fn(self._parts[algo][shard],
+                                          slots_dev)
+
+    def _mark_words_shard(self, algo: str, shard: int, words) -> None:
+        """Journal one shard's relay words (host-side decode: LOCAL slot
+        in the high bits -> global id; padding decodes past
+        slots_per_shard and is dropped by the journal's bounds filter)."""
+        j = self.journal
+        if j is None:
+            return
+        loc = (np.asarray(words).astype(np.uint64)
+               >> np.uint64(self.rank_bits + 1)).astype(np.int64)
+        base = shard * self.slots_per_shard
+        j.mark(algo, np.where(loc < self.slots_per_shard, loc + base, -1))
+
+    def route_on_device(self, key_ids=None, hashes=None):
+        """(shard, order, counts) for one chunk via the on-mesh
+        route-and-count pass (:func:`build_route_count`) — the same
+        contract as the host C router, so the storage's measured route
+        election can swap them freely.  ``key_ids`` i64 int keys, or
+        ``hashes`` u64 fingerprint h1 for string traffic."""
+        int_keys = hashes is None
+        arr = np.ascontiguousarray(
+            key_ids if int_keys else hashes,
+            dtype=np.int64 if int_keys else np.uint64)
+        n = len(arr)
+        size = _bucket(n, floor=1 << 14)
+        if size != n:
+            # Padding keys bin somewhere; their positions (>= n) are
+            # dropped below.
+            arr = np.concatenate(
+                [arr, np.zeros(size - n, dtype=arr.dtype)])
+        fn = self._route_fns.get(int_keys)
+        if fn is None:
+            fn = jax.jit(build_route_count(self.mesh, self.n_shards,
+                                           int_keys))
+            self._route_fns[int_keys] = fn
+        cnt, pos = fn(jnp.asarray(arr))
+        pos = np.asarray(pos)
+        del cnt  # padded-row counts; recomputed over valid positions
+        valid = (pos >= 0) & (pos < n)
+        counts = valid.sum(axis=1).astype(np.int64)
+        order = np.empty(n, dtype=np.int64)
+        shard = np.empty(n, dtype=np.int32)
+        off = 0
+        for s in range(self.n_shards):
+            sel = pos[s][valid[s]]
+            order[off:off + len(sel)] = sel
+            shard[sel] = s
+            off += len(sel)
+        return shard, order, counts
 
     # -- dirty-slot journal hooks (per-shard replication) ----------------------
     # Same host/device split as DeviceEngine's hooks: a device journal
@@ -524,7 +819,7 @@ class ShardedDeviceEngine:
         has_permits = permits_sb is not None
         now = jnp.int64(now_ms)
         fn = self._flat_fn(algo, lids_scalar, has_permits)
-        with self._lock:
+        with self._lock, self._exclusive():
             state = self.sw_packed if algo == "sw" else self.tb_packed
             if has_permits:
                 permits_sb = jnp.asarray(
@@ -623,7 +918,7 @@ class ShardedDeviceEngine:
             lids = jnp.asarray(np.ascontiguousarray(lids, dtype=np.int32))
         now = jnp.int64(now_ms)
         fn = self._relay_fn(algo, flavor, lids_scalar, out_dtype)
-        with self._lock:
+        with self._lock, self._exclusive():
             state = self.sw_packed if algo == "sw" else self.tb_packed
             state, out = fn(state, self.table.device_arrays,
                             words_sb, lids, now)
@@ -648,7 +943,7 @@ class ShardedDeviceEngine:
         has_permits = permits_skb is not None
         now_k = jnp.asarray(np.ascontiguousarray(now_k, dtype=np.int64))
         fn = self._scan_fn(algo, lids_scalar, has_permits)
-        with self._lock:
+        with self._lock, self._exclusive():
             state = self.sw_packed if algo == "sw" else self.tb_packed
             if has_permits:
                 permits_skb = jnp.asarray(
@@ -699,7 +994,7 @@ class ShardedDeviceEngine:
     def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         self._mark_mat("sw", mat)
-        with self._lock:
+        with self._lock, self._exclusive():
             new_state, out, totals = self._sw_step(
                 self.sw_packed, self.table.device_arrays,
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
@@ -721,7 +1016,7 @@ class ShardedDeviceEngine:
         }
 
     def _set_totals(self, seq: int, totals) -> None:
-        with self._lock:
+        with self._lock, self._exclusive():
             if seq > self._totals_seen:
                 self._totals_seen = seq
                 self.last_step_totals = totals
@@ -733,7 +1028,7 @@ class ShardedDeviceEngine:
     def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         mat, lids, perms, shard, cols = self._route_batch(slots, limiter_ids, permits)
         self._mark_mat("tb", mat)
-        with self._lock:
+        with self._lock, self._exclusive():
             new_state, out, totals = self._tb_step(
                 self.tb_packed, self.table.device_arrays,
                 jnp.asarray(mat), jnp.asarray(lids), jnp.asarray(perms),
@@ -762,7 +1057,7 @@ class ShardedDeviceEngine:
         lids = np.zeros((self.n_shards, B), dtype=np.int32)
         lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
         mat = np.maximum(mat, 0)  # peek clamps; padding read is discarded
-        with self._lock:
+        with self._lock, self._exclusive():
             out = self._sw_peek(self.sw_packed, self.table.device_arrays,
                                 jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
         return np.asarray(out)[shard, cols]
@@ -772,7 +1067,7 @@ class ShardedDeviceEngine:
         lids = np.zeros((self.n_shards, B), dtype=np.int32)
         lids[shard, cols] = np.asarray(limiter_ids, dtype=np.int32)
         mat = np.maximum(mat, 0)
-        with self._lock:
+        with self._lock, self._exclusive():
             out = self._tb_peek(self.tb_packed, self.table.device_arrays,
                                 jnp.asarray(mat), jnp.asarray(lids), jnp.int64(now_ms))
         return np.asarray(out)[shard, cols]
@@ -780,13 +1075,13 @@ class ShardedDeviceEngine:
     def sw_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
         self._mark_mat("sw", mat)
-        with self._lock:
+        with self._lock, self._exclusive():
             self.sw_packed = self._sw_reset(self.sw_packed, jnp.asarray(mat))
 
     def tb_clear(self, slots: Sequence[int]) -> None:
         mat, _, _, _ = self._route(slots)
         self._mark_mat("tb", mat)
-        with self._lock:
+        with self._lock, self._exclusive():
             self.tb_packed = self._tb_reset(self.tb_packed, jnp.asarray(mat))
 
     # -- raw packed-row access (export/import rebalance; replication cuts) ----
@@ -806,7 +1101,7 @@ class ShardedDeviceEngine:
         padded[:n] = slots
         shard = jnp.asarray(padded // self.slots_per_shard, dtype=jnp.int32)
         local = jnp.asarray(padded % self.slots_per_shard, dtype=jnp.int32)
-        with self._lock:
+        with self._lock, self._exclusive():
             packed = self.sw_packed if algo == "sw" else self.tb_packed
             rows = packed[shard, local]
         return np.asarray(rows)[:n]
@@ -817,7 +1112,7 @@ class ShardedDeviceEngine:
         shard = jnp.asarray(slots // self.slots_per_shard, dtype=jnp.int32)
         local = jnp.asarray(slots % self.slots_per_shard, dtype=jnp.int32)
         vals = jnp.asarray(np.ascontiguousarray(rows, dtype=np.int32))
-        with self._lock:
+        with self._lock, self._exclusive():
             packed = self.sw_packed if algo == "sw" else self.tb_packed
             # Device-side scatter (no full-array host roundtrip), then
             # re-constrain to the shard placement.
@@ -829,5 +1124,5 @@ class ShardedDeviceEngine:
                 self.tb_packed = new
 
     def block_until_ready(self) -> None:
-        with self._lock:
+        with self._lock, self._exclusive():
             jax.block_until_ready((self.sw_packed, self.tb_packed))

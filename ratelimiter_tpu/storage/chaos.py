@@ -869,6 +869,19 @@ def shard_failover_drill(
     finally:
         repl.stop()
 
+    # r8 follow-up (PR 6): the victim dies with work still IN its drain
+    # pool — one per-shard relay dispatch is enqueued on the victim's
+    # device and deliberately NOT fetched before the kill, so the drill
+    # proves single-shard promotion does not depend on the dead shard's
+    # pipeline being quiesced.  (Victim-only post-epoch traffic: the
+    # same loss class as the loss wave above — it dies with the shard.)
+    undrained = None
+    if hasattr(engine, "relay_shard_dispatch") and engine.relay_usable():
+        word = np.array([1 << (engine.rank_bits + 1)], dtype=np.uint32)
+        undrained = engine.relay_shard_dispatch(
+            "tb", victim, "bits", word, np.int32(lid_tb), clock["t"])
+    report["undrained_at_kill"] = undrained is not None
+
     # The kill: shard `victim` is gone.  Its standby survives.
     router.fail_shard(victim)
     health = router.shard_health()
@@ -918,6 +931,15 @@ def shard_failover_drill(
         if "shard" in e:
             assert e["shard"] == victim, e
     report["flight_timeline"] = kinds
+
+    if undrained is not None:
+        # Promotion + post-failover serving all happened with the dead
+        # shard's dispatch still undrained; the handle must also still
+        # resolve (on the virtual mesh the device itself never dies) —
+        # a wedged or poisoned handle here would mean promotion depended
+        # on quiescing the victim's drain pool.
+        assert np.asarray(undrained).shape[0] >= 1, (
+            "undrained victim dispatch did not resolve after promotion")
 
     report["victim_shard"] = victim
     report["shard_health"] = router.shard_health()

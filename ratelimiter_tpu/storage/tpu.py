@@ -121,6 +121,14 @@ _DISPATCH_RATE_DERATE = 0.55
 # fetch cycle, small enough to bound queued result buffers.
 _DRAIN_WORKERS = 4
 _DRAIN_INFLIGHT = 4
+# Per-shard stream pipelining (r8): how many chunks the routing pass may
+# run ahead of the oldest still-assembling chunk.  Each lane additionally
+# bounds its own drain queue (see _ShardLane), so total staging memory is
+# O(lookahead + drain bound) chunks.
+_SHARD_LOOKAHEAD = 2
+# Undrained dispatches a single shard lane may hold before its submit
+# blocks (and flags shard.drain_saturated to the flight recorder).
+_SHARD_DRAIN_INFLIGHT = 2
 # Device step cost per dispatched lane (words/weighted: per request;
 # digest: per unique, sorted vs unsorted scatter).  The elections
 # charge these explicitly; since r5 they are PROBED at runtime per
@@ -266,12 +274,17 @@ class _DrainSet:
     ``finish(swallow=True)`` is for paths already propagating a primary
     exception (drain errors are then secondary)."""
 
-    __slots__ = ("_pool", "_futs", "_inflight")
+    __slots__ = ("_pool", "_futs", "_inflight", "_on_block")
 
-    def __init__(self, pool, inflight: int = _DRAIN_INFLIGHT):
+    def __init__(self, pool, inflight: int = _DRAIN_INFLIGHT,
+                 on_block=None):
         self._pool = pool
         self._futs: list = []
         self._inflight = inflight
+        # Saturation hook (r8): called once each time submit must wait
+        # out an old drain — the per-shard lanes feed it to the flight
+        # recorder so a drain-bound shard is diagnosable.
+        self._on_block = on_block
 
     def submit(self, fn, *args) -> None:
         self._futs.append(self._pool.submit(fn, *args))
@@ -279,6 +292,8 @@ class _DrainSet:
         # by waiting out the oldest live drain past the cap.
         live = [f for f in self._futs if not f.done()]
         if len(live) > self._inflight:
+            if self._on_block is not None:
+                self._on_block()
             live[0].result()
 
     def finish(self, swallow: bool = False) -> None:
@@ -337,6 +352,63 @@ class _StagingPool:
                 return  # over budget: let the GC have it
             self._free.setdefault(key, []).append(arr)
             self._bytes += arr.nbytes
+
+
+class _ShardLane:
+    """One shard's fully independent dispatch pipeline (r8).
+
+    The pre-r8 sharded stream prepared ALL shards' host work on one
+    worker and barriered them into a single mesh-wide dispatch per
+    chunk — every shard waited for the slowest sibling's layout, the
+    multi-device launch rendezvoused all devices, and the request lane
+    padded to the BUSIEST shard's bucket.  A lane decomposes that: it
+    owns
+
+    - ``pipe``  — one FIFO worker running assign -> eviction-clear ->
+      layout -> per-shard dispatch.  FIFO == per-shard stream order, so
+      a shard's clears always enter its device stream ahead of the
+      dispatch that reuses the slots, with NO cross-shard barrier (a
+      key never migrates shards, so nothing else needs one);
+    - ``staging`` — the shard's own staging-buffer pool (per-shard
+      upload shapes recur per lane, and sibling lanes never contend on
+      its lock);
+    - ``drains`` — the shard's own bounded drain queue on its own
+      fetch worker; past the in-flight bound, submit blocks THIS lane
+      only and flags saturation to the flight recorder.
+
+    Chunk N+1 of shard A assembles while chunk N of shard B is still in
+    flight — the inversion fix for BENCH_r05's sharded_scaling curve.
+    """
+
+    __slots__ = ("shard", "pipe", "drain_pool", "staging", "drains",
+                 "saturated")
+
+    def __init__(self, shard: int, recorder=None, inflight: int | None = None):
+        import concurrent.futures as cf
+
+        if inflight is None:
+            inflight = _SHARD_DRAIN_INFLIGHT
+
+        self.shard = shard
+        self.pipe = cf.ThreadPoolExecutor(
+            1, thread_name_prefix=f"shard{shard}-pipe")
+        self.drain_pool = cf.ThreadPoolExecutor(
+            1, thread_name_prefix=f"shard{shard}-drain")
+        self.staging = _StagingPool(max_bytes=64 << 20)
+        self.saturated = 0
+
+        def on_block():
+            self.saturated += 1
+            if recorder is not None:
+                recorder.record("shard.drain_saturated",
+                                coalesce_ms=1000.0, shard=self.shard)
+
+        self.drains = _DrainSet(self.drain_pool, inflight=inflight,
+                                on_block=on_block)
+
+    def close(self) -> None:
+        self.pipe.shutdown(wait=False)
+        self.drain_pool.shutdown(wait=False)
 
 
 class _ChunkCursor:
@@ -571,7 +643,8 @@ class TpuBatchedStorage(RateLimitStorage):
                 s: meter_registry.timer(
                     f"ratelimiter.stream.{s}",
                     f"Stream pipeline {s} stage (us per chunk)")
-                for s in ("pack", "index", "layout", "enqueue", "fetch")}
+                for s in ("route", "pack", "index", "layout", "enqueue",
+                          "fetch")}
         # Reusable dispatch staging buffers shared by every stream loop.
         self._staging = _StagingPool()
         if engine is not None and table is None:
@@ -686,6 +759,9 @@ class TpuBatchedStorage(RateLimitStorage):
         # regions (ROUND_NOTES r3).
         self._link_profile: Tuple[float, float] | None = None
         self._chunk_plans: Dict[tuple, tuple] = {}
+        # Host-vs-device shard routing election (r8): None until the
+        # first large sharded chunk A/Bs both (see _route_sharded).
+        self._route_mode: str | None = None
         # Batch timestamps are clamped monotonically non-decreasing: a wall
         # clock stepping backwards (NTP) must not roll windows backwards —
         # the slot model keeps only (curr, prev) buckets, and a regressed
@@ -2120,385 +2196,432 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def _stream_relay_sharded(self, algo, lid, key_ids, index, multi_lid,
                               lid_arr, key_kind="ints") -> np.ndarray:
-        """Sharded relay streaming (unit permits), shard-parallel and
-        PIPELINED (r6): per chunk, keys route to shards host-side, each
-        shard's C sub-index emits its duplicate structure with LOCAL
-        slot ids, and one shard_map'd relay dispatch decides every
-        shard's slice — digest mode (per-unique counts) on skewed
-        traffic, per-request words otherwise.
+        """Sharded relay streaming over fully independent per-shard
+        pipelines (r8; ROADMAP item 1).
 
-        The r5 loop ran route -> assign -> layout -> dispatch strictly
-        serially per chunk, so every host stage sat exposed on the
-        critical path and the curve ANTI-scaled with shards.  Now chunk
-        N+1's whole host side — routing (one C pass), per-shard slot
-        assignment (pool fan-out, GIL-free C), mode election, and
-        per-shard LAYOUT (digest row fills / words rebuilds, also
-        fanned out per shard) — runs on the pipeline worker while chunk
-        N is in flight, double-buffered through the staging pool; the
-        only host work left between dispatches is the enqueue itself
-        (async and cheap, ROUND_NOTES r5).  String keys (key_kind
-        "strs") hash once per chunk and route by fingerprint h1 — the
-        same value shard_of_key computes scalar-side.  Decisions are
-        identical to the r5 serial loop (same per-shard request
-        order)."""
-        from ratelimiter_tpu.ops.relay import wire_costs
+        Per chunk the main thread does ONE routing pass (host C router
+        or the on-mesh route-and-count pass, whichever the measured
+        election picked — :meth:`_route_sharded`) and hands each shard
+        its contiguous slice; from there everything is per-shard: slot
+        assignment, eviction clears, layout into the lane's own staging
+        buffer, a SINGLE-DEVICE dispatch on the shard's own device
+        (``ShardedDeviceEngine.relay_shard_dispatch``), and a bounded
+        per-lane drain queue.  There is no cross-shard barrier anywhere;
+        the only ordering constraint is per-shard stream order, enforced
+        by each lane's FIFO worker — which is also the clear path: a
+        shard's eviction clears enter its device stream ahead of the
+        dispatch that reuses those slots, and a key never migrates
+        shards, so nothing else needs ordering.
+
+        The r6/r7 loop instead barriered every chunk into one mesh-wide
+        shard_map dispatch: every shard waited for the slowest sibling's
+        layout, the multi-device launch rendezvoused all devices, and
+        the lane padding followed the busiest shard — BENCH_r05 measured
+        the result anti-scaling 19.5M -> 4.3M decisions/s from 1 -> 8
+        shards on the CPU mesh.
+
+        Mode (digest vs words) is elected PER SHARD from that shard's
+        own dedup ratio; every dispatch records its route as
+        ``sharded|digest`` / ``sharded|words`` with its shard id in the
+        decision trace and latency histograms, per-shard stage seconds
+        feed the ``ratelimiter.stream.*`` timers (``route`` is the new
+        binning stage), and a lane whose drain queue blocks flags
+        ``shard.drain_saturated`` to the flight recorder.  Decisions are
+        bit-identical to the r7 loop and to the flat single-device
+        oracle on the same per-key request order (per-key order is
+        per-shard order)."""
+        from ratelimiter_tpu.engine.native_index import (
+            hash_str_keys,
+            relay_decide_pos,
+            rebuild_words_into,
+        )
+        from ratelimiter_tpu.ops.relay import rebuild_words, wire_costs
+        from ratelimiter_tpu.parallel.sharded import _bucket
 
         eng = self.engine
         n_sh, sps = eng.n_shards, eng.slots_per_shard
         rb = eng.rank_bits
         cdt = eng.counts_dtype()
         digest_bpu, words_bpr = wire_costs(multi_lid)
-        bits_dispatch = (eng.sw_relay_sharded_dispatch if algo == "sw"
-                         else eng.tb_relay_sharded_dispatch)
-        counts_dispatch = (eng.sw_relay_counts_sharded_dispatch
-                           if algo == "sw"
-                           else eng.tb_relay_counts_sharded_dispatch)
         n = len(key_ids)
         out = np.empty(n, dtype=bool)
-        drains = _DrainSet(self._drain_pool())
-        rec_lock = threading.Lock()
-        pool = self._shard_pool(n_sh)
-        staging = self._staging
+        if n == 0:
+            return out
+        lanes = self._shard_lanes(n_sh)
+        stop = threading.Event()
+        errors: list = []  # (chunk_i, shard, exc); first in stream order wins
+        err_lock = threading.Lock()
 
-        def drain(mode, handle, start, per_shard, t0, rec, bufs):
+        def fail(ci, s, exc):
+            with err_lock:
+                errors.append((ci, s, exc))
+            stop.set()
+
+        def shard_task(ci, s, start, now, keys_s, h1_s, h2_s, pos_s, l_s,
+                       pins_s, ctx):
+            """Everything one shard does for one chunk, on its lane's
+            FIFO worker.  Never raises: failures land in ``errors`` and
+            set ``stop`` (sibling lanes stop dispatching; evictions an
+            already-applied assignment made are still cleared)."""
+            if stop.is_set():
+                return
+            lane = lanes[s]
+            sub = index._sub[s]
+            ns = len(pos_s)
+            buf = None
+            pinned_local = None
+            dispatched = False
             try:
-                tf0 = time.perf_counter()
-                arr = np.asarray(handle)
-                tf1 = time.perf_counter()
-                dt_us = (tf1 - t0) * 1e6
-                self._stage("fetch", tf1 - tf0)
-                with rec_lock:
-                    if rec is not None:
-                        rec["fetch_s"] = round(tf1 - tf0, 6)
-                cnt = alw = 0
-                if mode == "digest":
-                    from ratelimiter_tpu.engine.native_index import (
-                        relay_decide_pos,
-                    )
-
-                    ov = out[start:]  # contiguous suffix view
-                    for s, (pos, uidx, rank, u) in enumerate(per_shard):
-                        if not len(pos):
-                            continue
-                        # Fused reconstruct + unscatter: one C pass
-                        # instead of dense decisions + fancy scatter.
-                        alw += relay_decide_pos(arr[s, :u], uidx, rank,
-                                                pos, ov)
-                        cnt += len(pos)
+                tw0 = time.perf_counter()
+                try:
+                    if key_kind != "ints":
+                        uw, uidx, rank, ev = sub.assign_batch_fps_uniques(
+                            h1_s, h2_s, rb, pinned=pins_s, hold_pins=True)
+                    elif multi_lid:
+                        uw, uidx, rank, ev = (
+                            sub.assign_batch_ints_multi_uniques(
+                                keys_s, l_s, rb, pinned=pins_s,
+                                hold_pins=True))
+                    else:
+                        uw, uidx, rank, ev = sub.assign_batch_ints_uniques(
+                            keys_s, lid, rb, pinned=pins_s, hold_pins=True)
+                except Exception as exc:  # noqa: BLE001
+                    # Lanes that assigned before the failure are already
+                    # remapped in the index: their evicted slots must be
+                    # zeroed even though nothing dispatches (ADVICE r3).
+                    pc = consume_pending_clears(exc, 0)
+                    if len(pc):
+                        self._clear_shard(algo, s, pc)
+                    raise
+                walk_s = time.perf_counter() - tw0
+                ctx["walk"][s] = walk_s
+                self._stage("index", walk_s)
+                if len(ev):
+                    # Stream-order clear path: this lane is a FIFO, so
+                    # the clear precedes this chunk's dispatch in this
+                    # shard's device stream.
+                    self._clear_shard(algo, s, ev)
+                u = len(uw)
+                ctx["u"][s] = u
+                pinned_local = (uw >> np.uint32(rb + 1)).astype(np.int32)
+                t_l0 = time.perf_counter()
+                digest = (cdt is not None
+                          and digest_bpu * _bucket(max(u, 1))
+                          <= words_bpr * ns)
+                if digest:
+                    u_pad = _bucket(max(u, 1))
+                    buf = lane.staging.take((u_pad,), np.uint32)
+                    buf[:u] = uw
+                    buf[u:] = 0xFFFFFFFF
+                    lid_lane = lid
+                    if multi_lid:
+                        first = rank == 0
+                        ulids = np.zeros(u_pad, dtype=np.int32)
+                        ulids[uidx[first]] = l_s[first]
+                        lid_lane = ulids
+                    ctx["wire"][s] = digest_bpu * u
                 else:
-                    bits = np.unpackbits(arr, axis=1)
-                    for s, (pos,) in enumerate(per_shard):
-                        if not len(pos):
-                            continue
-                        got = bits[s, :len(pos)].astype(bool)
-                        out[start + pos] = got
-                        cnt += len(pos)
-                        alw += int(got.sum())
-                with rec_lock:
-                    self._record_dispatch(algo, cnt, alw, dt_us,
-                                          path=f"sharded|{mode}")
+                    b_pad = _bucket(max(ns, 1))
+                    buf = lane.staging.take((b_pad,), np.uint32)
+                    if not rebuild_words_into(uw, uidx, rank, rb,
+                                              buf[:ns]):
+                        buf[:ns] = rebuild_words(uw, uidx, rank, rb)
+                    buf[ns:] = 0xFFFFFFFF
+                    lid_lane = lid
+                    if multi_lid:
+                        lid_lane = np.zeros(b_pad, dtype=np.int32)
+                        lid_lane[:ns] = l_s
+                    ctx["wire"][s] = words_bpr * ns
+                mode = "digest" if digest else "words"
+                ctx["modes"][s] = mode
+                layout_s = time.perf_counter() - t_l0
+                ctx["layout"][s] = layout_s
+                self._stage("layout", layout_s)
+                if stop.is_set():  # a sibling failed after our assign
+                    return
+                t0 = time.perf_counter()
+                if digest:
+                    handle = eng.relay_shard_dispatch(
+                        algo, s, "counts", buf, lid_lane, now, cdt)
+                else:
+                    handle = eng.relay_shard_dispatch(
+                        algo, s, "bits", buf, lid_lane, now)
+                dispatched = True
+                enq_s = time.perf_counter() - t0
+                ctx["enq"][s] = enq_s
+                self._stage("enqueue", enq_s)
+            except Exception as exc:  # noqa: BLE001
+                fail(ci, s, exc)
+                return
             finally:
-                for b in bufs:
-                    staging.give(b)
+                # Pins release once the dispatch entered the shard's
+                # stream (or on any failure) — see _pins_released.
+                if pinned_local is not None and hasattr(sub, "unpin_batch"):
+                    sub.unpin_batch(pinned_local)
+                if not dispatched and buf is not None:
+                    lane.staging.give(buf)
 
-        def prepare(start, cn):
-            """Whole host side of one chunk, run on the pipeline worker.
-            Never raises: errors come back IN the bundle together with
-            the pins and eviction-clears the failed chunk accumulated,
-            so the main loop cleans up in stream order."""
-            b = {"start": start, "cn": cn, "pin_glob": [], "clears": [],
-                 "err": None, "bufs": [], "mats": None}
-            try:
-                self._prepare_sharded_chunk(
-                    b, algo, lid, key_ids, index, multi_lid, lid_arr,
-                    key_kind, pool, rb, cdt, digest_bpu, words_bpr)
-            except Exception as exc:  # noqa: BLE001 — surfaced by main loop
-                if b["err"] is None:
-                    b["err"] = exc
-            return b
+            def drain(handle=handle, mode=mode, buf=buf, u=u, uidx=uidx,
+                      rank=rank, pos_s=pos_s, ns=ns, s=s, start=start,
+                      t0=t0, ctx=ctx):
+                try:
+                    tf0 = time.perf_counter()
+                    arr = np.asarray(handle)
+                    tf1 = time.perf_counter()
+                    self._stage("fetch", tf1 - tf0)
+                    if mode == "digest":
+                        # Fused reconstruct + unscatter straight into the
+                        # output suffix (one C pass).
+                        alw = relay_decide_pos(arr[:u], uidx, rank, pos_s,
+                                               out[start:])
+                    else:
+                        bits = np.unpackbits(arr)[:ns].astype(bool)
+                        out[start + pos_s] = bits
+                        alw = int(bits.sum())
+                    rec = ctx["rec"]
+                    if rec is not None:
+                        with ctx["lock"]:
+                            rec["fetch_s"] = round(
+                                max(rec.get("fetch_s", 0.0), tf1 - tf0), 6)
+                    self._record_dispatch(algo, ns, int(alw),
+                                          (tf1 - t0) * 1e6,
+                                          path=f"sharded|{mode}", shard=s)
+                finally:
+                    lane.staging.give(buf)
 
-        # Chunk sizing: the wire-budget growth schedule, with the learned
-        # steady-state size cached per stream shape so later passes start
-        # there instead of re-growing from the floor every pass.
+            lane.drains.submit(drain)
+
+        # Chunk sizing: learned steady-state size per stream shape (the
+        # single-device election machinery stays unused here — the
+        # lanes' host work is already off the critical path, so giant
+        # chunks win).
         plan_key = ("relay_sharded", key_kind, algo, bool(multi_lid),
                     _bucket_fine(n, floor=_RELAY_CHUNK))
         plan = self._chunk_plans.get(plan_key)
         chunk = (int(plan["chunk"]) if plan and plan.get("chunk")
                  else _RELAY_CHUNK)
+        inflight: list = []
+        ci = 0
         start = 0
-        fut = self._assign_pool().submit(prepare, 0, min(chunk, n))
-        try:
-            while start < n:
-                bundle = fut.result()
-                fut = None
-                cn = bundle["cn"]
-                held = bundle["pin_glob"]
-                try:
-                    if bundle["clears"]:
-                        self._clear_slots(algo, bundle["clears"])
-                    if bundle["err"] is not None:
-                        for buf in bundle["bufs"]:
-                            staging.give(buf)
-                        raise bundle["err"]
-                    now = self._monotonic_now()
-                    t0 = time.perf_counter()
-                    mode, mat, lid_mat = bundle["mats"]
-                    if mode == "digest":
-                        handle = counts_dispatch(
-                            mat, lid if not multi_lid else lid_mat, now,
-                            cdt)
-                    else:
-                        handle = bits_dispatch(
-                            mat, lid if not multi_lid else lid_mat, now)
-                    enq_s = time.perf_counter() - t0
-                finally:
-                    self._unpin_held(index, held)
-                self._stage("enqueue", enq_s)
-                # Per-shard walk seconds AND request counts expose where
-                # a sharded chunk's host time goes — walk spread with
-                # balanced shard_n is core contention, walk spread
-                # tracking shard_n is routing skew (VERDICT r4 #6).
-                rec = self._stream_rec(
-                    "relay_sharded", n=int(cn), u=int(bundle["u_total"]),
-                    mode=mode, wire_bytes=int(bundle["wire_b"]),
-                    assign_s=float(bundle["walk_s"]),
-                    shard_walk_s=[round(float(x), 6)
-                                  for x in bundle["walk_by_shard"]],
-                    shard_n=[int(x) for x in bundle["shard_n"]],
-                    layout_s=float(bundle["layout_s"]),
-                    dispatch_s=enq_s,
-                    host_s=float(bundle["host_s"]) + enq_s)
-                if rec is not None and bundle.get("pack_s"):
-                    rec["pack_s"] = round(bundle["pack_s"], 6)
-                # Size + prefetch the NEXT chunk before the drain of this
-                # one: its route+assign+layout overlap this fetch cycle.
-                bpr = max(bundle["wire_b"] / cn, 1e-3)
-                budget = (_RELAY_WIRE_BUDGET_DIGEST if mode == "digest"
+
+        def finalize(ctx):
+            """Join one chunk's shard tasks, fold its per-shard seconds
+            into the chunk record, and re-learn the chunk size from its
+            measured bytes/request."""
+            nonlocal chunk
+            for f in ctx["futs"]:
+                f.result()  # tasks never raise; surfaces executor faults
+            wire_b = float(ctx["wire"].sum())
+            rec = ctx["rec"]
+            if rec is not None:
+                modes = [m for m in ctx["modes"] if m]
+                with ctx["lock"]:
+                    rec.update(
+                        u=int(ctx["u"].sum()),
+                        mode=(modes[0] if len(set(modes)) == 1
+                              else "mixed"),
+                        wire_bytes=int(wire_b),
+                        route_s=round(float(ctx["route_s"]), 6),
+                        assign_s=round(float(ctx["walk"].max()), 6),
+                        shard_walk_s=[round(float(x), 6)
+                                      for x in ctx["walk"]],
+                        shard_n=[int(x) for x in ctx["shard_n"]],
+                        layout_s=round(float(ctx["layout"].sum()), 6),
+                        dispatch_s=round(float(ctx["enq"].sum()), 6),
+                        host_s=round(float(ctx["route_s"])
+                                     + float(ctx["layout"].sum())
+                                     + float(ctx["enq"].sum()), 6),
+                    )
+                    if ctx["pack_s"]:
+                        rec["pack_s"] = round(ctx["pack_s"], 6)
+            if wire_b > 0 and ctx["cn"]:
+                bpr = max(wire_b / ctx["cn"], 1e-3)
+                digesty = sum(1 for m in ctx["modes"] if m == "digest")
+                mody = max(sum(1 for m in ctx["modes"] if m), 1)
+                budget = (_RELAY_WIRE_BUDGET_DIGEST
+                          if 2 * digesty >= mody
                           else _RELAY_WIRE_BUDGET_WORDS)
                 chunk = int(min(max(budget / bpr, _RELAY_CHUNK),
                                 _RELAY_CHUNK_MAX))
-                nxt = start + cn
-                if nxt < n:
-                    fut = self._assign_pool().submit(
-                        prepare, nxt, min(chunk, n - nxt))
-                drains.submit(drain, mode, handle, start,
-                              bundle["per_shard"], t0, rec,
-                              bundle["bufs"])
-                start = nxt
-            drains.finish()
+
+        try:
+            while start < n and not stop.is_set():
+                cn = min(chunk, n - start)
+                t_r0 = time.perf_counter()
+                pack_s = 0.0
+                h1st = h2st = kst = None
+                if key_kind == "ints":
+                    kchunk = key_ids[start:start + cn]
+                    shard, order, counts, kst = self._route_sharded(
+                        eng, kchunk=kchunk)
+                else:
+                    t_p0 = time.perf_counter()
+                    fp = hash_str_keys(key_ids, lid, start, cn)
+                    if fp is None:
+                        raise RuntimeError(
+                            "native string hashing unavailable mid-stream "
+                            "(mutated key list?)")
+                    pack_s = time.perf_counter() - t_p0
+                    self._stage("pack", pack_s)
+                    shard, order, counts, h1st, h2st = self._route_sharded(
+                        eng, h1=fp[0], h2=fp[1])
+                route_s = time.perf_counter() - t_r0 - pack_s
+                self._stage("route", route_s)
+                offs = np.zeros(n_sh + 1, dtype=np.int64)
+                np.cumsum(counts, out=offs[1:])
+                l_chunk = lid_arr[start:start + cn] if multi_lid else None
+                pins = self._batcher.pending_slots_sharded(algo, sps)
+                now = self._monotonic_now()
+                rec = self._stream_rec("relay_sharded", n=int(cn))
+                ctx = {
+                    "cn": cn, "rec": rec, "lock": threading.Lock(),
+                    "walk": np.zeros(n_sh), "layout": np.zeros(n_sh),
+                    "enq": np.zeros(n_sh), "wire": np.zeros(n_sh),
+                    "u": np.zeros(n_sh, np.int64),
+                    "modes": [None] * n_sh, "shard_n": counts,
+                    "route_s": route_s, "pack_s": pack_s, "futs": [],
+                }
+                for s in range(n_sh):
+                    lo, hi = int(offs[s]), int(offs[s + 1])
+                    if lo == hi:
+                        continue
+                    pos_s = order[lo:hi]
+                    ctx["futs"].append(lanes[s].pipe.submit(
+                        shard_task, ci, s, start, now,
+                        kst[lo:hi] if kst is not None else None,
+                        h1st[lo:hi] if h1st is not None else None,
+                        h2st[lo:hi] if h2st is not None else None,
+                        pos_s,
+                        l_chunk[pos_s] if multi_lid else None,
+                        pins.get(s), ctx))
+                inflight.append(ctx)
+                start += cn
+                ci += 1
+                # Bounded look-ahead: route at most _SHARD_LOOKAHEAD
+                # chunks beyond the oldest still-assembling one (bounds
+                # staging memory and the learned-size feedback lag).
+                while len(inflight) > _SHARD_LOOKAHEAD:
+                    finalize(inflight.pop(0))
+            while inflight:
+                finalize(inflight.pop(0))
+            if not stop.is_set():
+                for lane in lanes:
+                    lane.drains.finish()
         finally:
-            if fut is not None:
-                self._abort_sharded_prefetch(algo, index, fut)
-            drains.finish(swallow=True)  # no-op on the normal path
-        # Remember the learned steady chunk for later passes over this
-        # shape (passes >= 3 marks it settled for warmup-stability
-        # checks; the single-device election machinery stays unused
-        # here — the sharded loop's layout is already off the critical
-        # path, so giant chunks with overlapped prepare win).
+            while inflight:
+                try:
+                    finalize(inflight.pop(0))
+                except Exception:  # noqa: BLE001 — primary error wins
+                    pass
+            for lane in lanes:
+                lane.drains.finish(swallow=True)  # no-op when healthy
+        if errors:
+            errors.sort(key=lambda e: (e[0], e[1]))
+            raise errors[0][2]
         self._chunk_plans[plan_key] = {"kind": "giant", "chunk": chunk,
                                        "passes": 3}
         return out
 
-    def _prepare_sharded_chunk(self, b, algo, lid, key_ids, index,
-                               multi_lid, lid_arr, key_kind, pool, rb,
-                               cdt, digest_bpu, words_bpr) -> None:
-        """Stage A of the sharded pipeline: route + per-shard assign +
-        election + layout for one chunk, filling the bundle ``b``.
-        Runs on the pipeline worker; partial per-shard failures leave
-        their pins/clears in the bundle and set ``b["err"]``."""
-        from ratelimiter_tpu.engine.native_index import (
-            hash_str_keys,
-            rebuild_words_into,
-        )
-        from ratelimiter_tpu.ops.relay import rebuild_words
-        from ratelimiter_tpu.parallel.sharded import _bucket
+    def _route_sharded(self, eng, kchunk=None, h1=None, h2=None):
+        """One chunk's shard routing: ``(shard, order, counts, gathered
+        keys)`` for int traffic, ``(..., h1_sorted, h2_sorted)`` for
+        string traffic.  Host C router (``rl_shard_route2`` /
+        ``rl_route_hashes2``) vs the on-mesh route-and-count pass
+        (parallel/sharded.py:build_route_count) is a MEASURED election —
+        ``RATELIMITER_DEVICE_ROUTE=on|off|auto`` (auto A/Bs both once
+        per storage on the first large chunk and reports the verdict to
+        the flight recorder).  On a CPU container the host pass wins
+        (the "device" shares the core); on a real slice the device does
+        the O(n) binning where the mesh is real, and the losing pass
+        never serves."""
+        ints = h1 is None
+        n = len(kchunk) if ints else len(h1)
+        mode = self._route_mode
+        if mode is None:
+            env = os.environ.get("RATELIMITER_DEVICE_ROUTE",
+                                 "auto").lower()
+            if env in ("1", "on", "device"):
+                mode = self._route_mode = "device"
+            elif env in ("0", "off", "host"):
+                mode = self._route_mode = "host"
+            elif n < (1 << 16):
+                mode = "host"  # too small to measure; not sticky
+            else:
+                t0 = time.perf_counter()
+                host = self._route_host(kchunk, h1, h2, eng.n_shards)
+                host_s = time.perf_counter() - t0
+                # Warm the device pass (compile + first transfer) so the
+                # election compares steady-state costs, not a one-time
+                # jit — the service pays the compile once per geometry.
+                (eng.route_on_device(key_ids=kchunk) if ints
+                 else eng.route_on_device(hashes=h1))
+                t0 = time.perf_counter()
+                dev = (eng.route_on_device(key_ids=kchunk) if ints
+                       else eng.route_on_device(hashes=h1))
+                # Charge the device side the gather the host router
+                # fuses in (the per-shard slices need sorted keys).
+                _ = kchunk[dev[1]] if ints else h1[dev[1]]
+                dev_s = time.perf_counter() - t0
+                self._route_mode = ("device" if dev_s < host_s
+                                    else "host")
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "sharded.route_elect",
+                        host_s=round(host_s, 6),
+                        device_s=round(dev_s, 6),
+                        elected=self._route_mode, n=int(n))
+                return host
+        if mode == "device":
+            if ints:
+                shard, order, counts = eng.route_on_device(key_ids=kchunk)
+                return shard, order, counts, kchunk[order]
+            shard, order, counts = eng.route_on_device(hashes=h1)
+            return shard, order, counts, h1[order], h2[order]
+        return self._route_host(kchunk, h1, h2, eng.n_shards)
 
-        eng = self.engine
-        n_sh, sps = eng.n_shards, eng.slots_per_shard
-        start, cn = b["start"], b["cn"]
-        t_c0 = time.perf_counter()
-        pins_by_shard: dict = {}
-        for g in self._batcher.pending_slots(algo):
-            pins_by_shard.setdefault(g // sps, set()).add(g % sps)
-        pack_s = 0.0
-        # One routing pass turns each shard's requests into a contiguous
-        # slice (still in arrival order): ints hash+counting-sort in one
-        # C pass; strings hash ONCE into fingerprints (consumed below by
-        # the per-shard fps assigns — zero further hashing) and route by
-        # h1, exactly as shard_of_key does scalar-side.
-        if key_kind == "ints":
+    @staticmethod
+    def _route_host(kchunk, h1, h2, n_sh):
+        if h1 is None:
             from ratelimiter_tpu.engine.native_index import (
                 shard_route_gather,
             )
 
-            kchunk = key_ids[start:start + cn]
             r2 = shard_route_gather(kchunk, n_sh)
             if r2 is not None:  # fused route+gather, one C pass
-                shard, order, scnt, kst = r2
-            else:
-                shard, order, scnt = _route_chunk(kchunk, n_sh)
-                kst = kchunk[order]
-            h1st = h2st = None
-        else:
-            from ratelimiter_tpu.engine.native_index import (
-                route_hashes_gather,
-            )
+                return r2
+            shard, order, counts = _route_chunk(kchunk, n_sh)
+            return shard, order, counts, kchunk[order]
+        from ratelimiter_tpu.engine.native_index import route_hashes_gather
 
-            t_p0 = time.perf_counter()
-            fp = hash_str_keys(key_ids, lid, start, cn)
-            if fp is None:
-                raise RuntimeError(
-                    "native string hashing unavailable mid-stream "
-                    "(mutated key list?)")
-            pack_s = time.perf_counter() - t_p0
-            shard, order, scnt, h1st, h2st = route_hashes_gather(
-                fp[0], fp[1], n_sh)
-            kst = None
-        soffs = np.zeros(n_sh + 1, dtype=np.int64)
-        np.cumsum(scnt, out=soffs[1:])
-        l_chunk = lid_arr[start:start + cn] if multi_lid else None
-        l_st = l_chunk[order] if multi_lid else None
-        walk_by_shard = np.zeros(n_sh)
+        return route_hashes_gather(h1, h2, n_sh)
 
-        def assign_shard(s):
-            lo, hi = int(soffs[s]), int(soffs[s + 1])
-            if lo == hi:
-                return None
-            sub = index._sub[s]
-            tw0 = time.perf_counter()
-            try:
-                if key_kind != "ints":
-                    return sub.assign_batch_fps_uniques(
-                        h1st[lo:hi], h2st[lo:hi], rb,
-                        pinned=pins_by_shard.get(s), hold_pins=True)
-                if multi_lid:
-                    return sub.assign_batch_ints_multi_uniques(
-                        kst[lo:hi], l_st[lo:hi], rb,
-                        pinned=pins_by_shard.get(s), hold_pins=True)
-                return sub.assign_batch_ints_uniques(
-                    kst[lo:hi], lid, rb, pinned=pins_by_shard.get(s),
-                    hold_pins=True)
-            finally:
-                walk_by_shard[s] = time.perf_counter() - tw0
-
-        # Pins of successful shards accumulate in the bundle as results
-        # are collected; the MAIN loop releases them after the dispatch
-        # enqueue (or on any raise — including a partial assignment
-        # failure, whose successful siblings' results never dispatch).
-        futs = [pool.submit(assign_shard, s) for s in range(n_sh)]
-        results = []
-        err = None
-        u_total = u_max = b_max = 0
-        for s, f in enumerate(futs):
-            pos = order[soffs[s]:soffs[s + 1]]
-            try:
-                r = f.result()
-            except Exception as exc:  # noqa: BLE001
-                err = err if err is not None else exc
-                # Partial-failure lanes still evicted: globalize into
-                # the bundle clears (ADVICE r3).
-                b["clears"].extend(consume_pending_clears(exc, s * sps))
-                results.append((pos, None, None, 0, None))
-                continue
-            if r is None:
-                results.append((pos, None, None, 0, None))
-                continue
-            uw, uidx, rank, ev = r
-            b["clears"].extend(s * sps + int(e) for e in ev)
-            results.append((pos, uidx, rank, len(uw), uw))
-            b["pin_glob"].append(
-                ((uw >> np.uint32(rb + 1)).astype(np.int64) + s * sps))
-            u_total += len(uw)
-            u_max = max(u_max, len(uw))
-            b_max = max(b_max, len(pos))
-        if err is not None:
-            b["err"] = err
+    def _clear_shard(self, algo: str, s: int, local_slots) -> None:
+        """Per-shard eviction clears (r8): zero LOCAL slots in shard
+        ``s``'s own device stream (``ShardedDeviceEngine.clear_shard``).
+        Mirrors :meth:`_clear_slots`' resident-lid invalidation — the
+        sharded digest path keeps no resident lids today, but the guard
+        preserves the invariant if it ever does."""
+        local_slots = [int(x) for x in local_slots]
+        if not local_slots:
             return
-        walk_s = float(walk_by_shard.max())
-        if pack_s:
-            self._stage("pack", pack_s)
-        self._stage("index", walk_s)
-
-        # Mode election (same rule as r5) + per-shard layout.
-        digest = cdt is not None and (
-            digest_bpu * n_sh * _bucket(max(u_max, 1))
-            <= words_bpr * cn)
-        t_l0 = time.perf_counter()
-        per_shard = []
-        if digest:
-            u_loc = _bucket(max(u_max, 1))
-            uw_mat = self._staging.take((n_sh, u_loc), np.uint32)
-            b["bufs"].append(uw_mat)
-            lid_mat = (np.zeros((n_sh, u_loc), dtype=np.int32)
-                       if multi_lid else None)
-            for s, item in enumerate(results):
-                pos = item[0]
-                if not len(pos):
-                    uw_mat[s] = 0xFFFFFFFF
-                    per_shard.append((pos, None, None, 0))
-                    continue
-                _, uidx, rank, u, uw = item
-                uw_mat[s, :u] = uw
-                uw_mat[s, u:] = 0xFFFFFFFF
-                if multi_lid:
-                    first = rank == 0
-                    ulids = np.zeros(u, dtype=np.int32)
-                    ulids[uidx[first]] = l_chunk[pos][first]
-                    lid_mat[s, :u] = ulids
-                per_shard.append((pos, uidx, rank, u))
-            b["mats"] = ("digest", uw_mat, lid_mat)
-            wire_b = digest_bpu * u_total
-        else:
-            b_loc = _bucket(max(b_max, 1))
-            w_mat = self._staging.take((n_sh, b_loc), np.uint32)
-            b["bufs"].append(w_mat)
-            lid_mat = (np.zeros((n_sh, b_loc), dtype=np.int32)
-                       if multi_lid else None)
-
-            def layout_shard(s):
-                pos, uidx, rank, u, uw = results[s]
-                row = w_mat[s]
-                if not len(pos):
-                    row[:] = 0xFFFFFFFF
-                    return
-                if not rebuild_words_into(uw, uidx, rank, rb,
-                                          row[:len(pos)]):
-                    row[:len(pos)] = rebuild_words(uw, uidx, rank, rb)
-                row[len(pos):] = 0xFFFFFFFF
-                if multi_lid:
-                    lid_mat[s, :len(pos)] = l_chunk[pos]
-
-            # Per-shard layout fan-out: the words rebuild is a GIL-free
-            # C pass per shard, so multi-core hosts overlap them.
-            for f in [pool.submit(layout_shard, s)
-                      for s in range(n_sh)]:
-                f.result()
-            per_shard = [(item[0],) for item in results]
-            b["mats"] = ("bits", w_mat, lid_mat)
-            wire_b = words_bpr * cn
-        layout_s = time.perf_counter() - t_l0
-        self._stage("layout", layout_s)
-        b.update(per_shard=per_shard, wire_b=wire_b, walk_s=walk_s,
-                 pack_s=pack_s, walk_by_shard=walk_by_shard,
-                 shard_n=scnt, u_total=u_total, layout_s=layout_s,
-                 host_s=time.perf_counter() - t_c0 - walk_s)
-
-    def _abort_sharded_prefetch(self, algo, index, fut) -> None:
-        """Consume an ORPHANED sharded prepare bundle (an exception
-        escaped before the main loop took it): its evictions must be
-        cleared, its pins released, its staging buffers returned —
-        exactly what the in-loop path does."""
-        try:
-            b = fut.result()
-        except Exception:  # noqa: BLE001 — nothing was prepared
+        known = self._lid_known.get(algo)
+        if known is None:
+            self.engine.clear_shard(algo, s, local_slots)
             return
-        try:
-            if b["clears"]:
-                self._clear_slots(algo, list(b["clears"]))
-        finally:
-            self._unpin_held(index, b["pin_glob"])
-            for buf in b["bufs"]:
-                self._staging.give(buf)
+        with self._lid_locks[algo]:
+            self.engine.clear_shard(algo, s, local_slots)
+            base = s * self.engine.slots_per_shard
+            known[np.asarray(local_slots, dtype=np.int64) + base] = False
+
+    def _shard_lanes(self, n_sh: int):
+        """The per-shard pipeline lanes (lazily created; see
+        :class:`_ShardLane`)."""
+        lanes = getattr(self, "_shard_lanes_obj", None)
+        if lanes is None:
+            lanes = [_ShardLane(s, recorder=self._recorder)
+                     for s in range(n_sh)]
+            self._shard_lanes_obj = lanes
+        return lanes
 
     def available_many(
         self, algo: str, lid: int, keys: Sequence[str]
@@ -3002,6 +3125,8 @@ class TpuBatchedStorage(RateLimitStorage):
             pool = getattr(self, attr, None)
             if pool is not None:
                 pool.shutdown(wait=False)
+        for lane in getattr(self, "_shard_lanes_obj", None) or ():
+            lane.close()
         for index in self._index.values():
             if hasattr(index, "close"):
                 index.close()
@@ -3070,14 +3195,21 @@ class TpuBatchedStorage(RateLimitStorage):
         return pool
 
     def _shard_pool(self, n_sh: int):
-        """Thread pool for per-shard C index calls (lazily created): the
-        calls release the GIL, so on multi-core hosts the shards' probe
-        walks run truly in parallel (single-core hosts lose nothing)."""
+        """Thread pool for per-shard C index calls (lazily created),
+        sized to the SMALLER of shard count and usable cores (r8): the
+        calls release the GIL, so real cores overlap them, but
+        oversubscribing one core with n_sh walk threads only buys
+        scheduler churn and inflated per-walk walls (the BENCH_r05
+        8-shard assign_s pathology)."""
         pool = getattr(self, "_shard_pool_obj", None)
         if pool is None:
             import concurrent.futures as cf
 
-            pool = cf.ThreadPoolExecutor(n_sh,
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):  # pragma: no cover
+                cores = os.cpu_count() or 1
+            pool = cf.ThreadPoolExecutor(max(1, min(n_sh, cores)),
                                          thread_name_prefix="shardidx")
             self._shard_pool_obj = pool
         return pool

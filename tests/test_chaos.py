@@ -630,21 +630,18 @@ def test_sharded_flat_stream_shard_assign_failure(monkeypatch):
 
 
 def test_sharded_relay_stream_dispatch_failure(monkeypatch):
-    """The shard_map'd relay dispatch dying on chunk 2 (unit permits):
-    raise, pins released on every shard, storage usable afterward with a
-    clean full-budget pass per key."""
+    """A per-shard relay dispatch (r8 lanes) dying after its first call
+    (unit permits): the stream raises, sibling lanes stop cleanly, pins
+    are released on every shard, and the storage is usable afterward
+    with a clean full-budget pass per key."""
     import ratelimiter_tpu.storage.tpu as tpu_mod
 
     monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
     monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
     st, lid, eng = _make_sharded_storage()
     monkeypatch.setattr(
-        eng, "tb_relay_counts_sharded_dispatch",
-        _fail_after(eng.tb_relay_counts_sharded_dispatch, 1,
-                    RuntimeError("injected sharded dispatch")))
-    monkeypatch.setattr(
-        eng, "tb_relay_sharded_dispatch",
-        _fail_after(eng.tb_relay_sharded_dispatch, 1,
+        eng, "relay_shard_dispatch",
+        _fail_after(eng.relay_shard_dispatch, 1,
                     RuntimeError("injected sharded dispatch")))
     ids = np.random.default_rng(1).integers(0, 150, 512).astype(np.int64)
     with pytest.raises(RuntimeError, match="injected sharded dispatch"):
@@ -676,6 +673,14 @@ def test_sharded_relay_shard_assign_failure_clears_and_releases(monkeypatch):
         st, "_clear_slots",
         lambda algo, slots: (cleared.extend(slots),
                              real_clear(algo, slots))[1])
+    # r8: sharded streams clear evictions per shard, in the lane's own
+    # stream order — observe that choke point too (global slot ids).
+    real_clear_shard = st._clear_shard
+    monkeypatch.setattr(
+        st, "_clear_shard",
+        lambda algo, s, local: (cleared.extend(
+            int(x) + s * eng.slots_per_shard for x in local),
+            real_clear_shard(algo, s, local))[1])
     sub = index._sub[3]
     monkeypatch.setattr(sub, "assign_batch_ints_uniques",
                         _fail_after(sub.assign_batch_ints_uniques, 0,

@@ -176,3 +176,174 @@ def test_sharded_str_stream_matches_single_device():
     np.testing.assert_array_equal(a, b)
     st_sharded.close()
     st_single.close()
+
+
+def test_device_route_count_matches_host_router():
+    """The on-mesh route-and-count pass (build_route_count, r8) must bin
+    bit-identically to the host router — (shard, order, counts) — for
+    int keys (splitmix64) and string fingerprints (h1), including the
+    empty-shard, all-one-shard and empty-chunk edge cases."""
+    import numpy as np
+
+    from ratelimiter_tpu.engine.native_index import route_hashes_gather
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+    from ratelimiter_tpu.storage.tpu import _route_chunk
+
+    engine = ShardedDeviceEngine(slots_per_shard=32, table=LimiterTable())
+    n_sh = engine.n_shards
+    rng = np.random.default_rng(11)
+
+    # Int keys (negative ids wrap through uint64 exactly like the host).
+    keys = rng.integers(-(1 << 62), 1 << 62, 4096).astype(np.int64)
+    h_shard, h_order, h_counts = _route_chunk(keys, n_sh)
+    d_shard, d_order, d_counts = engine.route_on_device(key_ids=keys)
+    np.testing.assert_array_equal(h_shard, d_shard)
+    np.testing.assert_array_equal(h_order, d_order)
+    np.testing.assert_array_equal(h_counts, d_counts)
+
+    # String fingerprints: route by the h1 stream, exactly as
+    # shard_of_key's string branch does.
+    h1 = rng.integers(0, 1 << 63, 2048).astype(np.uint64) * np.uint64(3)
+    h2 = rng.integers(0, 1 << 63, 2048).astype(np.uint64)
+    hs, ho, hc, h1s, h2s = route_hashes_gather(h1, h2, n_sh)
+    ds, do, dc = engine.route_on_device(hashes=h1)
+    np.testing.assert_array_equal(hs, ds)
+    np.testing.assert_array_equal(ho, do)
+    np.testing.assert_array_equal(hc, dc)
+    np.testing.assert_array_equal(h1s, h1[do])
+    np.testing.assert_array_equal(h2s, h2[do])
+
+    # All-one-shard: every key identical -> one full row, rest empty.
+    k1 = np.full(300, 424242, dtype=np.int64)
+    tgt = int(shard_of_int_keys(k1[:1], n_sh)[0])
+    s1, o1, c1 = engine.route_on_device(key_ids=k1)
+    assert c1[tgt] == 300 and c1.sum() == 300
+    np.testing.assert_array_equal(o1, np.arange(300))
+    np.testing.assert_array_equal(s1, np.full(300, tgt))
+
+    # Empty shards exist in a tiny chunk (n < n_shards).
+    k2 = np.asarray([7], dtype=np.int64)
+    s2, o2, c2 = engine.route_on_device(key_ids=k2)
+    assert c2.sum() == 1 and (c2 == 0).sum() == n_sh - 1
+
+    # Empty chunk.
+    s0, o0, c0 = engine.route_on_device(
+        key_ids=np.asarray([], dtype=np.int64))
+    assert len(s0) == 0 and len(o0) == 0 and c0.sum() == 0
+
+
+def test_sharded_stream_pipelining_invariant_under_concurrency(monkeypatch):
+    """Per-shard pipelines (r8): decisions must be IDENTICAL whether the
+    lanes run deeply pipelined (lookahead + concurrent bounded drains)
+    or fully serialized chunk-by-chunk — on a many-chunk Zipf stream
+    with EVICTION pressure, so the per-shard stream-order clear path
+    (evictions cleared in a shard's own device stream ahead of the
+    dispatch reusing the slots) is what keeps them equal."""
+    from ratelimiter_tpu.storage import tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 2048)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 2048)
+
+    rng = np.random.default_rng(17)
+    ids = rng.zipf(1.15, size=40_000).astype(np.int64) % 16_000
+    cfg = RateLimitConfig(max_permits=8, window_ms=60_000, refill_rate=2.0)
+
+    def run(lookahead, inflight):
+        monkeypatch.setattr(tpu_mod, "_SHARD_LOOKAHEAD", lookahead)
+        monkeypatch.setattr(tpu_mod, "_SHARD_DRAIN_INFLIGHT", inflight)
+        clock = FakeClock()
+        engine = ShardedDeviceEngine(slots_per_shard=512,
+                                     table=LimiterTable())
+        st = TpuBatchedStorage(engine=engine, clock_ms=clock)
+        lid = st.register_limiter("tb", cfg)
+        outs = []
+        for _ in range(2):  # uniques (16K) >> slots (4K): constant churn
+            outs.append(st.acquire_stream_ids("tb", lid, ids, None))
+            clock.t += 1500
+        st.close()
+        return outs
+
+    pipelined = run(2, 2)
+    serial = run(0, 1)
+    for a, b in zip(pipelined, serial):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_pipelined_stream_matches_single_device_multichunk(
+        monkeypatch):
+    """Multi-chunk sharded int stream (per-shard single-device
+    dispatches, concurrent drains) must decide bit-identically to the
+    flat single-device stream on an eviction-free workload."""
+    from ratelimiter_tpu.storage import tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 4096)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 4096)
+
+    rng = np.random.default_rng(23)
+    ids = rng.zipf(1.2, size=32_000).astype(np.int64) % 3000
+    cfg = RateLimitConfig(max_permits=12, window_ms=10_000,
+                          refill_rate=20.0)
+
+    clock_a, clock_b = FakeClock(), FakeClock()
+    engine = ShardedDeviceEngine(slots_per_shard=2048,
+                                 table=LimiterTable())
+    st_sharded = TpuBatchedStorage(engine=engine, clock_ms=clock_a)
+    st_single = TpuBatchedStorage(num_slots=1 << 14, clock_ms=clock_b)
+    lid_a = st_sharded.register_limiter("tb", cfg)
+    lid_b = st_single.register_limiter("tb", cfg)
+    for _ in range(2):
+        a = st_sharded.acquire_stream_ids("tb", lid_a, ids, None)
+        b = st_single.acquire_stream_ids("tb", lid_b, ids, None)
+        np.testing.assert_array_equal(a, b)
+        clock_a.t += 900
+        clock_b.t += 900
+    # Per-shard dispatch routes are in the decision trace.
+    paths = {r.get("path") for r in st_sharded.trace.snapshot()["recent"]}
+    assert any(p and p.startswith("sharded|") for p in paths), paths
+    st_sharded.close()
+    st_single.close()
+
+
+def test_sharded_route_election_records_verdict(monkeypatch):
+    """RATELIMITER_DEVICE_ROUTE=auto must A/B the host router against
+    the on-mesh pass once, serve the winner, and report the verdict to
+    the flight recorder; forcing either side must produce identical
+    decisions."""
+    import os
+
+    from ratelimiter_tpu.storage import tpu as tpu_mod
+
+    monkeypatch.delenv("RATELIMITER_DEVICE_ROUTE", raising=False)
+    rng = np.random.default_rng(29)
+    ids = rng.zipf(1.2, size=70_000).astype(np.int64) % 10_000
+    cfg = RateLimitConfig(max_permits=50, window_ms=60_000,
+                          refill_rate=10.0)
+
+    def run(route_env):
+        if route_env is None:
+            monkeypatch.delenv("RATELIMITER_DEVICE_ROUTE", raising=False)
+        else:
+            monkeypatch.setenv("RATELIMITER_DEVICE_ROUTE", route_env)
+        clock = FakeClock()
+        engine = ShardedDeviceEngine(slots_per_shard=4096,
+                                     table=LimiterTable())
+        st = TpuBatchedStorage(engine=engine, clock_ms=clock)
+        lid = st.register_limiter("tb", cfg)
+        out = st.acquire_stream_ids("tb", lid, ids, None)
+        mode = st._route_mode
+        events = [e for e in st._recorder.events()
+                  if e.get("kind") == "sharded.route_elect"]
+        st.close()
+        return out, mode, events
+
+    auto, auto_mode, auto_events = run(None)
+    assert auto_mode in ("host", "device")
+    assert auto_events, "election verdict missing from flight recorder"
+    assert auto_events[-1]["elected"] == auto_mode
+    assert auto_events[-1]["host_s"] > 0 and auto_events[-1]["device_s"] > 0
+
+    forced_host, host_mode, _ = run("off")
+    forced_dev, dev_mode, _ = run("on")
+    assert host_mode == "host" and dev_mode == "device"
+    np.testing.assert_array_equal(auto, forced_host)
+    np.testing.assert_array_equal(auto, forced_dev)
