@@ -19,6 +19,14 @@ standbys, a dead shard is promoted alone while the surviving shards
 keep serving behind a ``ShardFailoverRouter``, and health reports a
 DEGRADED-shard state instead of DOWN.
 
+Failover itself is autonomous (replication/orchestrator.py): the
+``FailoverOrchestrator`` watches per-shard liveness through an explicit
+state machine with flap damping (consecutive-failure + hysteresis),
+fences the replaced backend at a monotonic epoch (zombie dispatches
+refuse with ``FencedError``), drives the proven promotion path with
+bounded retry, and re-seeds a fresh standby so the system returns to
+N+1 — zero manual actuator calls (``ratelimiter.orchestrator.*``).
+
 Wiring (service/wiring.py) is config-gated and OFF by default:
 
     replication.enabled     = true
@@ -35,6 +43,10 @@ from ratelimiter_tpu.replication.log import (
     device_journal_elected,
     engine_state_fingerprint,
     make_journal,
+)
+from ratelimiter_tpu.replication.orchestrator import (
+    FailoverOrchestrator,
+    OrchestratorConfig,
 )
 from ratelimiter_tpu.replication.replicator import Replicator
 from ratelimiter_tpu.replication.sharded import (
@@ -63,7 +75,9 @@ from ratelimiter_tpu.replication.wire import (
 
 __all__ = [
     "DEFAULT_FRAME_BUDGET",
+    "FailoverOrchestrator",
     "FrameArchive",
+    "OrchestratorConfig",
     "InProcessSink",
     "ReplicationLog",
     "ReplicationServer",

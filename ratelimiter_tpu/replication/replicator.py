@@ -96,10 +96,46 @@ class Replicator:
                 "ratelimiter.replication.coalesced",
                 "Cuts skipped against a full in-flight queue; their "
                 "deltas coalesced in the journal (slow standby link)")
+            self._m_link = registry.gauge(
+                "ratelimiter.replication.link_up",
+                "1 while the standby link answers (sends/heartbeats "
+                "acked); 0 once it is marked DEAD")
+            self._m_link.set(1.0)
         else:
             self._m_lag = self._m_epoch = None
             self._m_frames = self._m_bytes = self._m_errors = None
             self._m_coalesced = None
+            self._m_link = None
+        self._link_last = None
+
+    # -- link liveness ---------------------------------------------------------
+    def link_state(self) -> str:
+        """The sink's view of the standby link (``unknown`` for sinks
+        that do not track one)."""
+        fn = getattr(self.sink, "link_state", None)
+        return fn() if fn is not None else "unknown"
+
+    def _observe_link(self) -> None:
+        """Record DEAD<->UP transitions: gauge + flight event.  A DEAD
+        link means the standby behind it is going STALE — the signal the
+        failover orchestrator uses to refuse promoting onto it."""
+        state = self.link_state()
+        if state == self._link_last or state == "unknown":
+            return
+        from ratelimiter_tpu.observability import flight_recorder
+
+        if state == "dead":
+            if self._m_link is not None:
+                self._m_link.set(0.0)
+            flight_recorder().record("replication.link_dead")
+            _log.warning("replication link marked DEAD (standby gone, "
+                         "not merely slow); its replica is going stale")
+        elif state == "up":
+            if self._m_link is not None:
+                self._m_link.set(1.0)
+            if self._link_last == "dead":
+                flight_recorder().record("replication.link_restored")
+        self._link_last = state
 
     # -- one synchronous ship cycle (tests drive this deterministically) ------
     def ship_now(self) -> int:
@@ -146,7 +182,9 @@ class Replicator:
                 self._m_errors.increment()
             self.log.remark(frames[shipped:])
             self.log.request_full()
+            self._observe_link()
             raise
+        self._observe_link()
         return shipped
 
     def _drain_queue_locked(self) -> int:
@@ -207,6 +245,13 @@ class Replicator:
             if self._m_lag is not None:
                 self._m_lag.set(self.log.last_cut_lag_ms)
             if not frames:
+                # Idle cycle: heartbeat the link so a standby that died
+                # SILENTLY (partition, power cut — no RST) is detected
+                # even with no deltas flowing.
+                hb = getattr(self.sink, "heartbeat", None)
+                if hb is not None:
+                    hb()
+                self._observe_link()
                 return
             if self._m_epoch is not None:
                 self._m_epoch.set(self.log.epoch)

@@ -204,6 +204,11 @@ class ShardedReplicator:
         self.errors = 0
         self.shard_errors = [0] * log.n_shards
         self._shard_last_error: List[Optional[str]] = [None] * log.n_shards
+        # Shards handed off to a promoted replacement: their standby is
+        # now SERVING — shipping more frames into it would corrupt it,
+        # so the orchestrator drops the shard from the stream.
+        self._dropped: set = set()
+        self._shard_link_last: List[Optional[str]] = [None] * log.n_shards
         if registry is not None:
             self._m_lag = registry.gauge(
                 "ratelimiter.replication.lag_ms",
@@ -219,9 +224,14 @@ class ShardedReplicator:
                 "ratelimiter.replication.errors",
                 "Replication ship failures (frames re-marked, next "
                 "frame full)")
+            self._m_links_dead = registry.gauge(
+                "ratelimiter.replication.links_dead",
+                "Standby-mesh links currently marked DEAD (standby "
+                "gone, its replica going stale)")
         else:
             self._m_lag = self._m_frames = None
             self._m_bytes = self._m_errors = None
+            self._m_links_dead = None
 
     def ship_now(self) -> int:
         """One synchronous cycle over every shard; returns frames
@@ -230,10 +240,46 @@ class ShardedReplicator:
         shipped = 0
         with self._ship_lock:
             for q in range(self.log.n_shards):
+                if q in self._dropped:
+                    continue
                 shipped += self._ship_shard(q)
+                self._observe_link(q)
             if self._m_lag is not None:
                 self._m_lag.set(self.log.last_cut_lag_ms)
+            if self._m_links_dead is not None:
+                self._m_links_dead.set(float(sum(
+                    1 for s in self._shard_link_last if s == "dead")))
         return shipped
+
+    def drop_shard(self, q: int) -> None:
+        """Stop shipping one shard's stream (its standby was promoted
+        and is now SERVING — more frames would corrupt it).  The shard's
+        pending delta stays in the journal; it is simply never cut."""
+        with self._ship_lock:
+            self._dropped.add(int(q))
+
+    def dropped_shards(self) -> set:
+        with self._ship_lock:
+            return set(self._dropped)
+
+    def shard_link_state(self, q: int) -> str:
+        fn = getattr(self.sinks[int(q)], "link_state", None)
+        return fn() if fn is not None else "unknown"
+
+    def _observe_link(self, q: int) -> None:
+        state = self.shard_link_state(q)
+        if state == self._shard_link_last[q] or state == "unknown":
+            return
+        from ratelimiter_tpu.observability import flight_recorder
+
+        if state == "dead":
+            flight_recorder().record("replication.link_dead", shard=q)
+            _log.warning("shard %d standby link marked DEAD (standby "
+                         "gone, not merely slow); its replica is going "
+                         "stale", q)
+        elif state == "up" and self._shard_link_last[q] == "dead":
+            flight_recorder().record("replication.link_restored", shard=q)
+        self._shard_link_last[q] = state
 
     def _ship_shard(self, q: int) -> int:
         sink = self.sinks[q]
@@ -244,6 +290,11 @@ class ShardedReplicator:
             self.log.request_full(q)
         frames = self.log.cut_shard(q)
         if not frames:
+            # Idle cycle for this shard: heartbeat so a silently-dead
+            # standby is detected with no deltas flowing.
+            hb = getattr(sink, "heartbeat", None)
+            if hb is not None:
+                hb()
             return 0
         shipped = 0
         try:
@@ -308,7 +359,9 @@ class ShardedReplicator:
     def shard_status(self) -> Dict[int, Dict]:
         return {q: {"epoch": self.log.epochs[q],
                     "errors": self.shard_errors[q],
-                    "last_error": self._shard_last_error[q]}
+                    "last_error": self._shard_last_error[q],
+                    "link": self.shard_link_state(q),
+                    "dropped": q in self._dropped}
                 for q in range(self.log.n_shards)}
 
 
@@ -334,6 +387,14 @@ class ShardStandbySet:
     def promote(self, shard: int, force: bool = False):
         """Promote ONE shard's standby; returns its (flat) storage."""
         return self.receivers[int(shard)].promote(force=force)
+
+    def replace(self, shard: int, storage, receiver) -> None:
+        """Swap in a freshly re-seeded standby for one shard (the
+        orchestrator's RESTORED step: the old standby was promoted to
+        serving, this one returns the system to N+1)."""
+        q = int(shard)
+        self.storages[q] = storage
+        self.receivers[q] = receiver
 
     def close(self, except_shards: tuple = ()) -> None:
         for q, storage in enumerate(self.storages):
@@ -361,11 +422,23 @@ class ShardFailoverRouter:
         self.failed: set = set()
         self.unavailable_denies = 0
         self._lock = threading.Lock()
+        # Per-shard state bookkeeping for the health surface: when the
+        # current state was entered (wall ms for operators, monotonic
+        # for durations) — the DEGRADED-shard payload reports both.
+        now_w, now_m = _wall_ms(), time.monotonic()
+        self._state_since_wall = [now_w] * self.n_shards
+        self._state_since_mono = [now_m] * self.n_shards
+
+    def _mark_transition(self, shard: int) -> None:
+        """Caller holds the lock."""
+        self._state_since_wall[shard] = _wall_ms()
+        self._state_since_mono[shard] = time.monotonic()
 
     # -- failover control ------------------------------------------------------
     def fail_shard(self, shard: int) -> None:
         with self._lock:
             self.failed.add(int(shard))
+            self._mark_transition(int(shard))
         from ratelimiter_tpu.observability import flight_recorder
 
         flight_recorder().record("shard.failed", shard=int(shard))
@@ -375,6 +448,7 @@ class ShardFailoverRouter:
         with self._lock:
             self.replacements[int(shard)] = storage
             self.failed.discard(int(shard))
+            self._mark_transition(int(shard))
         from ratelimiter_tpu.observability import flight_recorder
 
         flight_recorder().record("shard.promoted", shard=int(shard))
@@ -386,6 +460,25 @@ class ShardFailoverRouter:
                         else "active")
                     for q in range(self.n_shards)}
 
+    def shard_status(self) -> Dict[int, Dict]:
+        """Per-shard state WITH transition timestamps: the health
+        payload's DEGRADED-shard detail (operators and the orchestrator
+        drill assert promotion-window bounds from ``in_state_ms``)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for q in range(self.n_shards):
+                state = ("failed" if q in self.failed
+                         else "promoted" if q in self.replacements
+                         else "active")
+                out[q] = {
+                    "state": state,
+                    "since_ms": self._state_since_wall[q],
+                    "in_state_ms": round(
+                        (now - self._state_since_mono[q]) * 1000.0, 3),
+                }
+            return out
+
     def degraded_shards(self) -> List[int]:
         with self._lock:
             return sorted(self.failed | set(self.replacements))
@@ -396,6 +489,108 @@ class ShardFailoverRouter:
 
         return np.asarray([shard_of_key((int(l), k), self.n_shards)
                            for l, k in zip(lids, keys)], dtype=np.int64)
+
+    def __getattr__(self, name):
+        # Everything that is not a per-key decision surface (limiter
+        # registration, flush plumbing, the legacy host-side contract,
+        # engine/batcher attributes the health payload reads) passes
+        # through to the sharded primary.  Decision surfaces are routed
+        # explicitly below so a failed shard fails CLOSED.
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self.__dict__["primary"], name)
+
+    def acquire(self, algo, lid, key, permits, **kw):
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        q = int(shard_of_key((int(lid), key), self.n_shards))
+        backend = self._backend(q)
+        if backend is None:
+            with self._lock:
+                self.unavailable_denies += 1
+            # Fail-closed deny; cache_value is pinned at the ceiling so
+            # a local TTL cache can never convert this deny into allows.
+            return {"allowed": False, "observed": np.iinfo(np.int64).max,
+                    "remaining": 0, "cache_value": np.iinfo(np.int32).max}
+        return backend.acquire(algo, lid, key, permits, **kw)
+
+    def acquire_many_ids(self, algo, lid, key_ids, permits):
+        from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+        key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+        permits = np.asarray(permits)
+        shard = shard_of_int_keys(key_ids, self.n_shards)
+        with self._lock:
+            routed = bool(self.failed or self.replacements)
+        if not routed:
+            return self.primary.acquire_many_ids(algo, lid, key_ids,
+                                                 permits)
+        out: Dict[str, np.ndarray] = {}
+        n = len(key_ids)
+        for q in np.unique(shard):
+            idx = np.nonzero(shard == q)[0]
+            backend = self._backend(int(q))
+            if backend is None:
+                with self._lock:
+                    self.unavailable_denies += len(idx)
+                res = {"allowed": np.zeros(len(idx), dtype=bool)}
+            else:
+                res = backend.acquire_many_ids(algo, lid, key_ids[idx],
+                                               permits[idx])
+            for name, vals in res.items():
+                if name not in out:
+                    out[name] = np.zeros(n, dtype=np.asarray(vals).dtype)
+                out[name][idx] = vals
+        return out
+
+    def acquire_stream_strs(self, algo, lid, keys, permits=None, **kw):
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        with self._lock:
+            routed = bool(self.failed or self.replacements)
+        if not routed:
+            return self.primary.acquire_stream_strs(algo, lid, keys,
+                                                    permits=permits, **kw)
+        keys = list(keys)
+        shard = np.asarray([shard_of_key((int(lid), k), self.n_shards)
+                            for k in keys], dtype=np.int64)
+        out = np.zeros(len(keys), dtype=bool)
+        for q in np.unique(shard):
+            idx = np.nonzero(shard == q)[0]
+            backend = self._backend(int(q))
+            if backend is None:
+                with self._lock:
+                    self.unavailable_denies += len(idx)
+                continue  # denied: out already False
+            out[idx] = backend.acquire_stream_strs(
+                algo, lid, [keys[i] for i in idx],
+                permits=None if permits is None else permits[idx], **kw)
+        return out
+
+    def available_many(self, algo, lid, keys):
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        keys = list(keys)
+        out = np.zeros(len(keys), dtype=np.int64)
+        shard = np.asarray([shard_of_key((int(lid), k), self.n_shards)
+                            for k in keys], dtype=np.int64)
+        for q in np.unique(shard):
+            idx = np.nonzero(shard == q)[0]
+            backend = self._backend(int(q))
+            if backend is None:
+                out[idx] = 0  # failed shard: report no availability
+                continue
+            out[idx] = backend.available_many(algo, lid,
+                                              [keys[i] for i in idx])
+        return out
+
+    def reset_key(self, algo, lid, key) -> None:
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        q = int(shard_of_key((int(lid), key), self.n_shards))
+        backend = self._backend(q)
+        if backend is not None:
+            backend.reset_key(algo, lid, key)
 
     def _backend(self, q: int):
         with self._lock:

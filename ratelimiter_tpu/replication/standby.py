@@ -57,8 +57,18 @@ class StandbyReceiver:
         self.promoted = False
         self._index_dump: Optional[Dict] = None
         self._lock = threading.Lock()
+        # Promotion race guard: exactly ONE promote() wins; a concurrent
+        # caller (orchestrator vs manual actuator POST) gets the typed
+        # retryable refusal instead of double-rebuilding the index.
+        self._promote_guard = threading.Lock()
+        self._promote_inflight = False
         self._frames_applied = 0
         self.reordered = 0
+        # Frames arriving AFTER promotion are refused outright: the
+        # shadow is now the SERVING primary, and a zombie old-primary
+        # still shipping deltas would silently overwrite live decisions
+        # (the replication-side twin of the dispatch fence).
+        self.refused_after_promote = 0
         if registry is not None:
             self._applied_epoch = registry.gauge(
                 "ratelimiter.replication.applied_epoch",
@@ -84,6 +94,17 @@ class StandbyReceiver:
 
     def apply(self, frame: Dict) -> None:
         with self._lock:
+            if self.promoted:
+                self.refused_after_promote += 1
+                from ratelimiter_tpu.observability import flight_recorder
+
+                flight_recorder().record(
+                    "replication.frame_after_promote", coalesce_ms=1000.0,
+                    epoch=int(frame.get("epoch", -1)))
+                raise ReplicationStateError(
+                    "this standby was promoted and is serving; a frame "
+                    "arriving now is a zombie primary still shipping — "
+                    "refused (fence the old primary)")
             if frame["num_slots"] != self.storage.engine.num_slots:
                 raise ValueError(
                     f"frame geometry {frame['num_slots']} != standby "
@@ -155,26 +176,52 @@ class StandbyReceiver:
 
     # -- failover -------------------------------------------------------------
     def promote(self, force: bool = False):
-        """Promote the shadow to serving primary; returns its storage."""
-        with self._lock:
-            if not self.consistent and not force:
-                raise ReplicationStateError(
-                    "replica stream is gapped/unbootstrapped; wait for a "
-                    "full frame or promote(force=True) to accept data "
-                    "loss beyond the last consistent epoch")
-            if self._index_dump is None and not force:
-                raise ReplicationStateError(
-                    "no index journal replicated yet; nothing to promote")
-            if self._index_dump is not None:
-                self.storage.promote_from_replica(self._index_dump)
-            self.promoted = True
-            if self._failovers is not None:
-                self._failovers.increment()
-            from ratelimiter_tpu.observability import flight_recorder
+        """Promote the shadow to serving primary; returns its storage.
 
-            flight_recorder().record("replication.promote",
-                                     epoch=self.last_epoch, forced=force)
-            return self.storage
+        Exactly one caller wins: a promote racing an in-flight promote
+        (auto-orchestrator vs manual actuator POST) gets the typed
+        retryable ``PromotionInProgressError``; a promote arriving after
+        one already completed gets ``ReplicationStateError`` (the storage
+        is already serving — promoting twice would rebuild a live index
+        under traffic).
+        """
+        from ratelimiter_tpu.storage.errors import PromotionInProgressError
+
+        with self._promote_guard:
+            if self._promote_inflight:
+                raise PromotionInProgressError(
+                    "another promotion of this standby is in flight; "
+                    "exactly one wins")
+            if self.promoted:
+                raise ReplicationStateError(
+                    "this standby is already promoted and serving")
+            self._promote_inflight = True
+        try:
+            with self._lock:
+                if not self.consistent and not force:
+                    raise ReplicationStateError(
+                        "replica stream is gapped/unbootstrapped; wait "
+                        "for a full frame or promote(force=True) to "
+                        "accept data loss beyond the last consistent "
+                        "epoch")
+                if self._index_dump is None and not force:
+                    raise ReplicationStateError(
+                        "no index journal replicated yet; nothing to "
+                        "promote")
+                if self._index_dump is not None:
+                    self.storage.promote_from_replica(self._index_dump)
+                self.promoted = True
+                if self._failovers is not None:
+                    self._failovers.increment()
+                from ratelimiter_tpu.observability import flight_recorder
+
+                flight_recorder().record("replication.promote",
+                                         epoch=self.last_epoch,
+                                         forced=force)
+                return self.storage
+        finally:
+            with self._promote_guard:
+                self._promote_inflight = False
 
     @property
     def frames_applied(self) -> int:

@@ -27,6 +27,16 @@ ACK_ERROR = 1
 
 _LEN = struct.Struct("<I")
 
+# Link states a sink reports (``link_state()``): UNKNOWN before first
+# contact, UP after a successful send/heartbeat ack, DEAD after
+# ``dead_after`` consecutive ack failures.  The distinction the
+# orchestrator needs: a DEAD link means the standby behind it is STALE
+# ("standby gone"), not merely behind ("standby slow") — promoting onto
+# it loses every epoch since the link died.
+LINK_UNKNOWN = "unknown"
+LINK_UP = "up"
+LINK_DEAD = "dead"
+
 
 class InProcessSink:
     """Feeds a StandbyReceiver in the same process (tests, drills)."""
@@ -36,6 +46,12 @@ class InProcessSink:
 
     def send(self, data: bytes) -> None:
         self.receiver.apply_bytes(data)
+
+    def heartbeat(self) -> bool:
+        return True
+
+    def link_state(self) -> str:
+        return LINK_UP
 
     def close(self) -> None:
         pass
@@ -97,29 +113,79 @@ class SocketSink:
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  max_retries: int = 4, backoff_ms: float = 50.0,
-                 backoff_cap_ms: float = 2000.0, seed: int = 0):
+                 backoff_cap_ms: float = 2000.0, seed: int = 0,
+                 ack_timeout: float = 5.0, dead_after: int = 2):
         self.host = host
         self.port = int(port)
         self.timeout = float(timeout)
         self.max_retries = int(max_retries)
         self.backoff_ms = float(backoff_ms)
         self.backoff_cap_ms = float(backoff_cap_ms)
+        # Ack deadline: a standby that accepted the TCP bytes but never
+        # acks (process wedged, half-open connection after a silent
+        # death) must fail the send within ``ack_timeout`` seconds — the
+        # old behavior waited the full connect timeout per attempt, so a
+        # silently-dead standby just grew the byte-bounded queue until
+        # coalescing with nothing marking the link as gone.
+        self.ack_timeout = float(ack_timeout)
+        # Consecutive fully-failed sends/heartbeats before the link
+        # reports DEAD (one blip must not flap the gauge).
+        self.dead_after = max(int(dead_after), 1)
         self.reconnects = 0
         self._rng = random.Random(seed)
         self._sock: socket.socket | None = None
         self._ever_connected = False
         self._reconnected = False
+        self._consec_failures = 0
+        self._link = LINK_UNKNOWN
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Post-connect ops (sendall + ack recv) run under the tighter
+        # ack deadline, not the connect timeout.
+        sock.settimeout(self.ack_timeout)
         if self._ever_connected:
             self._reconnected = True
             self.reconnects += 1
         self._ever_connected = True
         return sock
+
+    # -- link liveness --------------------------------------------------------
+    def _note_outcome(self, ok: bool) -> None:
+        """Caller holds the lock."""
+        if ok:
+            self._consec_failures = 0
+            self._link = LINK_UP
+        else:
+            self._consec_failures += 1
+            if self._consec_failures >= self.dead_after:
+                self._link = LINK_DEAD
+
+    def link_state(self) -> str:
+        with self._lock:
+            return self._link
+
+    def heartbeat(self) -> bool:
+        """One zero-length liveness frame; the standby acks it without
+        applying anything.  Bounded by ``ack_timeout``.  The replicator
+        sends one on every idle cycle so a standby that dies SILENTLY
+        mid-stream (no RST — a network partition, a hard power cut) is
+        detected even when no deltas are flowing."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._sock.sendall(_LEN.pack(0))
+                ack = self._recv_exact(1)
+                ok = ack[0] == ACK_OK
+            except OSError:
+                self._drop()
+                ok = False
+            self._note_outcome(ok)
+            return ok
 
     def consume_reconnected(self) -> bool:
         """True once per reconnect since the last call — the replicator
@@ -156,9 +222,12 @@ class SocketSink:
                     # bytes cannot help.  Let the replicator's failure
                     # path re-mark and re-baseline.
                     self._drop()
+                    self._note_outcome(True)  # it answered: link is alive
                     raise ConnectionError(
                         f"standby rejected replication frame (ack={ack[0]})")
+                self._note_outcome(True)
                 return
+            self._note_outcome(False)
             raise ConnectionError(
                 f"replication link to {self.host}:{self.port} down after "
                 f"{self.max_retries + 1} attempts") from last_exc
@@ -212,6 +281,10 @@ class ReplicationServer:
                             break
                         frame = buf[_LEN.size:_LEN.size + length]
                         buf = buf[_LEN.size + length:]
+                        if length == 0:
+                            # Heartbeat: liveness ack, nothing to apply.
+                            out += bytes([ACK_OK])
+                            continue
                         try:
                             outer.receiver.apply_bytes(frame)
                             out += bytes([ACK_OK])

@@ -50,18 +50,22 @@ def _now_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
-def _find_shard_health(storage):
+def _find_surface(storage, name: str):
     """Walk the storage wrapper chain (retry -> breaker -> chaos -> ...)
-    for a ``shard_health()`` surface (replication/sharded.py's failover
-    router)."""
+    for a named callable surface (e.g. the failover router's
+    ``shard_health`` / ``shard_status``)."""
     seen = set()
     while storage is not None and id(storage) not in seen:
         seen.add(id(storage))
-        fn = getattr(storage, "shard_health", None)
+        fn = getattr(storage, name, None)
         if callable(fn):
             return fn
         storage = getattr(storage, "_inner", None)
     return None
+
+
+def _find_shard_health(storage):
+    return _find_surface(storage, "shard_health")
 
 
 def health_payload(ctx: AppContext) -> dict:
@@ -112,6 +116,23 @@ def health_payload(ctx: AppContext) -> dict:
         shards = shard_health_fn()
         payload["shards"] = {str(q): v for q, v in shards.items()}
         degraded_shards = [q for q, v in shards.items() if v != "active"]
+        status_fn = _find_surface(ctx.storage, "shard_status")
+        if status_fn is not None:
+            # DEGRADED-shard detail: time-in-state + last-transition
+            # timestamp per shard, so operators (and the orchestrator
+            # drill) can assert promotion-window bounds from the health
+            # payload alone.
+            payload["shards_detail"] = {
+                str(q): v for q, v in status_fn().items()}
+    orch = getattr(ctx, "orchestrator", None)
+    if orch is not None:
+        st = orch.orchestrator.status()
+        payload["orchestrator"] = {
+            "fence_epoch": st["fence_epoch"],
+            "promotions": st["promotions"],
+            "false_alarms": st["false_alarms"],
+            "states": {q: s["state"] for q, s in st["shards"].items()},
+        }
     shedding = False
     window_s = ctx.props.get_float(
         "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
@@ -268,6 +289,11 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             if repl is None:
                 return self._json(200, {"enabled": False})
             return self._json(200, {"enabled": True, **repl.status()})
+        if self.path == "/actuator/orchestrator":
+            orch = getattr(self.ctx, "orchestrator", None)
+            if orch is None:
+                return self._json(200, {"enabled": False})
+            return self._json(200, orch.status())
         if self.path.startswith("/actuator/trace"):
             trace = getattr(self.ctx.storage, "trace", None)
             if trace is None:

@@ -118,8 +118,30 @@ DEFAULTS = {
     "replication.enabled": "false",
     "replication.role": "primary",
     "replication.target": "",
+    "replication.targets": "",
     "replication.listen_port": "7401",
     "replication.interval_ms": "200",
+    # Standby-link ack deadline (replication/transport.py): a send or
+    # heartbeat unacked within this window fails fast, and enough
+    # consecutive failures mark the link DEAD (standby gone, replica
+    # going stale) instead of silently growing the coalescing queue.
+    "replication.ack_timeout_ms": "5000",
+    # Self-healing failover orchestrator (replication/orchestrator.py):
+    # OFF by default.  When enabled on a SHARDED primary it builds an
+    # in-process standby mesh (one flat standby per shard), replicates
+    # per shard, routes through a ShardFailoverRouter, and watches
+    # per-shard liveness through the MONITORING -> SUSPECT (consecutive
+    # failures + hysteresis) -> FENCING (monotonic fence epoch; zombie
+    # dispatches refused with FencedError) -> PROMOTING (bounded
+    # retry/backoff) -> RESTORED (fresh standby re-seeded, back to N+1)
+    # state machine — zero manual actuator calls.
+    "ratelimiter.orchestrator.enabled": "false",
+    "ratelimiter.orchestrator.probe_interval_ms": "100",
+    "ratelimiter.orchestrator.suspect_threshold": "3",
+    "ratelimiter.orchestrator.hysteresis_ms": "500",
+    "ratelimiter.orchestrator.promote_retries": "3",
+    "ratelimiter.orchestrator.promote_backoff_ms": "50",
+    "ratelimiter.orchestrator.reseed": "true",
 }
 
 # Typed keys: anything listed here is parse-checked at construction.
@@ -135,6 +157,8 @@ _INT_KEYS = (
     "ratelimiter.sidecar.max_connections",
     "ratelimiter.obs.trace_sample",
     "ratelimiter.obs.flight_capacity",
+    "ratelimiter.orchestrator.suspect_threshold",
+    "ratelimiter.orchestrator.promote_retries",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -146,11 +170,16 @@ _FLOAT_KEYS = (
     "ratelimiter.sidecar.resolve_timeout_ms",
     "ratelimiter.sidecar.drain_timeout_ms",
     "ratelimiter.obs.slo_ms",
+    "replication.ack_timeout_ms",
+    "ratelimiter.orchestrator.probe_interval_ms",
+    "ratelimiter.orchestrator.hysteresis_ms",
+    "ratelimiter.orchestrator.promote_backoff_ms",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
     "link.probe.enabled", "breaker.enabled", "ratelimiter.degraded.enabled",
-    "ratelimiter.sidecar.enabled",
+    "ratelimiter.sidecar.enabled", "ratelimiter.orchestrator.enabled",
+    "ratelimiter.orchestrator.reseed",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

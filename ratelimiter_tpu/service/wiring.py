@@ -72,6 +72,37 @@ class ReplicationHandle:
 
 
 @dataclasses.dataclass
+class OrchestratorHandle:
+    """Self-healing failover wiring (ratelimiter.orchestrator.*): the
+    orchestrator, the router the app serves through, the per-shard
+    replicator feeding the in-process standby mesh."""
+
+    orchestrator: object
+    router: object
+    replicator: object
+    standby_set: object
+
+    def status(self) -> Dict:
+        out = {"enabled": True, **self.orchestrator.status()}
+        out["router"] = {str(q): v
+                         for q, v in self.router.shard_status().items()}
+        out["replication"] = {str(q): v for q, v in
+                              self.replicator.shard_status().items()}
+        return out
+
+    def close(self) -> None:
+        self.orchestrator.close()
+        self.replicator.close()
+        # A standby whose receiver was PROMOTED is now the serving
+        # replacement (closed with the router's chain); re-seeded fresh
+        # standbys are ours to close.
+        promoted = tuple(
+            q for q, rx in enumerate(self.standby_set.receivers)
+            if getattr(rx, "promoted", False))
+        self.standby_set.close(except_shards=promoted)
+
+
+@dataclasses.dataclass
 class AppContext:
     props: AppProperties
     storage: RateLimitStorage
@@ -88,12 +119,17 @@ class AppContext:
     # The flight recorder behind GET /actuator/flightrecorder (the
     # process-global instance unless a test injected one).
     recorder: object = None
+    # Self-healing failover (ratelimiter.orchestrator.enabled) — the
+    # autonomous fence/promote/re-seed loop over a sharded primary.
+    orchestrator: OrchestratorHandle | None = None
 
     def close(self) -> None:
         if self.sidecar is not None:
             self.sidecar.stop()
         if self.replication is not None:
             self.replication.close()
+        if self.orchestrator is not None:
+            self.orchestrator.close()
         self.storage.close()
 
 
@@ -308,10 +344,13 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
                     "entry per shard (%d given, %d shards); replication "
                     "disabled", len(parts), engine.n_shards)
                 return None
+            ack_s = props.get_float("replication.ack_timeout_ms",
+                                    5000.0) / 1000.0
             sinks = {}
             for q, part in enumerate(parts):
                 host, _, port = part.rpartition(":")
-                sinks[q] = SocketSink(host or "127.0.0.1", int(port))
+                sinks[q] = SocketSink(host or "127.0.0.1", int(port),
+                                      ack_timeout=ack_s)
             repl = ShardedReplicator(
                 ShardedReplicationLog(storage), sinks,
                 interval_ms=props.get_float("replication.interval_ms",
@@ -327,7 +366,9 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
         host, _, port = target.rpartition(":")
         repl = Replicator(
             ReplicationLog(storage),
-            SocketSink(host or "127.0.0.1", int(port)),
+            SocketSink(host or "127.0.0.1", int(port),
+                       ack_timeout=props.get_float(
+                           "replication.ack_timeout_ms", 5000.0) / 1000.0),
             interval_ms=props.get_float("replication.interval_ms", 200.0),
             registry=registry,
         ).start()
@@ -340,6 +381,77 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
         return ReplicationHandle(role="standby", receiver=receiver,
                                  server=server)
     raise ValueError(f"unknown replication.role: {role!r}")
+
+
+def _maybe_orchestrator(storage: RateLimitStorage, props: AppProperties,
+                        registry: MeterRegistry):
+    """Config-gated self-healing failover (OFF by default).
+
+    Requires a SHARDED device engine.  Builds the single-host N+1
+    topology: an in-process standby mesh (one flat standby per shard),
+    per-shard replication streams, a ``ShardFailoverRouter`` the app
+    serves through, and the ``FailoverOrchestrator`` watching it all —
+    a dead shard is fenced, its standby promoted, its keys re-routed,
+    and a fresh standby re-seeded with zero operator involvement.
+
+    Returns ``(handle_or_None, serving_storage)`` — when enabled, the
+    ROUTER becomes the storage the breaker/retry wrappers compose
+    around.
+    """
+    if not props.get_bool("ratelimiter.orchestrator.enabled", False):
+        return None, storage
+    import logging
+
+    logger = logging.getLogger("ratelimiter")
+    engine = getattr(storage, "engine", None)
+    if not hasattr(engine, "n_shards"):
+        logger.warning(
+            "ratelimiter.orchestrator.enabled but the %s backend has no "
+            "sharded engine (orchestrated failover promotes one shard of "
+            "N); orchestrator disabled", type(storage).__name__)
+        return None, storage
+    from ratelimiter_tpu.replication import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+
+    sps = int(engine.slots_per_shard)
+
+    def standby_factory():
+        return TpuBatchedStorage(num_slots=sps)
+
+    mesh_set = ShardStandbySet(int(engine.n_shards), standby_factory,
+                               registry=registry)
+    repl = ShardedReplicator(
+        ShardedReplicationLog(storage), mesh_set.in_process_sinks(),
+        interval_ms=props.get_float("replication.interval_ms", 200.0),
+        registry=registry,
+    ).start()
+    router = ShardFailoverRouter(storage)
+    orch = FailoverOrchestrator(
+        router, mesh_set, repl, standby_factory=standby_factory,
+        config=OrchestratorConfig(
+            probe_interval_ms=props.get_float(
+                "ratelimiter.orchestrator.probe_interval_ms", 100.0),
+            suspect_threshold=props.get_int(
+                "ratelimiter.orchestrator.suspect_threshold", 3),
+            hysteresis_ms=props.get_float(
+                "ratelimiter.orchestrator.hysteresis_ms", 500.0),
+            promote_retries=props.get_int(
+                "ratelimiter.orchestrator.promote_retries", 3),
+            promote_backoff_ms=props.get_float(
+                "ratelimiter.orchestrator.promote_backoff_ms", 50.0),
+            reseed=props.get_bool("ratelimiter.orchestrator.reseed", True),
+        ),
+        registry=registry,
+    ).start()
+    handle = OrchestratorHandle(orchestrator=orch, router=router,
+                                replicator=repl, standby_set=mesh_set)
+    return handle, router
 
 
 def build_app(props: AppProperties | None = None,
@@ -366,10 +478,26 @@ def build_app(props: AppProperties | None = None,
     replication = None
     breaker = None
     sidecar = None
+    orchestrator = None
     if own_storage:
-        # Replication attaches to the RAW TPU storage (the journal hooks
-        # the engine), before the chaos/retry wrappers compose around it.
-        replication = _maybe_replication(storage, props, registry)
+        # Self-healing failover (the orchestrator owns its OWN per-shard
+        # replication into an in-process standby mesh, so it supersedes
+        # the replication.* wiring — both would fight over the journal).
+        orchestrator, serving = _maybe_orchestrator(storage, props,
+                                                    registry)
+        if orchestrator is not None and props.get_bool(
+                "replication.enabled", False):
+            import logging
+
+            logging.getLogger("ratelimiter").warning(
+                "ratelimiter.orchestrator.enabled supersedes "
+                "replication.* wiring (the orchestrator runs its own "
+                "per-shard streams); replication.* ignored")
+        elif orchestrator is None:
+            # Replication attaches to the RAW TPU storage (the journal
+            # hooks the engine), before the chaos/retry wrappers compose
+            # around it.
+            replication = _maybe_replication(storage, props, registry)
         sidecar = _maybe_sidecar(storage, props, registry)
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
@@ -399,6 +527,10 @@ def build_app(props: AppProperties | None = None,
                     logging.getLogger("ratelimiter").warning(
                         "boot link probe failed (%s): streaming loops run "
                         "on profile-less defaults", exc)
+        # The router (when the orchestrator is on) becomes the storage
+        # the breaker/retry wrappers compose around — warmup and the
+        # link probe above ran against the raw device storage.
+        storage = serving
         wrapped, breaker = _maybe_breaker(_maybe_chaos(storage, props),
                                           props, registry)
         storage = _maybe_retry(wrapped, props)
@@ -447,4 +579,5 @@ def build_app(props: AppProperties | None = None,
         breaker=breaker,
         sidecar=sidecar,
         recorder=recorder,
+        orchestrator=orchestrator,
     )

@@ -133,12 +133,22 @@ class FaultInjectingProxy:
       seeded-random bytes into the stream (framing corruption), then keep
       forwarding,
     - ``"kill"``      — abruptly close both sides after ``after``
-      forwarded bytes (a client dying mid-pipeline).
+      forwarded bytes (a client dying mid-pipeline),
+    - ``"partition"`` — drop bytes in BOTH directions without closing
+      either socket (no RST, no FIN): the network-partition shape — the
+      peer looks silently gone, exactly what an ack deadline/heartbeat
+      must detect (``partition()`` / ``heal()`` are shorthands),
+    - ``"flap"``      — alternate partitioned and healthy every half
+      ``period_s`` (a flaky link that heals before any single probe
+      window closes — what the orchestrator's hysteresis must damp).
 
-    The fault mode is snapshotted per connection at accept time, so a
-    drill can flip modes between waves without racing live pumps.
-    Server->client bytes always pass through untouched — the proxy
-    attacks the ingress, not the client.
+    The original fault modes are snapshotted per connection at accept
+    time, so a drill can flip modes between waves without racing live
+    pumps.  ``partition``/``flap`` are evaluated LIVE per chunk instead:
+    a long-lived connection (a replication link) must be cuttable and
+    healable mid-stream without reconnecting.  Server->client bytes
+    pass through untouched except under partition/flap — those attack
+    the LINK, not just the ingress.
     """
 
     def __init__(self, target_port: int, target_host: str = "127.0.0.1",
@@ -149,6 +159,7 @@ class FaultInjectingProxy:
         self.target = (target_host, int(target_port))
         self._rng = random.Random(seed)
         self._fault: tuple = (None, {})
+        self._flap_t0 = time.monotonic()
         self._lock = threading.Lock()
         self.connections = 0
         self.faults_injected = 0
@@ -196,15 +207,47 @@ class FaultInjectingProxy:
 
     # -- control surface ------------------------------------------------------
     def set_fault(self, mode: str | None, **params) -> None:
-        """Set the fault class applied to NEW connections.
+        """Set the fault class applied to NEW connections (and, for
+        ``partition``/``flap``, to LIVE ones).
 
         ``after``: client bytes forwarded before the fault engages
         (default 0); ``n``: garbage byte count; ``delay_ms``: per-byte
-        delay for ``"delay"``."""
-        if mode not in (None, "truncate", "delay", "garbage", "kill"):
+        delay for ``"delay"``; ``period_s``: full flap cycle for
+        ``"flap"`` (half up, half partitioned)."""
+        if mode not in (None, "truncate", "delay", "garbage", "kill",
+                        "partition", "flap"):
             raise ValueError(f"unknown fault mode: {mode!r}")
         with self._lock:
             self._fault = (mode, dict(params))
+            if mode == "flap":
+                self._flap_t0 = time.monotonic()
+
+    def partition(self) -> None:
+        """Drop both directions on every connection, live — no RST, no
+        FIN: the silent network partition.  ``heal()`` restores."""
+        self.set_fault("partition")
+
+    def flap(self, period_s: float) -> None:
+        """Alternate healthy/partitioned every ``period_s / 2``, live."""
+        self.set_fault("flap", period_s=float(period_s))
+
+    def heal(self) -> None:
+        """Back to transparent passthrough (ends a partition/flap)."""
+        self.set_fault(None)
+
+    def _link_cut(self) -> bool:
+        """Live verdict: are bytes currently being dropped?  (Only the
+        partition/flap modes — the snapshotted ingress faults keep their
+        per-connection semantics.)"""
+        with self._lock:
+            mode, params = self._fault
+            if mode == "partition":
+                return True
+            if mode == "flap":
+                period = float(params.get("period_s", 0.2))
+                phase = (time.monotonic() - self._flap_t0) % period
+                return phase >= period / 2.0
+            return False
 
     def start(self) -> "FaultInjectingProxy":
         self._thread.start()
@@ -216,7 +259,8 @@ class FaultInjectingProxy:
 
     # -- pumps ----------------------------------------------------------------
     def _pump_down(self, up, client) -> None:
-        """Server->client passthrough until either side dies."""
+        """Server->client passthrough until either side dies (bytes are
+        silently dropped while a live partition/flap cut is on)."""
         while True:
             try:
                 chunk = up.recv(65536)
@@ -228,6 +272,10 @@ class FaultInjectingProxy:
                 except OSError:
                     pass
                 return
+            if self._link_cut():
+                with self._lock:
+                    self.faults_injected += 1
+                continue  # dropped: no RST, no FIN — silence
             try:
                 client.sendall(chunk)
             except OSError:
@@ -246,6 +294,10 @@ class FaultInjectingProxy:
                 return
             if not chunk:
                 return
+            if self._link_cut():
+                with self._lock:
+                    self.faults_injected += 1
+                continue  # partition/flap: dropped — silence, no close
             if mode == "kill" and forwarded + len(chunk) >= after:
                 cut = max(after - forwarded, 0)
                 try:
@@ -949,6 +1001,566 @@ def shard_failover_drill(
         raise AssertionError(
             f"shard failover drill diverged from the oracle: {report}")
     return report
+
+
+# ---------------------------------------------------------------------------
+# Orchestrated failover drill (ZERO manual promotion calls)
+# ---------------------------------------------------------------------------
+
+def orchestrated_failover_drill(
+    n_shards: int = 4,
+    slots_per_shard: int = 256,
+    n_keys: int = 64,
+    waves: int = 3,
+    stream_n: int = 768,
+    batch: int = 24,
+    kill_shard: int | None = None,
+    seed: int = 0,
+    registry=None,
+    probe_interval_ms: float = 50.0,
+    suspect_threshold: int = 3,
+    hysteresis_ms: float = 200.0,
+    cycles: int = 1,
+) -> dict:
+    """Self-healing one-shard-of-N failover with ZERO manual actuator
+    calls — the orchestrator (replication/orchestrator.py) must detect
+    the kill, fence, promote, route, and re-seed on its own.
+
+    Topology is the ``shard_failover_drill`` one (sharded primary under
+    a controlled clock, in-process standby mesh, per-shard epoch
+    streams) plus a ``FailoverOrchestrator`` driven by deterministic
+    ``tick()`` calls against a SIMULATED monotonic clock — every probe,
+    hysteresis window, and transition lands at an exact simulated
+    millisecond, so the timeline assertions are exact.  Proves:
+
+    - **detection is bounded**: kill -> FENCING within the configured
+      probe budget (``suspect_threshold`` probes + hysteresis + one
+      interval of phase slack), measured in simulated time;
+    - **survivors serve during detection**: full survivor-shard waves
+      run between probe ticks, bit-identical to the oracle;
+    - **the zombie is fenced**: after FENCING, dispatching the victim
+      shard's keys DIRECTLY at the primary (router bypassed — the
+      zombie shape) raises the typed ``FencedError`` and is counted;
+      survivor keys dispatched directly still serve;
+    - **promotion is exact**: post-promotion mixed traffic through the
+      router is bit-identical to the oracle (victim keys on the
+      promoted flat storage, survivors on the primary);
+    - **the system returns to N+1**: the orchestrator re-seeds a FRESH
+      standby for the promoted replica via a FULL frame; the drill
+      asserts it is consistent, unpromoted, and byte-converged with
+      the promoted storage;
+    - **the flight recorder reads back in order**: MONITORING ->
+      SUSPECT -> FENCING -> PROMOTING -> RESTORED -> MONITORING for the
+      victim shard, with ``shard.failed`` before
+      ``replication.promote`` before ``shard.promoted``.
+
+    ``cycles > 1`` repeats kill -> promote -> re-seed against the shard
+    that is now serving from a promoted flat replacement (the soak's
+    kill-again path: the re-seeded standby is promoted next, proving
+    re-seeding actually restores failover capacity).
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import copy
+    import random
+
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys, shard_of_key
+    from ratelimiter_tpu.replication import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.errors import FencedError
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    from ratelimiter_tpu.observability import flight_recorder
+
+    frec = flight_recorder()
+    fmark = frec.mark()
+    rng = random.Random(seed)
+    nrng = np.random.default_rng(seed)
+    clock = {"t": 1_753_000_000_000}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    primary = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    router = ShardFailoverRouter(primary)
+    cfg_tb = RateLimitConfig(max_permits=25, window_ms=2000,
+                             refill_rate=8.0)
+    cfg_sw = RateLimitConfig(max_permits=15, window_ms=2000,
+                             enable_local_cache=False)
+    lid_tb = primary.register_limiter("tb", cfg_tb)
+    lid_sw = primary.register_limiter("sw", cfg_sw)
+
+    def standby_factory():
+        return TpuBatchedStorage(num_slots=slots_per_shard,
+                                 clock_ms=lambda: clock["t"])
+
+    mesh_set = ShardStandbySet(n_shards, standby_factory, registry=registry)
+    log = ShardedReplicationLog(primary)
+    repl = ShardedReplicator(log, mesh_set.in_process_sinks(),
+                             registry=registry)
+
+    # Simulated monotonic clock: one probe interval per tick — the
+    # orchestrator's hysteresis math runs on EXACT simulated time.
+    sim = {"s": 0.0}
+    dead = {"flag": False, "at_promotions": 0}
+    probe_victim = [None]
+    cfg = OrchestratorConfig(probe_interval_ms=probe_interval_ms,
+                             suspect_threshold=suspect_threshold,
+                             hysteresis_ms=hysteresis_ms,
+                             promote_backoff_ms=1.0)
+
+    def probe(q):
+        # The victim's serving backend is "dead" from the kill until
+        # THIS cycle's replacement is installed (a prior cycle's
+        # replacement does not clear a fresh kill); everything else
+        # answers.
+        if dead["flag"] and q == probe_victim[0] \
+                and orch.promotions == dead["at_promotions"]:
+            return False
+        return True
+    orch = FailoverOrchestrator(
+        router, mesh_set, repl, standby_factory=standby_factory,
+        config=cfg, probe=probe, registry=registry,
+        clock=lambda: sim["s"], sleep=lambda s: None)
+
+    def tick(n=1):
+        for _ in range(n):
+            sim["s"] += cfg.probe_interval_ms / 1000.0
+            orch.tick()
+
+    oracle_tb = TokenBucketOracle(cfg_tb)
+    oracle_sw = SlidingWindowOracle(cfg_sw)
+    report = {"decisions": 0, "mismatches": 0, "frames": 0,
+              "false_alarms": 0, "cycles": [], "manual_promotions": 0}
+
+    key_shard = shard_of_int_keys(np.arange(n_keys, dtype=np.int64),
+                                  n_shards)
+    sw_keys = [f"u{i}" for i in range(n_keys)]
+    sw_shard = np.asarray([shard_of_key((lid_sw, k), n_shards)
+                           for k in sw_keys])
+
+    def zipf_keys(n):
+        return (nrng.zipf(1.3, size=n) - 1) % n_keys
+
+    def tb_wave(backend, keys):
+        clock["t"] += rng.choice([1, 7, 250, 999, 2000, 2001])
+        now = clock["t"]
+        out = backend.acquire_stream_ids("tb", lid_tb,
+                                         np.asarray(keys, dtype=np.int64))
+        for k, got in zip(keys, out):
+            d = oracle_tb.try_acquire(int(k), 1, now)
+            report["decisions"] += 1
+            if bool(got) != d.allowed:
+                report["mismatches"] += 1
+
+    def sw_wave(backend, idx_keys):
+        clock["t"] += rng.choice([1, 7, 250, 999])
+        now = clock["t"]
+        keys = [sw_keys[i] for i in idx_keys]
+        perms = [rng.choice([1, 1, 2, 5]) for _ in keys]
+        out = backend.acquire_many("sw", [lid_sw] * len(keys), keys, perms)
+        for j, k in enumerate(keys):
+            d = oracle_sw.try_acquire(k, perms[j], now)
+            report["decisions"] += 1
+            if (bool(out["allowed"][j]) != d.allowed
+                    or int(out["observed"][j]) != d.observed):
+                report["mismatches"] += 1
+
+    try:
+        for cycle in range(max(int(cycles), 1)):
+            if cycle == 0:
+                # Victim: the busiest shard (worst blast radius) unless
+                # pinned; later cycles RE-KILL the same shard — its
+                # serving backend is now the promoted replacement, so a
+                # re-kill proves the re-seeded standby actually restored
+                # failover capacity.
+                counts = np.bincount(key_shard, minlength=n_shards)
+                victim = (int(kill_shard) if kill_shard is not None
+                          else int(counts.argmax()))
+            probe_victim[0] = victim
+            victim_tb = np.nonzero(key_shard == victim)[0].astype(np.int64)
+            survivor_tb = np.nonzero(key_shard != victim)[0].astype(np.int64)
+            survivor_sw = np.nonzero(sw_shard != victim)[0]
+            assert len(victim_tb) and len(survivor_tb), (
+                "degenerate key split; raise n_keys")
+
+            # Healthy soak: traffic + ships + idle orchestrator ticks.
+            for _ in range(max(waves, 1)):
+                tb_wave(router, zipf_keys(stream_n))
+                sw_wave(router, [rng.randrange(n_keys) for _ in range(batch)])
+                report["frames"] += repl.ship_now()
+                tick()
+            assert orch.status()["shards"][victim]["state"] == "MONITORING"
+            base_promotions = orch.promotions
+
+            # Final deterministic epoch, then (first cycle only) the
+            # loss wave: victim-only traffic that is never replicated —
+            # it dies with the shard; checked against a throwaway
+            # oracle, never the main one.  Later cycles skip it: the
+            # promoted replacement's re-seed stream ships on every
+            # orchestrator tick, so pre-fence mutations there SURVIVE
+            # by design (less loss, not more).
+            report["frames"] += repl.ship_now()
+            if cycle == 0:
+                loss_oracle = copy.deepcopy(oracle_tb)
+                clock["t"] += rng.choice([1, 7, 250])
+                now = clock["t"]
+                loss_keys = victim_tb[nrng.integers(
+                    0, len(victim_tb), size=min(stream_n, 256))]
+                out = primary.acquire_stream_ids(
+                    "tb", lid_tb, np.asarray(loss_keys, dtype=np.int64))
+                for k, got in zip(loss_keys, out):
+                    if bool(got) != loss_oracle.try_acquire(
+                            int(k), 1, now).allowed:
+                        report["mismatches"] += 1
+
+            # THE KILL.  No actuator call follows — the orchestrator
+            # must do everything.
+            dead["flag"] = True
+            dead["at_promotions"] = orch.promotions
+            fence_before = orch.fence_epoch
+            ticks_to_fence = 0
+            while orch.fence_epoch == fence_before and ticks_to_fence < 64:
+                tick()
+                ticks_to_fence += 1
+                # Survivors serve while detection is in progress.
+                if ticks_to_fence == suspect_threshold:
+                    tb_wave(router, survivor_tb[nrng.integers(
+                        0, len(survivor_tb), size=min(stream_n, 256))])
+            detection_ms = ticks_to_fence * cfg.probe_interval_ms
+            assert orch.fence_epoch > fence_before, (
+                "orchestrator never fenced the dead shard")
+            assert detection_ms <= cfg.detection_budget_ms \
+                + cfg.probe_interval_ms, (
+                f"detection took {detection_ms} ms (simulated); budget "
+                f"{cfg.detection_budget_ms} ms")
+
+            # Promotion is same-tick; a few more ticks settle RESTORED
+            # -> MONITORING (the re-seed FULL frame ships on a tick).
+            settle = 0
+            while (orch.status()["shards"][victim]["state"] != "MONITORING"
+                   and settle < 32):
+                tick()
+                settle += 1
+            assert orch.promotions == base_promotions + 1, (
+                "orchestrator did not promote exactly once this cycle")
+            assert router.shard_health()[victim] == "promoted"
+
+            # Zombie check: the fenced old backend refuses victim-shard
+            # keys DIRECTLY (router bypassed) with the typed error,
+            # while survivor keys dispatched directly still serve.
+            zombie = primary if cycle == 0 else zombie_prev
+            rejected_before = orch.total_fence_rejected()
+            try:
+                zombie.acquire_stream_ids(
+                    "tb", lid_tb, np.asarray(victim_tb[:8], dtype=np.int64))
+                raise AssertionError(
+                    "fenced zombie served victim-shard dispatches")
+            except FencedError:
+                pass
+            assert orch.total_fence_rejected() > rejected_before
+            if cycle == 0:
+                # Shard-scoped fence: survivors through the SAME storage
+                # still serve (their shards are not fenced).
+                probe_keys = survivor_tb[:8]
+                clock["t"] += 3
+                got = primary.acquire_stream_ids(
+                    "tb", lid_tb, np.asarray(probe_keys, dtype=np.int64))
+                # Those direct dispatches hit real state: keep the
+                # oracle in agreement (one permit each, same stamp).
+                for j, k in enumerate(probe_keys):
+                    d = oracle_tb.try_acquire(int(k), 1, clock["t"])
+                    report["decisions"] += 1
+                    if bool(got[j]) != d.allowed:
+                        report["mismatches"] += 1
+
+            # Back to N+1: a FRESH standby was re-seeded for the
+            # promoted replica and is byte-converged with it.
+            fresh_rx = mesh_set.receivers[victim]
+            assert fresh_rx.consistent and not fresh_rx.promoted, (
+                "re-seeded standby not consistent")
+            promoted_storage = router.replacements[victim]
+            from ratelimiter_tpu.replication import engine_state_fingerprint
+
+            fp_p = engine_state_fingerprint(promoted_storage.engine)
+            fp_s = engine_state_fingerprint(
+                mesh_set.storages[victim].engine)
+            np.testing.assert_array_equal(fp_p["tb"], fp_s["tb"])
+
+            # Post-failover mixed traffic: bit-identical via the router.
+            dead["flag"] = False
+            for _ in range(2):
+                tb_wave(router, zipf_keys(stream_n))
+                sw_wave(router, [rng.randrange(n_keys) for _ in range(batch)])
+                tick()
+            report["cycles"].append({
+                "victim": victim, "detection_ms": detection_ms,
+                "fence_epoch": orch.fence_epoch})
+            zombie_prev = promoted_storage
+
+        # Flight-recorder timeline: the victim's state machine must read
+        # back in order, and the failover triplet must be ordered.
+        victim0 = report["cycles"][0]["victim"]
+        trans = [(e["from"], e["to"]) for e in frec.events(since=fmark)
+                 if e["kind"] == "orchestrator.transition"
+                 and e["shard"] == victim0]
+        expect = [("MONITORING", "SUSPECT"), ("SUSPECT", "FENCING"),
+                  ("FENCING", "PROMOTING"), ("PROMOTING", "RESTORED"),
+                  ("RESTORED", "MONITORING")]
+        it = iter(trans)
+        assert all(step in it for step in expect), (
+            f"orchestrator timeline out of order: {trans}")
+        kinds = [e["kind"] for e in frec.events(since=fmark)
+                 if e["kind"] in ("shard.failed", "replication.promote",
+                                  "shard.promoted")]
+        it = iter(kinds)
+        assert all(k in it for k in ("shard.failed", "replication.promote",
+                                     "shard.promoted")), (
+            f"failover triplet out of order: {kinds}")
+        report["flight_transitions"] = trans
+        report["false_alarms"] = orch.false_alarms
+        report["promotions"] = orch.promotions
+        report["reseeds"] = orch.reseeds
+        report["fence_rejected"] = orch.total_fence_rejected()
+        assert orch.false_alarms == 0, "healthy probes raised false alarms"
+        if report["mismatches"]:
+            raise AssertionError(
+                f"orchestrated failover diverged from the oracle: {report}")
+        return report
+    finally:
+        orch.close()
+        repl.stop()
+        router.close()
+        mesh_set.close()
+
+
+def orchestrator_flap_drill(
+    n_shards: int = 2,
+    slots_per_shard: int = 128,
+    n_keys: int = 48,
+    flap_cycles: int = 3,
+    seed: int = 0,
+    registry=None,
+    probe_interval_ms: float = 50.0,
+    suspect_threshold: int = 2,
+    hysteresis_ms: float = 300.0,
+) -> dict:
+    """Flap damping: a fault that HEALS inside the hysteresis window
+    must never promote — and fencing must be a clean, liftable refusal.
+
+    The victim shard's liveness probe runs over a real TCP hop through a
+    :class:`FaultInjectingProxy`; each flap cycle calls ``partition()``
+    (bytes dropped both ways, no RST — the silent-partition shape) long
+    enough to enter SUSPECT, then ``heal()`` before the hysteresis
+    window closes.  Asserts per the ISSUE contract:
+
+    - every flap increments ``false_alarms`` and nothing else: zero
+      promotions, zero fence epochs, every shard ``active``, the state
+      machine back in MONITORING;
+    - traffic before/during/after flaps is bit-identical to the oracle
+      (no loss, because nothing was promoted);
+    - a fence installed on the primary refuses the fenced shard's
+      dispatches with the typed ``FencedError`` (counted) while the
+      other shard's keys still serve — and ``lift_fence`` restores the
+      fenced shard to exact service (the operator path after a
+      verified-quiesced false-dead).
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import random
+    import socket as socket_mod
+    import socketserver
+
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+    from ratelimiter_tpu.replication import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+    from ratelimiter_tpu.semantics.oracle import TokenBucketOracle
+    from ratelimiter_tpu.storage.errors import FencedError
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    rng = random.Random(seed)
+    nrng = np.random.default_rng(seed)
+    clock = {"t": 1_753_000_000_000}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    primary = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    router = ShardFailoverRouter(primary)
+    cfg_tb = RateLimitConfig(max_permits=20, window_ms=2000,
+                             refill_rate=8.0)
+    lid_tb = primary.register_limiter("tb", cfg_tb)
+
+    def standby_factory():
+        return TpuBatchedStorage(num_slots=slots_per_shard,
+                                 clock_ms=lambda: clock["t"])
+
+    mesh_set = ShardStandbySet(n_shards, standby_factory, registry=registry)
+    log = ShardedReplicationLog(primary)
+    repl = ShardedReplicator(log, mesh_set.in_process_sinks(),
+                             registry=registry)
+
+    # The victim's probe is a 1-byte echo over TCP THROUGH the chaos
+    # proxy: partition() makes it time out exactly like a silently-dead
+    # peer; heal() restores it.
+    class _Echo(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                if self.request.recv(1):
+                    self.request.sendall(b"o")
+            except OSError:
+                pass
+
+    class _EchoServer(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    echo = _EchoServer(("127.0.0.1", 0), _Echo)
+    echo_thread = threading.Thread(target=echo.serve_forever, daemon=True)
+    echo_thread.start()
+    proxy = FaultInjectingProxy(echo.server_address[1], seed=seed).start()
+
+    key_shard = shard_of_int_keys(np.arange(n_keys, dtype=np.int64),
+                                  n_shards)
+    victim = int(np.bincount(key_shard, minlength=n_shards).argmax())
+
+    def tcp_probe_ok() -> bool:
+        try:
+            s = socket_mod.create_connection(("127.0.0.1", proxy.port),
+                                             timeout=0.25)
+            s.settimeout(0.25)
+            s.sendall(b"p")
+            ok = s.recv(1) == b"o"
+            s.close()
+            return ok
+        except OSError:
+            return False
+
+    def probe(q):
+        return tcp_probe_ok() if q == victim else True
+
+    sim = {"s": 0.0}
+    cfg = OrchestratorConfig(probe_interval_ms=probe_interval_ms,
+                             suspect_threshold=suspect_threshold,
+                             hysteresis_ms=hysteresis_ms)
+    orch = FailoverOrchestrator(
+        router, mesh_set, repl, standby_factory=standby_factory,
+        config=cfg, probe=probe, registry=registry,
+        clock=lambda: sim["s"], sleep=lambda s: None)
+
+    def tick(n=1):
+        for _ in range(n):
+            sim["s"] += cfg.probe_interval_ms / 1000.0
+            orch.tick()
+
+    oracle_tb = TokenBucketOracle(cfg_tb)
+    report = {"decisions": 0, "mismatches": 0, "false_alarms": 0,
+              "fence_rejected": 0}
+
+    def wave():
+        clock["t"] += rng.choice([1, 7, 250, 999, 2000])
+        now = clock["t"]
+        keys = (nrng.zipf(1.3, size=384) - 1) % n_keys
+        out = router.acquire_stream_ids(
+            "tb", lid_tb, np.asarray(keys, dtype=np.int64))
+        for k, got in zip(keys, out):
+            d = oracle_tb.try_acquire(int(k), 1, now)
+            report["decisions"] += 1
+            if bool(got) != d.allowed:
+                report["mismatches"] += 1
+
+    try:
+        # Healthy baseline.
+        for _ in range(2):
+            wave()
+            repl.ship_now()
+            tick()
+        assert orch.false_alarms == 0
+
+        # Flap cycles: partition long enough to enter SUSPECT, heal
+        # before the hysteresis window closes.  The suspect window in
+        # simulated time must stay strictly under hysteresis_ms.
+        suspect_ticks = max(
+            1, int(hysteresis_ms / probe_interval_ms) - suspect_threshold - 1)
+        for cycle in range(flap_cycles):
+            proxy.partition()
+            tick(suspect_threshold)          # consecutive failures: SUSPECT
+            state = orch.status()["shards"][victim]["state"]
+            assert state == "SUSPECT", (cycle, state)
+            tick(suspect_ticks)              # inside the window, still bad
+            assert orch.status()["shards"][victim]["state"] == "SUSPECT"
+            proxy.heal()                     # fault clears BEFORE hysteresis
+            tick()
+            assert orch.status()["shards"][victim]["state"] == "MONITORING"
+            assert orch.false_alarms == cycle + 1
+            wave()                           # serving throughout, exact
+            repl.ship_now()
+        assert orch.promotions == 0, "a transient fault was promoted"
+        assert orch.fence_epoch == 0, "a transient fault installed a fence"
+        assert all(v == "active" for v in router.shard_health().values())
+
+        # Fence round-trip on the primary: the fenced shard's keys are
+        # refused with the typed error (zombie shape), the other
+        # shard's keys keep serving, and lift_fence restores exact
+        # service.
+        victim_keys = np.nonzero(key_shard == victim)[0].astype(np.int64)
+        other_keys = np.nonzero(key_shard != victim)[0].astype(np.int64)
+        primary.fence(1, shards=(victim,))
+        try:
+            primary.acquire_stream_ids("tb", lid_tb, victim_keys[:8])
+            raise AssertionError("fenced shard served a direct dispatch")
+        except FencedError:
+            pass
+        assert primary.fence_rejected >= 1
+        report["fence_rejected"] = primary.fence_rejected
+        clock["t"] += 7
+        got = primary.acquire_stream_ids("tb", lid_tb, other_keys[:8])
+        for k, g in zip(other_keys[:8], got):
+            d = oracle_tb.try_acquire(int(k), 1, clock["t"])
+            report["decisions"] += 1
+            if bool(g) != d.allowed:
+                report["mismatches"] += 1
+        primary.lift_fence(1)
+        wave()                               # victim keys serve again, exact
+
+        report["false_alarms"] = orch.false_alarms
+        report["victim"] = victim
+        if report["mismatches"]:
+            raise AssertionError(
+                f"flap drill diverged from the oracle: {report}")
+        return report
+    finally:
+        orch.close()
+        repl.stop()
+        proxy.stop()
+        echo.shutdown()
+        echo.server_close()
+        router.close()
+        mesh_set.close()
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,21 @@ class PromotionInProgressError(StorageException):
     """
 
 
+class FencedError(StorageException):
+    """This storage (or one of its shards) has been fenced by failover.
+
+    The failover orchestrator (replication/orchestrator.py) bumps a
+    monotonic fencing epoch on the storage it is replacing BEFORE
+    promoting a standby: a zombie primary — declared dead on a
+    false-positive health verdict but actually still running — must not
+    keep admitting traffic in parallel with its replacement ("When Two
+    is Worse Than One": two uncoordinated primaries over-admit without
+    bound).  Unlike :class:`PromotionInProgressError` this is NOT
+    transient: a fenced storage stays fenced until an operator lifts
+    the fence, so it is listed in ``RetryPolicy.no_retry``.
+    """
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Linear-backoff retry (RedisRateLimitStorage.java:19-20,155-178).
@@ -59,7 +74,8 @@ class RetryPolicy:
     max_retries: int = 3
     retry_delay_ms: float = 10.0
     no_retry: tuple = (ValueError, TypeError, KeyError,
-                       OverloadedError, ShutdownError, CircuitOpenError)
+                       OverloadedError, ShutdownError, CircuitOpenError,
+                       FencedError)
 
     def execute(self, operation: Callable[[], T], sleep=time.sleep) -> T:
         last_exc: Exception | None = None

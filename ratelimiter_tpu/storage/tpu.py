@@ -659,6 +659,16 @@ class TpuBatchedStorage(RateLimitStorage):
         # Standby-promotion window flag: decisions are refused (typed,
         # retryable) while promote_from_replica swaps the indexes.
         self._promoting = False
+        # Fencing (replication/orchestrator.py): a monotonically-bumped
+        # epoch installed by failover before a replacement starts serving.
+        # _fence_all refuses every decision; _fenced_shards scopes the
+        # fence to the named shards of a sharded engine (survivor traffic
+        # keeps flowing).  Both cost one falsy check on the hot path
+        # until a fence is actually installed.
+        self._fence_epoch = 0
+        self._fence_all = False
+        self._fenced_shards: frozenset = frozenset()
+        self.fence_rejected = 0
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
         # The native index checkpoints at fingerprint level by default;
@@ -906,6 +916,8 @@ class TpuBatchedStorage(RateLimitStorage):
     ) -> Dict[str, np.ndarray]:
         """Whole-batch synchronous decision (the vectorized/bench path)."""
         self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys(lid_per_req, keys)
         index = self._index[algo]
         lid0 = lid_per_req[0] if lid_per_req else 0
         uniform_lid = all(l == lid0 for l in lid_per_req)
@@ -958,6 +970,8 @@ class TpuBatchedStorage(RateLimitStorage):
         slot assignment, one device dispatch for the decisions.
         """
         self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_int_keys(key_ids)
         index = self._index[algo]
         if hasattr(index, "assign_batch_ints"):
             self._batcher.flush()
@@ -1034,6 +1048,8 @@ class TpuBatchedStorage(RateLimitStorage):
         device materializes ones).  Returns bool[n] allowed.
         """
         self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_int_keys(key_ids)
         multi_lid = np.ndim(lid) != 0
         if multi_lid:
             lid_arr = np.ascontiguousarray(lid, dtype=np.int64)
@@ -1944,6 +1960,9 @@ class TpuBatchedStorage(RateLimitStorage):
         on the same chunks (same index namespace, same kernels).  Returns
         bool[n] allowed.
         """
+        self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid] * len(keys), keys)
         index = self._index[algo]
         oversize = None
         if permits is not None:
@@ -3063,6 +3082,99 @@ class TpuBatchedStorage(RateLimitStorage):
         finally:
             self._promoting = False
 
+    # ------------------------------------------------------------------------
+    # Fencing (replication/orchestrator.py)
+    # ------------------------------------------------------------------------
+    def fence(self, epoch: int, shards=None) -> int:
+        """Install a fence at a monotonic ``epoch``: this storage (or the
+        named ``shards`` of its sharded engine) refuses every further
+        decision with the typed :class:`FencedError`.
+
+        Failover calls this on the storage being REPLACED before its
+        standby is promoted, so a zombie primary — declared dead on a
+        false positive but actually still running — cannot keep admitting
+        traffic in parallel with the replacement.  The epoch must strictly
+        exceed the last installed one (a stale orchestrator instance
+        replaying an old fence must not regress a newer decision); a
+        non-monotonic epoch raises ``ValueError`` and changes nothing.
+        """
+        epoch = int(epoch)
+        if epoch <= self._fence_epoch:
+            raise ValueError(
+                f"fence epoch {epoch} is not past the installed epoch "
+                f"{self._fence_epoch}; fencing is monotonic")
+        self._fence_epoch = epoch
+        if shards is None:
+            self._fence_all = True
+        else:
+            self._fenced_shards = self._fenced_shards | frozenset(
+                int(q) for q in shards)
+        if self._recorder is not None:
+            self._recorder.record(
+                "fence.installed", epoch=epoch,
+                shards=(sorted(self._fenced_shards) if shards is not None
+                        else "all"))
+        return epoch
+
+    def lift_fence(self, epoch: int, shards=None) -> None:
+        """Lift the fence (operator action after the false-dead primary is
+        verified quiesced).  ``epoch`` must be at or past the installed
+        fence epoch — a stale lift is refused the same way a stale fence
+        is."""
+        if int(epoch) < self._fence_epoch:
+            raise ValueError(
+                f"lift epoch {epoch} is behind the installed fence epoch "
+                f"{self._fence_epoch}")
+        if shards is None:
+            self._fence_all = False
+            self._fenced_shards = frozenset()
+        else:
+            self._fenced_shards = self._fenced_shards - frozenset(
+                int(q) for q in shards)
+        if self._recorder is not None:
+            self._recorder.record("fence.lifted", epoch=int(epoch))
+
+    def fence_info(self) -> Dict:
+        return {"epoch": self._fence_epoch, "all": self._fence_all,
+                "shards": sorted(self._fenced_shards),
+                "rejected": self.fence_rejected}
+
+    def _fence_reject(self, detail: str):
+        self.fence_rejected += 1
+        from ratelimiter_tpu.storage.errors import FencedError
+
+        raise FencedError(
+            f"storage fenced at epoch {self._fence_epoch} ({detail}): a "
+            "failover replacement owns this keyspace; this instance must "
+            "not decide")
+
+    def _check_fence_int_keys(self, key_ids) -> None:
+        """Shard-scoped fence check for int-key batch/stream paths (only
+        reached when a shard fence is installed)."""
+        n_sh = getattr(self.engine, "n_shards", None)
+        if n_sh is None:
+            return
+        from ratelimiter_tpu.parallel.sharded import shard_of_int_keys
+
+        shards = shard_of_int_keys(
+            np.ascontiguousarray(key_ids, dtype=np.int64), int(n_sh))
+        hit = sorted(q for q in self._fenced_shards if (shards == q).any())
+        if hit:
+            self._fence_reject(f"request routes to fenced shard(s) {hit}")
+
+    def _check_fence_keys(self, lid_per_req, keys) -> None:
+        """Shard-scoped fence check for string-key batch paths."""
+        n_sh = getattr(self.engine, "n_shards", None)
+        if n_sh is None:
+            return
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        for lid, key in zip(lid_per_req, keys):
+            q = shard_of_key((int(lid), key), int(n_sh))
+            if q in self._fenced_shards:
+                self._fence_reject(
+                    f"key routes to fenced shard {q}")
+
     def export_keys(self) -> Dict:
         """Geometry-free export of all live per-key state (the rebalance
         counterpart to checkpoints; engine/checkpoint.py:export_keys —
@@ -3217,8 +3329,11 @@ class TpuBatchedStorage(RateLimitStorage):
     # ------------------------------------------------------------------------
     def _check_not_promoting(self) -> None:
         """Refuse decisions while a standby promotion is swapping the
-        key->slot indexes (one attribute check on the hot path; see
-        :meth:`promote_from_replica`)."""
+        key->slot indexes, and refuse them FOREVER once this storage is
+        whole-fenced (two attribute checks on the hot path; see
+        :meth:`promote_from_replica` and :meth:`fence`)."""
+        if self._fence_all:
+            self._fence_reject("whole-storage fence")
         if self._promoting:
             from ratelimiter_tpu.storage.errors import (
                 PromotionInProgressError,
@@ -3231,6 +3346,8 @@ class TpuBatchedStorage(RateLimitStorage):
     def _assign_slot(self, algo: str, lid: int, key: str,
                      hold_pin: bool = False) -> int:
         self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid], [key])
         index = self._index[algo]
         pinned = self._batcher.pending_slots(algo)
         slot, evicted = index.assign((lid, key), pinned=pinned,

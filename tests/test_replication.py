@@ -630,3 +630,117 @@ def test_gauge_meter():
     assert registry.gauge("x.lag") is g
     with pytest.raises(TypeError):
         registry.counter("x.lag")
+
+
+# ---------------------------------------------------------------------------
+# Link liveness: ack deadline + heartbeat (standby gone vs standby slow)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_acks_and_keeps_link_up():
+    clock, primary, standby = make_pair(num_slots=256)
+    receiver = StandbyReceiver(standby)
+    server = ReplicationServer(receiver, host="127.0.0.1").start()
+    sink = SocketSink("127.0.0.1", server.port, ack_timeout=1.0)
+    try:
+        assert sink.link_state() == "unknown"   # no contact yet
+        assert sink.heartbeat() is True
+        assert sink.link_state() == "up"
+        # Heartbeats apply NOTHING to the standby.
+        assert receiver.frames_applied == 0
+    finally:
+        sink.close()
+        server.stop()
+        primary.close()
+        standby.close()
+
+
+def test_silently_dead_standby_marks_link_dead():
+    """A partition (bytes dropped, no RST) must fail the heartbeat at
+    the ACK DEADLINE — not the 10 s connect timeout — and enough
+    consecutive failures mark the link DEAD: the 'standby gone' verdict
+    the orchestrator needs, as opposed to 'standby slow'."""
+    import time as time_mod
+
+    from ratelimiter_tpu.storage.chaos import FaultInjectingProxy
+
+    clock, primary, standby = make_pair(num_slots=256)
+    receiver = StandbyReceiver(standby)
+    server = ReplicationServer(receiver, host="127.0.0.1").start()
+    proxy = FaultInjectingProxy(server.port).start()
+    sink = SocketSink("127.0.0.1", proxy.port, ack_timeout=0.25,
+                      dead_after=2, max_retries=0)
+    try:
+        assert sink.heartbeat() is True
+        assert sink.link_state() == "up"
+        proxy.partition()                      # silence, no RST
+        t0 = time_mod.monotonic()
+        assert sink.heartbeat() is False       # 1st failure: not dead yet
+        assert time_mod.monotonic() - t0 < 2.0  # the ACK deadline fired
+        assert sink.link_state() == "up"
+        assert sink.heartbeat() is False       # 2nd consecutive: DEAD
+        assert sink.link_state() == "dead"
+        # Healing restores UP on the next successful ack.
+        proxy.heal()
+        deadline = time_mod.monotonic() + 5.0
+        while not sink.heartbeat() and time_mod.monotonic() < deadline:
+            pass
+        assert sink.link_state() == "up"
+    finally:
+        sink.close()
+        proxy.stop()
+        server.stop()
+        primary.close()
+        standby.close()
+
+
+def test_replicator_idle_cycles_heartbeat_and_flag_dead_link():
+    """With NO deltas flowing, the replicator's idle cycles must still
+    detect a silently-dead standby: heartbeat -> link DEAD -> gauge 0 +
+    flight event (the old behavior saw nothing until the next delta)."""
+    import time as time_mod
+
+    from ratelimiter_tpu.observability import flight_recorder
+    from ratelimiter_tpu.storage.chaos import FaultInjectingProxy
+
+    frec = flight_recorder()
+    fmark = frec.mark()
+    registry = MeterRegistry()
+    clock, primary, standby = make_pair(num_slots=256)
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=20, window_ms=1000, refill_rate=10.0))
+    receiver = StandbyReceiver(standby)
+    server = ReplicationServer(receiver, host="127.0.0.1").start()
+    proxy = FaultInjectingProxy(server.port).start()
+    sink = SocketSink("127.0.0.1", proxy.port, ack_timeout=0.2,
+                      dead_after=2, max_retries=0)
+    repl = Replicator(ReplicationLog(primary), sink, interval_ms=30.0,
+                      registry=registry).start()
+    try:
+        clock["t"] += 5
+        primary.acquire_many("tb", [lid] * 2, ["a", "b"], [1, 1])
+        deadline = time_mod.monotonic() + 10.0
+        while receiver.last_epoch < 1 and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.02)
+        assert receiver.last_epoch >= 1
+        assert registry.scrape()["ratelimiter.replication.link_up"] == 1.0
+        proxy.partition()                       # standby silently gone
+        deadline = time_mod.monotonic() + 15.0
+        while sink.link_state() != "dead" \
+                and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.05)
+        assert sink.link_state() == "dead", (
+            "idle heartbeats never detected the partition")
+        deadline = time_mod.monotonic() + 5.0
+        while registry.scrape()["ratelimiter.replication.link_up"] != 0.0 \
+                and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.05)
+        assert registry.scrape()["ratelimiter.replication.link_up"] == 0.0
+        assert any(e["kind"] == "replication.link_dead"
+                   for e in frec.events(since=fmark))
+    finally:
+        repl.stop()
+        sink.close()
+        proxy.stop()
+        server.stop()
+        primary.close()
+        standby.close()

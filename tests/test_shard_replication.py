@@ -281,6 +281,100 @@ def test_promotion_refuses_racing_dispatch(monkeypatch):
     standby.close()
 
 
+def test_promotion_race_exactly_one_wins(monkeypatch):
+    """Orchestrator auto-promotion vs a concurrent manual
+    POST /actuator/replication/promote (both land on the same
+    ``StandbyReceiver.promote``): exactly one wins, the loser gets the
+    typed retryable ``PromotionInProgressError``, and the fencing state
+    ends up consistent (one promotion recorded, storage serving)."""
+    import concurrent.futures as cf
+
+    from ratelimiter_tpu.engine import checkpoint as ckpt
+    from ratelimiter_tpu.storage.errors import PromotionInProgressError
+
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=40, window_ms=1000, refill_rate=10.0))
+    clock["t"] += 5
+    primary.acquire_many("tb", [lid] * 4, list("abcd"), [1] * 4)
+    registry = MeterRegistry()
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby, registry=registry)
+    for f in log.cut():
+        receiver.apply(f)
+
+    entered = threading.Event()
+    release = threading.Event()
+    real_restore = ckpt.restore_slot_indexes
+
+    def slow_restore(storage, dump):
+        entered.set()
+        assert release.wait(5.0)
+        return real_restore(storage, dump)
+
+    monkeypatch.setattr(ckpt, "restore_slot_indexes", slow_restore)
+
+    def promote():
+        return receiver.promote()
+
+    with cf.ThreadPoolExecutor(2) as pool:
+        first = pool.submit(promote)
+        assert entered.wait(5.0)
+        # The second (the "manual" POST) races the in-flight one and
+        # must lose with the typed error, NOT deadlock or double-run.
+        second = pool.submit(promote)
+        with pytest.raises(PromotionInProgressError):
+            second.result(timeout=5.0)
+        release.set()
+        assert first.result(timeout=5.0) is standby
+    # Exactly one promotion ran.
+    assert registry.scrape()["ratelimiter.replication.failovers"] == 1.0
+    assert receiver.promoted
+    # A latecomer after the window is told the storage already serves.
+    with pytest.raises(ReplicationStateError):
+        receiver.promote()
+    # The promoted storage serves normally (fencing state consistent:
+    # nothing fenced IT — only the replaced primary gets fenced).
+    out = standby.acquire_many("tb", [lid] * 2, ["a", "x"], [1, 1])
+    assert len(out["allowed"]) == 2
+    assert standby.fence_info()["epoch"] == 0
+    primary.close()
+    standby.close()
+
+
+def test_promoted_standby_refuses_late_frames():
+    """A zombie primary still shipping frames into a PROMOTED (now
+    serving) standby must be refused — the replication-side twin of the
+    dispatch fence."""
+    clock = {"t": T0}
+    primary = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    standby = TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"])
+    lid = primary.register_limiter("tb", RateLimitConfig(
+        max_permits=40, window_ms=1000, refill_rate=10.0))
+    clock["t"] += 5
+    primary.acquire_many("tb", [lid] * 4, list("abcd"), [1] * 4)
+    log = ReplicationLog(primary)
+    receiver = StandbyReceiver(standby)
+    for f in log.cut():
+        receiver.apply(f)
+    receiver.promote()
+    fp_before = engine_state_fingerprint(standby.engine)
+    clock["t"] += 5
+    primary.acquire_many("tb", [lid] * 4, list("abcd"), [1] * 4)
+    late = log.cut()
+    assert late
+    with pytest.raises(ReplicationStateError, match="zombie"):
+        receiver.apply(late[0])
+    assert receiver.refused_after_promote == 1
+    # The serving state was NOT overwritten by the zombie's rows.
+    fp_after = engine_state_fingerprint(standby.engine)
+    np.testing.assert_array_equal(fp_before["tb"], fp_after["tb"])
+    primary.close()
+    standby.close()
+
+
 # ---------------------------------------------------------------------------
 # Replicator backpressure
 # ---------------------------------------------------------------------------

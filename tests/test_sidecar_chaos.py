@@ -257,3 +257,103 @@ def test_ingress_soak_slow():
         proxy.stop()
         server.stop()
         storage.close()
+
+
+# ---------------------------------------------------------------------------
+# Partition / flap primitives (the orchestrator drills build on these)
+# ---------------------------------------------------------------------------
+
+def _echo_server():
+    import socketserver
+
+    class Echo(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                try:
+                    data = self.request.recv(64)
+                except OSError:
+                    return
+                if not data:
+                    return
+                try:
+                    self.request.sendall(data)
+                except OSError:
+                    return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    server = Server(("127.0.0.1", 0), Echo)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def test_proxy_partition_drops_both_directions_without_rst():
+    """partition(): bytes vanish in BOTH directions, but neither socket
+    is closed — the peer looks silently gone (recv blocks to timeout,
+    send succeeds into the void), exactly the no-RST network-partition
+    shape.  heal() restores the SAME connection."""
+    import socket
+
+    echo = _echo_server()
+    proxy = FaultInjectingProxy(echo.server_address[1]).start()
+    try:
+        conn = socket.create_connection(("127.0.0.1", proxy.port),
+                                        timeout=2.0)
+        conn.sendall(b"ping")
+        assert conn.recv(16) == b"ping"
+
+        proxy.partition()
+        conn.settimeout(0.3)
+        conn.sendall(b"lost")            # send succeeds: no RST came back
+        with pytest.raises(socket.timeout):
+            conn.recv(16)                # ...but nothing ever returns
+        assert proxy.faults_injected >= 1
+
+        proxy.heal()                     # same connection, live again
+        conn.settimeout(2.0)
+        conn.sendall(b"back")
+        assert conn.recv(16) == b"back"
+        conn.close()
+    finally:
+        proxy.stop()
+        echo.shutdown()
+        echo.server_close()
+
+
+def test_proxy_flap_alternates_partition_and_passthrough():
+    """flap(period_s): the link alternates healthy/partitioned every
+    half period — the flaky-link shape the orchestrator's hysteresis
+    must damp.  Sampled across several periods, both phases must be
+    observed on one connection."""
+    import socket
+
+    echo = _echo_server()
+    proxy = FaultInjectingProxy(echo.server_address[1]).start()
+    try:
+        period = 0.4
+        proxy.flap(period)
+        conn = socket.create_connection(("127.0.0.1", proxy.port),
+                                        timeout=2.0)
+        conn.settimeout(0.15)
+        ok = cut = 0
+        deadline = time.monotonic() + 4 * period
+        while time.monotonic() < deadline and not (ok and cut):
+            try:
+                conn.sendall(b"x")
+                if conn.recv(16):
+                    ok += 1
+                else:
+                    break
+            except socket.timeout:
+                cut += 1
+            time.sleep(period / 8)
+        assert ok >= 1, "flap never let a byte through"
+        assert cut >= 1, "flap never cut the link"
+        proxy.heal()
+        conn.close()
+    finally:
+        proxy.stop()
+        echo.shutdown()
+        echo.server_close()

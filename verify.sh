@@ -107,6 +107,28 @@ else
   fail=1
 fi
 
+echo "running orchestrated failover + flap drills (self-healing, zero manual promotes)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_orchestrator.py::test_orchestrated_failover_drill_fast \
+    tests/test_orchestrator.py::test_orchestrator_flap_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  orchestrated failover + flap drills"
+else
+  echo "  FAILED  orchestrated failover + flap drills"
+  fail=1
+fi
+
+echo "running orchestrator idle overhead gate (probe loop <= 2% steady-state)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
+    bench/orchestrator_overhead.py --n 1048576 --rounds 3 \
+    --assert-budget 0.02 > /dev/null; then
+  echo "  ok  orchestrator idle overhead budget"
+else
+  echo "  FAILED  orchestrator idle overhead budget (the probe loop costs"
+  echo "          more than 2% steady-state CPU at its cadence)"
+  fail=1
+fi
+
 echo "running fast overload + breaker chaos drills..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_overload.py::test_overload_drill_fast \
@@ -160,6 +182,7 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
       tests/test_replication.py::test_failover_soak_slow \
       tests/test_shard_replication.py::test_shard_failover_soak_slow \
+      tests/test_orchestrator.py::test_orchestrator_soak_slow \
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
       tests/test_sidecar_chaos.py::test_ingress_soak_slow \
