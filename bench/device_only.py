@@ -236,6 +236,49 @@ def main() -> None:
     # Later chains start from fresh state (prior chains donated theirs).
     from ratelimiter_tpu.ops.token_bucket import make_tb_packed
 
+    # Steady-state micro-loop recompile guard (r11 satellite): warm the
+    # double-buffered staged shapes (both in-flight buffers), then drive
+    # a steady interactive loop at jittered lane counts inside the
+    # warmed buckets and assert ZERO new XLA compiles fire — a compile
+    # inside the steady loop is a multi-hundred-ms p99 spike the warmup
+    # exists to prevent.
+    from ratelimiter_tpu.engine.engine import MICRO_STAGE_ROWS
+
+    eng.tb_packed = make_tb_packed(num_slots)  # relay chain donated it
+    eng.warm_micro_shapes(sizes=(32, 64, 128))
+    compiles_before = eng.micro_compile_count()
+    bufs = []
+    for cap in (32, 64, 128, 32):  # the double buffer's two halves
+        b = np.empty((MICRO_STAGE_ROWS, cap), dtype=np.int64)
+        b[0] = -1
+        b[1] = lid
+        b[2] = 1
+        bufs.append(b)
+    steps = 200
+    t0 = time.perf_counter()
+    for i in range(steps):
+        b = bufs[i % len(bufs)]
+        algo = "tb" if i % 2 else "sw"
+        n = 1 + (i * 13) % b.shape[1]
+        b[0, :n] = (np.arange(n) * 7919 + i) % num_slots
+        b[3, 0] = 3_000_000 + i
+        h = eng.micro_staged_dispatch(algo, b, n)
+        eng.micro_staged_drain(algo, h, n)
+        b[0, :n] = -1
+    dt = time.perf_counter() - t0
+    compiles_after = eng.micro_compile_count()
+    out["micro_staged"] = {
+        "steps": steps,
+        "ms_per_dispatch_drain": round(dt / steps * 1000, 3),
+        "compiles_before": compiles_before,
+        "compiles_after": compiles_after,
+        "recompiled": bool(compiles_after != compiles_before),
+    }
+    assert not out["micro_staged"]["recompiled"], (
+        f"steady-state micro loop recompiled: {compiles_before} -> "
+        f"{compiles_after} staged-step executables (warm_micro_shapes "
+        "no longer covers the batcher's dispatch buckets)")
+
     out["flat_weighted"] = measure(flat_chain, make_tb_packed(num_slots))
     out["digest_sorted"] = measure(digest_chain(uslots_sorted, True),
                                    make_tb_packed(num_slots))

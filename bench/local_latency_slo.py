@@ -14,6 +14,7 @@ bench.py records the output as latency_slo_local.
 Run from the repo root (subprocess of bench.py).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -23,8 +24,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def main() -> None:
+def main(assert_meets: bool = False) -> int:
     import jax
+
+    # Latency runs want prompt GIL handoff between submitters, flusher
+    # and drain (default 5 ms slices add multi-ms scheduling tails).
+    sys.setswitchinterval(0.001)
 
     # Must be pinned before any device op (see local_single_key.py).
     jax.config.update("jax_platforms", "cpu")
@@ -75,8 +80,19 @@ def main() -> None:
         eng.sw_acquire_drain(h, 16)
     step_ms = (time.perf_counter() - t0) / 50 * 1000
 
-    n_threads = 16
-    keys_per = 256  # 4096 distinct keys; each request a different one
+    # The closed-loop generator SHARES the host with the serving stack:
+    # on a many-core box 16 threads is the realistic interactive load,
+    # but on a 1-2 core CI container that many spinning submitters
+    # saturate the core and the bench degenerates into a capacity
+    # measurement (every request queues behind 15 others) instead of
+    # the latency SLO it exists to check.  Scale the offered concurrency
+    # to the hardware: 2x cores, clamped to [2, 16].
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    n_threads = max(2, min(16, 2 * cores))
+    keys_per = 256  # n_threads*256 distinct keys; each request a new one
     res = bench_threaded(
         limiter,
         keys_per_thread=lambda t: [f"slo-u{t}-{i}" for i in range(keys_per)],
@@ -122,7 +138,38 @@ def main() -> None:
     }
     storage.close()
     print(json.dumps(res))
+    if assert_meets:
+        # CI gate (verify.sh): the 1 ms p99 target must hold on CPU, and
+        # the decomposition must show assembly is no longer the dominant
+        # stage (the r11 double-buffer/staged-dispatch claim).
+        if not res["meets_target"]:
+            print(f"FAIL: p99 {lat['p99_us']:.0f} us > 1000 us target",
+                  file=sys.stderr)
+            return 1
+        # "No longer dominant": pre-r11 assembly sat at 0.88-1.02 ms
+        # p50, ~3x every other stage.  Post-fix it runs at parity with
+        # queue wait (~0.1 ms), so a hair's win either way is noise —
+        # fail only if assembly CLEARLY dominates again (>1.25x the
+        # largest other stage) or regresses toward the old absolute
+        # level (>0.45 ms p50, half the pre-fix figure).
+        asm = stages.get("assembly", {}).get("p50_ms", 0.0)
+        others = max((stages[s]["p50_ms"] for s in stages
+                      if s not in ("total", "assembly")), default=0.0)
+        if asm > max(1.25 * others, 0.2) or asm > 0.45:
+            print(f"FAIL: assembly is again the dominant stage "
+                  f"({asm} ms p50 vs {others} ms largest other)",
+                  file=sys.stderr)
+            return 1
+        print(f"ok: p99 {lat['p99_us']:.0f} us <= 1000 us; assembly "
+              f"p50 {asm} ms (largest other stage {others} ms)",
+              file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--assert-meets", action="store_true",
+                    help="exit nonzero unless p99 <= 1 ms on CPU and "
+                         "assembly is not the dominant stage")
+    args = ap.parse_args()
+    sys.exit(main(assert_meets=args.assert_meets))

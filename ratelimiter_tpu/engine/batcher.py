@@ -5,11 +5,24 @@ round-trip (~800 us, ARCHITECTURE.md latency model); ours is a Future that
 resolves when its device batch's results land.  Threads submit requests; a
 dedicated flusher thread dispatches a batch when either
 
-- the pending batch reaches ``max_batch``, or
-- the oldest pending request has waited ``max_delay_ms`` (adaptive flush:
-  size OR deadline — SURVEY.md §7 "Batching latency vs p99"),
+- the pending batch reaches the size trigger (``max_batch``, or the
+  adaptive controller's applied trigger), or
+- the oldest pending request has waited the flush deadline
+  (``max_delay_ms``, or the controller's applied deadline — SURVEY.md §7
+  "Batching latency vs p99"),
 
-whichever comes first.
+whichever comes first.  With an ``AdaptiveFlushController`` attached
+(engine/flush_control.py), both bounds track the measured device-step
+time, hard-clamped within the configured ones.
+
+**Double-buffered assembly (r11).**  Requests are packed at submit time
+into a preallocated combined staging buffer (``_Pending``), so batch
+N+1's host assembly happens on the submitters' threads while batch N is
+in flight; a flush swaps the active buffer for a recycled standby and —
+with a ``dispatch_staged`` callback — dispatch collapses to one device
+upload plus a cached jit call.  This is the same overlap structure the
+stream path's prefetch pipeline uses (ARCHITECTURE §6b), applied to the
+interactive micro path.
 
 **Pipelined dispatch/drain.**  Dispatching a batch (enqueue on device,
 state advanced) and draining it (the blocking device->host fetch that
@@ -54,29 +67,118 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
+import numpy as np
+
 from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
 from ratelimiter_tpu.utils.logging import get_logger
 
 log = get_logger("engine.batcher")
 
+#: Initial staging-buffer lane count (the _MICRO_FLOOR bucket); buffers
+#: grow by doubling so every capacity is a valid dispatch bucket.
+_STAGE_CAP = 32
+
 
 class _Pending:
-    __slots__ = ("slots", "lids", "permits", "futures", "deadlines",
-                 "t_sub", "clears", "born")
+    """One algo's pending queue, double-buffered (r11).
+
+    Requests are packed **at submit time** into a preallocated combined
+    i64[4, cap] staging buffer (row 0 slots / 1 lids / 2 permits / 3 the
+    batch timestamp lane — engine/engine.py:MICRO_STAGE_ROWS), so batch
+    N+1's assembly happens on the submitters' threads while batch N is in
+    flight, and flush-time "assembly" collapses to one device upload.
+    Padding lanes carry their fill values permanently: a take hands the
+    staged buffer to the dispatch as-is, and recycling re-fills only the
+    lanes a batch actually used.  The per-request Python lists that
+    remain (futures/deadlines/t_sub) are host-resolution bookkeeping the
+    device never sees.
+    """
+
+    __slots__ = ("buf", "n", "futures", "deadlines", "t_sub", "clears",
+                 "born")
 
     #: Parallel per-request lists that shed/forget filtering must keep
-    #: in lockstep.
-    LANES = ("slots", "lids", "permits", "futures", "deadlines", "t_sub")
+    #: in lockstep with the staging-buffer lanes.
+    LISTS = ("futures", "deadlines", "t_sub")
 
-    def __init__(self):
-        self.slots: List[int] = []
-        self.lids: List[int] = []
-        self.permits: List[int] = []
+    def __init__(self, cap: int = _STAGE_CAP):
+        self.buf = np.empty((4, cap), dtype=np.int64)
+        self.buf[0] = -1  # slots   (pad: masked lane)
+        self.buf[1] = 0   # lids
+        self.buf[2] = 1   # permits
+        self.buf[3, 0] = 0  # batch timestamp (stamped at dispatch)
+        self.n = 0
         self.futures: List[Future] = []
         self.deadlines: List[float] = []  # monotonic queue deadlines (inf=none)
         self.t_sub: List[float] = []      # perf_counter at submit (tracing)
         self.clears: List[int] = []
         self.born: float | None = None  # monotonic time of oldest request
+
+    @property
+    def cap(self) -> int:
+        return self.buf.shape[1]
+
+    def append(self, slot: int, lid: int, permits: int) -> None:
+        i = self.n
+        if i == self.cap:
+            self._grow(self.cap * 2)
+        self.buf[0, i] = slot
+        self.buf[1, i] = lid
+        self.buf[2, i] = permits
+        self.n = i + 1
+
+    def extend(self, slots, lids, permits) -> None:
+        i, n = self.n, len(slots)
+        need = i + n
+        if need > self.cap:
+            grown = self.cap * 2
+            while grown < need:
+                grown *= 2
+            self._grow(grown)
+        self.buf[0, i:need] = slots
+        self.buf[1, i:need] = lids
+        self.buf[2, i:need] = permits
+        self.n = need
+
+    def _grow(self, cap: int) -> None:
+        new = np.empty((4, cap), dtype=np.int64)
+        new[0] = -1
+        new[1] = 0
+        new[2] = 1
+        new[:, : self.n] = self.buf[:, : self.n]
+        self.buf = new
+
+    def slot_list(self) -> List[int]:
+        return self.buf[0, : self.n].tolist()
+
+    def compact(self, keep: List[int]) -> None:
+        """Keep only the requests at the given indices (shed/forget),
+        restoring padding fills behind the new tail."""
+        k = len(keep)
+        if k:
+            idx = np.asarray(keep, dtype=np.int64)
+            for row, _fill in ((0, -1), (1, 0), (2, 1)):
+                self.buf[row, :k] = self.buf[row, idx]
+        self.buf[0, k: self.n] = -1
+        self.buf[1, k: self.n] = 0
+        self.buf[2, k: self.n] = 1
+        self.n = k
+        for name in self.LISTS:
+            vals = getattr(self, name)
+            setattr(self, name, [vals[i] for i in keep])
+
+    def recycle(self) -> None:
+        """Reset for reuse as the next standby buffer.  New list objects:
+        the drain pipeline still holds the dispatched batch's futures."""
+        self.buf[0, : self.n] = -1
+        self.buf[1, : self.n] = 0
+        self.buf[2, : self.n] = 1
+        self.n = 0
+        self.futures = []
+        self.deadlines = []
+        self.t_sub = []
+        self.clears = []
+        self.born = None
 
 
 class MicroBatcher:
@@ -87,16 +189,28 @@ class MicroBatcher:
         dispatch: Dict[str, Callable],      # algo -> fn(slots, lids, permits) -> handle
         clear: Dict[str, Callable],         # algo -> fn(slots) -> None
         drain: Dict[str, Callable] | None = None,  # algo -> fn(handle, n) -> dict
+        dispatch_staged: Dict[str, Callable] | None = None,
         max_batch: int = 8192,
         max_delay_ms: float = 0.5,
         max_inflight: int = 4,
         max_pending: int = 0,
         deadline_ms: float = 0.0,
+        controller=None,
         meter_registry=None,
         tracer=None,
         recorder=None,
     ):
         self._dispatch = dispatch
+        # Staged fast path (r11): algo -> fn(staged_buf, n) -> handle.
+        # The flusher hands queued batches over as the pre-packed
+        # combined staging buffer (see _Pending) instead of three Python
+        # lists; callers without one (tests, simple backends,
+        # dispatch_direct) keep the list contract.
+        self._dispatch_staged = dispatch_staged or {}
+        # Adaptive flush control (engine/flush_control.py): when present,
+        # the flusher reads its applied deadline/size trigger each cycle
+        # and the drain feeds it the measured device-step time.
+        self._controller = controller
         # Without a drain fn the dispatch result IS the output dict
         # (synchronous mode — tests and simple backends).
         self._drain = drain or {}
@@ -136,6 +250,12 @@ class MicroBatcher:
             if meter_registry is not None else None)
         self._cv = threading.Condition()
         self._pending: Dict[str, _Pending] = {a: _Pending() for a in dispatch}
+        # Recycled standby staging buffers (the other half of the double
+        # buffer): _take swaps one in, the flusher returns the dispatched
+        # one once its upload completed.  Oversized buffers from a burst
+        # are dropped instead of pooled.
+        self._spare: Dict[str, List[_Pending]] = {a: [] for a in dispatch}
+        self._spare_cap_max = max(2 * self.max_batch, 4 * _STAGE_CAP)
         self._waiters: Set[Future] = set()  # every unresolved submit future
         self._dispatch_lock = threading.Lock()  # serializes device batches
         self._closed = False
@@ -179,46 +299,81 @@ class MicroBatcher:
                     "flusher thread died; nothing will dispatch this queue",
                     reason="flusher_dead", retry_after_ms=1000.0)
             pend = self._pending[algo]
-            if self.max_pending and len(pend.slots) >= self.max_pending:
-                self.shed_total += 1
-                self.last_shed_s = time.monotonic()
-                if self._shed_counter is not None:
-                    self._shed_counter.increment()
-                if self._recorder is not None:
-                    self._recorder.record(
-                        "overload.shed", coalesce_ms=1000.0,
-                        reason="queue_full", depth=len(pend.slots))
-                # The queue drains one max_batch per dispatch cycle; a
-                # rough cycle estimate keeps the hint cheap and honest.
-                cycles = max(len(pend.slots) / max(self.max_batch, 1), 1.0)
-                raise OverloadedError(
-                    f"pending queue full ({len(pend.slots)} >= "
-                    f"{self.max_pending})", reason="queue_full",
-                    retry_after_ms=cycles * max(self.max_delay_s * 1000.0,
-                                                1.0))
+            self._check_admission(pend, 1)
             if pend.born is None:
                 pend.born = time.monotonic()
             budget = self.deadline_ms if deadline_ms is None else deadline_ms
-            pend.slots.append(slot)
-            pend.lids.append(lid)
-            pend.permits.append(permits)
+            pend.append(slot, lid, permits)
             pend.futures.append(fut)
             pend.deadlines.append(
                 time.monotonic() + budget / 1000.0 if budget and budget > 0
                 else math.inf)
             pend.t_sub.append(time.perf_counter())
-            if len(pend.slots) > self.max_depth_seen:
-                self.max_depth_seen = len(pend.slots)
+            if pend.n > self.max_depth_seen:
+                self.max_depth_seen = pend.n
             self._waiters.add(fut)
             self._cv.notify()
         return fut
+
+    def _check_admission(self, pend: _Pending, incoming: int) -> None:
+        """Queue-full shed check (cv held)."""
+        if not self.max_pending or pend.n + incoming <= self.max_pending:
+            return
+        self.shed_total += incoming
+        self.last_shed_s = time.monotonic()
+        if self._shed_counter is not None:
+            self._shed_counter.add(incoming)
+        if self._recorder is not None:
+            self._recorder.record(
+                "overload.shed", coalesce_ms=1000.0,
+                reason="queue_full", depth=pend.n)
+        # The queue drains one max_batch per dispatch cycle; a rough
+        # cycle estimate keeps the hint cheap and honest.
+        cycles = max(pend.n / max(self.max_batch, 1), 1.0)
+        raise OverloadedError(
+            f"pending queue full ({pend.n} >= {self.max_pending})",
+            reason="queue_full",
+            retry_after_ms=cycles * max(self.max_delay_s * 1000.0, 1.0))
+
+    def submit_many(self, algo: str, slots, lids, permits,
+                    deadline_ms: float | None = None) -> List[Future]:
+        """Bulk :meth:`submit` for a pipelined burst whose slots were
+        assigned in one batched index call (storage.acquire_async_many):
+        one cv acquisition and three vectorized staging-buffer writes
+        instead of a Python round trip per request.  All-or-nothing
+        admission: a burst that would cross ``max_pending`` is shed
+        whole."""
+        n = len(slots)
+        futs = [Future() for _ in range(n)]
+        with self._cv:
+            if self._closed:
+                raise ShutdownError("batcher closed")
+            if self._flusher_dead:
+                raise OverloadedError(
+                    "flusher thread died; nothing will dispatch this queue",
+                    reason="flusher_dead", retry_after_ms=1000.0)
+            pend = self._pending[algo]
+            self._check_admission(pend, n)
+            if pend.born is None:
+                pend.born = time.monotonic()
+            budget = self.deadline_ms if deadline_ms is None else deadline_ms
+            deadline = (time.monotonic() + budget / 1000.0
+                        if budget and budget > 0 else math.inf)
+            pend.extend(slots, lids, permits)
+            pend.futures.extend(futs)
+            pend.deadlines.extend([deadline] * n)
+            pend.t_sub.extend([time.perf_counter()] * n)
+            if pend.n > self.max_depth_seen:
+                self.max_depth_seen = pend.n
+            self._waiters.update(futs)
+            self._cv.notify()
+        return futs
 
     def queue_depth(self) -> int:
         """Largest per-algo pending queue (the admission-control bound's
         operand), for health reporting."""
         with self._cv:
-            return max((len(p.slots) for p in self._pending.values()),
-                       default=0)
+            return max((p.n for p in self._pending.values()), default=0)
 
     def add_clear(self, algo: str, slot: int) -> None:
         """Schedule a slot zeroing ahead of the next batch (eviction)."""
@@ -232,7 +387,7 @@ class MicroBatcher:
     def pending_slots(self, algo: str) -> Set[int]:
         """Slots referenced by queued requests (pin set for eviction)."""
         with self._cv:
-            return set(self._pending[algo].slots)
+            return set(self._pending[algo].slot_list())
 
     def pending_slots_sharded(self, algo: str,
                               slots_per_shard: int) -> Dict[int, Set[int]]:
@@ -242,7 +397,7 @@ class MicroBatcher:
         shard per chunk."""
         out: Dict[int, Set[int]] = {}
         with self._cv:
-            for g in self._pending[algo].slots:
+            for g in self._pending[algo].slot_list():
                 out.setdefault(g // slots_per_shard,
                                set()).add(g % slots_per_shard)
         return out
@@ -264,10 +419,8 @@ class MicroBatcher:
                 keep = [i for i, f in enumerate(pend.futures)
                         if f not in targets]
                 removed.extend(f for f in pend.futures if f in targets)
-                for name in _Pending.LANES:
-                    vals = getattr(pend, name)
-                    setattr(pend, name, [vals[i] for i in keep])
-                if not pend.slots and not pend.clears:
+                pend.compact(keep)
+                if not pend.n and not pend.clears:
                     # An empty queue must not keep waking the flusher.
                     pend.born = None
             for fut in removed:
@@ -279,11 +432,26 @@ class MicroBatcher:
 
     # -- flushing -------------------------------------------------------------
     def _take(self, algo: str) -> _Pending | None:
+        """Swap the active staging buffer out (cv held): the taken batch
+        is already packed; the standby buffer (recycled from a previous
+        dispatch when one is available) starts filling immediately."""
         pend = self._pending[algo]
-        if not pend.slots and not pend.clears:
+        if not pend.n and not pend.clears:
             return None
-        self._pending[algo] = _Pending()
+        spare = self._spare[algo]
+        self._pending[algo] = spare.pop() if spare else _Pending()
         return pend
+
+    def _recycle(self, algo: str, pend: _Pending) -> None:
+        """Return a dispatched batch's staging buffer to the standby pool
+        (its device upload has completed — the dispatch call copies)."""
+        if pend.cap > self._spare_cap_max:
+            return  # burst-grown buffer: let it go instead of pinning RAM
+        pend.recycle()
+        with self._cv:
+            spare = self._spare.get(algo)
+            if spare is not None and len(spare) < 2:
+                spare.append(pend)
 
     def flush(self) -> None:
         """Dispatch everything pending (admin/reset/shutdown and read
@@ -307,7 +475,7 @@ class MicroBatcher:
             self._waiters.discard(fut)
 
     def _resolve(self, algo: str, handle, futures: List[Future],
-                 stamps=None) -> None:
+                 stamps=None, pend: "_Pending | None" = None) -> None:
         """Fetch a dispatched batch's results and resolve its futures.
 
         ``stamps`` is the lifecycle-tracing tuple ``(t_sub_list, t_take,
@@ -319,6 +487,10 @@ class MicroBatcher:
             drain = self._drain.get(algo)
             out = drain(handle, len(futures)) if drain else handle
             t_dev = time.perf_counter()
+            if self._controller is not None and stamps is not None:
+                # Adaptive flush feedback: the measured device stage
+                # (dispatch enqueued -> results fetched) for this batch.
+                self._controller.observe(t_dev - stamps[2], len(futures))
             for i, fut in enumerate(futures):
                 if not fut.done():  # close() may have failed it already
                     fut.set_result({k: v[i] for k, v in out.items()})
@@ -337,14 +509,20 @@ class MicroBatcher:
                     log.exception("latency tracer failed (ignored)")
         finally:
             self._finish(futures)
+            if pend is not None:
+                # The fetch completed, so the device is done reading the
+                # staged buffer (the jit call may alias the host numpy
+                # memory zero-copy — recycling any earlier would corrupt
+                # an in-flight batch).
+                self._recycle(algo, pend)
 
     def _enqueue_drain(self, algo: str, handle, futures: List[Future],
-                       stamps=None) -> None:
+                       stamps=None, pend: "_Pending | None" = None) -> None:
         self._inflight_sem.acquire()  # backpressure on the device queue
 
         def job():
             try:
-                self._resolve(algo, handle, futures, stamps)
+                self._resolve(algo, handle, futures, stamps, pend)
             finally:
                 self._inflight_sem.release()
 
@@ -381,9 +559,7 @@ class MicroBatcher:
         log.warning("shed %d queued request(s): queue deadline exceeded "
                     "before dispatch%s", n,
                     " (watchdog)" if in_queue else "")
-        for name in _Pending.LANES:
-            vals = getattr(pend, name)
-            setattr(pend, name, [vals[i] for i in keep])
+        pend.compact(keep)
         exc = OverloadedError(
             "queue deadline exceeded before dispatch", reason="deadline",
             retry_after_ms=max(self.max_delay_s * 1000.0, 1.0))
@@ -396,20 +572,52 @@ class MicroBatcher:
                 continue
             self._shed_expired(pend, time.monotonic())
             t_take = time.perf_counter()  # assembly starts (tracing)
+            staged_fn = self._dispatch_staged.get(algo)
             try:
                 if pend.clears:
                     self._clear[algo](pend.clears)
-                if pend.slots:
+                if pend.n:
                     log.debug("dispatch algo=%s batch=%d clears=%d",
-                              algo, len(pend.slots), len(pend.clears))
-                    handle = self._dispatch[algo](
-                        pend.slots, pend.lids, pend.permits)
-                    self._enqueue_drain(
-                        algo, handle, pend.futures,
-                        (pend.t_sub, t_take, time.perf_counter()))
+                              algo, pend.n, len(pend.clears))
+                    if staged_fn is not None:
+                        # Staged fast path: the batch was packed at
+                        # submit time; hand the combined buffer over
+                        # whole (one upload inside).
+                        handle = staged_fn(pend.buf, pend.n)
+                    else:
+                        handle = self._dispatch[algo](
+                            pend.buf[0, :pend.n].tolist(),
+                            pend.buf[1, :pend.n].tolist(),
+                            pend.buf[2, :pend.n].tolist())
+                    futures = pend.futures
+                    stamps = (pend.t_sub, t_take, time.perf_counter())
+                    # The staging buffer recycles at DRAIN time (the jit
+                    # call may alias the host numpy memory zero-copy —
+                    # it is free only once the results were fetched).
+                    # With no other batch in flight, the drain-pool
+                    # handoff (task queue + worker wake) is pure added
+                    # latency — the fetch releases the GIL anyway, and
+                    # in a request-response loop the next submissions
+                    # only arrive AFTER these futures resolve.  Resolve
+                    # inline; pipelined load keeps the pool.
+                    recycled = pend if staged_fn is not None else None
+                    if (staged_fn is not None
+                            and self._inflight_sem._value
+                            >= self.max_inflight
+                            and self._inflight_sem.acquire(blocking=False)):
+                        try:
+                            self._resolve(algo, handle, futures, stamps,
+                                          recycled)
+                        finally:
+                            self._inflight_sem.release()
+                    else:
+                        self._enqueue_drain(algo, handle, futures, stamps,
+                                            recycled)
+                elif staged_fn is not None:
+                    self._recycle(algo, pend)
             except Exception as exc:  # noqa: BLE001 — fail every waiter
                 log.warning("dispatch failed algo=%s batch=%d: %s",
-                            algo, len(pend.slots), exc)
+                            algo, pend.n, exc)
                 for fut in pend.futures:
                     if not fut.done():
                         fut.set_exception(exc)
@@ -448,8 +656,7 @@ class MicroBatcher:
                     self._shed_expired(pend, now, in_queue=True)
                 if self._depth_gauge is not None:
                     self._depth_gauge.set(max(
-                        (len(p.slots) for p in self._pending.values()),
-                        default=0))
+                        (p.n for p in self._pending.values()), default=0))
                 if not self._flusher_dead and not self._flusher.is_alive():
                     self._flusher_dead = True
                 if self._flusher_dead:
@@ -486,14 +693,34 @@ class MicroBatcher:
                 while not self._closed:
                     now = time.monotonic()
                     ready, wait = [], None
+                    # Adaptive flush (engine/flush_control.py): the
+                    # controller's applied deadline/size trigger replace
+                    # the static bounds, re-read every cycle; both are
+                    # clamped so they never exceed the configured ones.
+                    # Pacing the flush against the device-step time only
+                    # pays while the device pipeline is OCCUPIED (a
+                    # flush faster than the service rate just queues at
+                    # the dispatch lock); with every in-flight slot free
+                    # the wait is pure added latency, so an idle device
+                    # flushes at the controller's floor.
+                    if self._controller is not None:
+                        idle = (self._inflight_sem._value
+                                >= self.max_inflight)
+                        delay_s = min(self._controller.floor_s if idle
+                                      else self._controller.delay_s(),
+                                      self.max_delay_s)
+                        trigger = min(self._controller.size_trigger(),
+                                      self.max_batch)
+                    else:
+                        delay_s, trigger = self.max_delay_s, self.max_batch
                     for algo, pend in self._pending.items():
                         if pend.born is None:
                             continue
                         age = now - pend.born
-                        if len(pend.slots) >= self.max_batch or age >= self.max_delay_s:
+                        if pend.n >= trigger or age >= delay_s:
                             ready.append(algo)
                         else:
-                            remaining = self.max_delay_s - age
+                            remaining = delay_s - age
                             wait = remaining if wait is None else min(wait, remaining)
                     if ready:
                         # Deadline hit — but if a dispatch is mid-flight,

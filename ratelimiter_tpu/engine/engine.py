@@ -60,6 +60,39 @@ _MIN_BATCH = 256
 # lanes.  Streams never see these shapes (their chunks are >= 2^19).
 _MICRO_FLOOR = 32
 
+# Staged micro-batch layout (r11): one i64[4, B] host buffer carries the
+# whole batch — row 0 slots (pad -1), row 1 limiter ids (pad 0), row 2
+# permits (pad 1), row 3 lane 0 the batch timestamp.  One device_put per
+# dispatch instead of four: on the CPU backend each small-array put costs
+# ~50-70 us of runtime overhead regardless of size, and four of them were
+# most of the 0.88 ms assembly stage the latency SLO missed on.
+MICRO_STAGE_ROWS = 4
+
+
+def _sw_micro_step_combined(state, tarrs, staged):
+    return sw_step_fused(state, tarrs,
+                         staged[0].astype(jnp.int32),
+                         staged[1].astype(jnp.int32),
+                         staged[2], staged[3, 0])
+
+
+def _tb_micro_step_combined(state, tarrs, staged):
+    return tb_step_fused(state, tarrs,
+                         staged[0].astype(jnp.int32),
+                         staged[1].astype(jnp.int32),
+                         staged[2], staged[3, 0])
+
+
+# Module-level jitted singletons, NOT per-engine closures: jax's tracing
+# and executable caches key on the underlying function identity, so every
+# DeviceEngine in a process shares one compile per (algo, bucket, table
+# shape) — a per-engine closure would re-trace (~0.3 s) and possibly
+# re-compile on every storage construction.
+_MICRO_STEPS = {
+    "sw": jax.jit(_sw_micro_step_combined, donate_argnums=0),
+    "tb": jax.jit(_tb_micro_step_combined, donate_argnums=0),
+}
+
 
 def _bucket_size(n: int) -> int:
     size = _MICRO_FLOOR
@@ -108,8 +141,10 @@ class DeviceEngine:
         self.tb_packed = make_tb_packed(self.num_slots)
         # Fused steps return all outputs in one array — one D2H transfer per
         # batch instead of four (the transfer-latency fix; ops/packed.py).
-        self._sw_step = jax.jit(sw_step_fused, donate_argnums=0)
-        self._tb_step = jax.jit(tb_step_fused, donate_argnums=0)
+        # The micro path runs them through the COMBINED staged form
+        # (_micro_step: one i64[4, B] upload carries slots/lids/permits/
+        # now) so the list and staged dispatch surfaces share one
+        # compiled executable per (algo, bucket).
         self._sw_scan = jax.jit(sw_scan_bits, donate_argnums=0)
         self._tb_scan = jax.jit(tb_scan_bits, donate_argnums=0)
         self._sw_flat = jax.jit(sw_flat_bits, donate_argnums=0)
@@ -198,22 +233,28 @@ class DeviceEngine:
     # split is what lets the micro-batcher keep several batches in flight:
     # the next dispatch runs while previous fetches are still on the wire.
 
+    def _acquire_dispatch(self, algo: str, slots, limiter_ids, permits,
+                          now_ms: int):
+        """List-surface dispatch: stage the batch into a combined buffer
+        and run the same staged step the micro-batcher's flusher uses —
+        one upload, one cached executable per (algo, bucket)."""
+        n = len(slots)
+        size = _bucket_size(n)
+        staged = np.empty((MICRO_STAGE_ROWS, size), dtype=np.int64)
+        staged[0] = -1
+        staged[1] = 0
+        staged[2] = 1
+        staged[0, :n] = np.asarray(slots, dtype=np.int64)
+        staged[1, :n] = np.asarray(limiter_ids, dtype=np.int64)
+        staged[2, :n] = np.asarray(permits, dtype=np.int64)
+        staged[3, 0] = now_ms
+        return self.micro_staged_dispatch(algo, staged, n)
+
     def sw_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
         """Dispatch a sliding-window batch; returns a lazy fused handle
         (pass to :meth:`sw_acquire_drain` with the batch length)."""
-        self._mark("sw", slots)
-        size = _bucket_size(len(slots))
-        with self._lock:
-            new_state, packed = self._sw_step(
-                self.sw_packed,
-                self.table.device_arrays,
-                _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
-                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-                _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
-                jnp.int64(now_ms),
-            )
-            self.sw_packed = new_state
-        return packed
+        return self._acquire_dispatch("sw", slots, limiter_ids, permits,
+                                      now_ms)
 
     @staticmethod
     def sw_acquire_drain(handle, n: int):
@@ -226,19 +267,8 @@ class DeviceEngine:
         return self.sw_acquire_drain(handle, len(slots))
 
     def tb_acquire_dispatch(self, slots, limiter_ids, permits, now_ms: int):
-        self._mark("tb", slots)
-        size = _bucket_size(len(slots))
-        with self._lock:
-            new_state, packed = self._tb_step(
-                self.tb_packed,
-                self.table.device_arrays,
-                _pad_i32(np.asarray(slots, dtype=np.int32), size, -1),
-                _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0),
-                _pad_i64(np.asarray(permits, dtype=np.int64), size, 1),
-                jnp.int64(now_ms),
-            )
-            self.tb_packed = new_state
-        return packed
+        return self._acquire_dispatch("tb", slots, limiter_ids, permits,
+                                      now_ms)
 
     @staticmethod
     def tb_acquire_drain(handle, n: int):
@@ -247,6 +277,51 @@ class DeviceEngine:
     def tb_acquire(self, slots, limiter_ids, permits, now_ms: int):
         handle = self.tb_acquire_dispatch(slots, limiter_ids, permits, now_ms)
         return self.tb_acquire_drain(handle, len(slots))
+
+    # -- staged micro-batch dispatch (double-buffered assembly, r11) ----------
+    # The micro-batcher packs requests into an i64[4, cap] staging buffer
+    # AT SUBMIT TIME (engine/batcher.py:_Pending), so by flush time the
+    # batch is already laid out and dispatch is one upload + one cached
+    # jit call.  Layout: MICRO_STAGE_ROWS doc at the top of this module.
+
+    def micro_staged_dispatch(self, algo: str, staged: np.ndarray, n: int):
+        """Dispatch a pre-staged micro-batch: ``staged`` is the combined
+        i64[4, cap] host buffer (cap a pow2 >= _MICRO_FLOOR, padding lanes
+        already holding their fill values, timestamp at [3, 0]); ``n`` is
+        the live lane count.  Returns the lazy fused handle for
+        :meth:`micro_staged_drain`.  The device copy happens outside the
+        engine lock so a staged upload overlaps a concurrent dispatch."""
+        size = _bucket_size(n)
+        if size != staged.shape[1]:
+            staged = np.ascontiguousarray(staged[:, :size])
+        self._mark(algo, staged[0, :n])
+        step = _MICRO_STEPS[algo]
+        # The staged numpy buffer goes to the jit call DIRECTLY (~30 us
+        # vs ~100 us via an explicit device_put first — the §6b
+        # committed-array trap).  On CPU the call may ALIAS the host
+        # memory zero-copy: the caller must not mutate the buffer until
+        # the batch's results were fetched (the batcher recycles staging
+        # buffers at drain time for exactly this reason).
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, packed = step(
+                    self.sw_packed, self.table.device_arrays, staged)
+            else:
+                self.tb_packed, packed = step(
+                    self.tb_packed, self.table.device_arrays, staged)
+        return packed
+
+    @staticmethod
+    def micro_staged_drain(algo: str, handle, n: int):
+        decode = decode_sw_fused if algo == "sw" else decode_tb_fused
+        return decode(np.asarray(handle)[:, :n])
+
+    @staticmethod
+    def micro_compile_count() -> int:
+        """Number of compiled micro-step signatures (staged path,
+        process-wide — the steps are module-level singletons), for the
+        no-recompile steady-state assertion in bench/device_only.py."""
+        return sum(fn._cache_size() for fn in _MICRO_STEPS.values())
 
     # -- scan dispatch (K sub-batches, bit-packed decisions) -------------------
     # The hyperscale streaming path: one device dispatch for K*B decisions,
@@ -660,20 +735,38 @@ class DeviceEngine:
             else:
                 self.tb_packed = self.tb_packed.at[idx].set(vals)
 
-    def warm_micro_shapes(self, algos=("sw", "tb")) -> None:
-        """Pre-compile the dedicated small-shape step (the _MICRO_FLOOR
-        bucket) so an interactive deployment's first micro-batch doesn't
-        pay its XLA compile inside a caller's latency budget.  The warm
-        batch is one padding lane (slot -1): every kernel masks it out
-        and the journal filters it, so no state or replication traffic
-        is touched."""
+    def warm_micro_shapes(self, algos=("sw", "tb"),
+                          sizes=(32, 64, 128)) -> None:
+        """Pre-compile the small-shape micro steps so an interactive
+        deployment's first micro-batch doesn't pay an XLA compile inside
+        a caller's latency budget.  Warms the legacy list path at the
+        _MICRO_FLOOR bucket AND the staged combined path at every size in
+        ``sizes`` — dispatched twice per size from two distinct staging
+        buffers, mirroring the batcher's double-buffered assembly, so the
+        steady-state micro loop never compiles (asserted by
+        bench/device_only.py).  Warm batches are all padding lanes
+        (slot -1): every kernel masks them out and the journal filters
+        them, so no state or replication traffic is touched."""
         for algo in algos:
-            if algo == "sw":
-                self.sw_acquire_drain(
-                    self.sw_acquire_dispatch([-1], [0], [1], 0), 1)
-            else:
-                self.tb_acquire_drain(
-                    self.tb_acquire_dispatch([-1], [0], [1], 0), 1)
+            for size in sizes:
+                # Both in-flight buffers of the double-buffered assembly:
+                # identical shape (the compile cache is keyed on it), but
+                # dispatching from two distinct host arrays proves the
+                # staged path is buffer-identity-agnostic at warm time.
+                for _ in range(2):
+                    staged = np.empty((MICRO_STAGE_ROWS, size),
+                                      dtype=np.int64)
+                    staged[0] = -1
+                    staged[1] = 0
+                    staged[2] = 1
+                    staged[3, 0] = 0
+                    # n == size so the dispatch buckets AT this size
+                    # (a smaller n would slice down to the floor bucket
+                    # and warm only that one shape).
+                    self.micro_staged_drain(
+                        algo,
+                        self.micro_staged_dispatch(algo, staged, size),
+                        size)
 
     def block_until_ready(self) -> None:
         with self._lock:

@@ -47,6 +47,14 @@ from typing import Optional, Sequence
 
 STAGES = ("queue_wait", "assembly", "device", "resolve", "total")
 
+#: Assembly sub-stages (r11), mirroring the stream path's
+#: pack/index/layout split: where inside the assembly stage a
+#: micro-batch's microseconds go.  ``pack`` = host staging-buffer
+#: finalize + eviction clears at take, ``index`` = per-request key->slot
+#: assignment (recorded at submit, the only per-request piece),
+#: ``layout`` = device placement + step enqueue.
+ASSEMBLY_SUBSTAGES = ("pack", "index", "layout")
+
 
 class LatencyTracer:
     """Aggregates batcher lifecycle timestamps into stage histograms."""
@@ -59,10 +67,20 @@ class LatencyTracer:
                 f"Request lifecycle: {stage} stage (us)")
             for stage in STAGES
         }
+        self._sub = {
+            stage: registry.timer(
+                f"ratelimiter.latency.assembly.{stage}",
+                f"Micro-batch assembly sub-stage: {stage} (us)")
+            for stage in ASSEMBLY_SUBSTAGES
+        }
         self._trace = trace
         self._sample_n = max(int(sample_n), 0)
         self._tick = 0          # requests since the last sampled trace
         self._recorder = recorder
+
+    def record_sub(self, stage: str, us: float) -> None:
+        """One assembly sub-stage sample (storage dispatch path)."""
+        self._sub[stage].record_us(us)
 
     def observe_batch(self, algo: str, out: Optional[dict],
                       t_subs: Sequence[float], t_take: float,

@@ -77,6 +77,25 @@ DEFAULTS = {
     "ratelimiter.sidecar.read_timeout_ms": "5000",
     "ratelimiter.sidecar.resolve_timeout_ms": "30000",
     "ratelimiter.sidecar.drain_timeout_ms": "1000",
+    # Micro-batch assembly (r11, ARCHITECTURE §6d).  adaptive_flush: the
+    # flush deadline/size trigger track the measured device-step time
+    # (engine/flush_control.py), hard-clamped within
+    # [flush_floor_ms, batcher.max_delay_ms] / [32, batcher.max_batch].
+    "ratelimiter.microbatch.adaptive_flush": "true",
+    "ratelimiter.microbatch.flush_floor_ms": "0.05",
+    # Hybrid host-side serving tier (cache/hybrid.py): answers hot
+    # repeat-reject and safely-under-limit keys host-side from exact
+    # adopted state, device-confirmed asynchronously; over-admission
+    # bounded like the degraded path (one extra max_permits per key per
+    # window, worst case).  OFF by default.  ttl_ms bounds staleness
+    # since the last device confirmation; unconfirmed_cap bounds
+    # forwarded-but-unconfirmed mutations per key; guard_ms refuses
+    # host serves in the last slice of a sliding window.
+    "ratelimiter.cache.hybrid.enabled": "false",
+    "ratelimiter.cache.hybrid.ttl_ms": "50",
+    "ratelimiter.cache.hybrid.max_keys": "65536",
+    "ratelimiter.cache.hybrid.unconfirmed_cap": "64",
+    "ratelimiter.cache.hybrid.guard_ms": "5",
     # Observability (observability/, ARCHITECTURE §13).  trace_sample:
     # record one full per-request lifecycle trace per ~N requests into
     # the enriched /actuator/trace ring (0 = off).  slo_ms: any dispatch
@@ -159,6 +178,8 @@ _INT_KEYS = (
     "ratelimiter.obs.flight_capacity",
     "ratelimiter.orchestrator.suspect_threshold",
     "ratelimiter.orchestrator.promote_retries",
+    "ratelimiter.cache.hybrid.max_keys",
+    "ratelimiter.cache.hybrid.unconfirmed_cap",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -174,12 +195,17 @@ _FLOAT_KEYS = (
     "ratelimiter.orchestrator.probe_interval_ms",
     "ratelimiter.orchestrator.hysteresis_ms",
     "ratelimiter.orchestrator.promote_backoff_ms",
+    "ratelimiter.microbatch.flush_floor_ms",
+    "ratelimiter.cache.hybrid.ttl_ms",
+    "ratelimiter.cache.hybrid.guard_ms",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
     "link.probe.enabled", "breaker.enabled", "ratelimiter.degraded.enabled",
     "ratelimiter.sidecar.enabled", "ratelimiter.orchestrator.enabled",
     "ratelimiter.orchestrator.reseed",
+    "ratelimiter.microbatch.adaptive_flush",
+    "ratelimiter.cache.hybrid.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

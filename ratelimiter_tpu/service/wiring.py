@@ -212,6 +212,21 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
             # sampling + the slow-dispatch anomaly threshold.
             trace_sample=props.get_int("ratelimiter.obs.trace_sample", 0),
             obs_slo_ms=props.get_float("ratelimiter.obs.slo_ms", 0.0),
+            # Adaptive flush + hybrid serving tier (ARCHITECTURE §6d).
+            adaptive_flush=props.get_bool(
+                "ratelimiter.microbatch.adaptive_flush", True),
+            flush_floor_ms=props.get_float(
+                "ratelimiter.microbatch.flush_floor_ms", 0.05),
+            serving_cache=props.get_bool(
+                "ratelimiter.cache.hybrid.enabled", False),
+            serving_cache_ttl_ms=props.get_float(
+                "ratelimiter.cache.hybrid.ttl_ms", 50.0),
+            serving_cache_max_keys=props.get_int(
+                "ratelimiter.cache.hybrid.max_keys", 65536),
+            serving_cache_unconfirmed_cap=props.get_int(
+                "ratelimiter.cache.hybrid.unconfirmed_cap", 64),
+            serving_cache_guard_ms=props.get_float(
+                "ratelimiter.cache.hybrid.guard_ms", 5.0),
         )
     raise ValueError(f"unknown storage.backend: {backend!r}")
 

@@ -31,6 +31,7 @@ import contextlib
 import os
 import threading
 import time
+from concurrent.futures import Future
 from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -601,6 +602,13 @@ class TpuBatchedStorage(RateLimitStorage):
         obs_slo_ms: float = 0.0,
         observability: bool = True,
         recorder=None,
+        adaptive_flush: bool = True,
+        flush_floor_ms: float = 0.05,
+        serving_cache: bool = False,
+        serving_cache_ttl_ms: float = 50.0,
+        serving_cache_max_keys: int = 65536,
+        serving_cache_unconfirmed_cap: int = 64,
+        serving_cache_guard_ms: float = 5.0,
     ):
         self._clock_ms = clock_ms
         # Observability (ARCHITECTURE §13).  The stage/latency histograms
@@ -805,35 +813,114 @@ class TpuBatchedStorage(RateLimitStorage):
 
         self._monotonic_now = _stamp
 
+        # Hybrid host-side serving tier (cache/hybrid.py, Apt-Serve
+        # shape): answers hot repeat-reject and safely-under-limit keys
+        # host-side from exact adopted per-key state, device-confirmed
+        # asynchronously.  OFF by default (ratelimiter.cache.hybrid.*
+        # wires it); None costs one falsy check per acquire.
+        self._serving = None
+        if serving_cache:
+            from ratelimiter_tpu.cache.hybrid import HybridServingCache
+
+            self._serving = HybridServingCache(
+                clock_ms=lambda: self._monotonic_now(),
+                ttl_ms=serving_cache_ttl_ms,
+                max_keys=serving_cache_max_keys,
+                unconfirmed_cap=serving_cache_unconfirmed_cap,
+                guard_ms=serving_cache_guard_ms,
+                registry=meter_registry if self._obs else None,
+            )
+
         # Dispatch/drain split (engine + batcher): the flusher only enqueues
         # device work; the drainer fetches — several batches in flight at
         # once, so fetch latency overlaps the next dispatches.
         def _dispatcher(fn):
             def run(s, l, p):
-                return (fn(s, l, p, _stamp()), time.perf_counter())
+                stamp = _stamp()
+                return (fn(s, l, p, stamp), time.perf_counter(), stamp)
 
             return run
 
-        def _drainer(algo, fn):
+        # Staged fast path (r11): the batcher hands over its pre-packed
+        # combined staging buffer; dispatch is stamp + one upload + one
+        # cached jit call, with the pack/layout sub-stages timed into
+        # the ratelimiter.latency.assembly.* histograms.
+        def _staged_dispatcher(algo):
+            micro_ok = hasattr(self.engine, "micro_staged_dispatch")
+
+            def run(buf, n):
+                tracer = self._tracer
+                t0 = time.perf_counter()
+                stamp = _stamp()
+                buf[3, 0] = stamp
+                t1 = time.perf_counter()
+                handle = self.engine.micro_staged_dispatch(algo, buf, n)
+                if tracer is not None:
+                    t2 = time.perf_counter()
+                    tracer.record_sub("pack", (t1 - t0) * 1e6)
+                    tracer.record_sub("layout", (t2 - t1) * 1e6)
+                return (handle, t1, stamp)
+
+            return run if micro_ok else None
+
+        def _drainer(algo, fn, staged_fn=None):
             def run(handle_t0, n):
-                handle, t0 = handle_t0
+                handle, t0, stamp = handle_t0
                 out = fn(handle, n)
                 dt_us = (time.perf_counter() - t0) * 1e6
                 self._record_dispatch(algo, n, int(out["allowed"].sum()),
                                       dt_us)
+                if self._serving is not None:
+                    # The hybrid serving tier needs the dispatch stamp to
+                    # adopt exact per-key state (cache/hybrid.py).
+                    out["stamp"] = np.full(n, stamp, dtype=np.int64)
                 return out
 
             return run
 
+        def _staged_drainer(algo):
+            return _drainer(
+                algo, lambda h, n: self.engine.micro_staged_drain(
+                    algo, h, n))
+
+        # The legacy list drains decode the same fused handle layout as
+        # the staged path, so one drainer per algo serves both: the
+        # flusher dispatches staged, dispatch_direct dispatches lists,
+        # and either handle round-trips through (handle, t0, stamp).
+        staged = {a: f for a, f in (("sw", _staged_dispatcher("sw")),
+                                    ("tb", _staged_dispatcher("tb")))
+                  if f is not None}
+        # Adaptive flush control (engine/flush_control.py): ON by
+        # default; the controller's applied deadline/size trigger stay
+        # hard-clamped within [flush_floor_ms, max_delay_ms] /
+        # [_MICRO_FLOOR-ish, max_batch].
+        self._flush_controller = None
+        if adaptive_flush:
+            from ratelimiter_tpu.engine.flush_control import (
+                AdaptiveFlushController,
+            )
+
+            self._flush_controller = AdaptiveFlushController(
+                base_delay_ms=max_delay_ms,
+                floor_ms=min(flush_floor_ms, max_delay_ms)
+                if max_delay_ms > 0 else flush_floor_ms,
+                cap_ms=max(max_delay_ms, flush_floor_ms),
+                size_floor=32,
+                size_cap=max_batch,
+                meter_registry=meter_registry if self._obs else None,
+            )
         self._batcher = MicroBatcher(
             dispatch={
                 "sw": _dispatcher(self.engine.sw_acquire_dispatch),
                 "tb": _dispatcher(self.engine.tb_acquire_dispatch),
             },
             drain={
-                "sw": _drainer("sw", self.engine.sw_acquire_drain),
-                "tb": _drainer("tb", self.engine.tb_acquire_drain),
+                "sw": (_staged_drainer("sw") if "sw" in staged
+                       else _drainer("sw", self.engine.sw_acquire_drain)),
+                "tb": (_staged_drainer("tb") if "tb" in staged
+                       else _drainer("tb", self.engine.tb_acquire_drain)),
             },
+            dispatch_staged=staged or None,
             clear={
                 "sw": lambda slots: self._clear_slots("sw", slots),
                 "tb": lambda slots: self._clear_slots("tb", slots),
@@ -843,6 +930,7 @@ class TpuBatchedStorage(RateLimitStorage):
             max_inflight=max_inflight,
             max_pending=max_pending,
             deadline_ms=queue_deadline_ms,
+            controller=self._flush_controller,
             meter_registry=meter_registry,
             tracer=self._tracer,
             recorder=self._recorder,
@@ -884,6 +972,8 @@ class TpuBatchedStorage(RateLimitStorage):
         config.validate()
         lid = self.table.register(config)
         self._configs[lid] = (algo, config)
+        if self._serving is not None:
+            self._serving.register(lid, algo, config)
         return lid
 
     def acquire(self, algo: str, lid: int, key: str, permits: int,
@@ -902,13 +992,98 @@ class TpuBatchedStorage(RateLimitStorage):
         primitive (service/sidecar.py): a connection handler submits
         every frame of a pipelined batch before resolving any, so all
         of them coalesce into the same micro-batch flush instead of
-        paying one batcher round trip each."""
+        paying one batcher round trip each.
+
+        With the hybrid serving tier enabled, a tracked key's decision
+        may resolve host-side immediately (see cache/hybrid.py): a pure
+        reject touches no device at all; a mutating decision rides the
+        next micro-batch asynchronously as its device confirmation."""
+        serving = self._serving
+        if serving is not None:
+            fut = self._serve_host_side(algo, lid, key, permits)
+            if fut is not None:
+                return fut
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
         slot = self._assign_slot(algo, lid, key, hold_pin=True)
+        if self._tracer is not None:
+            self._tracer.record_sub(
+                "index", (time.perf_counter() - t0) * 1e6)
         # The pin (taken atomically inside the assign) holds until the
         # submit registers the slot in pending_slots.
         with self._pins_released(self._index[algo], [slot]):
-            return self._batcher.submit(algo, slot, lid, permits,
-                                        deadline_ms=deadline_ms)
+            fut = self._batcher.submit(algo, slot, lid, permits,
+                                       deadline_ms=deadline_ms)
+        if serving is not None:
+            serving.watch_miss(algo, lid, key, permits, slot, fut)
+        return fut
+
+    def _serve_host_side(self, algo: str, lid: int, key: str, permits: int):
+        """Hybrid-tier serve attempt: a resolved Future, or None (miss).
+
+        The fence/promotion checks run BEFORE the tier is consulted — a
+        host-served decision must refuse exactly where a device dispatch
+        would.  A host-served mutating decision is forwarded through the
+        normal batcher path under the tier's lock (so device order ==
+        serve order per key) and confirmed by its drain callback."""
+        self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid], [key])
+        serving = self._serving
+        with serving.lock:
+            served = serving.serve(algo, lid, key, permits)
+            if served is None:
+                return None
+            out, predicted = served
+            if predicted is not None:  # mutated host-side: confirm async
+                slot = self._assign_slot(algo, lid, key, hold_pin=True)
+                with self._pins_released(self._index[algo], [slot]):
+                    cfut = self._batcher.submit(algo, slot, lid, permits)
+                serving.watch_confirm(algo, lid, key, predicted, slot,
+                                      cfut)
+        fut: Future = Future()
+        fut.set_result(out)
+        return fut
+
+    def acquire_async_many(self, algo: str, lid: int,
+                           keys: Sequence[str], permits=None,
+                           deadline_ms: float | None = None):
+        """Bulk :meth:`acquire_async` for a pipelined burst sharing one
+        limiter: the keys hash in one windowed C pass off the interned
+        UTF-8 buffers and map in one batched slot walk
+        (native/str_pack.cpp:rl_strlist_hash_fp ->
+        rl_index_assign_fps/engine/native_index.py:assign_batch_strs),
+        then submit in one vectorized staging-buffer write — zero
+        per-request Python on the index/layout half of assembly.
+        Returns one Future per key; decisions ride the next micro-batch
+        flush together.  Falls back to per-key submits without the
+        native index.  The hybrid tier is bypassed (burst callers want
+        coalescing, not per-key host serves)."""
+        self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid] * len(keys), keys)
+        n = len(keys)
+        if permits is None:
+            permits = np.ones(n, dtype=np.int64)
+        index = self._index[algo]
+        if not hasattr(index, "assign_batch_strs"):
+            return [self.acquire_async(algo, lid, k, int(p),
+                                       deadline_ms=deadline_ms)
+                    for k, p in zip(keys, permits)]
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
+        with self._evictions_cleared(algo):
+            slots, clears = index.assign_batch_strs(
+                list(keys), lid,
+                pinned=self._batcher.pending_slots(algo),
+                hold_pins=True)
+        if self._tracer is not None:
+            self._tracer.record_sub(
+                "index", (time.perf_counter() - t0) * 1e6)
+        for evicted in clears:
+            self._batcher.add_clear(algo, int(evicted))
+        with self._pins_released(index, slots):
+            return self._batcher.submit_many(
+                algo, slots, np.full(n, lid, dtype=np.int64), permits,
+                deadline_ms=deadline_ms)
 
     def acquire_many(
         self, algo: str, lid_per_req: Sequence[int], keys: Sequence[str],
@@ -2678,6 +2853,11 @@ class TpuBatchedStorage(RateLimitStorage):
         assigned the slot before it is clean (a zeroed slot reads as absent).
         """
         index = self._index[algo]
+        if self._serving is not None:
+            # Mid-stream policy reset: the hybrid tier must forget its
+            # adopted state BEFORE the device clear so a concurrent
+            # serve can't answer from pre-reset counters.
+            self._serving.invalidate(algo, lid, key)
         if index.get((lid, key)) is None:
             return
         self._batcher.flush()
@@ -2979,6 +3159,12 @@ class TpuBatchedStorage(RateLimitStorage):
         lid must be re-uploaded on next digest use."""
         if not len(slots):
             return
+        if self._serving is not None:
+            # A cleared slot's key state is gone on device; any hybrid
+            # tier entry tracking it is stale the moment the clear is in
+            # the stream (eviction paths also invalidate at remap time —
+            # see _assign_slot — this is the stream/direct-path backstop).
+            self._serving.invalidate_slots(algo, slots)
         if self._lid_known.get(algo) is None:
             # No resident-lid tracking for this algo: nothing to
             # invalidate, so don't serialize against digest dispatches.
@@ -3076,6 +3262,9 @@ class TpuBatchedStorage(RateLimitStorage):
         self._promoting = True
         try:
             self._batcher.flush()
+            if self._serving is not None:
+                # Every adopted snapshot predates the index swap.
+                self._serving.invalidate_all()
             ckpt.restore_slot_indexes(self, index_dump)
             self._lid_known.clear()
             self.engine.block_until_ready()
@@ -3353,5 +3542,11 @@ class TpuBatchedStorage(RateLimitStorage):
         slot, evicted = index.assign((lid, key), pinned=pinned,
                                      hold_pin=hold_pin)
         if evicted is not None:
+            if self._serving is not None:
+                # Invalidate at REMAP time, not clear time: the evicted
+                # key's index entry is already gone, so a hybrid-tier
+                # serve from its adopted state would track a key the
+                # device is about to forget.
+                self._serving.invalidate_slots(algo, [evicted])
             self._batcher.add_clear(algo, evicted)
         return slot

@@ -23,6 +23,18 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
 
         jax.config.update("jax_compilation_cache_dir",
                           cache_dir or default_cache_dir())
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # 0.1 s (was 1.0): the staged micro steps compile in ~0.3-0.8 s
+        # on CPU — under the old threshold they were re-compiled every
+        # process boot, which is exactly the latency spike the warmup
+        # and the local-SLO p99 gate exist to prevent.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        # The cache module latches "disabled" the first time a compile
+        # consults it with no directory configured (_cache_initialized).
+        # A caller that builds a storage BEFORE wiring (tests, embedded
+        # use) would silently lose the cache for the whole process —
+        # reset so this configuration takes effect from now on.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
     except Exception:  # noqa: BLE001 — cache is an optimization only
         pass

@@ -107,6 +107,17 @@ else
   fail=1
 fi
 
+echo "running local latency SLO gate (p99 <= 1 ms on CPU, assembly not dominant)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
+    bench/local_latency_slo.py --assert-meets > /dev/null; then
+  echo "  ok  local latency SLO (sub-ms p99, assembly stage demoted)"
+else
+  echo "  FAILED  local latency SLO (p99 over 1 ms, or assembly is"
+  echo "          again the dominant lifecycle stage — see the bench's"
+  echo "          stderr decomposition)"
+  fail=1
+fi
+
 echo "running orchestrated failover + flap drills (self-healing, zero manual promotes)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_orchestrator.py::test_orchestrated_failover_drill_fast \
