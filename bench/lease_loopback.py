@@ -1,0 +1,201 @@
+"""Lease loopback benchmark: the wire-frame collapse, measured.
+
+Token leases (leases/, ARCHITECTURE §14) exist to stop paying one wire
+frame per decision.  This bench runs both ingress shapes over the same
+storage on loopback TCP and reports the collapse:
+
+- **v2 pass** (baseline): N pipelining clients stream per-decision
+  TRY_ACQUIRE frames through the sidecar — exactly one wire frame per
+  decision (the PR 5 ingress, i.e. today's production path);
+- **lease pass**: the same clients speak protocol v3 through a
+  ``LeaseClient``: budgets are charged once, burned locally, renewed
+  one frame per budget — wire frames per decision ~ 1/budget.
+
+``--assert-ratio`` gates BOTH claims (run by verify.sh):
+
+- >= 10x fewer wire frames per decision than the v2 pass, and
+- equal or better decision throughput (local burns are memory-speed;
+  anything less means the lease path added overhead somewhere it must
+  not).
+
+Emits one JSON line; bench.py can record it as ``lease_loopback``.
+Run with cwd=repo root:  python bench/lease_loopback.py
+Env: BENCH_SCALE=small shrinks the request count (CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_CLIENTS = 4
+PIPELINE = 64          # frames per pipelined v2 batch
+KEYS_PER_CLIENT = 8    # distinct leased keys per client (one lease each)
+BUDGET = 64
+
+
+def v2_pass(server, lid, reps: int) -> dict:
+    """Per-decision baseline: pipelined TRY_ACQUIRE, 1 frame/decision."""
+    from ratelimiter_tpu.service.sidecar import SidecarClient
+
+    barrier = threading.Barrier(N_CLIENTS + 1)
+    allowed = [0] * N_CLIENTS
+
+    def client_loop(t: int) -> None:
+        cli = SidecarClient("127.0.0.1", server.port, protocol=2)
+        try:
+            keys = [f"v2-c{t}-k{i % KEYS_PER_CLIENT}"
+                    for i in range(PIPELINE)]
+            cli.acquire_batch(lid, keys)  # warm
+            barrier.wait()
+            got = 0
+            for _ in range(reps):
+                res = cli.acquire_batch(lid, keys)
+                got += sum(1 for _, a, _ in res if a)
+            allowed[t] = got
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=client_loop, args=(t,), daemon=True)
+               for t in range(N_CLIENTS)]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    n = N_CLIENTS * reps * PIPELINE
+    return {
+        "decisions": n,
+        "allowed": sum(allowed),
+        "wall_s": round(wall, 4),
+        "decisions_per_sec": round(n / wall, 1),
+        # The v2 protocol is one frame per decision by definition.
+        "wire_frames": n,
+        "frames_per_decision": 1.0,
+    }
+
+
+def lease_pass(server, lid, reps: int) -> dict:
+    """Leased clients: local burns, one renewal frame per budget."""
+    from ratelimiter_tpu.leases import LeaseClient
+    from ratelimiter_tpu.service.sidecar import SidecarClient
+
+    barrier = threading.Barrier(N_CLIENTS + 1)
+    stats = [None] * N_CLIENTS
+    per_client = reps * PIPELINE
+
+    def client_loop(t: int) -> None:
+        wire = SidecarClient("127.0.0.1", server.port)
+        cli = LeaseClient(wire, lid, budget=BUDGET)
+        try:
+            keys = [f"ls-c{t}-k{i}" for i in range(KEYS_PER_CLIENT)]
+            assert cli.try_acquire(keys[0])  # warm (compiles the grant)
+            barrier.wait()
+            got = 0
+            for i in range(per_client):
+                if cli.try_acquire(keys[i % KEYS_PER_CLIENT]):
+                    got += 1
+            cli.release_all()
+            stats[t] = {"allowed": got, "wire": cli.wire_ops,
+                        "local": cli.local_decisions}
+        finally:
+            wire.close()
+
+    threads = [threading.Thread(target=client_loop, args=(t,), daemon=True)
+               for t in range(N_CLIENTS)]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    n = N_CLIENTS * per_client
+    wire = sum(s["wire"] for s in stats)
+    return {
+        "decisions": n,
+        "allowed": sum(s["allowed"] for s in stats),
+        "local_decisions": sum(s["local"] for s in stats),
+        "wall_s": round(wall, 4),
+        "decisions_per_sec": round(n / wall, 1),
+        "wire_frames": wire,
+        "frames_per_decision": round(wire / n, 5),
+        "budget": BUDGET,
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--assert-ratio", action="store_true",
+                        help="gate >=10x wire-frame reduction at equal or "
+                             "better decision throughput vs the v2 pass")
+    args = parser.parse_args()
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.leases import LeaseManager
+    from ratelimiter_tpu.service.sidecar import SidecarServer
+    from ratelimiter_tpu.storage import TpuBatchedStorage
+    from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
+    small = os.environ.get("BENCH_SCALE", "small") == "small"
+    reps = 30 if small else 150
+
+    storage = TpuBatchedStorage(num_slots=1 << 14, max_delay_ms=0.3,
+                                max_inflight=4)
+    server = SidecarServer(storage, host="127.0.0.1").start()
+    try:
+        lid = server.register("tb", RateLimitConfig(
+            max_permits=1 << 20, window_ms=60_000, refill_rate=1e6))
+        server.attach_leases(LeaseManager(
+            storage, default_budget=BUDGET, max_budget=BUDGET,
+            ttl_ms=60_000.0))
+        storage.warm_micro_shapes()
+
+        # Best-of-2 each (scheduler noise must not read as a regression).
+        v2 = max((v2_pass(server, lid, reps) for _ in range(2)),
+                 key=lambda r: r["decisions_per_sec"])
+        ls = max((lease_pass(server, lid, reps) for _ in range(2)),
+                 key=lambda r: r["decisions_per_sec"])
+
+        reduction = (v2["frames_per_decision"]
+                     / max(ls["frames_per_decision"], 1e-9))
+        speedup = ls["decisions_per_sec"] / max(v2["decisions_per_sec"],
+                                                1.0)
+        out = {
+            "bench": "lease_loopback",
+            "note": ("loopback TCP, CPU device in-process: measures the "
+                     "wire-frame collapse of token leases vs the "
+                     "per-decision v2 ingress over the same storage"),
+            "v2": v2,
+            "lease": ls,
+            "wire_frame_reduction": round(reduction, 1),
+            "throughput_ratio": round(speedup, 2),
+        }
+        print(json.dumps(out))
+        if args.assert_ratio:
+            assert reduction >= 10.0, (
+                f"lease wire-frame reduction {reduction:.1f}x < 10x "
+                f"(lease {ls['frames_per_decision']:.4f} frames/decision "
+                f"vs v2 {v2['frames_per_decision']:.1f})")
+            assert speedup >= 1.0, (
+                f"leased decision throughput fell to {speedup:.2f}x of "
+                f"the per-decision v2 path ({ls['decisions_per_sec']:.0f}"
+                f"/s vs {v2['decisions_per_sec']:.0f}/s)")
+    finally:
+        server.stop()
+        storage.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -674,6 +674,69 @@ class DeviceEngine:
                     now)
         return counts
 
+    # -- lease RESERVE / CREDIT (ops/lease.py; leases/) ------------------------
+    # The lease flavor of the decision dispatch: charge (or return) a
+    # per-key permit budget in one gather -> roll/refill -> greedy grant
+    # -> scatter pass, atomically under the same engine lock every other
+    # dispatch serializes through.  Rare by design (one reserve amortizes
+    # over a whole client-side budget), so these run synchronously —
+    # dispatch + fetch in one call.
+
+    def lease_reserve(self, algo: str, slots, limiter_ids, requested,
+                      now_ms: int):
+        """Atomically grant up to ``requested[i]`` permits against each
+        slot's live counters.  Returns ``(granted i64[n], ws i64[n])``
+        where ``ws`` is the window the charge landed in (sliding window;
+        zeros for the token bucket) — a later :meth:`lease_credit` must
+        present it."""
+        from ratelimiter_tpu.ops import lease as lease_ops
+
+        n = len(slots)
+        size = _bucket_size(n)
+        self._mark(algo, np.asarray(slots))
+        step = lease_ops.RESERVE_STEPS[algo]
+        slots_p = _pad_i32(np.asarray(slots, dtype=np.int32), size, -1)
+        lids_p = _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0)
+        req_p = _pad_i64(np.asarray(requested, dtype=np.int64), size, 0)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, granted, ws = step(
+                    self.sw_packed, self.table.device_arrays,
+                    slots_p, lids_p, req_p, jnp.int64(now_ms))
+            else:
+                self.tb_packed, granted, ws = step(
+                    self.tb_packed, self.table.device_arrays,
+                    slots_p, lids_p, req_p, jnp.int64(now_ms))
+        return np.asarray(granted)[:n], np.asarray(ws)[:n]
+
+    def lease_credit(self, algo: str, slots, limiter_ids, credit, grant_ws,
+                     now_ms: int) -> np.ndarray:
+        """Return unused reserved permits (lease renewal/release).
+        ``grant_ws`` is the per-lane window stamp :meth:`lease_reserve`
+        returned (sliding window: a rolled window drops the credit — the
+        charge already ages out with the window).  Returns the permits
+        actually credited per lane."""
+        from ratelimiter_tpu.ops import lease as lease_ops
+
+        n = len(slots)
+        size = _bucket_size(n)
+        self._mark(algo, np.asarray(slots))
+        step = lease_ops.CREDIT_STEPS[algo]
+        slots_p = _pad_i32(np.asarray(slots, dtype=np.int32), size, -1)
+        lids_p = _pad_i32(np.asarray(limiter_ids, dtype=np.int32), size, 0)
+        cr_p = _pad_i64(np.asarray(credit, dtype=np.int64), size, 0)
+        ws_p = _pad_i64(np.asarray(grant_ws, dtype=np.int64), size, 0)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, credited = step(
+                    self.sw_packed, self.table.device_arrays,
+                    slots_p, lids_p, cr_p, ws_p, jnp.int64(now_ms))
+            else:
+                self.tb_packed, credited = step(
+                    self.tb_packed, self.table.device_arrays,
+                    slots_p, lids_p, cr_p, ws_p, jnp.int64(now_ms))
+        return np.asarray(credited)[:n]
+
     # -- read-only ------------------------------------------------------------
     def sw_available(self, slots, limiter_ids, now_ms: int) -> np.ndarray:
         n = len(slots)
@@ -746,7 +809,15 @@ class DeviceEngine:
         steady-state micro loop never compiles (asserted by
         bench/device_only.py).  Warm batches are all padding lanes
         (slot -1): every kernel masks them out and the journal filters
-        them, so no state or replication traffic is touched."""
+        them, so no state or replication traffic is touched.
+
+        Sizes that are not dispatch buckets are ROUNDED UP to their
+        bucket (pow2 ladder from the 32-lane floor) and deduped: a warm
+        dispatch whose n is below its buffer width would slice down and
+        silently compile a lane count the batcher never produces —
+        warming the wrong executable while the real buckets still
+        compile inside the first request's latency budget."""
+        sizes = sorted({_bucket_size(max(int(n), 1)) for n in sizes})
         for algo in algos:
             for size in sizes:
                 # Both in-flight buffers of the double-buffered assembly:

@@ -164,6 +164,17 @@ class LimiterTable:
     def __len__(self) -> int:
         return self._n
 
+    def host_policy(self, lid: int):
+        """Host-side policy row ``(max_permits, window_ms, cap_fp,
+        rate_fp, ttl2_ms)`` for one limiter id — the lease host mirrors
+        (ops/lease.py) restate the device arithmetic over host rows and
+        read the policy here instead of fetching device arrays."""
+        with self._lock:
+            i = int(lid)
+            return (int(self._max_permits[i]), int(self._window_ms[i]),
+                    int(self._cap_fp[i]), int(self._rate_fp[i]),
+                    int(self._ttl2_ms[i]))
+
     @property
     def max_permits_registered(self) -> int:
         """Largest max_permits across registered policies (0 if none) —

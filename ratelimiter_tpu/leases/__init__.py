@@ -1,0 +1,28 @@
+"""Token leases: client-side enforcement with server reconciliation.
+
+The server grants a client a bounded per-key permit budget (a *lease*)
+charged atomically against the live device counters; the client burns
+it locally at memory speed and renews one wire frame per budget instead
+of one per decision — the 10-100x ingress collapse of "Rethinking HTTP
+API Rate Limiting: A Client-Side Approach" (PAPERS.md).
+
+Layers: ``ops/lease.py`` (the device RESERVE/CREDIT kernels, specified
+bit-for-bit by ``semantics/oracle.py:reserve/credit``), ``table.py``
+(host lease accounting), ``manager.py`` (grant/renew/release/revoke,
+fence-epoch integration with PR 9 failover), ``client.py`` (the local
+burner), wire protocol v3 (``service/sidecar.py``), and the chaos drill
+``storage/chaos.py:lease_failover_drill``.
+"""
+
+from ratelimiter_tpu.leases.client import DirectTransport, LeaseClient
+from ratelimiter_tpu.leases.manager import LeaseGrant, LeaseManager
+from ratelimiter_tpu.leases.table import Lease, LeaseTable
+
+__all__ = [
+    "DirectTransport",
+    "Lease",
+    "LeaseClient",
+    "LeaseGrant",
+    "LeaseManager",
+    "LeaseTable",
+]

@@ -1,0 +1,185 @@
+"""Client side of token leases: the local burner.
+
+A :class:`LeaseClient` turns "one wire frame per decision" into "one
+wire frame per budget": it holds a per-key lease (a permit budget the
+server pre-charged on the device) and answers ``try_acquire`` from host
+memory — a dict lookup and a decrement — renewing over the wire only
+when the budget runs out, the TTL expires, or the server revokes.
+
+Admission safety is the server's by construction: every locally-allowed
+permit was already charged against the device counters at grant time,
+so a crashing client can only UNDER-admit (charged-but-unburned budget,
+reclaimed by TTL/window expiry).  The over-admission window exists only
+across a failover (burns between a fence-epoch bump and the next
+renewal), bounded by the outstanding budget — which the reserve kernel
+bounded by the key's remaining-window budget.
+
+Decision semantics seen by the caller:
+
+- lease live and budget covers ``permits`` -> local ALLOW (zero wire);
+- budget exhausted / TTL passed -> one RENEW (or LEASE) round trip,
+  then the fresh budget answers;
+- server granted 0 (key contended, already leased elsewhere, fenced,
+  or over its remaining-window budget) -> the key stays on the
+  per-decision path: with ``direct_fallback=True`` (default) each
+  decision forwards to the server's ordinary TRY_ACQUIRE (the device
+  arbitrates contended keys, exactly as without leases); with
+  ``direct_fallback=False`` the client denies locally until the
+  server's retry hint elapses (strict lease-only mode — the chaos
+  drill uses it so every state mutation flows through the replayable
+  reserve/credit log).
+
+Transports are duck-typed: ``service/sidecar.py:SidecarClient`` (wire
+protocol v3) and :class:`DirectTransport` (in-process, over a
+``LeaseManager``) both provide ``lease_grant`` / ``lease_renew`` /
+``lease_release`` / ``try_acquire``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, Optional
+
+
+def _wall_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class _Local:
+    """One locally-held lease."""
+
+    __slots__ = ("remaining", "used", "deadline", "epoch", "deny_until")
+
+    def __init__(self, remaining: int, deadline: int, epoch: int,
+                 deny_until: int = 0):
+        self.remaining = int(remaining)
+        self.used = 0
+        self.deadline = int(deadline)
+        self.epoch = int(epoch)
+        self.deny_until = int(deny_until)
+
+
+class DirectTransport:
+    """In-process transport: LeaseClient -> LeaseManager (drills,
+    embedded deployments — no TCP in the loop)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+
+    def lease_grant(self, lid: int, key: str, requested: int):
+        return self.manager.grant(lid, key, requested)
+
+    def lease_renew(self, lid: int, key: str, used: int,
+                    requested: int = 0):
+        return self.manager.renew(lid, key, used, requested)
+
+    def lease_release(self, lid: int, key: str, used: int) -> None:
+        self.manager.release(lid, key, used)
+
+    def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
+        algo, _cfg = self.manager._algo_cfg(lid)
+        out = self.manager.storage.acquire(algo, lid, key, permits)
+        return bool(out["allowed"])
+
+
+class LeaseClient:
+    """Local lease burner over a lease-capable transport."""
+
+    def __init__(self, transport, lid: int, *, budget: int = 64,
+                 clock_ms=None, direct_fallback: bool = True):
+        self._t = transport
+        self.lid = int(lid)
+        self.budget = max(int(budget), 1)
+        self._clock_ms = clock_ms or _wall_ms
+        self.direct_fallback = bool(direct_fallback)
+        self._leases: Dict[str, _Local] = {}
+        # Accounting (the loopback bench computes its wire-frame ratio
+        # from these; the chaos drill asserts per-key admission).
+        self.local_decisions = 0   # allows answered with ZERO wire frames
+        self.local_denies = 0
+        self.wire_ops = 0          # lease + fallback frames sent
+        self.revoked_seen = 0
+        self.allowed_by_key: collections.Counter = collections.Counter()
+
+    # -- the decision surface --------------------------------------------------
+    def try_acquire(self, key: str, permits: int = 1) -> bool:
+        permits = max(int(permits), 1)
+        now = int(self._clock_ms())
+        lease = self._leases.get(key)
+        if lease is not None and now < lease.deadline \
+                and lease.remaining >= permits:
+            lease.remaining -= permits
+            lease.used += permits
+            self.local_decisions += 1
+            self.allowed_by_key[key] += permits
+            return True
+        lease = self._refresh(key, lease, now)
+        if lease is not None and now < lease.deadline \
+                and lease.remaining >= permits:
+            lease.remaining -= permits
+            lease.used += permits
+            self.allowed_by_key[key] += permits
+            return True
+        if self.direct_fallback:
+            self.wire_ops += 1
+            allowed = bool(self._t.try_acquire(self.lid, key, permits))
+            if allowed:
+                self.allowed_by_key[key] += permits
+            return allowed
+        self.local_denies += 1
+        return False
+
+    def _refresh(self, key: str, lease: Optional[_Local],
+                 now: int) -> Optional[_Local]:
+        """Renew/re-grant over the wire; None when no budget is usable
+        (cooldown after a zero grant, or the server refused)."""
+        if lease is not None and lease.remaining <= 0 \
+                and now < lease.deny_until:
+            return None  # zero-grant cooldown: no wire spam
+        if lease is not None and (lease.used or lease.remaining):
+            self.wire_ops += 1
+            resp = self._t.lease_renew(self.lid, key, lease.used,
+                                       self.budget)
+            lease.used = 0
+            if resp is None:  # revoked: re-grant against whatever serves
+                self.revoked_seen += 1
+                self.wire_ops += 1
+                resp = self._t.lease_grant(self.lid, key, self.budget)
+        else:
+            self.wire_ops += 1
+            resp = self._t.lease_grant(self.lid, key, self.budget)
+        if resp is None:
+            self._leases.pop(key, None)
+            return None
+        granted, ttl_ms, epoch = resp[0], resp[1], resp[2]
+        if granted <= 0:
+            cool = _Local(0, now, epoch, deny_until=now + max(ttl_ms, 1))
+            self._leases[key] = cool
+            return None
+        fresh = _Local(granted, now + ttl_ms, epoch)
+        self._leases[key] = fresh
+        return fresh
+
+    # -- lifecycle -------------------------------------------------------------
+    def release_all(self) -> None:
+        """Report final burns and hand every unused budget back."""
+        for key, lease in list(self._leases.items()):
+            if lease.used or lease.remaining:
+                self.wire_ops += 1
+                try:
+                    self._t.lease_release(self.lid, key, lease.used)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        self._leases.clear()
+
+    def drop(self) -> dict:
+        """Simulate a client crash (the chaos drill's kill): abandon
+        every lease WITHOUT releasing — returns what was outstanding so
+        the drill can assert the over-admission bound."""
+        out = {k: {"remaining": v.remaining, "used": v.used}
+               for k, v in self._leases.items()}
+        self._leases.clear()
+        return out
+
+    close = release_all

@@ -1,0 +1,358 @@
+"""Server side of token leases: grant / renew / release / revoke.
+
+The manager bridges the host lease table (leases/table.py) and the
+storage's atomic ``lease_reserve``/``lease_credit`` surface
+(storage/tpu.py -> ops/lease.py), and owns every policy decision:
+
+- **Grant**: charge up to ``budget`` permits for a key in one device
+  reserve.  The kernel bounds the grant by the remaining-window budget
+  (sliding window) / current tokens (token bucket), so over-admission
+  when a leased client dies is bounded by construction — the same
+  per-key "one extra max_permits per window, worst case" bound
+  ``storage/degraded.py`` documents.  A key that is ALREADY leased is
+  refused (granted 0): one burner per key keeps the bound per-key; the
+  second client stays on the per-decision path (the device keeps
+  arbitrating contended keys — the lease design goal).
+- **TTL**: ``min(ttl_ms, remaining window)`` for the sliding window —
+  the charge ages out when the window rolls, so the budget must not
+  outlive it; plain ``ttl_ms`` for the token bucket (its charge never
+  expires, only refills around it).
+- **Renew**: the client reports ``used`` burns; the manager credits the
+  unused remainder back to the device and reserves a fresh budget in
+  the same call — renewals ride the normal decision path, one wire
+  frame per budget instead of one per decision.
+- **Fence epochs**: every lease is stamped with the storage's fence
+  epoch at grant time.  A renewal whose lease predates the current
+  epoch is REVOKED, not honored — a failover promoted a replacement in
+  between, and crediting/charging across that boundary would corrupt
+  whichever side survived.  The client re-grants against the (possibly
+  new) serving backend.  ``FencedError`` from the storage forces the
+  same revocation.  Burns reported on a revoked or expired lease are
+  counted into ``ratelimiter.lease.over_admission`` — a conservative
+  upper bound on permits admitted locally that the serving backend may
+  never have seen charged.
+
+Metrics (``ratelimiter.lease.*``): granted / renewed / revoked /
+expired counters, ``local_decisions`` (client-reported burns —
+decisions that cost ZERO wire frames at decision time), ``over_
+admission`` (permits, see above), and an ``outstanding`` gauge.
+
+``record_ops=True`` keeps a replayable log of every reserve/credit with
+its device stamp; the chaos drill (storage/chaos.py:
+lease_failover_drill) replays it into ``semantics/oracle.py`` and
+asserts the device state is bit-identical once renewals drain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+from ratelimiter_tpu.leases.table import Lease, LeaseTable
+from ratelimiter_tpu.storage.errors import FencedError, StorageException
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("leases.manager")
+
+
+def _wall_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class LeaseGrant(NamedTuple):
+    """What a grant/renew answers: ``granted == 0`` means the key stays
+    on the per-decision path for ``ttl_ms`` (retry hint)."""
+
+    granted: int
+    ttl_ms: int
+    epoch: int
+
+
+class LeaseManager:
+    """Grants, renews, and revokes per-key permit budgets."""
+
+    def __init__(self, storage, *,
+                 default_budget: int = 64,
+                 max_budget: int = 1024,
+                 ttl_ms: float = 2000.0,
+                 deny_ttl_ms: float = 25.0,
+                 max_leases: int = 65536,
+                 clock_ms=None,
+                 registry=None,
+                 recorder=None,
+                 record_ops: bool = False):
+        self.storage = storage
+        self.default_budget = max(int(default_budget), 1)
+        self.max_budget = max(int(max_budget), 1)
+        self.ttl_ms = float(ttl_ms)
+        self.deny_ttl_ms = max(float(deny_ttl_ms), 1.0)
+        self.table = LeaseTable(max_leases=max_leases)
+        self._clock_ms = (clock_ms
+                          or getattr(storage, "_clock_ms", None)
+                          or _wall_ms)
+        self._lock = threading.RLock()
+        self._sweep_tick = 0
+        self.ops: List[Tuple] = []   # replay log (record_ops)
+        self._record = bool(record_ops)
+        if recorder is not None:
+            self._recorder = recorder
+        else:
+            from ratelimiter_tpu.observability import flight_recorder
+
+            self._recorder = flight_recorder()
+        if registry is not None:
+            mk = registry.counter
+            self._m_granted = mk(
+                "ratelimiter.lease.granted",
+                "Leases granted (fresh per-key budgets charged on device)")
+            self._m_renewed = mk(
+                "ratelimiter.lease.renewed",
+                "Lease renewals served (unused credited, budget re-charged)")
+            self._m_revoked = mk(
+                "ratelimiter.lease.revoked",
+                "Leases revoked (fence-epoch advance, FencedError, or "
+                "unknown lease at renewal)")
+            self._m_expired = mk(
+                "ratelimiter.lease.expired",
+                "Leases dropped by TTL expiry")
+            self._m_local = mk(
+                "ratelimiter.lease.local_decisions",
+                "Client-reported decisions burned locally against a lease "
+                "(zero wire frames at decision time)")
+            self._m_over = mk(
+                "ratelimiter.lease.over_admission",
+                "Permits burned against revoked/expired leases — "
+                "conservative upper bound on admission the serving "
+                "backend may not have seen charged")
+            self._m_outstanding = registry.gauge(
+                "ratelimiter.lease.outstanding",
+                "Leases currently outstanding")
+        else:
+            self._m_granted = self._m_renewed = self._m_revoked = None
+            self._m_expired = self._m_local = self._m_over = None
+            self._m_outstanding = None
+        # Plain counters (drills read them without a registry).
+        self.granted_total = 0
+        self.renewed_total = 0
+        self.revoked_total = 0
+        self.expired_total = 0
+        self.local_decisions_total = 0
+        self.over_admission_total = 0
+
+    # -- small helpers ---------------------------------------------------------
+    def _algo_cfg(self, lid: int):
+        entry = self.storage._configs.get(int(lid))
+        if entry is None:
+            raise KeyError(f"no limiter registered under lid={lid}")
+        return entry  # (algo, config)
+
+    def _epoch(self) -> int:
+        fn = getattr(self.storage, "fence_info", None)
+        if fn is None:
+            return 0
+        try:
+            return int(fn()["epoch"])
+        except Exception:  # noqa: BLE001 — epoch is best-effort metadata
+            return 0
+
+    def _bump(self, meter, attr: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        setattr(self, attr, getattr(self, attr) + n)
+        if meter is not None:
+            meter.add(n)
+
+    def _gauge(self) -> None:
+        if self._m_outstanding is not None:
+            self._m_outstanding.set(float(self.table.outstanding()))
+
+    def _maybe_sweep(self, now: int) -> None:
+        self._sweep_tick += 1
+        if self._sweep_tick % 256:
+            return
+        for lease in self.table.sweep_expired(now):
+            self._bump(self._m_expired, "expired_total")
+            self._recorder.record("lease.expired", coalesce_ms=1000.0,
+                                  key=lease.key)
+
+    def _credit(self, lease: Lease, unused: int) -> None:
+        """Best-effort device credit of unused budget (kernel drops a
+        rolled-window credit safely)."""
+        if unused <= 0:
+            return
+        out = self.storage.lease_credit(
+            lease.algo, lease.lid, lease.key, int(unused), lease.ws)
+        # stamp == 0 marks a fail-closed router answer (no device op ran)
+        # — recording it would corrupt an oracle replay.
+        if self._record and out.get("stamp", 0) > 0:
+            self.ops.append(("credit", lease.algo, lease.lid, lease.key,
+                             int(unused), lease.ws, out["stamp"]))
+
+    # -- the lease protocol ----------------------------------------------------
+    def grant(self, lid: int, key: str, requested: int = 0) -> LeaseGrant:
+        """Grant a fresh per-key budget.  ``granted == 0`` (with a retry
+        hint in ``ttl_ms``) when the key is already leased, the budget
+        is exhausted, the table is full, or the storage is fenced."""
+        with self._lock:
+            algo, cfg = self._algo_cfg(lid)
+            now = int(self._clock_ms())
+            self._maybe_sweep(now)
+            existing = self.table.get(algo, lid, key)
+            if existing is not None:
+                if existing.expired(now):
+                    self.table.pop(algo, lid, key)
+                    self._bump(self._m_expired, "expired_total")
+                else:
+                    # One burner per key: the second client stays on the
+                    # per-decision path (the device arbitrates contended
+                    # keys).
+                    return LeaseGrant(0, int(self.deny_ttl_ms),
+                                      existing.epoch)
+            req = int(requested) or self.default_budget
+            req = max(1, min(req, self.max_budget, cfg.max_permits))
+            try:
+                out = self.storage.lease_reserve(algo, lid, key, req)
+            except FencedError:
+                self._bump(self._m_revoked, "revoked_total")
+                return LeaseGrant(0, int(self.deny_ttl_ms), self._epoch())
+            except StorageException:
+                return LeaseGrant(0, int(self.deny_ttl_ms), self._epoch())
+            if self._record and out.get("stamp", 0) > 0:
+                self.ops.append(("reserve", algo, lid, key, req,
+                                 out["granted"], out["ws"], out["stamp"]))
+            granted = int(out["granted"])
+            epoch = self._epoch()
+            if granted <= 0:
+                return LeaseGrant(0, int(self.deny_ttl_ms), epoch)
+            ttl = self._ttl_for(algo, cfg, out["stamp"])
+            lease = Lease(algo=algo, lid=int(lid), key=key, budget=granted,
+                          ws=int(out["ws"]), epoch=epoch,
+                          deadline_ms=now + ttl, granted_total=granted)
+            if not self.table.put(lease):
+                # Table full: undo the charge and refuse — bounded state.
+                self._credit(lease, granted)
+                return LeaseGrant(0, int(self.deny_ttl_ms), epoch)
+            self._bump(self._m_granted, "granted_total")
+            self._gauge()
+            return LeaseGrant(granted, ttl, epoch)
+
+    def renew(self, lid: int, key: str, used: int,
+              requested: int = 0) -> Optional[LeaseGrant]:
+        """Renew: report ``used`` burns, credit the unused remainder,
+        charge a fresh budget.  Returns ``None`` when the lease was
+        REVOKED (fence epoch advanced, storage fenced, or unknown
+        lease) — the client must re-grant before burning again."""
+        with self._lock:
+            algo, cfg = self._algo_cfg(lid)
+            now = int(self._clock_ms())
+            used = max(int(used), 0)
+            self._bump(self._m_local, "local_decisions_total", used)
+            lease = self.table.get(algo, lid, key)
+            if lease is None:
+                # Swept/never granted: those burns ran against a lease
+                # this table no longer vouches for.
+                self._bump(self._m_over, "over_admission_total", used)
+                self._bump(self._m_revoked, "revoked_total")
+                return None
+            lease.used_total += used
+            cur_epoch = self._epoch()
+            if cur_epoch > lease.epoch:
+                # Failover promoted a replacement since the grant: the
+                # charge lives (at best) on the old backend, so neither
+                # credit nor honor — revoke, client re-grants against
+                # whatever serves now.  Burns since the last report are
+                # the (bounded) over-admission window.
+                self.table.pop(algo, lid, key)
+                self._bump(self._m_revoked, "revoked_total")
+                self._bump(self._m_over, "over_admission_total", used)
+                self._recorder.record("lease.revoked", key=key,
+                                      reason="fence_epoch",
+                                      coalesce_ms=200.0)
+                self._gauge()
+                return None
+            unused = max(lease.budget - used, 0)
+            if lease.expired(now):
+                self.table.pop(algo, lid, key)
+                self._bump(self._m_expired, "expired_total")
+                self._bump(self._m_over, "over_admission_total", used)
+                try:
+                    self._credit(lease, unused)
+                except (FencedError, StorageException):
+                    pass
+                self._gauge()
+                return None
+            req = int(requested) or lease.budget
+            req = max(1, min(req, self.max_budget, cfg.max_permits))
+            try:
+                self._credit(lease, unused)
+                out = self.storage.lease_reserve(algo, lid, key, req)
+            except FencedError:
+                self.table.pop(algo, lid, key)
+                self._bump(self._m_revoked, "revoked_total")
+                self._recorder.record("lease.revoked", key=key,
+                                      reason="fenced", coalesce_ms=200.0)
+                self._gauge()
+                return None
+            except StorageException:
+                self.table.pop(algo, lid, key)
+                self._gauge()
+                return LeaseGrant(0, int(self.deny_ttl_ms), cur_epoch)
+            if self._record and out.get("stamp", 0) > 0:
+                self.ops.append(("reserve", algo, lid, key, req,
+                                 out["granted"], out["ws"], out["stamp"]))
+            granted = int(out["granted"])
+            if granted <= 0:
+                self.table.pop(algo, lid, key)
+                self._gauge()
+                return LeaseGrant(0, int(self.deny_ttl_ms), cur_epoch)
+            ttl = self._ttl_for(algo, cfg, out["stamp"])
+            lease.budget = granted
+            lease.ws = int(out["ws"])
+            lease.epoch = self._epoch()
+            lease.deadline_ms = now + ttl
+            lease.granted_total += granted
+            lease.renewals += 1
+            self._bump(self._m_renewed, "renewed_total")
+            return LeaseGrant(granted, ttl, lease.epoch)
+
+    def release(self, lid: int, key: str, used: int) -> None:
+        """Close a lease: report final burns and credit the remainder."""
+        with self._lock:
+            algo, _cfg = self._algo_cfg(lid)
+            used = max(int(used), 0)
+            self._bump(self._m_local, "local_decisions_total", used)
+            lease = self.table.pop(algo, lid, key)
+            if lease is None:
+                return
+            lease.used_total += used
+            if self._epoch() > lease.epoch:
+                self._bump(self._m_over, "over_admission_total", used)
+                self._gauge()
+                return
+            try:
+                self._credit(lease, max(lease.budget - used, 0))
+            except (FencedError, StorageException):
+                pass
+            self._gauge()
+
+    def _ttl_for(self, algo: str, cfg, stamp: int) -> int:
+        """Sliding window: the charge ages out when the window rolls, so
+        the lease must not outlive it.  Token bucket: plain ttl_ms."""
+        if algo == "sw":
+            remaining = cfg.window_ms - (int(stamp) % cfg.window_ms)
+            return max(1, min(int(self.ttl_ms), int(remaining)))
+        return max(1, int(self.ttl_ms))
+
+    # -- introspection ---------------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "outstanding": self.table.outstanding(),
+            "outstanding_budget": self.table.outstanding_budget(),
+            "granted": self.granted_total,
+            "renewed": self.renewed_total,
+            "revoked": self.revoked_total,
+            "expired": self.expired_total,
+            "local_decisions": self.local_decisions_total,
+            "over_admission": self.over_admission_total,
+        }

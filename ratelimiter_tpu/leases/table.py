@@ -1,0 +1,93 @@
+"""Host-side lease accounting: who holds which per-key permit budget.
+
+One :class:`Lease` per ``(algo, lid, key)`` at a time — a leased key has
+exactly one client burning it locally, which is what makes the
+over-admission bound compose per key.  The table is pure bookkeeping
+(budgets, TTL deadlines, fence epochs, usage counters); the device
+charges/credits live in ``leases/manager.py`` via the storage's
+``lease_reserve``/``lease_credit`` surface.
+
+Bounded: ``max_leases`` caps the table; when full, expired leases are
+swept first, then grants are refused (a refused grant just means the
+client stays on the per-decision path — fail-closed, never unbounded
+state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Lease:
+    """One outstanding per-key permit budget."""
+
+    algo: str
+    lid: int
+    key: str
+    budget: int          # permits granted by the LAST reserve
+    ws: int              # window the charge landed in (sw; 0 for tb)
+    epoch: int           # fence epoch observed at grant time
+    deadline_ms: int     # TTL deadline (manager clock)
+    granted_total: int = 0   # permits charged over the lease's lifetime
+    used_total: int = 0      # burns the client has reported back
+    renewals: int = 0
+
+    def expired(self, now_ms: int) -> bool:
+        return now_ms >= self.deadline_ms
+
+
+class LeaseTable:
+    """Thread-safe bounded registry of outstanding leases."""
+
+    def __init__(self, max_leases: int = 65536):
+        self._lock = threading.Lock()
+        self._leases: Dict[Tuple[str, int, str], Lease] = {}
+        self.max_leases = int(max_leases)
+
+    @staticmethod
+    def _k(algo: str, lid: int, key: str) -> Tuple[str, int, str]:
+        return (algo, int(lid), key)
+
+    def get(self, algo: str, lid: int, key: str) -> Optional[Lease]:
+        with self._lock:
+            return self._leases.get(self._k(algo, lid, key))
+
+    def put(self, lease: Lease) -> bool:
+        """Install a lease; False when the table is full (after sweeping
+        nothing expired) — the caller refuses the grant."""
+        with self._lock:
+            k = self._k(lease.algo, lease.lid, lease.key)
+            if k not in self._leases and len(self._leases) >= self.max_leases:
+                return False
+            self._leases[k] = lease
+            return True
+
+    def pop(self, algo: str, lid: int, key: str) -> Optional[Lease]:
+        with self._lock:
+            return self._leases.pop(self._k(algo, lid, key), None)
+
+    def sweep_expired(self, now_ms: int) -> list:
+        """Remove and return every TTL-expired lease."""
+        with self._lock:
+            dead = [k for k, v in self._leases.items()
+                    if v.expired(now_ms)]
+            return [self._leases.pop(k) for k in dead]
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    def outstanding_budget(self) -> int:
+        """Sum of unburned budget across live leases — the system-wide
+        worst-case over-admission exposure if every leased client died
+        right now AND every charge were lost (each per-key term is
+        itself bounded by that key's remaining-window budget)."""
+        with self._lock:
+            return sum(v.budget for v in self._leases.values())
+
+    def __iter__(self) -> Iterator[Lease]:
+        with self._lock:
+            return iter(list(self._leases.values()))

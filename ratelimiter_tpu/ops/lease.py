@@ -1,0 +1,379 @@
+"""Batched lease RESERVE / CREDIT steps (device side).
+
+Token leases (leases/) push enforcement to the client: the server
+reserves a bounded per-key permit budget in ONE atomic device pass —
+gather slot rows -> roll/refill to ``now`` -> greedy segmented grant ->
+scatter updated rows — and the client burns the budget locally at memory
+speed.  These two steps are the device half of that contract:
+
+- **RESERVE** charges up to ``requested`` permits per key against the
+  live counters.  Sliding window: grant ``min(requested, max_permits -
+  weighted_estimate)`` and charge the current-window bucket with the
+  usual PEXPIRE refresh.  Token bucket: grant ``min(requested,
+  refilled_whole_tokens)`` and consume them with the allow-branch
+  write-back.  The grant is therefore bounded by the remaining-window
+  budget / current tokens — the lease over-admission bound falls out by
+  construction.
+- **CREDIT** returns unused permits at renewal/release.  Sliding
+  window: the decrement applies only while the charged window
+  (``grant_ws``) is still current (a rolled window already ages the
+  charge out as previous-window weight) and never refreshes the TTL.
+  Token bucket: refill-then-add up to capacity; a bucket already at
+  capacity stays bit-untouched.
+
+Decision math is the exact integer semantics specified by
+``semantics/oracle.py:{SlidingWindowOracle,TokenBucketOracle}.reserve/
+credit`` — differential tests drive both on identical streams
+(tests/test_leases.py).
+
+Duplicate slots within a batch are granted greedily in sorted order via
+the closed form ``grant_j = clip(avail - cumsum_excl(req)_j, 0, req_j)``
+(prior requests are fully served until the budget runs out, then
+partially, then not at all — exactly the sequential semantics).
+
+The ``host_*_rows`` mirrors restate the same arithmetic over host numpy
+rows for engines that reserve via a read-rows -> update -> write-rows
+round trip (the sharded mesh engine); callers there pass unique slots
+per call (the lease manager reserves one key at a time).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import TOKEN_FP_ONE
+from ratelimiter_tpu.engine.state import TableArrays
+from ratelimiter_tpu.ops.scatter import scatter_rows_sorted
+from ratelimiter_tpu.ops.segments import (
+    first_occurrence,
+    last_occurrence,
+    segment_totals,
+    segmented_cumsum_exclusive,
+)
+from ratelimiter_tpu.ops.sliding_window import _rolled, _sw_decode, _sw_encode
+from ratelimiter_tpu.ops.sorting import sort_batch, unsort
+from ratelimiter_tpu.ops.token_bucket import _refilled, _tb_decode, _tb_encode
+
+
+# -- device steps -------------------------------------------------------------
+
+def sw_reserve_p(
+    packed: jnp.ndarray,       # i32[S, 6] — resident packed state
+    table: TableArrays,
+    slots: jnp.ndarray,        # i32[B]; < 0 = padding
+    limiter_ids: jnp.ndarray,  # i32[B]
+    requested: jnp.ndarray,    # i64[B]; padding 0
+    now: jnp.ndarray,          # i64 scalar
+):
+    """Returns ``(new_packed, granted i64[B], window_start i64[B])`` —
+    jit with donate_argnums=0."""
+    inv, s, (lid, req) = sort_batch(slots, limiter_ids, requested)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.max_permits.shape[0] - 1)
+    maxp = table.max_permits[lidc]
+    win = table.window_ms[lidc]
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    rem = now % win
+    base = (prev_e * (win - rem)) // win
+    avail = jnp.maximum(maxp - base - curr_e, 0)
+
+    req = jnp.where(valid, jnp.maximum(req, 0), 0)
+    first = first_occurrence(s)
+    pre = segmented_cumsum_exclusive(req, first)
+    grant = jnp.clip(avail - pre, 0, req)
+    tot = segment_totals(grant, first)
+
+    lastm = last_occurrence(s) & valid
+    any_g = tot > 0
+    curr_new = curr_e + tot
+    samew = rows[0] == curr_ws
+    # PEXPIRE refresh exactly where an increment would apply it.
+    cdl_new = jnp.where(any_g, now + win, jnp.where(samew, rows[2], 0))
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    new_rows = _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e)
+    packed_new = scatter_rows_sorted(packed, s, lastm, new_rows)
+    return packed_new, unsort(grant, inv), unsort(curr_ws_b, inv)
+
+
+def sw_credit_p(
+    packed: jnp.ndarray,
+    table: TableArrays,
+    slots: jnp.ndarray,        # i32[B]; < 0 = padding
+    limiter_ids: jnp.ndarray,  # i32[B]
+    credit: jnp.ndarray,       # i64[B]; padding 0
+    grant_ws: jnp.ndarray,     # i64[B] — window the charge landed in
+    now: jnp.ndarray,
+):
+    """Returns ``(new_packed, credited i64[B])`` — jit donate_argnums=0."""
+    inv, s, (lid, cr, gws) = sort_batch(slots, limiter_ids, credit, grant_ws)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.max_permits.shape[0] - 1)
+    win = table.window_ms[lidc]
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    ok = valid & (gws == curr_ws)
+    cr = jnp.where(ok, jnp.maximum(cr, 0), 0)
+    first = first_occurrence(s)
+    pre = segmented_cumsum_exclusive(cr, first)
+    credited = jnp.clip(curr_e - pre, 0, cr)
+    tot = segment_totals(credited, first)
+
+    # A nonzero credit implies the row is in the charged (current)
+    # window — a rolled row reads curr_e == 0 and credits nothing — so
+    # written rows always have samew and keep their existing deadline
+    # (a credit is not an increment: no TTL refresh).
+    lastm = last_occurrence(s) & valid & (tot > 0)
+    curr_new = curr_e - tot
+    samew = rows[0] == curr_ws
+    cdl_keep = jnp.where(samew, rows[2], 0)
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    new_rows = _sw_encode(curr_ws_b, curr_new, cdl_keep, prev_e, prev_dl_e)
+    packed_new = scatter_rows_sorted(packed, s, lastm, new_rows)
+    return packed_new, unsort(credited, inv)
+
+
+def tb_reserve_p(
+    packed: jnp.ndarray,       # i32[S, 4]
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    requested: jnp.ndarray,
+    now: jnp.ndarray,
+):
+    """Returns ``(new_packed, granted i64[B], zeros i64[B])`` (the third
+    output keeps the reserve surface uniform with the sliding window)."""
+    inv, s, (lid, req) = sort_batch(slots, limiter_ids, requested)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+    avail = v1 // TOKEN_FP_ONE
+
+    req = jnp.where(valid, jnp.maximum(req, 0), 0)
+    first = first_occurrence(s)
+    pre = segmented_cumsum_exclusive(req, first)
+    grant = jnp.clip(avail - pre, 0, req)
+    tot = segment_totals(grant, first)
+
+    lastm = last_occurrence(s) & valid
+    any_g = tot > 0
+    # Write-back only where something was granted (deny keeps prior
+    # state bit-for-bit, like the Lua deny branch / tb_step_p).
+    tokens_new = jnp.where(any_g, v1 - tot * TOKEN_FP_ONE, rows[0])
+    last_new = jnp.where(any_g, jnp.maximum(now, 1), rows[1])
+    packed_new = scatter_rows_sorted(
+        packed, s, lastm, _tb_encode(tokens_new, last_new))
+    return packed_new, unsort(grant, inv), unsort(
+        jnp.zeros_like(grant), inv)
+
+
+def tb_credit_p(
+    packed: jnp.ndarray,
+    table: TableArrays,
+    slots: jnp.ndarray,
+    limiter_ids: jnp.ndarray,
+    credit: jnp.ndarray,
+    grant_ws: jnp.ndarray,     # ignored (uniform surface)
+    now: jnp.ndarray,
+):
+    """Returns ``(new_packed, credited i64[B])``."""
+    del grant_ws
+    inv, s, (lid, cr) = sort_batch(slots, limiter_ids, credit)
+    valid = s >= 0
+    sc = jnp.clip(s, 0, packed.shape[0] - 1)
+    lidc = jnp.clip(lid, 0, table.cap_fp.shape[0] - 1)
+    cap = table.cap_fp[lidc]
+    rate = table.rate_fp[lidc]
+    ttl2 = table.ttl2_ms[lidc]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+    gap = jnp.maximum(cap - v1, 0)
+
+    cr_fp = jnp.where(valid, jnp.maximum(cr, 0), 0) * TOKEN_FP_ONE
+    first = first_occurrence(s)
+    pre = segmented_cumsum_exclusive(cr_fp, first)
+    absorbed = jnp.clip(gap - pre, 0, cr_fp)
+    tot = segment_totals(absorbed, first)
+
+    # Write-back only where something was absorbed: a bucket already at
+    # capacity stays bit-untouched (oracle credit parity).
+    lastm = last_occurrence(s) & valid & (tot > 0)
+    tokens_new = v1 + tot
+    last_new = jnp.broadcast_to(jnp.maximum(now, 1), sc.shape)
+    packed_new = scatter_rows_sorted(
+        packed, s, lastm, _tb_encode(tokens_new, last_new))
+    return packed_new, unsort(absorbed // TOKEN_FP_ONE, inv)
+
+
+# -- host mirrors (read-rows -> update -> write-rows engines) -----------------
+# Exact per-lane restatement of the device arithmetic over decoded host
+# rows.  Lanes are independent: callers pass UNIQUE slots per call (the
+# lease manager reserves/credits one key at a time).
+
+def _np_pair_i64(rows: np.ndarray, lo: int) -> np.ndarray:
+    """Two little-endian i32 lanes -> i64 (bitcast, like the device)."""
+    return np.ascontiguousarray(
+        rows[:, lo:lo + 2].astype(np.int32)).view(np.int64).ravel()
+
+
+def _np_i64_pair(vals: np.ndarray) -> np.ndarray:
+    """i64[n] -> i32[n, 2] (inverse bitcast)."""
+    return np.ascontiguousarray(
+        vals.astype(np.int64)).view(np.int32).reshape(-1, 2)
+
+
+def _sw_host_roll(row, win: int, now: int):
+    """Host restatement of sliding_window._rolled for ONE decoded row."""
+    ws0, curr0, cdl0, prev0, pdl0 = row
+    curr_ws = now - now % win
+    if ws0 == curr_ws:
+        curr = curr0
+        prev = prev0 if now < pdl0 else 0
+        prev_dl = pdl0
+    elif ws0 == curr_ws - win:
+        curr = 0
+        prev = curr0 if now < cdl0 else 0
+        prev_dl = cdl0
+    else:
+        curr, prev, prev_dl = 0, 0, 0
+    return curr_ws, curr, prev, prev_dl
+
+
+def _sw_decode_host(rows: np.ndarray):
+    ws = _np_pair_i64(rows, 0)
+    curr = rows[:, 2].astype(np.int64)
+    prev = rows[:, 3].astype(np.int64)
+    cdl = ws + rows[:, 4]
+    pdl = ws + rows[:, 5]
+    return ws, curr, cdl, prev, pdl
+
+
+def _sw_encode_host(ws, curr, cdl, prev, pdl) -> np.ndarray:
+    n = len(ws)
+    out = np.empty((n, 6), dtype=np.int32)
+    out[:, 0:2] = _np_i64_pair(np.asarray(ws, dtype=np.int64))
+    out[:, 2] = np.asarray(curr, dtype=np.int64)
+    out[:, 3] = np.asarray(prev, dtype=np.int64)
+    out[:, 4] = np.maximum(np.asarray(cdl, dtype=np.int64) - ws, 0)
+    out[:, 5] = np.maximum(np.asarray(pdl, dtype=np.int64) - ws, 0)
+    return out
+
+
+def host_reserve_rows(algo: str, rows: np.ndarray, lids, requested,
+                      policies, now: int):
+    """Reserve over host rows.  ``policies`` maps lid -> (max_permits,
+    window_ms, cap_fp, rate_fp, ttl2_ms) (LimiterTable.host_policy).
+    Returns ``(granted i64[n], ws i64[n], new_rows, changed bool[n])``."""
+    n = len(rows)
+    granted = np.zeros(n, dtype=np.int64)
+    ws_out = np.zeros(n, dtype=np.int64)
+    changed = np.zeros(n, dtype=bool)
+    new_rows = np.array(rows, dtype=np.int32, copy=True)
+    now = int(now)
+    if algo == "sw":
+        dec = _sw_decode_host(rows)
+        for i in range(n):
+            maxp, win, _, _, _ = policies(int(lids[i]))
+            row = (int(dec[0][i]), int(dec[1][i]), int(dec[2][i]),
+                   int(dec[3][i]), int(dec[4][i]))
+            curr_ws, curr, prev, prev_dl = _sw_host_roll(row, win, now)
+            base = (prev * (win - now % win)) // win
+            g = max(0, min(int(requested[i]), maxp - base - curr))
+            cdl = (now + win) if g > 0 else (
+                row[2] if row[0] == curr_ws else 0)
+            new_rows[i] = _sw_encode_host(
+                np.array([curr_ws]), np.array([curr + g]), np.array([cdl]),
+                np.array([prev]), np.array([prev_dl]))[0]
+            granted[i] = g
+            ws_out[i] = curr_ws
+            changed[i] = True  # rolled rewrite, like the device scatter
+        return granted, ws_out, new_rows, changed
+    for i in range(n):
+        maxp, win, cap, rate, ttl2 = policies(int(lids[i]))
+        tokens = int(_np_pair_i64(rows[i:i + 1], 0)[0])
+        last = int(_np_pair_i64(rows[i:i + 1], 2)[0])
+        if last == 0 or now >= last + ttl2:
+            tokens, last = cap, now
+        elapsed = min(max(now - last, 0), cap // max(rate, 1) + 1)
+        v1 = min(cap, tokens + elapsed * rate)
+        g = max(0, min(int(requested[i]), v1 // TOKEN_FP_ONE))
+        granted[i] = g
+        if g > 0:
+            new_rows[i, 0:2] = _np_i64_pair(
+                np.array([v1 - g * TOKEN_FP_ONE]))[0]
+            new_rows[i, 2:4] = _np_i64_pair(np.array([max(now, 1)]))[0]
+            changed[i] = True
+    return granted, ws_out, new_rows, changed
+
+
+def host_credit_rows(algo: str, rows: np.ndarray, lids, credit, grant_ws,
+                     policies, now: int):
+    """Credit over host rows; returns ``(credited, new_rows, changed)``."""
+    n = len(rows)
+    credited = np.zeros(n, dtype=np.int64)
+    changed = np.zeros(n, dtype=bool)
+    new_rows = np.array(rows, dtype=np.int32, copy=True)
+    now = int(now)
+    if algo == "sw":
+        dec = _sw_decode_host(rows)
+        for i in range(n):
+            _, win, _, _, _ = policies(int(lids[i]))
+            row = (int(dec[0][i]), int(dec[1][i]), int(dec[2][i]),
+                   int(dec[3][i]), int(dec[4][i]))
+            curr_ws, curr, prev, prev_dl = _sw_host_roll(row, win, now)
+            if curr_ws != int(grant_ws[i]) or curr <= 0:
+                continue
+            c = min(max(int(credit[i]), 0), curr)
+            if c <= 0:
+                continue
+            # curr > 0 implies the row is already in the current window,
+            # so the existing deadline is kept (no TTL refresh).
+            new_rows[i] = _sw_encode_host(
+                np.array([curr_ws]), np.array([curr - c]),
+                np.array([row[2]]), np.array([prev]),
+                np.array([prev_dl]))[0]
+            credited[i] = c
+            changed[i] = True
+        return credited, new_rows, changed
+    for i in range(n):
+        _, _, cap, rate, ttl2 = policies(int(lids[i]))
+        tokens = int(_np_pair_i64(rows[i:i + 1], 0)[0])
+        last = int(_np_pair_i64(rows[i:i + 1], 2)[0])
+        if last == 0 or now >= last + ttl2:
+            tokens, last = cap, now
+        elapsed = min(max(now - last, 0), cap // max(rate, 1) + 1)
+        v1 = min(cap, tokens + elapsed * rate)
+        absorbed = min(max(int(credit[i]), 0) * TOKEN_FP_ONE, cap - v1)
+        if absorbed <= 0:
+            continue
+        new_rows[i, 0:2] = _np_i64_pair(np.array([v1 + absorbed]))[0]
+        new_rows[i, 2:4] = _np_i64_pair(np.array([max(now, 1)]))[0]
+        credited[i] = absorbed // TOKEN_FP_ONE
+        changed[i] = True
+    return credited, new_rows, changed
+
+
+# Module-level jitted singletons (one compile per (algo, bucket) across
+# every engine in the process — the engine/engine.py _MICRO_STEPS rule).
+RESERVE_STEPS = {
+    "sw": jax.jit(sw_reserve_p, donate_argnums=0),
+    "tb": jax.jit(tb_reserve_p, donate_argnums=0),
+}
+CREDIT_STEPS = {
+    "sw": jax.jit(sw_credit_p, donate_argnums=0),
+    "tb": jax.jit(tb_credit_p, donate_argnums=0),
+}

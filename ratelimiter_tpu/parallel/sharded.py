@@ -1123,6 +1123,48 @@ class ShardedDeviceEngine:
             else:
                 self.tb_packed = new
 
+    # -- lease RESERVE / CREDIT (ops/lease.py; leases/) ------------------------
+    # The sharded mesh reserves via a read-rows -> host arithmetic ->
+    # write-rows round trip under the exclusive lock set (atomic against
+    # every other dispatch path — both read_rows and write_rows re-enter
+    # the same RLocks).  Lease ops are rare by design (one reserve
+    # amortizes over a whole client-side budget), so the host round trip
+    # is off every hot path; the single-device engine runs the fused
+    # device kernel instead (engine/engine.py:lease_reserve).  Callers
+    # pass UNIQUE slots per call (the lease manager reserves one key at
+    # a time); the host mirrors process lanes independently.
+
+    def lease_reserve(self, algo: str, slots, limiter_ids, requested,
+                      now_ms: int):
+        from ratelimiter_tpu.ops import lease as lease_ops
+
+        slots = np.asarray(slots, dtype=np.int64)
+        with self._lock, self._exclusive():
+            rows = self.read_rows(algo, slots)
+            granted, ws, new_rows, changed = lease_ops.host_reserve_rows(
+                algo, rows, np.asarray(limiter_ids, dtype=np.int64),
+                np.asarray(requested, dtype=np.int64),
+                self.table.host_policy, int(now_ms))
+            if changed.any():
+                self.write_rows(algo, slots[changed], new_rows[changed])
+        return granted, ws
+
+    def lease_credit(self, algo: str, slots, limiter_ids, credit, grant_ws,
+                     now_ms: int) -> np.ndarray:
+        from ratelimiter_tpu.ops import lease as lease_ops
+
+        slots = np.asarray(slots, dtype=np.int64)
+        with self._lock, self._exclusive():
+            rows = self.read_rows(algo, slots)
+            credited, new_rows, changed = lease_ops.host_credit_rows(
+                algo, rows, np.asarray(limiter_ids, dtype=np.int64),
+                np.asarray(credit, dtype=np.int64),
+                np.asarray(grant_ws, dtype=np.int64),
+                self.table.host_policy, int(now_ms))
+            if changed.any():
+                self.write_rows(algo, slots[changed], new_rows[changed])
+        return credited
+
     def block_until_ready(self) -> None:
         with self._lock, self._exclusive():
             jax.block_until_ready((self.sw_packed, self.tb_packed))

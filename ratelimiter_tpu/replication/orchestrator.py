@@ -471,6 +471,68 @@ class FailoverOrchestrator:
             except Exception as exc:  # noqa: BLE001 — stream survives
                 _log.warning("re-seed ship for shard %d failed: %s", q, exc)
 
+    # -- operator unfence (the exit from terminal FAILED) ----------------------
+    def unfence(self, q: int) -> Dict:
+        """Recover a terminal ``FAILED`` shard: the operator has verified
+        the primary's shard is actually healthy (the kill was a false
+        positive, or the fault was repaired in place), so lift the
+        fence(s) covering shard ``q``, repair the router back to the
+        primary, replace the shard's standby with a fresh one, resume
+        its replication stream (FULL re-baseline), and reset the watch
+        to MONITORING.  Exposed at ``POST /actuator/orchestrator/
+        unfence`` — previously this state was only recoverable from a
+        Python shell (``lift_fence`` + manual router surgery).
+
+        Refused (``ValueError``) unless the shard is FAILED: auto-unlike
+        paths out of any live state would reopen the two-primaries trap
+        this machine exists to close."""
+        q = int(q)
+        with self._tick_lock:
+            w = self._watch[q]
+            if w.state != FAILED:
+                raise ValueError(
+                    f"shard {q} is {w.state}, not FAILED; unfence is the "
+                    "operator exit from the terminal state only")
+            for storage in self._fenced_storages:
+                try:
+                    info = storage.fence_info()
+                    if info["all"]:
+                        storage.lift_fence(info["epoch"])
+                    elif q in set(info["shards"]):
+                        storage.lift_fence(info["epoch"], shards=(q,))
+                except Exception as exc:  # noqa: BLE001 — best effort:
+                    # a truly-dead backend may refuse even the lift; the
+                    # router repair below still restores routing.
+                    _log.warning("unfence: lift on a fenced backend "
+                                 "failed for shard %d: %s", q, exc)
+            self.router.repair_shard(q)
+            # Restore N+1 coverage: fresh standby + resumed stream
+            # (the fence dropped this shard's stream; its old standby
+            # may be promoted, stale, or mid-failed-promotion).
+            if self.standby_factory is not None \
+                    and self.replicator is not None:
+                from ratelimiter_tpu.replication.standby import (
+                    StandbyReceiver,
+                )
+                from ratelimiter_tpu.replication.transport import (
+                    InProcessSink,
+                )
+
+                fresh = self.standby_factory()
+                rx = StandbyReceiver(fresh)
+                self.standby_set.replace(q, fresh, rx)
+                self.replicator.restore_shard(q, sink=InProcessSink(rx))
+            w.consecutive = 0
+            w.candidate_idx = 0
+            w.promote_attempts = 0
+            w.last_error = None
+            self._transition(q, MONITORING)
+            self._recorder.record("orchestrator.unfenced", shard=q,
+                                  epoch=self.fence_epoch)
+            self._export_metrics()
+            return {"shard": q, "state": MONITORING,
+                    "fence_epoch": self.fence_epoch}
+
     # -- metrics / status ------------------------------------------------------
     def _export_metrics(self) -> None:
         if self._m_state is not None:

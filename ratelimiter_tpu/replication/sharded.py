@@ -258,6 +258,16 @@ class ShardedReplicator:
         with self._ship_lock:
             self._dropped.add(int(q))
 
+    def restore_shard(self, q: int, sink=None) -> None:
+        """Resume a dropped shard's stream (the operator unfence path):
+        optionally swap in a fresh sink (a replaced standby's receiver)
+        and re-baseline with a FULL frame on the next cut."""
+        with self._ship_lock:
+            self._dropped.discard(int(q))
+            if sink is not None:
+                self.sinks[int(q)] = sink
+        self.log.request_full(int(q))
+
     def dropped_shards(self) -> set:
         with self._ship_lock:
             return set(self._dropped)
@@ -453,6 +463,22 @@ class ShardFailoverRouter:
 
         flight_recorder().record("shard.promoted", shard=int(shard))
 
+    def repair_shard(self, shard: int) -> None:
+        """Operator repair: route ``shard``'s keys back to the PRIMARY.
+
+        The exit from a terminal FAILED shard (orchestrator.unfence):
+        the operator has verified the primary's shard is actually
+        healthy (false-dead) and its fence lifted — clear both the
+        failed mark and any installed replacement so routing falls
+        through to the primary again."""
+        with self._lock:
+            self.failed.discard(int(shard))
+            self.replacements.pop(int(shard), None)
+            self._mark_transition(int(shard))
+        from ratelimiter_tpu.observability import flight_recorder
+
+        flight_recorder().record("shard.repaired", shard=int(shard))
+
     def shard_health(self) -> Dict[int, str]:
         with self._lock:
             return {q: ("failed" if q in self.failed
@@ -591,6 +617,32 @@ class ShardFailoverRouter:
         backend = self._backend(q)
         if backend is not None:
             backend.reset_key(algo, lid, key)
+
+    # -- lease routing (leases/manager.py) -------------------------------------
+    # Lease reserve/credit must route per key like every other decision
+    # surface — the __getattr__ passthrough would silently hand them to
+    # the primary, bypassing a promoted replacement, and a failed shard
+    # must refuse grants (fail-closed: no budget, no local admission).
+
+    def lease_reserve(self, algo, lid, key, requested):
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        q = int(shard_of_key((int(lid), key), self.n_shards))
+        backend = self._backend(q)
+        if backend is None:
+            with self._lock:
+                self.unavailable_denies += 1
+            return {"granted": 0, "ws": 0, "stamp": 0}
+        return backend.lease_reserve(algo, lid, key, requested)
+
+    def lease_credit(self, algo, lid, key, credit, grant_ws):
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        q = int(shard_of_key((int(lid), key), self.n_shards))
+        backend = self._backend(q)
+        if backend is None:
+            return {"credited": 0, "stamp": 0}
+        return backend.lease_credit(algo, lid, key, credit, grant_ws)
 
     def _backend(self, q: int):
         with self._lock:

@@ -143,6 +143,53 @@ class SlidingWindowOracle:
     def get_available_permits(self, key: str, now_ms: int) -> int:
         return max(0, self.config.max_permits - self.current_count(key, now_ms))
 
+    # -- lease reserve/credit (spec for ops/lease.py) -------------------------
+    def reserve(self, key: str, requested: int, now_ms: int) -> Tuple[int, int]:
+        """Bulk-reserve up to ``requested`` permits in one atomic step:
+        grant ``min(requested, max_permits - estimate)`` (clamped >= 0) and
+        charge the current-window bucket by the granted count, with the
+        same PEXPIRE refresh an increment applies.  This is the host
+        specification of the device RESERVE kernel (ops/lease.py) that
+        backs token leases (leases/): the grant is bounded by the
+        remaining-window budget, which is what bounds lease
+        over-admission by construction.  Returns ``(granted,
+        window_start)`` — the window the charge landed in, which a later
+        :meth:`credit` must present."""
+        if requested <= 0:
+            return 0, (now_ms // self.config.window_ms) * self.config.window_ms
+        win = self.config.window_ms
+        estimated = self.current_count(key, now_ms)
+        granted = max(0, min(int(requested),
+                             self.config.max_permits - estimated))
+        curr_ws = (now_ms // win) * win
+        if granted > 0:
+            count = self._get_bucket(key, curr_ws, now_ms) + granted
+            self._buckets[(key, curr_ws)] = (count, now_ms + win)
+        return granted, curr_ws
+
+    def credit(self, key: str, unused: int, grant_ws: int,
+               now_ms: int) -> int:
+        """Return ``unused`` reserved permits (lease release/renewal).
+        Credits apply only while the window the charge landed in is still
+        the CURRENT window (``grant_ws``): once the window rolled, the
+        charge already ages out as previous-window weight, and crediting
+        a later window would under-count live traffic.  The decrement
+        never refreshes the bucket TTL (a credit is not an increment).
+        Returns the permits actually credited."""
+        if unused <= 0:
+            return 0
+        win = self.config.window_ms
+        curr_ws = (now_ms // win) * win
+        if curr_ws != int(grant_ws):
+            return 0
+        count = self._get_bucket(key, curr_ws, now_ms)
+        if count <= 0:
+            return 0
+        credited = min(int(unused), count)
+        _, deadline = self._buckets[(key, curr_ws)]
+        self._buckets[(key, curr_ws)] = (count - credited, deadline)
+        return credited
+
     def seed_count(self, key: str, count: int, now_ms: int) -> None:
         """Install ``count`` as the current-window bucket as of ``now_ms``
         (TTL = one window, as a real increment would set).  Used by the
@@ -231,6 +278,45 @@ class TokenBucketOracle:
         """Refill-then-floor, replacing the reference's broken string-GET of a
         hash (quirk Q3)."""
         return self._refilled(key, now_ms) // TOKEN_FP_ONE
+
+    # -- lease reserve/credit (spec for ops/lease.py) -------------------------
+    def reserve(self, key: str, requested: int, now_ms: int) -> Tuple[int, int]:
+        """Bulk-reserve up to ``requested`` whole tokens atomically:
+        grant ``min(requested, refilled // ONE)``, consume the granted
+        tokens, and write back with the allow-branch TTL.  Host
+        specification of the device RESERVE kernel backing token leases.
+        Returns ``(granted, 0)`` — the token bucket has no window start;
+        the second element keeps the surface uniform with the sliding
+        window."""
+        if requested <= 0:
+            return 0, 0
+        tokens_fp = self._refilled(key, now_ms)
+        granted = min(int(requested), tokens_fp // TOKEN_FP_ONE)
+        if granted > 0:
+            tokens_fp -= granted * TOKEN_FP_ONE
+            self._buckets[key] = (tokens_fp, now_ms,
+                                  now_ms + 2 * self.config.window_ms)
+        return granted, 0
+
+    def credit(self, key: str, unused: int, grant_ws: int,
+               now_ms: int) -> int:
+        """Return ``unused`` reserved tokens (lease release/renewal):
+        refill, then add back up to capacity.  State is written only
+        when something was actually absorbed (a bucket already at
+        capacity stays bit-untouched, like the deny branch).
+        ``grant_ws`` is ignored (uniform surface).  Returns whole tokens
+        absorbed."""
+        if unused <= 0:
+            return 0
+        cfg = self.config
+        tokens_fp = self._refilled(key, now_ms)
+        absorbed = min(int(unused) * TOKEN_FP_ONE,
+                       cfg.max_permits_fp - tokens_fp)
+        if absorbed <= 0:
+            return 0
+        self._buckets[key] = (tokens_fp + absorbed, now_ms,
+                              now_ms + 2 * cfg.window_ms)
+        return absorbed // TOKEN_FP_ONE
 
     def seed_tokens(self, key: str, whole_tokens: int, now_ms: int) -> None:
         """Install a bucket holding ``whole_tokens`` as of ``now_ms`` (TTL =

@@ -319,7 +319,25 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._batch()
         if self.path == "/actuator/replication/promote":
             return self._promote()
+        if self.path == "/actuator/orchestrator/unfence":
+            return self._unfence()
         self._json(404, {"error": "not found"})
+
+    def _unfence(self):
+        """Operator recovery for a terminal FAILED shard: lift the
+        fence(s), repair the router back to the primary, re-seed a
+        fresh standby — without a Python shell.  Body: {"shard": N}."""
+        orch = getattr(self.ctx, "orchestrator", None)
+        if orch is None:
+            return self._json(409, {"error": "orchestrator not enabled"})
+        shard = self._body().get("shard")
+        if shard is None:
+            return self._json(400, {"error": "body must carry {\"shard\": N}"})
+        try:
+            out = orch.orchestrator.unfence(int(shard))
+        except (TypeError, ValueError) as exc:
+            return self._json(409, {"error": str(exc)})
+        return self._json(200, out)
 
     def _promote(self):
         """Failover control: promote a standby to serving primary."""

@@ -96,6 +96,20 @@ DEFAULTS = {
     "ratelimiter.cache.hybrid.max_keys": "65536",
     "ratelimiter.cache.hybrid.unconfirmed_cap": "64",
     "ratelimiter.cache.hybrid.guard_ms": "5",
+    # Token leases (leases/, ARCHITECTURE §14): the server grants
+    # clients bounded per-key permit budgets burned locally (protocol
+    # v3 LEASE/RENEW/RELEASE on the sidecar) — one wire frame per
+    # budget instead of one per decision.  OFF by default.
+    # default_budget/max_budget bound grants (wire cap 65535); ttl_ms
+    # bounds a dead client's strand (sliding-window leases also clamp
+    # to the remaining window); deny_ttl_ms is the retry hint a zero
+    # grant carries; max_leases bounds the server table.
+    "ratelimiter.lease.enabled": "false",
+    "ratelimiter.lease.default_budget": "64",
+    "ratelimiter.lease.max_budget": "1024",
+    "ratelimiter.lease.ttl_ms": "2000",
+    "ratelimiter.lease.deny_ttl_ms": "25",
+    "ratelimiter.lease.max_leases": "65536",
     # Observability (observability/, ARCHITECTURE §13).  trace_sample:
     # record one full per-request lifecycle trace per ~N requests into
     # the enriched /actuator/trace ring (0 = off).  slo_ms: any dispatch
@@ -180,6 +194,9 @@ _INT_KEYS = (
     "ratelimiter.orchestrator.promote_retries",
     "ratelimiter.cache.hybrid.max_keys",
     "ratelimiter.cache.hybrid.unconfirmed_cap",
+    "ratelimiter.lease.default_budget",
+    "ratelimiter.lease.max_budget",
+    "ratelimiter.lease.max_leases",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -198,6 +215,8 @@ _FLOAT_KEYS = (
     "ratelimiter.microbatch.flush_floor_ms",
     "ratelimiter.cache.hybrid.ttl_ms",
     "ratelimiter.cache.hybrid.guard_ms",
+    "ratelimiter.lease.ttl_ms",
+    "ratelimiter.lease.deny_ttl_ms",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
@@ -206,6 +225,7 @@ _BOOL_KEYS = (
     "ratelimiter.orchestrator.reseed",
     "ratelimiter.microbatch.adaptive_flush",
     "ratelimiter.cache.hybrid.enabled",
+    "ratelimiter.lease.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

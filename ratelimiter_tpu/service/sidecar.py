@@ -17,9 +17,12 @@ can speak it in ~30 lines:
       2 = AVAILABLE     (a=limiter id; remaining permits; allowed unused)
       3 = RESET         (a=limiter id; admin)
       4 = PING          (health; allowed=1 when storage is up)
-      5 = HELLO         (v2 handshake: a=client protocol version, b=flags;
+      5 = HELLO         (handshake: a=client protocol version, b=flags;
                          response: allowed=negotiated version,
                          remaining=server max frame bytes)
+      6 = LEASE         (v3: a=limiter id, b=requested budget)
+      7 = RENEW         (v3: a=limiter id, b=used | requested << 16)
+      8 = RELEASE       (v3: a=limiter id, b=used)
   status: 0 = OK
           1 = ERROR          (generic; remaining carries an errno — the only
                               error status v1 clients ever see)
@@ -28,13 +31,29 @@ can speak it in ~30 lines:
           3 = SHUTTING_DOWN  (server is draining; reconnect elsewhere)
           4 = BAD_FRAME      (malformed frame, answered in-protocol;
                               remaining carries an errno)
+          5 = LEASE_REVOKED  (v3 only: the lease predates the current fence
+                              epoch or the backend is fenced; re-grant)
 
-**Versioning.**  A v2 client's first frame is HELLO; the server answers
-with the negotiated version and its frame-size cap, and from then on may
-use the typed v2 statuses.  A v1 client never sends HELLO — the server
-serves it unchanged, downgrading every v2-only status to the generic
-``ERROR`` (status 1) with a matching errno, so old clients keep their
-"status != 0 means error" contract and never desync.
+**Versioning.**  A v2+ client's first frame is HELLO; the server answers
+with the negotiated version (``min(client, server)``) and its frame-size
+cap, and from then on may use the typed statuses of that version.  A v1
+client never sends HELLO — the server serves it unchanged, downgrading
+every v2-only status to the generic ``ERROR`` (status 1) with a matching
+errno, so old clients keep their "status != 0 means error" contract and
+never desync.  The v3 LEASE/RENEW/RELEASE ops exist only on connections
+negotiated at v3: a v2 connection sending them gets ``BAD_FRAME``
+(unknown op) and NEVER sees a lease status — v2 ingress is served
+byte-identically to a v2 server.
+
+**Token leases (v3; leases/).**  LEASE charges a bounded per-key permit
+budget atomically against the device counters and the client burns it
+locally — one wire frame per budget instead of one per decision (the
+10-100x ingress collapse).  The lease response packs three fields into
+``remaining``: ``granted | ttl_ms << 16 | fence_epoch << 40`` (granted
+<= 65535, ttl < 2^24 ms, epoch < 2^23).  RENEW reports burns and
+re-charges in one frame; ``LEASE_REVOKED`` forces a re-grant after a
+failover (the fence epoch advanced — leases/manager.py).  Budgets are
+capped at 65535 by the wire format.
 
 **Ingress hardening.**  Every byte on the wire is untrusted:
 
@@ -85,7 +104,7 @@ import threading
 import time
 from concurrent.futures import CancelledError
 from concurrent.futures import TimeoutError as _FutureTimeout
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
@@ -99,14 +118,18 @@ OP_AVAILABLE = 2
 OP_RESET = 3
 OP_PING = 4
 OP_HELLO = 5
+OP_LEASE = 6
+OP_RENEW = 7
+OP_RELEASE = 8
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 
 ST_OK = 0
 ST_ERROR = 1
 ST_SHED = 2
 ST_SHUTTING_DOWN = 3
 ST_BAD_FRAME = 4
+ST_LEASE_REVOKED = 5
 
 ERR_UNKNOWN_OP = 1
 ERR_UNKNOWN_LIMITER = 2
@@ -117,6 +140,25 @@ ERR_FRAME_TOO_LONG = 6
 ERR_OVERLOADED = 7
 ERR_SHUTTING_DOWN = 8
 ERR_BAD_KEY = 9
+ERR_LEASE_DISABLED = 10
+ERR_LEASE_REVOKED = 11
+
+# Lease-response field packing (remaining i64):
+#   granted | ttl_ms << 16 | fence_epoch << 40
+_LEASE_GRANT_MAX = 0xFFFF
+_LEASE_TTL_MAX = 0xFFFFFF
+_LEASE_EPOCH_MAX = 0x7FFFFF
+
+
+def _pack_lease(granted: int, ttl_ms: int, epoch: int) -> int:
+    return (min(int(granted), _LEASE_GRANT_MAX)
+            | min(max(int(ttl_ms), 0), _LEASE_TTL_MAX) << 16
+            | min(max(int(epoch), 0), _LEASE_EPOCH_MAX) << 40)
+
+
+def _unpack_lease(remaining: int):
+    return (remaining & 0xFFFF, (remaining >> 16) & 0xFFFFFF,
+            (remaining >> 40) & 0x7FFFFF)
 
 _REQ_BODY = struct.Struct("<BII")    # op, a, b (after the u32 len)
 _RESP = struct.Struct("<IBBq")       # len, status, allowed, remaining
@@ -160,6 +202,7 @@ class SidecarServer:
 
     def __init__(self, storage: TpuBatchedStorage, host: str = "0.0.0.0",
                  port: int = 0, *,
+                 leases=None,
                  meter_registry=None,
                  max_frame_bytes: int = 4096,
                  max_key_bytes: int = 1024,
@@ -170,6 +213,9 @@ class SidecarServer:
                  resolve_timeout_ms: float = 30_000.0,
                  drain_timeout_ms: float = 1_000.0):
         self.storage = storage
+        # Token-lease manager (leases/manager.py) behind the v3 LEASE/
+        # RENEW/RELEASE ops; None answers them ERR_LEASE_DISABLED.
+        self._leases = leases
         self.max_frame_bytes = int(max_frame_bytes or 0)
         self.max_key_bytes = int(max_key_bytes or 0)
         self.max_pipeline = int(max_pipeline or 0)
@@ -290,6 +336,12 @@ class SidecarServer:
         decide against the same device counters."""
         self._limiters[int(lid)] = (algo, config)
         return int(lid)
+
+    def attach_leases(self, manager) -> "SidecarServer":
+        """Attach a LeaseManager serving the v3 LEASE/RENEW/RELEASE ops
+        (wiring calls this when ``ratelimiter.lease.enabled``)."""
+        self._leases = manager
+        return self
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "SidecarServer":
@@ -445,9 +497,13 @@ class SidecarServer:
     # -- frame handling -------------------------------------------------------
     def _resp(self, st: _ConnState, status: int, allowed: int,
               remaining: int) -> bytes:
-        """Version-aware response: v2-only statuses downgrade to the
-        generic v1 ERROR (status 1) with a matching errno so v1 clients
-        keep their status!=0-means-error contract."""
+        """Version-aware response: statuses above a connection's
+        negotiated version downgrade to the generic ERROR (status 1)
+        with a matching errno, so older clients keep their
+        status!=0-means-error contract.  (Lease statuses can only arise
+        from v3-gated ops, so the v3 downgrade is pure defense.)"""
+        if st.version < 3 and status == ST_LEASE_REVOKED:
+            status, remaining = ST_ERROR, ERR_LEASE_REVOKED
         if st.version < 2 and status > ST_ERROR:
             if status in _V1_ERRNO:
                 remaining = _V1_ERRNO[status]
@@ -469,14 +525,25 @@ class SidecarServer:
                 self._count_malformed()
                 return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
             if op == OP_HELLO:
-                st.version = PROTOCOL_VERSION if a >= 2 else 1
+                # min(client, server): a v2 client stays on v2 — it
+                # never sees the v3 ops or statuses.
+                st.version = min(int(a), PROTOCOL_VERSION) if a >= 2 else 1
                 return _mk_resp(ST_OK, st.version, self.max_frame_bytes)
             if op == OP_PING:
                 if self._draining:
                     return resp(st, ST_OK, 0, 0)
                 return resp(st, ST_OK,
                             1 if self.storage.is_available() else 0, 0)
-            if op not in (OP_TRY_ACQUIRE, OP_AVAILABLE, OP_RESET):
+            lease_op = op in (OP_LEASE, OP_RENEW, OP_RELEASE)
+            if lease_op and st.version < 3:
+                # The lease ops do not exist below v3: a v2 (or v1)
+                # connection sending one gets the same unknown-op
+                # answer a v2 server would give — and never a lease
+                # status.
+                self._count_malformed()
+                return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
+            if not lease_op and op not in (OP_TRY_ACQUIRE, OP_AVAILABLE,
+                                           OP_RESET):
                 self._count_malformed()
                 return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
             if self._draining:
@@ -491,6 +558,8 @@ class SidecarServer:
             if entry is None:
                 return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
             algo, _cfg = entry
+            if lease_op:
+                return self._lease_frame(st, op, a, b, key)
             if op == OP_TRY_ACQUIRE:
                 return self._begin_acquire(st, algo, a, key,
                                            max(int(b), 1))
@@ -502,6 +571,33 @@ class SidecarServer:
             return resp(st, ST_OK, 1, 0)
         except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
             return resp(st, ST_ERROR, 0, ERR_INTERNAL)
+
+    def _lease_frame(self, st: _ConnState, op: int, lid: int, b: int,
+                     key: str) -> bytes:
+        """One v3 lease op against the attached LeaseManager.  Resolves
+        synchronously (a lease frame amortizes over a whole budget, so
+        it does not ride the pipelined decision path)."""
+        if self._leases is None:
+            return self._resp(st, ST_ERROR, 0, ERR_LEASE_DISABLED)
+        try:
+            if op == OP_LEASE:
+                g = self._leases.grant(lid, key,
+                                       requested=int(b) & 0xFFFF)
+            elif op == OP_RENEW:
+                g = self._leases.renew(lid, key, used=int(b) & 0xFFFF,
+                                       requested=(int(b) >> 16) & 0xFFFF)
+                if g is None:
+                    return self._resp(st, ST_LEASE_REVOKED, 0,
+                                      _pack_lease(0, 0, 0))
+            else:  # OP_RELEASE
+                self._leases.release(lid, key, used=int(b) & 0xFFFF)
+                return self._resp(st, ST_OK, 1, 0)
+            return self._resp(st, ST_OK, 1 if g.granted > 0 else 0,
+                              _pack_lease(g.granted, g.ttl_ms, g.epoch))
+        except KeyError:
+            return self._resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
 
     def _begin_acquire(self, st: _ConnState, algo: str, lid: int, key: str,
                        permits: int):
@@ -603,13 +699,26 @@ class SidecarShedError(RuntimeError):
         self.retry_after_ms = float(retry_after_ms)
 
 
+class LeaseWire(NamedTuple):
+    """Unpacked lease response: (granted, ttl_ms, epoch)."""
+
+    granted: int
+    ttl_ms: int
+    epoch: int
+
+
 class SidecarClient:
     """Minimal pipelining client (reference for other-language ports).
 
-    Speaks protocol v2 by default: sends HELLO at connect and records the
+    Speaks protocol v3 by default: sends HELLO at connect and records the
     negotiated version + the server's frame cap.  ``protocol=1`` skips
     the handshake (byte-compatible with the pre-v2 client); a v1 server
-    answering HELLO with an error also downgrades the client to v1.
+    answering HELLO with an error also downgrades the client to v1, and
+    a v2 server negotiates the connection down to v2 (no lease ops).
+
+    The lease methods (``lease_grant``/``lease_renew``/``lease_release``)
+    make this a ``leases/client.py:LeaseClient`` transport: burn
+    decisions locally, renew one frame per budget.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
@@ -621,8 +730,10 @@ class SidecarClient:
         self.server_max_frame = 0
         if protocol >= 2:
             # The HELLO response carries the negotiated version in the
-            # `allowed` byte — read it raw (no bool coercion).
-            self._send(self._frame(OP_HELLO, PROTOCOL_VERSION, 0, ""))
+            # `allowed` byte — read it raw (no bool coercion).  Sends the
+            # CALLER'S protocol (a v2-pinned client must negotiate v2,
+            # not whatever this module's ceiling is).
+            self._send(self._frame(OP_HELLO, int(protocol), 0, ""))
             status, version, max_frame = self._read_raw()
             if status == ST_OK and version:
                 self.server_version = int(version)
@@ -696,6 +807,44 @@ class SidecarClient:
             self._frame(OP_TRY_ACQUIRE, lid, p, k) for k, p in zip(keys, permits))
         self._send(payload)
         return self._read_responses(len(keys))
+
+    # -- token leases (protocol v3) -------------------------------------------
+    def _lease_roundtrip(self, op: int, lid: int, b: int,
+                         key: str) -> Optional[LeaseWire]:
+        if self.server_version < 3:
+            raise RuntimeError(
+                f"server negotiated protocol v{self.server_version}; "
+                "lease ops need v3")
+        self._send(self._frame(op, lid, b, key))
+        status, allowed, remaining = self._read_raw()
+        if status == ST_LEASE_REVOKED:
+            return None
+        self._check(status, remaining)
+        del allowed
+        return LeaseWire(*_unpack_lease(remaining))
+
+    def lease_grant(self, lid: int, key: str,
+                    requested: int = 0) -> Optional[LeaseWire]:
+        """Charge a per-key budget; ``granted == 0`` means the key stays
+        on the per-decision path for ``ttl_ms`` (retry hint)."""
+        return self._lease_roundtrip(OP_LEASE, lid,
+                                     min(int(requested), 0xFFFF), key)
+
+    def lease_renew(self, lid: int, key: str, used: int,
+                    requested: int = 0) -> Optional[LeaseWire]:
+        """Report ``used`` burns + re-charge; None when REVOKED (the
+        fence epoch advanced — re-grant via :meth:`lease_grant`)."""
+        b = (min(int(used), 0xFFFF)
+             | min(int(requested), 0xFFFF) << 16)
+        return self._lease_roundtrip(OP_RENEW, lid, b, key)
+
+    def lease_release(self, lid: int, key: str, used: int) -> None:
+        """Close a lease: final burn report, unused budget credited."""
+        if self.server_version < 3:
+            return
+        self._send(self._frame(OP_RELEASE, lid,
+                               min(int(used), 0xFFFF), key))
+        self._read_raw()
 
     def available(self, lid: int, key: str) -> int:
         self._send(self._frame(OP_AVAILABLE, lid, 0, key))

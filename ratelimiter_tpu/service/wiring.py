@@ -122,6 +122,9 @@ class AppContext:
     # Self-healing failover (ratelimiter.orchestrator.enabled) — the
     # autonomous fence/promote/re-seed loop over a sharded primary.
     orchestrator: OrchestratorHandle | None = None
+    # Token-lease manager (ratelimiter.lease.enabled) — serves the
+    # sidecar's v3 LEASE/RENEW/RELEASE ops and in-process LeaseClients.
+    leases: object = None
 
     def close(self) -> None:
         if self.sidecar is not None:
@@ -292,6 +295,44 @@ def _maybe_sidecar(storage: RateLimitStorage, props: AppProperties,
     from ratelimiter_tpu.service.sidecar import SidecarServer
 
     return SidecarServer.from_props(storage, props, registry).start()
+
+
+def _maybe_leases(storage: RateLimitStorage, sidecar, props: AppProperties,
+                  registry: MeterRegistry):
+    """Config-gated token-lease tier (OFF by default; ARCHITECTURE §14).
+
+    Builds a ``LeaseManager`` over the SERVING storage (the failover
+    router when the orchestrator is on — lease grants must route to a
+    promoted replacement exactly like decisions) and attaches it to the
+    sidecar's v3 LEASE/RENEW/RELEASE ops when one is running.  Without
+    a sidecar the manager still serves in-process ``LeaseClient``s
+    through ``DirectTransport``."""
+    if not props.get_bool("ratelimiter.lease.enabled", False):
+        return None
+    if not getattr(storage, "supports_device_batching", False) \
+            and not hasattr(storage, "lease_reserve"):
+        import logging
+
+        logging.getLogger("ratelimiter").warning(
+            "ratelimiter.lease.enabled but the %s backend has no "
+            "lease_reserve surface; leases disabled",
+            type(storage).__name__)
+        return None
+    from ratelimiter_tpu.leases import LeaseManager
+
+    manager = LeaseManager(
+        storage,
+        default_budget=props.get_int("ratelimiter.lease.default_budget",
+                                     64),
+        max_budget=props.get_int("ratelimiter.lease.max_budget", 1024),
+        ttl_ms=props.get_float("ratelimiter.lease.ttl_ms", 2000.0),
+        deny_ttl_ms=props.get_float("ratelimiter.lease.deny_ttl_ms", 25.0),
+        max_leases=props.get_int("ratelimiter.lease.max_leases", 65536),
+        registry=registry,
+    )
+    if sidecar is not None:
+        sidecar.attach_leases(manager)
+    return manager
 
 
 def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
@@ -494,6 +535,7 @@ def build_app(props: AppProperties | None = None,
     breaker = None
     sidecar = None
     orchestrator = None
+    leases = None
     if own_storage:
         # Self-healing failover (the orchestrator owns its OWN per-shard
         # replication into an in-process standby mesh, so it supersedes
@@ -546,6 +588,10 @@ def build_app(props: AppProperties | None = None,
         # the breaker/retry wrappers compose around — warmup and the
         # link probe above ran against the raw device storage.
         storage = serving
+        # Leases grant against the SERVING storage (router when
+        # present) so a promoted replacement receives the charges for
+        # its keys exactly like decisions.
+        leases = _maybe_leases(serving, sidecar, props, registry)
         wrapped, breaker = _maybe_breaker(_maybe_chaos(storage, props),
                                           props, registry)
         storage = _maybe_retry(wrapped, props)
@@ -595,4 +641,5 @@ def build_app(props: AppProperties | None = None,
         sidecar=sidecar,
         recorder=recorder,
         orchestrator=orchestrator,
+        leases=leases,
     )

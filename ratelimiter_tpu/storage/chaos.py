@@ -415,7 +415,7 @@ def ingress_drill(
         oracle_sw = SlidingWindowOracle(cfg_sw)
         oracle_tb = TokenBucketOracle(cfg_tb)
         healthy = sc.SidecarClient("127.0.0.1", server.port)
-        assert healthy.server_version == 2, "v2 handshake failed"
+        assert healthy.server_version == 3, "handshake failed"
 
         def healthy_wave() -> None:
             """Pipelined decisions on the DIRECT path, oracle-checked."""
@@ -1340,6 +1340,289 @@ def orchestrated_failover_drill(
         if report["mismatches"]:
             raise AssertionError(
                 f"orchestrated failover diverged from the oracle: {report}")
+        return report
+    finally:
+        orch.close()
+        repl.stop()
+        router.close()
+        mesh_set.close()
+
+
+def lease_failover_drill(
+    n_shards: int = 4,
+    slots_per_shard: int = 256,
+    n_keys: int = 16,
+    burns: int = 600,
+    budget: int = 16,
+    seed: int = 0,
+    registry=None,
+    probe_interval_ms: float = 50.0,
+    suspect_threshold: int = 3,
+    hysteresis_ms: float = 200.0,
+) -> dict:
+    """Token leases under failure: dead clients, a killed shard, and an
+    orchestrated promotion — with the lease over-admission bound held
+    and the reserve/credit stream reconciling bit-identically against
+    ``semantics/oracle.py`` once renewals drain.  Proves:
+
+    - **wire collapse**: a leased client burning ``burns`` decisions
+      spends <= burns/10 wire round trips (the >=10x frame reduction is
+      the subsystem's reason to exist — the loopback bench gates the
+      TCP version of the same claim);
+    - **dead client is bounded by construction**: killing a client
+      mid-burn strands only its outstanding budget, each per-key term
+      <= the grant cap <= the policy's ``max_permits`` (the reserve
+      kernel bounded every grant by the remaining-window budget), and
+      the strand is reclaimed: after TTL expiry the key grants again;
+    - **honor-or-revoke across failover**: the orchestrator kills one
+      shard and promotes its standby with zero manual calls; burns made
+      against outstanding leases during the failover window are honored
+      locally (bounded by the outstanding budget at fence time), every
+      renewal after the fence-epoch bump is REVOKED (never silently
+      honored against the wrong backend), re-grants land on the
+      promoted replacement carrying the new epoch, and the manager's
+      ``over_admission`` counter accounts exactly the burns reported on
+      revoked leases;
+    - **bit-identical reconciliation**: after every lease is released
+      and renewals drain, replaying the manager's recorded reserve/
+      credit stream into the oracles reproduces the device counters
+      bit-for-bit for every key (grants included — each replayed
+      reserve must grant exactly what the device granted).
+
+    Deterministic: controlled decision clock, simulated orchestrator
+    clock, in-process transports.  Raises AssertionError on any
+    violated claim; returns a report dict.
+    """
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.leases import DirectTransport, LeaseClient, LeaseManager
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_key
+    from ratelimiter_tpu.replication import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    clock = {"t": 1_753_000_000_000}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    primary = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    router = ShardFailoverRouter(primary)
+    cfg_tb = RateLimitConfig(max_permits=1 << 14, window_ms=60_000,
+                             refill_rate=1000.0)
+    cfg_sw = RateLimitConfig(max_permits=1 << 14, window_ms=60_000,
+                             enable_local_cache=False)
+    lid_tb = primary.register_limiter("tb", cfg_tb)
+    lid_sw = primary.register_limiter("sw", cfg_sw)
+
+    def standby_factory():
+        return TpuBatchedStorage(num_slots=slots_per_shard,
+                                 clock_ms=lambda: clock["t"])
+
+    mesh_set = ShardStandbySet(n_shards, standby_factory, registry=registry)
+    repl = ShardedReplicator(ShardedReplicationLog(primary),
+                             mesh_set.in_process_sinks(), registry=registry)
+    sim = {"s": 0.0}
+    dead = {"flag": False}
+    victim_box = [None]
+    cfg = OrchestratorConfig(probe_interval_ms=probe_interval_ms,
+                             suspect_threshold=suspect_threshold,
+                             hysteresis_ms=hysteresis_ms,
+                             promote_backoff_ms=1.0)
+
+    def probe(q):
+        return not (dead["flag"] and q == victim_box[0])
+
+    orch = FailoverOrchestrator(
+        router, mesh_set, repl, standby_factory=standby_factory,
+        config=cfg, probe=probe, registry=registry,
+        clock=lambda: sim["s"], sleep=lambda s: None)
+
+    def tick(n=1):
+        for _ in range(n):
+            sim["s"] += cfg.probe_interval_ms / 1000.0
+            orch.tick()
+
+    mgr = LeaseManager(router, default_budget=budget, max_budget=budget,
+                       ttl_ms=5_000.0, registry=registry, record_ops=True,
+                       clock_ms=lambda: clock["t"])
+    # Strict lease-only clients: every device mutation flows through the
+    # replayable reserve/credit log (no per-decision fallback traffic).
+    cli_tb = LeaseClient(DirectTransport(mgr), lid_tb, budget=budget,
+                         clock_ms=lambda: clock["t"],
+                         direct_fallback=False)
+    cli_sw = LeaseClient(DirectTransport(mgr), lid_sw, budget=budget,
+                         clock_ms=lambda: clock["t"],
+                         direct_fallback=False)
+    tb_keys = [f"lease-tb-{i}" for i in range(n_keys)]
+    sw_keys = [f"lease-sw-{i}" for i in range(n_keys)]
+    report = {"decisions": 0, "local_denies": 0}
+
+    try:
+        # -- Phase A: healthy leased burn (both algos) --------------------
+        for i in range(burns):
+            clock["t"] += 1
+            assert cli_tb.try_acquire(tb_keys[i % n_keys]), "tb burn denied"
+            assert cli_sw.try_acquire(sw_keys[i % n_keys]), "sw burn denied"
+            report["decisions"] += 2
+            if i % 100 == 0:
+                repl.ship_now()
+                tick()
+        wire = cli_tb.wire_ops + cli_sw.wire_ops
+        assert wire * 10 <= report["decisions"], (
+            f"wire ops {wire} for {report['decisions']} decisions — "
+            "the >=10x frame reduction failed in-process")
+        report["wire_ops_healthy"] = wire
+
+        # -- Phase B: dead client — bounded strand, reclaimed by TTL ------
+        # A dedicated short-TTL manager so the expiry advance cannot
+        # expire the main clients' leases (one lease per key per
+        # manager; "dead-key" belongs only to this one).
+        mgr_dead = LeaseManager(router, default_budget=budget,
+                                max_budget=budget, ttl_ms=5.0,
+                                record_ops=True,
+                                clock_ms=lambda: clock["t"])
+        cli_dead = LeaseClient(DirectTransport(mgr_dead), lid_tb,
+                               budget=budget,
+                               clock_ms=lambda: clock["t"],
+                               direct_fallback=False)
+        for i in range(budget // 2):
+            assert cli_dead.try_acquire("dead-key")
+        stranded = cli_dead.drop()
+        assert set(stranded) == {"dead-key"}
+        assert 0 < stranded["dead-key"]["remaining"] <= budget \
+            <= cfg_tb.max_permits, "strand exceeds the grant bound"
+        expired_before = mgr_dead.expired_total
+        clock["t"] += int(mgr_dead.ttl_ms) + 1  # past the lease TTL
+        g = mgr_dead.grant(lid_tb, "dead-key", budget)
+        assert g.granted > 0, "expired lease still blocks the key"
+        assert mgr_dead.expired_total == expired_before + 1
+        mgr_dead.release(lid_tb, "dead-key", 0)
+        report["stranded_budget"] = stranded["dead-key"]["remaining"]
+
+        # -- Phase C: orchestrated failover — honor-or-revoke -------------
+        # Victim: the shard holding the most leased tb keys.
+        shard_of = {k: int(shard_of_key((lid_tb, k), n_shards))
+                    for k in tb_keys}
+        counts = [0] * n_shards
+        for k in tb_keys:
+            counts[shard_of[k]] += 1
+        victim = victim_box[0] = int(np.argmax(counts))
+        victim_keys = [k for k in tb_keys if shard_of[k] == victim]
+        assert victim_keys, "degenerate key split; raise n_keys"
+        # Complete replication BEFORE the kill: every charge is on the
+        # standby, so the reconciliation phase is exact (the unshipped-
+        # epoch delta is exactly the documented over-admission term).
+        repl.ship_now()
+        epoch_before = orch.fence_epoch
+        dead["flag"] = True
+        burned_after_fence = 0
+        ticks = 0
+        while orch.fence_epoch == epoch_before and ticks < 64:
+            tick()
+            ticks += 1
+        assert orch.fence_epoch > epoch_before, "never fenced"
+        # Burns against outstanding leases during the failover window
+        # are honored LOCALLY — this is the bounded over-admission.
+        outstanding_at_fence = {
+            k: cli_tb._leases[k].remaining for k in victim_keys
+            if k in cli_tb._leases}
+        for k in victim_keys:
+            lease = cli_tb._leases.get(k)
+            while lease is not None and lease.remaining > 0:
+                clock["t"] += 1
+                assert cli_tb.try_acquire(k)
+                burned_after_fence += 1
+        assert burned_after_fence == sum(outstanding_at_fence.values())
+        assert all(v <= budget <= cfg_tb.max_permits
+                   for v in outstanding_at_fence.values()), (
+            "outstanding budget exceeds the per-key bound")
+        # Settle the promotion.
+        settle = 0
+        while (orch.status()["shards"][victim]["state"] != "MONITORING"
+               and settle < 32):
+            tick()
+            settle += 1
+        assert orch.promotions == 1
+        assert router.shard_health()[victim] == "promoted"
+        dead["flag"] = False
+        # Every renewal now hits the fence-epoch check: REVOKED, then
+        # the client re-grants against the promoted replacement.
+        over_before = mgr.over_admission_total
+        revoked_before = mgr.revoked_total
+        used_unreported = {k: cli_tb._leases[k].used
+                           for k in victim_keys if k in cli_tb._leases}
+        post_burns = 0
+        for k in victim_keys:
+            clock["t"] += 1
+            assert cli_tb.try_acquire(k), (
+                "post-promotion re-grant failed to serve")
+            post_burns += 1
+        assert mgr.revoked_total > revoked_before, "no lease was revoked"
+        assert cli_tb.revoked_seen >= 1
+        # over_admission accounts exactly the burns reported on revoked
+        # leases (every other burn was reported on a live renewal).
+        assert mgr.over_admission_total - over_before == \
+            sum(used_unreported.values()), (
+            mgr.over_admission_total, over_before, used_unreported)
+        # Fresh grants carry the new fence epoch.
+        for k in victim_keys:
+            if k in cli_tb._leases:
+                assert cli_tb._leases[k].epoch == orch.fence_epoch, (
+                    "re-grant does not carry the bumped fence epoch")
+        report["decisions"] += burned_after_fence + post_burns
+        report["burned_after_fence"] = burned_after_fence
+        report["revoked"] = mgr.revoked_total
+        report["over_admission"] = mgr.over_admission_total
+
+        # -- Phase D: drain + bit-identical reconciliation ----------------
+        cli_tb.release_all()
+        cli_sw.release_all()
+        router.flush()
+        oracle_tb = TokenBucketOracle(cfg_tb)
+        oracle_sw = SlidingWindowOracle(cfg_sw)
+        oracles = {"tb": oracle_tb, "sw": oracle_sw}
+        # The two managers touch disjoint key sets, so appending the
+        # dead-client log preserves per-key operation order.
+        for op in mgr.ops + mgr_dead.ops:
+            if op[0] == "reserve":
+                _, algo, _lid, key, req, granted, ws, stamp = op
+                g, w = oracles[algo].reserve(key, req, stamp)
+                assert (g, w) == (granted, ws), (
+                    f"replayed reserve diverged for {key!r}: oracle "
+                    f"({g}, {w}) vs device ({granted}, {ws})")
+            else:
+                _, algo, _lid, key, unused, ws, stamp = op
+                oracles[algo].credit(key, unused, ws, stamp)
+        now = clock["t"]
+        for k in tb_keys + ["dead-key"]:
+            got = int(router.available_many("tb", lid_tb, [k])[0])
+            want = oracle_tb.get_available_permits(k, now)
+            assert got == want, (
+                f"tb availability diverged for {k!r}: device {got} vs "
+                f"oracle {want}")
+        for k in sw_keys:
+            got = int(router.available_many("sw", lid_sw, [k])[0])
+            want = oracle_sw.get_available_permits(k, now)
+            assert got == want, (
+                f"sw availability diverged for {k!r}: device {got} vs "
+                f"oracle {want}")
+        report["local_denies"] = cli_tb.local_denies + cli_sw.local_denies
+        report["status"] = mgr.status()
+        report["promotions"] = orch.promotions
+        report["fence_epoch"] = orch.fence_epoch
         return report
     finally:
         orch.close()

@@ -2867,6 +2867,65 @@ class TpuBatchedStorage(RateLimitStorage):
         self._clear_slots(algo, [slot])
         index.remove((lid, key))
 
+    # ------------------------------------------------------------------------
+    # Token leases (leases/): atomic bulk reserve / credit
+    # ------------------------------------------------------------------------
+    def lease_reserve(self, algo: str, lid: int, key: str,
+                      requested: int) -> Dict:
+        """Atomically charge up to ``requested`` permits for one key
+        against the live device counters — the grant side of a token
+        lease (leases/manager.py).  Pending micro-batch traffic is
+        flushed first so the grant observes every decision already
+        admitted.  Runs the fused RESERVE kernel (ops/lease.py) on the
+        single-device engine, the exclusive host round trip on the
+        sharded mesh.  Returns ``{"granted", "ws", "stamp"}`` —
+        ``ws`` is the charged window start (sliding window; 0 for the
+        token bucket), which :meth:`lease_credit` must present.
+
+        The same fence/promotion checks guard this as every decision
+        surface: a fenced storage refuses with ``FencedError``, which
+        the lease manager converts into lease revocation."""
+        self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid], [key])
+        if self._serving is not None:
+            # A leased key's state mutates outside the hybrid tier's
+            # watch: its adopted snapshot is stale the moment the
+            # reserve lands.
+            self._serving.invalidate(algo, lid, key)
+        self._batcher.flush()
+        slot = self._assign_slot(algo, lid, key, hold_pin=True)
+        with self._pins_released(self._index[algo], [slot]):
+            now = self._monotonic_now()
+            granted, ws = self.engine.lease_reserve(
+                algo, [slot], [int(lid)], [int(requested)], now)
+        return {"granted": int(granted[0]), "ws": int(ws[0]),
+                "stamp": int(now)}
+
+    def lease_credit(self, algo: str, lid: int, key: str, credit: int,
+                     grant_ws: int) -> Dict:
+        """Return ``credit`` unused reserved permits for one key (lease
+        renewal/release).  A key whose slot was evicted credits nothing
+        — its charge was cleared with the slot.  Returns ``{"credited",
+        "stamp"}`` (the stamp makes the operation replayable against
+        the oracle bit-for-bit — leases/manager.py records it)."""
+        self._check_not_promoting()
+        if self._fenced_shards:
+            self._check_fence_keys([lid], [key])
+        index = self._index[algo]
+        if index.get((lid, key)) is None:
+            return {"credited": 0, "stamp": 0}
+        if self._serving is not None:
+            self._serving.invalidate(algo, lid, key)
+        self._batcher.flush()
+        slot = index.get((lid, key))
+        if slot is None:
+            return {"credited": 0, "stamp": 0}
+        now = self._monotonic_now()
+        credited = self.engine.lease_credit(
+            algo, [slot], [int(lid)], [int(credit)], [int(grant_ws)], now)
+        return {"credited": int(credited[0]), "stamp": int(now)}
+
     def flush(self) -> None:
         self._batcher.flush()
 

@@ -1,0 +1,333 @@
+"""Token leases (leases/ + ops/lease.py + protocol v3).
+
+Layers under test, bottom-up:
+
+- the RESERVE/CREDIT device kernels against their oracle specification
+  (``semantics/oracle.py:reserve/credit``) — bit-identical over random
+  interleavings, including duplicate-slot batches (greedy segmented
+  grants) and the sharded engine's host round-trip path;
+- the storage surface: fence checks, eviction-safe credits, stamps;
+- the LeaseManager: one lease per key, TTL clamping to the sliding
+  window, fence-epoch revocation, table bounds;
+- the LeaseClient: local burn, wire-frame collapse, renewal, fallback;
+- the chaos drill (the fast variant verify.sh runs).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.leases import DirectTransport, LeaseClient, LeaseManager
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.storage import TpuBatchedStorage
+from ratelimiter_tpu.storage.errors import FencedError
+
+T0 = 1_753_000_000_000
+
+
+def make_storage(clock, **kw):
+    return TpuBatchedStorage(num_slots=256, clock_ms=lambda: clock["t"],
+                             **kw)
+
+
+# ---------------------------------------------------------------------------
+# Kernels vs oracle (the bit-identity contract)
+# ---------------------------------------------------------------------------
+
+def test_reserve_credit_matches_oracle_random_stream():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg_sw = RateLimitConfig(max_permits=20, window_ms=2000,
+                             enable_local_cache=False)
+    cfg_tb = RateLimitConfig(max_permits=50, window_ms=2000,
+                             refill_rate=10.0)
+    lsw = st.register_limiter("sw", cfg_sw)
+    ltb = st.register_limiter("tb", cfg_tb)
+    osw = SlidingWindowOracle(cfg_sw)
+    otb = TokenBucketOracle(cfg_tb)
+    rng = random.Random(0)
+    ws_store = {}
+    try:
+        for step in range(250):
+            clock["t"] += rng.choice([1, 7, 250, 999, 2000, 2501])
+            now = clock["t"]
+            key = f"k{rng.randrange(4)}"
+            kind = rng.choice(["res_sw", "res_tb", "cred_sw", "cred_tb"])
+            if kind == "res_sw":
+                req = rng.randrange(1, 30)
+                out = st.lease_reserve("sw", lsw, key, req)
+                g, ws = osw.reserve(key, req, now)
+                assert (out["granted"], out["ws"]) == (g, ws), (step, kind)
+                ws_store[key] = out["ws"]
+            elif kind == "res_tb":
+                req = rng.randrange(1, 60)
+                out = st.lease_reserve("tb", ltb, key, req)
+                assert out["granted"] == otb.reserve(key, req, now)[0], (
+                    step, kind)
+            elif kind == "cred_sw":
+                ws = ws_store.get(key, 0)
+                c = rng.randrange(0, 10)
+                out = st.lease_credit("sw", lsw, key, c, ws)
+                assert out["credited"] == osw.credit(key, c, ws, now), (
+                    step, kind)
+            else:
+                c = rng.randrange(0, 20)
+                out = st.lease_credit("tb", ltb, key, c, 0)
+                assert out["credited"] == otb.credit(key, c, 0, now), (
+                    step, kind)
+            # Availability must stay bit-identical after every op.
+            assert int(st.available_many("sw", lsw, [key])[0]) == \
+                osw.get_available_permits(key, now), step
+            assert int(st.available_many("tb", ltb, [key])[0]) == \
+                otb.get_available_permits(key, now), step
+    finally:
+        st.close()
+
+
+def test_reserve_duplicate_slots_grant_greedily():
+    """A batch reserving the SAME slot twice grants sequentially —
+    exactly two back-to-back oracle reserves at one timestamp."""
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=25, window_ms=2000, refill_rate=8.0)
+    lid = st.register_limiter("tb", cfg)
+    oracle = TokenBucketOracle(cfg)
+    try:
+        slot = st._assign_slot("tb", lid, "dup", hold_pin=False)
+        now = clock["t"]
+        granted, _ = st.engine.lease_reserve(
+            "tb", [slot, slot], [lid, lid], [20, 20], now)
+        want = [oracle.reserve("dup", 20, now)[0],
+                oracle.reserve("dup", 20, now)[0]]
+        assert list(granted) == want == [20, 5]
+    finally:
+        st.close()
+
+
+def test_reserve_on_sharded_engine_matches_oracle():
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+
+    clock = {"t": T0}
+    engine = ShardedDeviceEngine(slots_per_shard=64, table=LimiterTable(),
+                                 mesh=make_mesh(n_devices=4))
+    st = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    cfg = RateLimitConfig(max_permits=30, window_ms=2000,
+                          enable_local_cache=False)
+    lid = st.register_limiter("sw", cfg)
+    oracle = SlidingWindowOracle(cfg)
+    rng = random.Random(3)
+    ws_store = {}
+    try:
+        for step in range(60):
+            clock["t"] += rng.choice([1, 250, 999, 2000])
+            now = clock["t"]
+            key = f"shk{rng.randrange(6)}"
+            if rng.random() < 0.6:
+                req = rng.randrange(1, 20)
+                out = st.lease_reserve("sw", lid, key, req)
+                g, ws = oracle.reserve(key, req, now)
+                assert (out["granted"], out["ws"]) == (g, ws), step
+                ws_store[key] = out["ws"]
+            else:
+                c = rng.randrange(0, 8)
+                out = st.lease_credit("sw", lid, key, c,
+                                      ws_store.get(key, 0))
+                assert out["credited"] == oracle.credit(
+                    key, c, ws_store.get(key, 0), now), step
+            assert int(st.available_many("sw", lid, [key])[0]) == \
+                oracle.get_available_permits(key, now), step
+    finally:
+        st.close()
+
+
+def test_fenced_storage_refuses_lease_ops():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    lid = st.register_limiter("tb", RateLimitConfig(
+        max_permits=10, window_ms=1000, refill_rate=5.0))
+    try:
+        out = st.lease_reserve("tb", lid, "a", 4)
+        assert out["granted"] == 4
+        st.fence(7)
+        with pytest.raises(FencedError):
+            st.lease_reserve("tb", lid, "a", 4)
+        with pytest.raises(FencedError):
+            st.lease_credit("tb", lid, "a", 2, 0)
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# LeaseManager policy
+# ---------------------------------------------------------------------------
+
+def test_manager_one_lease_per_key_and_release():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                          refill_rate=50.0)
+    lid = st.register_limiter("tb", cfg)
+    mgr = LeaseManager(st, default_budget=16, ttl_ms=1000.0,
+                       clock_ms=lambda: clock["t"])
+    try:
+        g = mgr.grant(lid, "k", 16)
+        assert g.granted == 16
+        # Second grant on a live lease is refused (one burner per key).
+        assert mgr.grant(lid, "k", 16).granted == 0
+        # Renew credits the unused remainder and re-charges.
+        g2 = mgr.renew(lid, "k", used=10)
+        assert g2 is not None and g2.granted == 16
+        assert int(st.available_many("tb", lid, ["k"])[0]) == 100 - 10 - 16
+        mgr.release(lid, "k", used=4)
+        assert mgr.table.outstanding() == 0
+        assert int(st.available_many("tb", lid, ["k"])[0]) == 100 - 14
+    finally:
+        st.close()
+
+
+def test_manager_sw_ttl_clamps_to_remaining_window():
+    clock = {"t": (T0 // 2000) * 2000 + 1500}  # 500 ms left in the window
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=100, window_ms=2000,
+                          enable_local_cache=False)
+    lid = st.register_limiter("sw", cfg)
+    mgr = LeaseManager(st, default_budget=8, ttl_ms=60_000.0,
+                       clock_ms=lambda: clock["t"])
+    try:
+        g = mgr.grant(lid, "k", 8)
+        assert g.granted == 8
+        # The lease must not outlive the charged window.
+        assert g.ttl_ms <= 500
+    finally:
+        st.close()
+
+
+def test_manager_fence_epoch_revokes_on_renew():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                          refill_rate=50.0)
+    lid = st.register_limiter("tb", cfg)
+    registry = MeterRegistry()
+    mgr = LeaseManager(st, default_budget=16, ttl_ms=10_000.0,
+                       clock_ms=lambda: clock["t"], registry=registry)
+    try:
+        g = mgr.grant(lid, "k", 16)
+        assert g.granted == 16 and g.epoch == 0
+        st.fence(3)
+        st.lift_fence(3)  # epoch stays 3; storage serves again
+        assert mgr.renew(lid, "k", used=5) is None  # REVOKED
+        assert mgr.revoked_total == 1
+        assert mgr.over_admission_total == 5
+        # Re-grant carries the new epoch.
+        g2 = mgr.grant(lid, "k", 16)
+        assert g2.granted == 16 and g2.epoch == 3
+        meters = registry.scrape()
+        assert meters["ratelimiter.lease.revoked"] == 1.0
+        assert meters["ratelimiter.lease.over_admission"] == 5.0
+    finally:
+        st.close()
+
+
+def test_manager_table_bound_refuses_and_uncharges():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                          refill_rate=50.0)
+    lid = st.register_limiter("tb", cfg)
+    mgr = LeaseManager(st, default_budget=8, ttl_ms=10_000.0,
+                       max_leases=2, clock_ms=lambda: clock["t"])
+    try:
+        assert mgr.grant(lid, "a", 8).granted == 8
+        assert mgr.grant(lid, "b", 8).granted == 8
+        assert mgr.grant(lid, "c", 8).granted == 0  # table full
+        # The refused grant's charge was credited back.
+        assert int(st.available_many("tb", lid, ["c"])[0]) == 100
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# LeaseClient burn semantics
+# ---------------------------------------------------------------------------
+
+def test_client_wire_collapse_and_reconcile():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=500, window_ms=2000,
+                          refill_rate=100.0)
+    lid = st.register_limiter("tb", cfg)
+    mgr = LeaseManager(st, default_budget=32, ttl_ms=5000.0,
+                       record_ops=True, clock_ms=lambda: clock["t"])
+    cli = LeaseClient(DirectTransport(mgr), lid, budget=32,
+                      clock_ms=lambda: clock["t"], direct_fallback=False)
+    try:
+        allowed = 0
+        for _ in range(300):
+            clock["t"] += 1
+            allowed += bool(cli.try_acquire("hot"))
+        assert allowed == 300
+        assert cli.wire_ops * 10 <= 300
+        cli.release_all()
+        st.flush()
+        oracle = TokenBucketOracle(cfg)
+        for op in mgr.ops:
+            if op[0] == "reserve":
+                _, _a, _l, key, req, granted, _ws, stamp = op
+                assert oracle.reserve(key, req, stamp)[0] == granted
+            else:
+                _, _a, _l, key, unused, ws, stamp = op
+                oracle.credit(key, unused, ws, stamp)
+        assert int(st.available_many("tb", lid, ["hot"])[0]) == \
+            oracle.get_available_permits("hot", clock["t"])
+    finally:
+        st.close()
+
+
+def test_client_falls_back_per_decision_on_contended_key():
+    """granted == 0 (key leased elsewhere) -> the client forwards each
+    decision to the ordinary acquire path: the device arbitrates."""
+    clock = {"t": T0}
+    st = make_storage(clock)
+    cfg = RateLimitConfig(max_permits=100, window_ms=60_000,
+                          refill_rate=50.0)
+    lid = st.register_limiter("tb", cfg)
+    mgr = LeaseManager(st, default_budget=16, ttl_ms=10_000.0,
+                       clock_ms=lambda: clock["t"])
+    holder = LeaseClient(DirectTransport(mgr), lid, budget=16,
+                         clock_ms=lambda: clock["t"])
+    contender = LeaseClient(DirectTransport(mgr), lid, budget=16,
+                            clock_ms=lambda: clock["t"],
+                            direct_fallback=True)
+    try:
+        assert holder.try_acquire("shared")   # holder owns the lease
+        assert contender.try_acquire("shared")  # served per-decision
+        assert contender.wire_ops >= 2        # grant attempt + fallback
+        assert contender.local_decisions == 0
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# The drill (fast variant; verify.sh runs this)
+# ---------------------------------------------------------------------------
+
+def test_lease_failover_drill_fast():
+    from ratelimiter_tpu.storage.chaos import lease_failover_drill
+
+    registry = MeterRegistry()
+    report = lease_failover_drill(registry=registry)
+    assert report["promotions"] == 1
+    assert report["decisions"] > 1000
+    assert report["wire_ops_healthy"] * 10 <= report["decisions"]
+    assert report["burned_after_fence"] <= \
+        report["status"]["outstanding_budget"] + 16 * 16  # bounded
+    meters = registry.scrape()
+    assert meters["ratelimiter.lease.granted"] >= 1.0
+    assert meters["ratelimiter.lease.revoked"] >= 1.0
+    assert meters["ratelimiter.lease.local_decisions"] > 1000.0
+    assert meters["ratelimiter.lease.outstanding"] == 0.0

@@ -44,6 +44,7 @@ def test_all_registered_meters_are_documented():
         "link.probe.enabled": "false",
         "ratelimiter.sidecar.enabled": "true",
         "ratelimiter.sidecar.port": "0",
+        "ratelimiter.lease.enabled": "true",
         "ratelimiter.obs.trace_sample": "4",
     })
     ctx = build_app(props)
@@ -80,5 +81,8 @@ def test_catalog_regex_expands_families():
     for expected in ("ratelimiter.stream.pack", "ratelimiter.stream.fetch",
                      "ratelimiter.sidecar.pipeline_shed",
                      "ratelimiter.replication.applied_epoch",
-                     "ratelimiter.requests.allowed"):
+                     "ratelimiter.requests.allowed",
+                     "ratelimiter.lease.granted",
+                     "ratelimiter.lease.local_decisions",
+                     "ratelimiter.lease.over_admission"):
         assert expected in names, expected

@@ -268,3 +268,31 @@ def test_concurrent_submitters_staged_correctness(storage):
     for t in threads:
         t.join(timeout=60)
     assert not errors
+
+
+def test_warm_micro_shapes_rounds_to_buckets_no_recompile(storage):
+    """The PR 11 footgun, guarded: warming with NON-bucket sizes must
+    round up to the real dispatch buckets (a warm dispatch whose n is
+    below its buffer width would slice down and compile a lane count
+    the batcher never produces).  After a public-API warm with odd
+    sizes, steady-state micro traffic compiles NOTHING new."""
+    from ratelimiter_tpu.engine.engine import DeviceEngine, _bucket_size
+
+    cfg = RateLimitConfig(max_permits=1_000_000, window_ms=60_000)
+    lid = storage.register_limiter("sw", cfg)
+    # Odd sizes: each must round UP to its pow2 bucket (48 -> 64,
+    # 100 -> 128, 1 -> 32) instead of warming phantom executables.
+    assert isinstance(storage.engine, DeviceEngine)
+    storage.engine.warm_micro_shapes(sizes=(1, 48, 100))
+    assert {_bucket_size(n) for n in (1, 48, 100)} == {32, 64, 128}
+    compiles = DeviceEngine.micro_compile_count()
+    # Steady micro traffic across every warmed bucket: zero recompiles.
+    for n in (1, 20, 33, 48, 64, 100, 128):
+        futs = [storage.acquire_async("sw", lid, f"warm{n}-{i}", 1)
+                for i in range(n)]
+        storage.flush()
+        for f in futs:
+            assert bool(f.result(timeout=30)["allowed"])
+    assert DeviceEngine.micro_compile_count() == compiles, (
+        "micro traffic recompiled after a public-API warm — the "
+        "bucket-rounding guard regressed")

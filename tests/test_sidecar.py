@@ -102,15 +102,96 @@ def test_error_paths(sidecar):
 
 
 # ---------------------------------------------------------------------------
-# Protocol v2: handshake, downgrade, edge frames (all answered in-protocol)
+# Protocol v2/v3: handshake, downgrade, edge frames (answered in-protocol)
 # ---------------------------------------------------------------------------
 
-def test_v2_handshake_negotiates(sidecar):
+def test_v3_handshake_negotiates(sidecar):
     server, _ = sidecar
     client = SidecarClient("127.0.0.1", server.port)
-    assert client.server_version == 2
+    assert client.server_version == 3
     assert client.server_max_frame == server.max_frame_bytes
     client.close()
+
+
+def test_v2_client_negotiates_down_and_never_sees_lease_ops(sidecar):
+    """min(client, server): a v2 HELLO stays on v2, and the v3 lease ops
+    are unknown ops on that connection — answered BAD_FRAME, never a
+    lease status — even with a lease manager attached."""
+    from ratelimiter_tpu.leases import LeaseManager
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    server.attach_leases(LeaseManager(server.storage))
+    client = SidecarClient("127.0.0.1", server.port, protocol=2)
+    assert client.server_version == 2
+    for op in (sc.OP_LEASE, sc.OP_RENEW, sc.OP_RELEASE):
+        client._send(client._frame(op, lid, 8, "k"))
+        status, _, errno = client._read_raw()
+        assert (status, errno) == (sc.ST_BAD_FRAME, sc.ERR_UNKNOWN_OP), op
+    # ... and the ordinary v2 decision path still serves afterwards.
+    assert client.try_acquire(lid, "v2-still-works") is True
+    client.close()
+
+
+def test_unknown_op_on_v3_connection_is_bad_frame(sidecar):
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    client = SidecarClient("127.0.0.1", server.port)
+    assert client.server_version == 3
+    client._send(client._frame(42, lid, 0, "k"))
+    status, _, errno = client._read_raw()
+    assert (status, errno) == (sc.ST_BAD_FRAME, sc.ERR_UNKNOWN_OP)
+    assert client.try_acquire(lid, "after-unknown-op") is True
+    client.close()
+
+
+def test_lease_ops_without_manager_answer_disabled(sidecar):
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    client = SidecarClient("127.0.0.1", server.port)
+    client._send(client._frame(sc.OP_LEASE, lid, 8, "k"))
+    status, _, errno = client._read_raw()
+    assert (status, errno) == (sc.ST_ERROR, sc.ERR_LEASE_DISABLED)
+    client.close()
+
+
+def test_lease_wire_round_trip_and_local_burn(sidecar):
+    """Full v3 lease cycle over TCP: grant -> local burns -> renew ->
+    release, with the decision stream matching a per-decision oracle
+    replay of the charges."""
+    from ratelimiter_tpu.leases import LeaseClient, LeaseManager
+
+    server, clock = sidecar
+    cfg = RateLimitConfig(max_permits=500, window_ms=60_000,
+                          refill_rate=100.0)
+    lid = server.register("tb", cfg)
+    mgr = LeaseManager(server.storage, default_budget=16, ttl_ms=10_000.0,
+                       clock_ms=lambda: clock.t)
+    server.attach_leases(mgr)
+    wire = SidecarClient("127.0.0.1", server.port)
+    cli = LeaseClient(wire, lid, budget=16, clock_ms=lambda: clock.t,
+                      direct_fallback=False)
+    allowed = sum(1 for _ in range(100) if cli.try_acquire("leased-key"))
+    assert allowed == 100
+    assert cli.wire_ops <= 100 // 10  # >= 10x frame reduction
+    cli.release_all()
+    assert mgr.table.outstanding() == 0
+    # Everything the client burned was pre-charged on the device.
+    st = mgr.status()
+    assert st["local_decisions"] == 100
+    assert st["over_admission"] == 0
+    avail = int(server.storage.available_many("tb", lid,
+                                              ["leased-key"])[0])
+    assert avail == cfg.max_permits - 100
+    wire.close()
 
 
 def test_v1_client_interoperates_unchanged(sidecar):

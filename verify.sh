@@ -129,6 +129,29 @@ else
   fail=1
 fi
 
+echo "running fast lease failover drill (leases honored-or-revoked, bounded over-admission)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_leases.py::test_lease_failover_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  lease failover drill"
+else
+  echo "  FAILED  lease failover drill (a leased client or a promoted"
+  echo "          standby broke the over-admission bound, or the"
+  echo "          reserve/credit replay diverged from the oracle)"
+  fail=1
+fi
+
+echo "running lease loopback gate (>= 10x wire-frame reduction at equal+ throughput)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/lease_loopback.py \
+    --assert-ratio > /dev/null; then
+  echo "  ok  lease wire-frame reduction"
+else
+  echo "  FAILED  lease loopback (fewer than 10x frames saved per decision"
+  echo "          vs the per-decision v2 path, or leased throughput fell"
+  echo "          below the v2 baseline)"
+  fail=1
+fi
+
 echo "running orchestrator idle overhead gate (probe loop <= 2% steady-state)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
     bench/orchestrator_overhead.py --n 1048576 --rounds 3 \
