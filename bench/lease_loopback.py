@@ -85,7 +85,8 @@ def v2_pass(server, lid, reps: int) -> dict:
 
 
 def lease_pass(server, lid, reps: int) -> dict:
-    """Leased clients: local burns, one renewal frame per budget."""
+    """Leased clients: local burns, one renewal frame per budget (+ the
+    piggybacked response-less telemetry frame, counted honestly)."""
     from ratelimiter_tpu.leases import LeaseClient
     from ratelimiter_tpu.service.sidecar import SidecarClient
 
@@ -95,7 +96,10 @@ def lease_pass(server, lid, reps: int) -> dict:
 
     def client_loop(t: int) -> None:
         wire = SidecarClient("127.0.0.1", server.port)
-        cli = LeaseClient(wire, lid, budget=BUDGET)
+        # Client 0 traces its leases so the bench can assert the full
+        # client->sidecar->batcher->shard lineage server-side.
+        cli = LeaseClient(wire, lid, budget=BUDGET,
+                          trace_lineage=(t == 0))
         try:
             keys = [f"ls-c{t}-k{i}" for i in range(KEYS_PER_CLIENT)]
             assert cli.try_acquire(keys[0])  # warm (compiles the grant)
@@ -104,9 +108,13 @@ def lease_pass(server, lid, reps: int) -> dict:
             for i in range(per_client):
                 if cli.try_acquire(keys[i % KEYS_PER_CLIENT]):
                     got += 1
+            traces = [cli.trace_of(k) for k in keys]
             cli.release_all()
             stats[t] = {"allowed": got, "wire": cli.wire_ops,
-                        "local": cli.local_decisions}
+                        "local": cli.local_decisions,
+                        "telemetry_frames": cli.telemetry_flushes,
+                        "telemetry_dropped": cli.telemetry_dropped,
+                        "traces": [x for x in traces if x]}
         finally:
             wire.close()
 
@@ -120,7 +128,9 @@ def lease_pass(server, lid, reps: int) -> dict:
         th.join()
     wall = time.perf_counter() - t0
     n = N_CLIENTS * per_client
-    wire = sum(s["wire"] for s in stats)
+    # Telemetry frames ride the wire too (response-less, piggybacked on
+    # renew) — count them so the frame-reduction claim stays honest.
+    wire = sum(s["wire"] + s["telemetry_frames"] for s in stats)
     return {
         "decisions": n,
         "allowed": sum(s["allowed"] for s in stats),
@@ -128,8 +138,14 @@ def lease_pass(server, lid, reps: int) -> dict:
         "wall_s": round(wall, 4),
         "decisions_per_sec": round(n / wall, 1),
         "wire_frames": wire,
+        "telemetry_frames": sum(s["telemetry_frames"] for s in stats),
+        "telemetry_dropped": sum(s["telemetry_dropped"] for s in stats),
         "frames_per_decision": round(wire / n, 5),
         "budget": BUDGET,
+        "traces": [t for s in stats for t in s.get("traces", ())],
+        # Ground truth for the fleet-reconciliation assertion: every
+        # decision this pass made (including the warm one per client).
+        "ground_truth_decisions": N_CLIENTS * (per_client + 1),
     }
 
 
@@ -165,8 +181,41 @@ def main() -> None:
         # Best-of-2 each (scheduler noise must not read as a regression).
         v2 = max((v2_pass(server, lid, reps) for _ in range(2)),
                  key=lambda r: r["decisions_per_sec"])
-        ls = max((lease_pass(server, lid, reps) for _ in range(2)),
-                 key=lambda r: r["decisions_per_sec"])
+        plane = storage.telemetry
+        fleet0 = plane.allowed_total + plane.denied_total
+        ls_runs = [lease_pass(server, lid, reps) for _ in range(2)]
+        fleet_delta = plane.allowed_total + plane.denied_total - fleet0
+        ls = max(ls_runs, key=lambda r: r["decisions_per_sec"])
+
+        # Telemetry round trip: after release_all's final flush, the
+        # server-side fleet decision counters must reconcile EXACTLY
+        # with the clients' ground-truth decision counts (the staleness
+        # bound is one flush interval; at release it is zero).
+        expected = sum(r["ground_truth_decisions"] for r in ls_runs)
+        telemetry = {
+            "fleet_counter_delta": fleet_delta,
+            "ground_truth": expected,
+            "lease_local_folded": plane.lease_local_total,
+            "reports": plane.reports_total,
+            "staleness_ms": plane.staleness_ms(),
+        }
+        assert fleet_delta == expected, (
+            f"fleet decision counters ({fleet_delta}) do not reconcile "
+            f"with client ground truth ({expected}) after the final "
+            "telemetry flush")
+        assert plane.reports_total > 0, "no telemetry report was folded"
+        # A traced leased key must read back the full distributed
+        # lineage: client -> sidecar -> batcher -> shard.
+        lineage_ok = False
+        for tid in ls_runs[-1]["traces"]:
+            hops = set(storage.lineage.hops(tid))
+            if {"sidecar", "lease.grant", "client", "batcher",
+                    "shard"} <= hops:
+                lineage_ok = True
+                break
+        assert lineage_ok, (
+            "no leased trace carried the full client->sidecar->batcher->"
+            "shard lineage")
 
         reduction = (v2["frames_per_decision"]
                      / max(ls["frames_per_decision"], 1e-9))
@@ -178,7 +227,8 @@ def main() -> None:
                      "wire-frame collapse of token leases vs the "
                      "per-decision v2 ingress over the same storage"),
             "v2": v2,
-            "lease": ls,
+            "lease": {k: v for k, v in ls.items() if k != "traces"},
+            "telemetry": telemetry,
             "wire_frame_reduction": round(reduction, 1),
             "throughput_ratio": round(speedup, 2),
         }

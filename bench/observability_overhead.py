@@ -60,6 +60,48 @@ class ObsMeter:
         return run
 
 
+def leased_arm(storage, reps: int) -> dict:
+    """Client-side telemetry cost on the LEASED decision path: local
+    burns with the burn accumulator + latency histogram on vs off, over
+    the same in-process lease manager.  The server-side plane (usage
+    ring + fleet counters) is measured by the main arm's direct
+    fraction; this arm bounds what the CLIENT pays per local decision."""
+    import time as _time
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.leases import (
+        DirectTransport,
+        LeaseClient,
+        LeaseManager,
+    )
+
+    cfg = RateLimitConfig(max_permits=1 << 20, window_ms=60_000,
+                          refill_rate=1e6)
+    lid = storage.register_limiter("tb", cfg)
+    mgr = LeaseManager(storage, default_budget=4096, max_budget=4096,
+                       ttl_ms=60_000.0)
+    keys = [f"tenant{i}:burner" for i in range(8)]
+    rates = {}
+    for mode in ("off", "on"):
+        cli = LeaseClient(DirectTransport(mgr), lid, budget=4096,
+                          telemetry=(mode == "on"),
+                          telemetry_flush_ms=50.0)
+        for k in keys:
+            assert cli.try_acquire(k)   # warm: grants charged
+        t0 = _time.perf_counter()
+        for i in range(reps):
+            cli.try_acquire(keys[i & 7])
+        wall = _time.perf_counter() - t0
+        cli.release_all()
+        rates[mode] = reps / wall
+    return {
+        "reps": reps,
+        "local_rps_telemetry_off": round(rates["off"]),
+        "local_rps_telemetry_on": round(rates["on"]),
+        "leased_throughput_ratio": round(rates["on"] / rates["off"], 3),
+    }
+
+
 def timed_pass(storage, lid, key_ids) -> float:
     """One timed stream pass (GC parked, as in replication_overhead)."""
     import gc
@@ -145,6 +187,18 @@ def main() -> None:
     assert len(storages["on"].trace.snapshot(last=5)["recent"]) > 0, (
         "decision trace never recorded")
 
+    # Leased-workload arm: the client-side telemetry accumulator's cost
+    # per LOCAL decision (the decision surface PR 12 moved off the
+    # server — the fleet plane must stay affordable there too).
+    leased = leased_arm(storages["on"], reps=1 << 16)
+
+    # Sanity: the usage ring actually aggregated the stream passes
+    # (per-tenant accounting is part of the measured layer).
+    plane = storages["on"].telemetry
+    assert plane is not None and plane.allowed_total > 0, (
+        "fleet telemetry plane never folded a decision")
+    assert plane.usage.tenants(), "usage ring tracked no tenant"
+
     best = {m: min(v) for m, v in walls.items()}
     ratios = sorted(walls["on"][r] / walls["off"][r]
                     for r in range(args.rounds))
@@ -162,6 +216,7 @@ def main() -> None:
         "obs_direct_pct": round(100.0 * direct_frac, 3),
         "obs_seconds_best_pass": round(min(obs_s), 4),
         "trace_sample": args.trace_sample,
+        "leased": leased,
     }
     for s in storages.values():
         s.close()

@@ -94,12 +94,12 @@ class _Pending:
     device never sees.
     """
 
-    __slots__ = ("buf", "n", "futures", "deadlines", "t_sub", "clears",
-                 "born")
+    __slots__ = ("buf", "n", "futures", "deadlines", "t_sub", "traces",
+                 "clears", "born")
 
     #: Parallel per-request lists that shed/forget filtering must keep
     #: in lockstep with the staging-buffer lanes.
-    LISTS = ("futures", "deadlines", "t_sub")
+    LISTS = ("futures", "deadlines", "t_sub", "traces")
 
     def __init__(self, cap: int = _STAGE_CAP):
         self.buf = np.empty((4, cap), dtype=np.int64)
@@ -111,6 +111,7 @@ class _Pending:
         self.futures: List[Future] = []
         self.deadlines: List[float] = []  # monotonic queue deadlines (inf=none)
         self.t_sub: List[float] = []      # perf_counter at submit (tracing)
+        self.traces: List[int] = []       # 64-bit trace ids (0 = untraced)
         self.clears: List[int] = []
         self.born: float | None = None  # monotonic time of oldest request
 
@@ -177,6 +178,7 @@ class _Pending:
         self.futures = []
         self.deadlines = []
         self.t_sub = []
+        self.traces = []
         self.clears = []
         self.born = None
 
@@ -282,11 +284,14 @@ class MicroBatcher:
 
     # -- submission -----------------------------------------------------------
     def submit(self, algo: str, slot: int, lid: int, permits: int,
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None,
+               trace_id: int = 0) -> Future:
         """Queue one decision; returns its Future.
 
         ``deadline_ms`` overrides the batcher-wide queue-deadline budget
-        for this request (None = default; 0 = no deadline).  Raises
+        for this request (None = default; 0 = no deadline).
+        ``trace_id`` is an optional 64-bit trace id carried to the drain
+        (observability/telemetry.py lineage).  Raises
         ``OverloadedError`` when the pending queue is at ``max_pending``
         or the flusher has died, ``ShutdownError`` when closed.
         """
@@ -309,6 +314,7 @@ class MicroBatcher:
                 time.monotonic() + budget / 1000.0 if budget and budget > 0
                 else math.inf)
             pend.t_sub.append(time.perf_counter())
+            pend.traces.append(int(trace_id))
             if pend.n > self.max_depth_seen:
                 self.max_depth_seen = pend.n
             self._waiters.add(fut)
@@ -336,7 +342,8 @@ class MicroBatcher:
             retry_after_ms=cycles * max(self.max_delay_s * 1000.0, 1.0))
 
     def submit_many(self, algo: str, slots, lids, permits,
-                    deadline_ms: float | None = None) -> List[Future]:
+                    deadline_ms: float | None = None,
+                    trace_ids=None) -> List[Future]:
         """Bulk :meth:`submit` for a pipelined burst whose slots were
         assigned in one batched index call (storage.acquire_async_many):
         one cv acquisition and three vectorized staging-buffer writes
@@ -363,6 +370,8 @@ class MicroBatcher:
             pend.futures.extend(futs)
             pend.deadlines.extend([deadline] * n)
             pend.t_sub.extend([time.perf_counter()] * n)
+            pend.traces.extend([int(t) for t in trace_ids] if trace_ids
+                               else [0] * n)
             if pend.n > self.max_depth_seen:
                 self.max_depth_seen = pend.n
             self._waiters.update(futs)
@@ -500,11 +509,12 @@ class MicroBatcher:
                     fut.set_exception(exc)
         else:
             if self._tracer is not None and stamps is not None:
-                t_subs, t_take, t_disp = stamps
+                t_subs, t_take, t_disp = stamps[:3]
+                traces = stamps[3] if len(stamps) > 3 else None
                 try:
                     self._tracer.observe_batch(
                         algo, out, t_subs, t_take, t_disp, t_dev,
-                        time.perf_counter())
+                        time.perf_counter(), trace_ids=traces)
                 except Exception:  # noqa: BLE001 — tracing must not fail waiters
                     log.exception("latency tracer failed (ignored)")
         finally:
@@ -590,7 +600,8 @@ class MicroBatcher:
                             pend.buf[1, :pend.n].tolist(),
                             pend.buf[2, :pend.n].tolist())
                     futures = pend.futures
-                    stamps = (pend.t_sub, t_take, time.perf_counter())
+                    stamps = (pend.t_sub, t_take, time.perf_counter(),
+                              pend.traces)
                     # The staging buffer recycles at DRAIN time (the jit
                     # call may alias the host numpy memory zero-copy —
                     # it is free only once the results were fetched).

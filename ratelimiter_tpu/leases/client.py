@@ -30,9 +30,26 @@ Decision semantics seen by the caller:
   reserve/credit log).
 
 Transports are duck-typed: ``service/sidecar.py:SidecarClient`` (wire
-protocol v3) and :class:`DirectTransport` (in-process, over a
+protocol v3/v4) and :class:`DirectTransport` (in-process, over a
 ``LeaseManager``) both provide ``lease_grant`` / ``lease_renew`` /
-``lease_release`` / ``try_acquire``.
+``lease_release`` / ``try_acquire`` / ``telemetry_report``.
+
+**Burn telemetry (observability/telemetry.py).**  With leases on, the
+server no longer observes most decisions — it sees one coarse ``used``
+count per renewal.  The client therefore accumulates per-(lid,
+key-class) burn/deny counts plus a local-decision latency histogram
+(the Timer log2-bucket scheme) and flushes them as one TELEMETRY
+report: piggybacked in front of every renew/grant wire op (the op is
+response-less, so this adds zero round trips) and on a bounded cadence
+(``telemetry_flush_ms``) otherwise.  **Drop-don't-block**: a flush
+that cannot be shipped is dropped and counted
+(``telemetry_dropped``) — its counts are lost by design; telemetry is
+an observability signal, never backpressure on the decision path.
+
+**Trace lineage.**  With ``trace_lineage=True`` each lease mints one
+64-bit trace id at grant and carries it on every wire op, so the
+server's lineage ring shows grant -> local burns (the ``client`` hop
+renew stamps) -> renew under one id (``trace_of(key)`` returns it).
 """
 
 from __future__ import annotations
@@ -49,15 +66,17 @@ def _wall_ms() -> int:
 class _Local:
     """One locally-held lease."""
 
-    __slots__ = ("remaining", "used", "deadline", "epoch", "deny_until")
+    __slots__ = ("remaining", "used", "deadline", "epoch", "deny_until",
+                 "trace")
 
     def __init__(self, remaining: int, deadline: int, epoch: int,
-                 deny_until: int = 0):
+                 deny_until: int = 0, trace: int = 0):
         self.remaining = int(remaining)
         self.used = 0
         self.deadline = int(deadline)
         self.epoch = int(epoch)
         self.deny_until = int(deny_until)
+        self.trace = int(trace)
 
 
 class DirectTransport:
@@ -67,27 +86,38 @@ class DirectTransport:
     def __init__(self, manager):
         self.manager = manager
 
-    def lease_grant(self, lid: int, key: str, requested: int):
-        return self.manager.grant(lid, key, requested)
+    def lease_grant(self, lid: int, key: str, requested: int,
+                    trace_id: int = 0):
+        return self.manager.grant(lid, key, requested, trace_id=trace_id)
 
     def lease_renew(self, lid: int, key: str, used: int,
-                    requested: int = 0):
-        return self.manager.renew(lid, key, used, requested)
+                    requested: int = 0, trace_id: int = 0):
+        return self.manager.renew(lid, key, used, requested,
+                                  trace_id=trace_id)
 
-    def lease_release(self, lid: int, key: str, used: int) -> None:
-        self.manager.release(lid, key, used)
+    def lease_release(self, lid: int, key: str, used: int,
+                      trace_id: int = 0) -> None:
+        self.manager.release(lid, key, used, trace_id=trace_id)
 
-    def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
+    def try_acquire(self, lid: int, key: str, permits: int = 1,
+                    trace_id: int = 0) -> bool:
         algo, _cfg = self.manager._algo_cfg(lid)
         out = self.manager.storage.acquire(algo, lid, key, permits)
         return bool(out["allowed"])
+
+    def telemetry_report(self, blob: bytes) -> bool:
+        return self.manager.telemetry_report(blob) >= 0
 
 
 class LeaseClient:
     """Local lease burner over a lease-capable transport."""
 
     def __init__(self, transport, lid: int, *, budget: int = 64,
-                 clock_ms=None, direct_fallback: bool = True):
+                 clock_ms=None, direct_fallback: bool = True,
+                 telemetry: bool = True,
+                 telemetry_flush_ms: float = 250.0,
+                 key_class=None,
+                 trace_lineage: bool = False):
         self._t = transport
         self.lid = int(lid)
         self.budget = max(int(budget), 1)
@@ -101,10 +131,31 @@ class LeaseClient:
         self.wire_ops = 0          # lease + fallback frames sent
         self.revoked_seen = 0
         self.allowed_by_key: collections.Counter = collections.Counter()
+        # Burn telemetry (module docstring): only armed when the
+        # transport can ship a report.
+        self._telem = None
+        self.telemetry_flush_ms = float(telemetry_flush_ms)
+        self.telemetry_flushes = 0    # reports shipped
+        self.telemetry_dropped = 0    # reports dropped (never blocked on)
+        self._last_flush = int(self._clock_ms())
+        if telemetry and hasattr(transport, "telemetry_report"):
+            from ratelimiter_tpu.observability.telemetry import (
+                ClientTelemetry,
+            )
+
+            self._telem = ClientTelemetry(key_class=key_class)
+        self._trace_lineage = bool(trace_lineage)
+
+    def trace_of(self, key: str) -> int:
+        """The lease's lineage trace id (0 when untraced/unknown)."""
+        lease = self._leases.get(key)
+        return lease.trace if lease is not None else 0
 
     # -- the decision surface --------------------------------------------------
     def try_acquire(self, key: str, permits: int = 1) -> bool:
         permits = max(int(permits), 1)
+        telem = self._telem
+        t0 = time.perf_counter() if telem is not None else 0.0
         now = int(self._clock_ms())
         lease = self._leases.get(key)
         if lease is not None and now < lease.deadline \
@@ -113,6 +164,10 @@ class LeaseClient:
             lease.used += permits
             self.local_decisions += 1
             self.allowed_by_key[key] += permits
+            if telem is not None:
+                telem.record_burn(self.lid, key, permits,
+                                  (time.perf_counter() - t0) * 1e6)
+                self._maybe_flush(now)
             return True
         lease = self._refresh(key, lease, now)
         if lease is not None and now < lease.deadline \
@@ -120,6 +175,11 @@ class LeaseClient:
             lease.remaining -= permits
             lease.used += permits
             self.allowed_by_key[key] += permits
+            if telem is not None:
+                # The first burn of a fresh budget: local too (the wire
+                # op charged the BUDGET, not this decision).
+                telem.record_burn(self.lid, key, permits,
+                                  (time.perf_counter() - t0) * 1e6)
             return True
         if self.direct_fallback:
             self.wire_ops += 1
@@ -128,7 +188,35 @@ class LeaseClient:
                 self.allowed_by_key[key] += permits
             return allowed
         self.local_denies += 1
+        if telem is not None:
+            telem.record_deny(self.lid, key,
+                              (time.perf_counter() - t0) * 1e6)
+            self._maybe_flush(now)
         return False
+
+    # -- telemetry flushing ----------------------------------------------------
+    def _maybe_flush(self, now: int) -> None:
+        if self._telem is not None and self._telem.pending() \
+                and now - self._last_flush >= self.telemetry_flush_ms:
+            self._flush_telemetry(now)
+
+    def _flush_telemetry(self, now: int) -> None:
+        """Ship the accumulated report.  Drop-don't-block: a failed
+        send loses that report's counts (counted in
+        ``telemetry_dropped``) and never retries inline."""
+        telem = self._telem
+        if telem is None or not telem.pending():
+            return
+        self._last_flush = now
+        blob = telem.encode_and_reset()
+        try:
+            ok = self._t.telemetry_report(blob)
+        except Exception:  # noqa: BLE001 — telemetry must never propagate
+            ok = False
+        if ok:
+            self.telemetry_flushes += 1
+        else:
+            self.telemetry_dropped += 1
 
     def _refresh(self, key: str, lease: Optional[_Local],
                  now: int) -> Optional[_Local]:
@@ -137,38 +225,55 @@ class LeaseClient:
         if lease is not None and lease.remaining <= 0 \
                 and now < lease.deny_until:
             return None  # zero-grant cooldown: no wire spam
+        # Piggyback: the renew/grant below already pays a round trip;
+        # a response-less TELEMETRY frame in front of it rides free.
+        self._flush_telemetry(now)
+        tid = lease.trace if lease is not None else 0
+        if not tid and self._trace_lineage:
+            from ratelimiter_tpu.observability.telemetry import (
+                mint_trace_id,
+            )
+
+            tid = mint_trace_id()
         if lease is not None and (lease.used or lease.remaining):
             self.wire_ops += 1
             resp = self._t.lease_renew(self.lid, key, lease.used,
-                                       self.budget)
+                                       self.budget, trace_id=tid)
             lease.used = 0
             if resp is None:  # revoked: re-grant against whatever serves
                 self.revoked_seen += 1
                 self.wire_ops += 1
-                resp = self._t.lease_grant(self.lid, key, self.budget)
+                resp = self._t.lease_grant(self.lid, key, self.budget,
+                                           trace_id=tid)
         else:
             self.wire_ops += 1
-            resp = self._t.lease_grant(self.lid, key, self.budget)
+            resp = self._t.lease_grant(self.lid, key, self.budget,
+                                       trace_id=tid)
         if resp is None:
             self._leases.pop(key, None)
             return None
         granted, ttl_ms, epoch = resp[0], resp[1], resp[2]
         if granted <= 0:
-            cool = _Local(0, now, epoch, deny_until=now + max(ttl_ms, 1))
+            cool = _Local(0, now, epoch, deny_until=now + max(ttl_ms, 1),
+                          trace=tid)
             self._leases[key] = cool
             return None
-        fresh = _Local(granted, now + ttl_ms, epoch)
+        fresh = _Local(granted, now + ttl_ms, epoch, trace=tid)
         self._leases[key] = fresh
         return fresh
 
     # -- lifecycle -------------------------------------------------------------
     def release_all(self) -> None:
-        """Report final burns and hand every unused budget back."""
+        """Report final burns and hand every unused budget back (after
+        a final telemetry flush, so the server's fleet counters
+        reconcile exactly at release time)."""
+        self._flush_telemetry(int(self._clock_ms()))
         for key, lease in list(self._leases.items()):
             if lease.used or lease.remaining:
                 self.wire_ops += 1
                 try:
-                    self._t.lease_release(self.lid, key, lease.used)
+                    self._t.lease_release(self.lid, key, lease.used,
+                                          trace_id=lease.trace)
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
         self._leases.clear()

@@ -45,6 +45,7 @@ asserts the device state is bit-identical once renewals drain.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import List, NamedTuple, Optional, Tuple
@@ -81,7 +82,9 @@ class LeaseManager:
                  clock_ms=None,
                  registry=None,
                  recorder=None,
-                 record_ops: bool = False):
+                 record_ops: bool = False,
+                 storm_threshold: int = 8,
+                 storm_window_ms: float = 2000.0):
         self.storage = storage
         self.default_budget = max(int(default_budget), 1)
         self.max_budget = max(int(max_budget), 1)
@@ -95,6 +98,18 @@ class LeaseManager:
         self._sweep_tick = 0
         self.ops: List[Tuple] = []   # replay log (record_ops)
         self._record = bool(record_ops)
+        # Revocation-storm coalescing: N fence-driven revocations inside
+        # the window read as ONE flight event with a tally — after a
+        # failover, every outstanding lease revokes at its next renewal,
+        # and a post-mortem needs "storm of 412" not 412 ring entries.
+        self.storm_threshold = max(int(storm_threshold), 1)
+        self.storm_window_ms = float(storm_window_ms)
+        self._revoke_times: collections.deque = collections.deque(
+            maxlen=max(self.storm_threshold, 64))
+        self.revocation_storms = 0
+        # Trace lineage ring (observability/telemetry.py), discovered on
+        # the serving storage (the router passes through to the primary).
+        self._lineage = getattr(storage, "lineage", None)
         if recorder is not None:
             self._recorder = recorder
         else:
@@ -167,6 +182,30 @@ class LeaseManager:
         if self._m_outstanding is not None:
             self._m_outstanding.set(float(self.table.outstanding()))
 
+    def _trace(self, trace_id: int, hop: str, **fields) -> None:
+        """One lineage hop under a (forced-sampled) wire trace id."""
+        lin = self._lineage
+        if lin is not None and trace_id:
+            lin.force(trace_id)
+            lin.record(trace_id, hop, **fields)
+
+    def _note_fence_revocation(self, now: int, key: str,
+                               reason: str) -> None:
+        """Record a fence-driven revocation and coalesce bursts: the
+        Nth revocation inside the window lands ONE ``lease.
+        revocation_storm`` flight event (itself coalesced), so the ring
+        shows the fence-epoch bump's blast radius as a tally."""
+        self._revoke_times.append(now)
+        recent = sum(1 for t in self._revoke_times
+                     if now - t <= self.storm_window_ms)
+        if recent >= self.storm_threshold:
+            self.revocation_storms += 1
+            self._recorder.record(
+                "lease.revocation_storm",
+                coalesce_ms=self.storm_window_ms,
+                n_revocations=recent, epoch=self._epoch(), key=key,
+                reason=reason)
+
     def _maybe_sweep(self, now: int) -> None:
         self._sweep_tick += 1
         if self._sweep_tick % 256:
@@ -190,19 +229,25 @@ class LeaseManager:
                              int(unused), lease.ws, out["stamp"]))
 
     # -- the lease protocol ----------------------------------------------------
-    def grant(self, lid: int, key: str, requested: int = 0) -> LeaseGrant:
+    def grant(self, lid: int, key: str, requested: int = 0,
+              trace_id: int = 0) -> LeaseGrant:
         """Grant a fresh per-key budget.  ``granted == 0`` (with a retry
         hint in ``ttl_ms``) when the key is already leased, the budget
-        is exhausted, the table is full, or the storage is fenced."""
+        is exhausted, the table is full, or the storage is fenced.
+        ``trace_id`` threads the grant into the lineage ring."""
         with self._lock:
             algo, cfg = self._algo_cfg(lid)
             now = int(self._clock_ms())
             self._maybe_sweep(now)
+            self._trace(trace_id, "lease.grant", key=key,
+                        requested=int(requested))
             existing = self.table.get(algo, lid, key)
             if existing is not None:
                 if existing.expired(now):
                     self.table.pop(algo, lid, key)
                     self._bump(self._m_expired, "expired_total")
+                    self._recorder.record("lease.expired",
+                                          coalesce_ms=1000.0, key=key)
                 else:
                     # One burner per key: the second client stays on the
                     # per-decision path (the device arbitrates contended
@@ -211,6 +256,7 @@ class LeaseManager:
                                       existing.epoch)
             req = int(requested) or self.default_budget
             req = max(1, min(req, self.max_budget, cfg.max_permits))
+            self._trace(trace_id, "batcher", op="flush+reserve")
             try:
                 out = self.storage.lease_reserve(algo, lid, key, req)
             except FencedError:
@@ -222,6 +268,8 @@ class LeaseManager:
                 self.ops.append(("reserve", algo, lid, key, req,
                                  out["granted"], out["ws"], out["stamp"]))
             granted = int(out["granted"])
+            self._trace(trace_id, "shard", path="lease_reserve",
+                        granted=granted, stamp=int(out.get("stamp", 0)))
             epoch = self._epoch()
             if granted <= 0:
                 return LeaseGrant(0, int(self.deny_ttl_ms), epoch)
@@ -234,11 +282,16 @@ class LeaseManager:
                 self._credit(lease, granted)
                 return LeaseGrant(0, int(self.deny_ttl_ms), epoch)
             self._bump(self._m_granted, "granted_total")
+            self._recorder.record("lease.granted", coalesce_ms=1000.0,
+                                  key=key, granted=granted)
+            self._trace(trace_id, "resolve", granted=granted, ttl_ms=ttl,
+                        epoch=epoch)
             self._gauge()
             return LeaseGrant(granted, ttl, epoch)
 
     def renew(self, lid: int, key: str, used: int,
-              requested: int = 0) -> Optional[LeaseGrant]:
+              requested: int = 0,
+              trace_id: int = 0) -> Optional[LeaseGrant]:
         """Renew: report ``used`` burns, credit the unused remainder,
         charge a fresh budget.  Returns ``None`` when the lease was
         REVOKED (fence epoch advanced, storage fenced, or unknown
@@ -248,12 +301,20 @@ class LeaseManager:
             now = int(self._clock_ms())
             used = max(int(used), 0)
             self._bump(self._m_local, "local_decisions_total", used)
+            # The client leg of the lineage: burns since the last wire
+            # op ran client-side with ZERO frames — this hop is where
+            # they become visible server-side.
+            self._trace(trace_id, "client", local_burns=used, key=key)
+            self._trace(trace_id, "lease.renew", key=key)
             lease = self.table.get(algo, lid, key)
             if lease is None:
                 # Swept/never granted: those burns ran against a lease
                 # this table no longer vouches for.
                 self._bump(self._m_over, "over_admission_total", used)
                 self._bump(self._m_revoked, "revoked_total")
+                self._recorder.record("lease.revoked", key=key,
+                                      reason="unknown_lease",
+                                      coalesce_ms=200.0)
                 return None
             lease.used_total += used
             cur_epoch = self._epoch()
@@ -269,6 +330,7 @@ class LeaseManager:
                 self._recorder.record("lease.revoked", key=key,
                                       reason="fence_epoch",
                                       coalesce_ms=200.0)
+                self._note_fence_revocation(now, key, "fence_epoch")
                 self._gauge()
                 return None
             unused = max(lease.budget - used, 0)
@@ -276,6 +338,8 @@ class LeaseManager:
                 self.table.pop(algo, lid, key)
                 self._bump(self._m_expired, "expired_total")
                 self._bump(self._m_over, "over_admission_total", used)
+                self._recorder.record("lease.expired", coalesce_ms=1000.0,
+                                      key=key)
                 try:
                     self._credit(lease, unused)
                 except (FencedError, StorageException):
@@ -284,6 +348,7 @@ class LeaseManager:
                 return None
             req = int(requested) or lease.budget
             req = max(1, min(req, self.max_budget, cfg.max_permits))
+            self._trace(trace_id, "batcher", op="credit+reserve")
             try:
                 self._credit(lease, unused)
                 out = self.storage.lease_reserve(algo, lid, key, req)
@@ -292,6 +357,7 @@ class LeaseManager:
                 self._bump(self._m_revoked, "revoked_total")
                 self._recorder.record("lease.revoked", key=key,
                                       reason="fenced", coalesce_ms=200.0)
+                self._note_fence_revocation(now, key, "fenced")
                 self._gauge()
                 return None
             except StorageException:
@@ -302,6 +368,8 @@ class LeaseManager:
                 self.ops.append(("reserve", algo, lid, key, req,
                                  out["granted"], out["ws"], out["stamp"]))
             granted = int(out["granted"])
+            self._trace(trace_id, "shard", path="lease_reserve",
+                        granted=granted, stamp=int(out.get("stamp", 0)))
             if granted <= 0:
                 self.table.pop(algo, lid, key)
                 self._gauge()
@@ -314,18 +382,25 @@ class LeaseManager:
             lease.granted_total += granted
             lease.renewals += 1
             self._bump(self._m_renewed, "renewed_total")
+            self._trace(trace_id, "resolve", granted=granted, ttl_ms=ttl,
+                        epoch=lease.epoch)
             return LeaseGrant(granted, ttl, lease.epoch)
 
-    def release(self, lid: int, key: str, used: int) -> None:
+    def release(self, lid: int, key: str, used: int,
+                trace_id: int = 0) -> None:
         """Close a lease: report final burns and credit the remainder."""
         with self._lock:
             algo, _cfg = self._algo_cfg(lid)
             used = max(int(used), 0)
             self._bump(self._m_local, "local_decisions_total", used)
+            self._trace(trace_id, "client", local_burns=used, key=key)
+            self._trace(trace_id, "lease.release", key=key)
             lease = self.table.pop(algo, lid, key)
             if lease is None:
                 return
             lease.used_total += used
+            self._recorder.record("lease.released", coalesce_ms=1000.0,
+                                  key=key)
             if self._epoch() > lease.epoch:
                 self._bump(self._m_over, "over_admission_total", used)
                 self._gauge()
@@ -335,6 +410,16 @@ class LeaseManager:
             except (FencedError, StorageException):
                 pass
             self._gauge()
+
+    def telemetry_report(self, blob: bytes) -> int:
+        """Fold one client burn report into the storage's fleet
+        telemetry plane (the in-process leg of the TELEMETRY op —
+        ``DirectTransport`` calls this).  Returns the record count, -1
+        on a malformed blob, or -1 when the storage carries no plane."""
+        plane = getattr(self.storage, "telemetry", None)
+        if plane is None:
+            return -1
+        return plane.fold(blob)
 
     def _ttl_for(self, algo: str, cfg, stamp: int) -> int:
         """Sliding window: the charge ages out when the window rolls, so
@@ -355,4 +440,5 @@ class LeaseManager:
             "expired": self.expired_total,
             "local_decisions": self.local_decisions_total,
             "over_admission": self.over_admission_total,
+            "revocation_storms": self.revocation_storms,
         }

@@ -131,6 +131,19 @@ class Timer:
     def bucket_counts(self) -> List[int]:
         return list(self._counts)
 
+    def merge(self, sparse_buckets, total_us: float) -> None:
+        """Fold pre-bucketed samples recorded elsewhere with the SAME
+        log2 scheme (a lease client's local-latency histogram arriving
+        in a telemetry report): ``sparse_buckets`` is an iterable of
+        ``(bucket_idx, count)``."""
+        added = 0
+        for idx, count in sparse_buckets:
+            idx = min(max(int(idx), 0), self.N_BUCKETS - 1)
+            self._counts[idx] += int(count)
+            added += int(count)
+        self._count += added
+        self._total_us += float(total_us)
+
     def count(self) -> int:
         return self._count
 

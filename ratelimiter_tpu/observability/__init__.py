@@ -14,7 +14,14 @@ Four layers over the metrics registry the service already carries:
 - the flight recorder (``flightrecorder.FlightRecorder``): a bounded
   structured-event ring that subsystems append to at state transitions,
   plus an anomaly hook that snapshots the stage breakdown of any
-  dispatch over the SLO threshold; ``GET /actuator/flightrecorder``.
+  dispatch over the SLO threshold; ``GET /actuator/flightrecorder``
+  (``?kind=`` / ``?since_ms=`` filter ring-side);
+- the fleet telemetry plane (``telemetry.TelemetryPlane``): client
+  lease-burn reports folded into fleet-true ``ratelimiter.decisions.*``
+  counters, per-tenant usage accounting (``usage.UsageRing``,
+  ``GET /actuator/tenants``, ``UsageSignals`` for the adaptive
+  controller), and 64-bit trace-id lineage across client -> sidecar ->
+  batcher -> shard -> resolve (``telemetry.TraceLineage``).
 
 The whole layer is CI-gated at <= 2% of the headline decision stream
 (``bench/observability_overhead.py --assert-budget 0.02`` in verify.sh).
@@ -27,4 +34,16 @@ from ratelimiter_tpu.observability.flightrecorder import (  # noqa: F401
 from ratelimiter_tpu.observability.prometheus import (  # noqa: F401
     render as render_prometheus,
 )
+from ratelimiter_tpu.observability.telemetry import (  # noqa: F401
+    ClientTelemetry,
+    TelemetryPlane,
+    TraceLineage,
+    decode_report,
+    mint_trace_id,
+    trace_hex,
+)
 from ratelimiter_tpu.observability.trace import LatencyTracer  # noqa: F401
+from ratelimiter_tpu.observability.usage import (  # noqa: F401
+    UsageRing,
+    UsageSignals,
+)

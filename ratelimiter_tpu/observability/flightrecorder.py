@@ -151,29 +151,54 @@ class FlightRecorder:
             return self._seq
 
     def events(self, kind: Optional[str] = None,
-               since: int = -1) -> List[dict]:
-        """Ring events in order, optionally filtered by kind prefix and
-        by ``seq > since``."""
+               since: int = -1,
+               since_ms: Optional[int] = None) -> List[dict]:
+        """Ring events in order, optionally filtered by kind prefix, by
+        ``seq >= since``, and by wall-clock ``t_ms >= since_ms``."""
         with self._lock:
             out = self._ordered_locked()
         if since >= 0:
             out = [e for e in out if e["seq"] >= since]
+        if since_ms is not None:
+            out = [e for e in out if e["t_ms"] >= since_ms]
         if kind is not None:
             out = [e for e in out if e["kind"] == kind
                    or e["kind"].startswith(kind + ".")]
         return out
 
-    def snapshot(self, last: int = 256) -> dict:
+    def snapshot(self, last: int = 256, kind: Optional[str] = None,
+                 since_ms: Optional[int] = None) -> dict:
+        """Full payload for ``GET /actuator/flightrecorder``; ``kind``
+        (exact or dotted prefix) and ``since_ms`` filter ring-side so an
+        incident query returns only the relevant slice, not the whole
+        ring for the client to sift."""
+        filtered = kind is not None or since_ms is not None
         with self._lock:
             events = self._ordered_locked()
-            return {
-                "total_events": self._seq,
-                "capacity": self._capacity,
-                "slo_ms": self._slo_us / 1000.0,
-                "events": events[-last:],
-                "anomaly_total": self._anomaly_total,
-                "anomalies": list(self._anomalies),
-            }
+            anomalies = list(self._anomalies)
+            total = self._seq
+        if filtered:
+            if since_ms is not None:
+                events = [e for e in events if e["t_ms"] >= since_ms]
+                anomalies = [a for a in anomalies
+                             if a["t_ms"] >= since_ms]
+            if kind is not None:
+                events = [e for e in events if e["kind"] == kind
+                          or e["kind"].startswith(kind + ".")]
+                anomalies = [a for a in anomalies if a["kind"] == kind
+                             or a["kind"].startswith(kind + ".")]
+        out = {
+            "total_events": total,
+            "capacity": self._capacity,
+            "slo_ms": self._slo_us / 1000.0,
+            "events": events[-last:],
+            "anomaly_total": self._anomaly_total,
+            "anomalies": anomalies,
+        }
+        if filtered:
+            out["filtered"] = {"kind": kind, "since_ms": since_ms,
+                               "matched": len(events)}
+        return out
 
     def reset(self) -> None:
         """Drop everything (test isolation for the global instance)."""

@@ -11,10 +11,23 @@ sanitize to ``ratelimiter_storage_latency``):
   lines stop at the highest non-empty bucket; the mandatory
   ``le="+Inf"`` line always carries the full count.
 
-HELP text escapes ``\\`` and newlines per the exposition format.  The
-golden test (tests/test_observability.py) pins the exact output shape;
-bucket monotonicity and ``_sum``/``_count`` consistency are asserted
-over a live registry scrape.
+``# HELP`` comes from the meter's registered description when one was
+given, else from the :data:`METRIC_HELP` description table — so a meter
+registered at a call site that omitted the description still documents
+itself on the scrape.  HELP text escapes ``\\`` and newlines per the
+exposition format.
+
+**Labeled series.**  The registry's meters are unlabeled; per-tenant /
+per-key-class series come from *collectors* — objects exposing
+``prometheus_samples() -> [(name, kind, help, [(labels, value)])]``
+(e.g. ``observability/telemetry.TelemetryPlane``).  Label VALUES are
+escaped (``\\`` -> ``\\\\``, ``\"`` -> ``\\\"``, newline -> ``\\n``):
+key-class labels arrive off the wire and must not be able to break the
+exposition syntax.
+
+The golden test (tests/test_observability.py) pins the exact output
+shape; bucket monotonicity and ``_sum``/``_count`` consistency are
+asserted over a live registry scrape.
 """
 
 from __future__ import annotations
@@ -28,6 +41,29 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
+#: Fallback HELP text by metric name, used when the meter was registered
+#: without a description.  Keep entries for names that are (or were)
+#: registered description-less somewhere — a missing entry just means
+#: the name echoes as its own HELP.
+METRIC_HELP = {
+    "ratelimiter.requests.allowed": "Sliding-window decisions allowed",
+    "ratelimiter.requests.rejected": "Sliding-window decisions rejected",
+    "ratelimiter.tokenbucket.allowed": "Token-bucket decisions allowed",
+    "ratelimiter.tokenbucket.rejected": "Token-bucket decisions rejected",
+    "ratelimiter.cache.hits": "Local TTL-cache hits",
+    "ratelimiter.storage.latency":
+        "Device dispatch latency (per micro-batch)",
+    "ratelimiter.decisions.allowed":
+        "Fleet-wide allowed decisions (server + degraded + lease-local)",
+    "ratelimiter.decisions.denied": "Fleet-wide denied decisions",
+    "ratelimiter.decisions.shed":
+        "Decisions refused by admission control",
+    "ratelimiter.decisions.lease_local":
+        "Fleet decisions decided client-side against token leases",
+    "ratelimiter.telemetry.staleness_ms":
+        "Age of the oldest client's last telemetry report",
+}
+
 
 def _metric_name(name: str) -> str:
     out = _NAME_RE.sub("_", name)
@@ -38,6 +74,26 @@ def _metric_name(name: str) -> str:
 
 def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping — label values (key
+    classes!) come off the wire and may contain anything."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_metric_name(str(k))}="{_escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _help_for(name: str, description: str) -> str:
+    return _escape_help(description or METRIC_HELP.get(name, name))
 
 
 def _fmt(value: float) -> str:
@@ -60,14 +116,17 @@ def _le(bound_us: float) -> str:
     return _fmt(bound_us / 1e6)
 
 
-def render(registry) -> str:
-    """The full exposition document for ``GET /actuator/prometheus``."""
+def render(registry, collectors=()) -> str:
+    """The full exposition document for ``GET /actuator/prometheus``.
+
+    ``collectors`` append labeled sample families after the registry's
+    meters (see module docstring)."""
     lines: List[str] = []
     meters = registry.meters()
     for name in sorted(meters):
         meter = meters[name]
         base = _metric_name(name)
-        help_text = _escape_help(meter.description or name)
+        help_text = _help_for(name, meter.description)
         if isinstance(meter, Counter):
             lines.append(f"# HELP {base}_total {help_text}")
             lines.append(f"# TYPE {base}_total counter")
@@ -78,6 +137,15 @@ def render(registry) -> str:
             lines.append(f"{base} {_fmt(meter.value())}")
         elif isinstance(meter, Timer):
             lines.extend(_render_timer(base, help_text, meter))
+    for collector in collectors:
+        for name, kind, help_text, samples in collector.prometheus_samples():
+            base = _metric_name(name)
+            if kind == "counter":
+                base += "_total"
+            lines.append(f"# HELP {base} {_escape_help(help_text or name)}")
+            lines.append(f"# TYPE {base} {kind}")
+            for labels, value in samples:
+                lines.append(f"{base}{_labels(labels)} {_fmt(value)}")
     return "\n".join(lines) + "\n" if lines else ""
 
 

@@ -60,7 +60,7 @@ class LatencyTracer:
     """Aggregates batcher lifecycle timestamps into stage histograms."""
 
     def __init__(self, registry, trace=None, sample_n: int = 0,
-                 recorder=None):
+                 recorder=None, lineage=None):
         self._h = {
             stage: registry.timer(
                 f"ratelimiter.latency.{stage}",
@@ -77,6 +77,10 @@ class LatencyTracer:
         self._sample_n = max(int(sample_n), 0)
         self._tick = 0          # requests since the last sampled trace
         self._recorder = recorder
+        # Trace-id lineage ring (observability/telemetry.TraceLineage):
+        # sampled ids get per-hop records (batcher/shard/resolve) so a
+        # trace minted at ingress reads as an ordered path.
+        self._lineage = lineage
 
     def record_sub(self, stage: str, us: float) -> None:
         """One assembly sub-stage sample (storage dispatch path)."""
@@ -84,10 +88,13 @@ class LatencyTracer:
 
     def observe_batch(self, algo: str, out: Optional[dict],
                       t_subs: Sequence[float], t_take: float,
-                      t_disp: float, t_dev: float, t_res: float) -> None:
+                      t_disp: float, t_dev: float, t_res: float,
+                      trace_ids: Optional[Sequence[int]] = None) -> None:
         """One dispatched-and-resolved batch's stamps.  Runs on the
         drain thread AFTER the waiters' futures resolved — nothing here
-        is on a caller's critical path."""
+        is on a caller's critical path.  ``trace_ids`` (aligned with
+        ``t_subs``; 0 = untraced) feed the lineage ring and enrich the
+        sampled DecisionTrace with the trace the batch carried."""
         n = len(t_subs)
         if n == 0:
             return
@@ -111,6 +118,23 @@ class LatencyTracer:
         }
         total_us = (t_res - t_oldest) * 1e6
 
+        sampled_tids = []
+        lin = self._lineage
+        if lin is not None and trace_ids:
+            sampled_tids = [t for t in trace_ids if t and lin.sampled(t)]
+            for i, tid in enumerate(trace_ids):
+                if not tid or tid not in sampled_tids:
+                    continue
+                lin.record(tid, "batcher", algo=algo, batch=n,
+                           queue_wait_us=round(
+                               (t_take - t_subs[i]) * 1e6, 1),
+                           assembly_us=round(
+                               (t_disp - t_take) * 1e6, 1))
+                lin.record(tid, "shard", path="micro", shard=0,
+                           device_us=round((t_dev - t_disp) * 1e6, 1))
+                lin.record(tid, "resolve",
+                           total_us=round((t_res - t_subs[i]) * 1e6, 1))
+
         if self._sample_n and self._trace is not None:
             self._tick += n
             if self._tick >= self._sample_n:
@@ -118,10 +142,18 @@ class LatencyTracer:
                 allowed = -1
                 if out is not None and "allowed" in out:
                     allowed = int(sum(1 for a in out["allowed"] if a))
+                extra = {}
+                if sampled_tids:
+                    from ratelimiter_tpu.observability.telemetry import (
+                        trace_hex,
+                    )
+
+                    extra["trace"] = trace_hex(sampled_tids[0])
                 self._trace.record(
                     algo, n, allowed, total_us, path="micro",
                     stages_us={k: round(v, 1)
-                               for k, v in stages_us.items()})
+                               for k, v in stages_us.items()},
+                    **extra)
 
         if self._recorder is not None:
             self._recorder.note_dispatch(total_us, stages_us,

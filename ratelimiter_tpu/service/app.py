@@ -68,6 +68,19 @@ def _find_shard_health(storage):
     return _find_surface(storage, "shard_health")
 
 
+def _find_attr(storage, name: str):
+    """Like :func:`_find_surface` but for non-callable attributes (the
+    telemetry plane, the lineage ring)."""
+    seen = set()
+    while storage is not None and id(storage) not in seen:
+        seen.add(id(storage))
+        value = getattr(storage, name, None)
+        if value is not None:
+            return value
+        storage = getattr(storage, "_inner", None)
+    return None
+
+
 def health_payload(ctx: AppContext) -> dict:
     """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
 
@@ -276,14 +289,12 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
                               payload)
         if self.path == "/actuator/metrics":
             return self._json(200, {"meters": self.ctx.registry.scrape()})
-        if self.path == "/actuator/prometheus":
+        if self.path.startswith("/actuator/prometheus"):
             return self._prometheus()
+        if self.path.startswith("/actuator/tenants"):
+            return self._tenants()
         if self.path.startswith("/actuator/flightrecorder"):
-            recorder = self.ctx.recorder
-            if recorder is None:
-                return self._json(200, {"total_events": 0, "events": [],
-                                        "anomalies": []})
-            return self._json(200, recorder.snapshot())
+            return self._flightrecorder()
         if self.path == "/actuator/replication":
             repl = self.ctx.replication
             if repl is None:
@@ -302,15 +313,61 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
         self._json(404, {"error": "not found"})
 
     def _prometheus(self):
-        """Prometheus text exposition over every registered meter."""
+        """Prometheus text exposition over every registered meter, plus
+        the telemetry plane's labeled per-tenant / per-key-class
+        series."""
         from ratelimiter_tpu.observability import prometheus
 
-        body = prometheus.render(self.ctx.registry).encode()
+        plane = _find_attr(self.ctx.storage, "telemetry")
+        collectors = (plane,) if plane is not None else ()
+        body = prometheus.render(self.ctx.registry,
+                                 collectors=collectors).encode()
         self.send_response(200)
         self.send_header("Content-Type", prometheus.CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _tenants(self):
+        """Per-tenant usage accounting + telemetry staleness
+        (ARCHITECTURE §13e — the human-readable face of UsageSignals)."""
+        plane = _find_attr(self.ctx.storage, "telemetry")
+        if plane is None:
+            return self._json(200, {"enabled": False, "tenants": {}})
+        payload = {"enabled": True, **plane.tenants_payload()}
+        leases = getattr(self.ctx, "leases", None)
+        if leases is not None:
+            payload["leases"] = leases.status()
+        return self._json(200, payload)
+
+    def _flightrecorder(self):
+        """Flight-recorder snapshot; ``?kind=`` (exact or dotted
+        prefix), ``?since_ms=`` (wall-clock ms), and ``?last=`` filter
+        ring-side."""
+        import urllib.parse
+
+        recorder = self.ctx.recorder
+        if recorder is None:
+            return self._json(200, {"total_events": 0, "events": [],
+                                    "anomalies": []})
+        query = urllib.parse.urlparse(self.path).query
+        params = urllib.parse.parse_qs(query)
+
+        def _one(name):
+            vals = params.get(name)
+            return vals[0] if vals else None
+
+        kind = _one("kind")
+        since_ms = _one("since_ms")
+        last = _one("last")
+        try:
+            since_ms = int(since_ms) if since_ms is not None else None
+            last = int(last) if last is not None else 256
+        except ValueError:
+            return self._json(400, {
+                "error": "since_ms and last must be integers"})
+        return self._json(200, recorder.snapshot(
+            last=last, kind=kind, since_ms=since_ms))
 
     def do_POST(self):
         if self.path == "/api/login":

@@ -119,6 +119,14 @@ DEFAULTS = {
     "ratelimiter.obs.trace_sample": "0",
     "ratelimiter.obs.slo_ms": "0",
     "ratelimiter.obs.flight_capacity": "1024",
+    # Fleet telemetry plane (observability/telemetry.py + usage.py,
+    # ARCHITECTURE §13e): per-tenant usage ring bound (tenants over the
+    # cap are counted, not tracked), the LRU window of distinct clients
+    # tracked for the staleness gauge, and the trace-lineage ring bound
+    # (sampled trace ids whose hop paths are retained).
+    "ratelimiter.usage.max_tenants": "256",
+    "ratelimiter.telemetry.max_clients": "1024",
+    "ratelimiter.obs.lineage_capacity": "256",
     # Shard the slot array over all visible devices when > 1.
     "parallel.shard": "auto",
     # Compile hot dispatch shapes at boot (moves 40-90s/shape jit stalls
@@ -190,6 +198,9 @@ _INT_KEYS = (
     "ratelimiter.sidecar.max_connections",
     "ratelimiter.obs.trace_sample",
     "ratelimiter.obs.flight_capacity",
+    "ratelimiter.usage.max_tenants",
+    "ratelimiter.telemetry.max_clients",
+    "ratelimiter.obs.lineage_capacity",
     "ratelimiter.orchestrator.suspect_threshold",
     "ratelimiter.orchestrator.promote_retries",
     "ratelimiter.cache.hybrid.max_keys",

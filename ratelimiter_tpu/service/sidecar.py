@@ -23,6 +23,8 @@ can speak it in ~30 lines:
       6 = LEASE         (v3: a=limiter id, b=requested budget)
       7 = RENEW         (v3: a=limiter id, b=used | requested << 16)
       8 = RELEASE       (v3: a=limiter id, b=used)
+      9 = TELEMETRY     (v4: key bytes carry a client burn report;
+                         RESPONSE-LESS — see below)
   status: 0 = OK
           1 = ERROR          (generic; remaining carries an errno — the only
                               error status v1 clients ever see)
@@ -54,6 +56,25 @@ locally — one wire frame per budget instead of one per decision (the
 re-charges in one frame; ``LEASE_REVOKED`` forces a re-grant after a
 failover (the fence epoch advanced — leases/manager.py).  Budgets are
 capped at 65535 by the wire format.
+
+**Wire v4: trace ids + client telemetry (observability/telemetry.py).**
+On a connection negotiated at v4, every request frame EXCEPT HELLO
+carries a 64-bit trace id between the header and the key bytes::
+
+  v4 request := u32 len | u8 op | u32 a | u32 b | u64 trace_id | key
+
+``trace_id == 0`` means untraced (the server mints one when lineage
+sampling is armed); a nonzero id is force-sampled — the caller asked
+for this trace — and threads client -> sidecar -> batcher -> shard ->
+resolve through the lineage ring.  v<=3 clients never send the extra
+field and are served byte-identically to a v3 server.  The TELEMETRY
+op (9) ships a ``LeaseClient``'s accumulated burn report; it is
+**response-less** by design (drop-don't-block: telemetry must never
+add a wire round trip), so clients pipeline it in front of RENEW
+frames for free or fire it on a cadence without reading anything back.
+The server folds reports into the fleet telemetry plane
+(``storage.telemetry``); a report during drain or on a plane-less
+server is silently dropped (still no response — op 9 never answers).
 
 **Ingress hardening.**  Every byte on the wire is untrusted:
 
@@ -121,8 +142,9 @@ OP_HELLO = 5
 OP_LEASE = 6
 OP_RENEW = 7
 OP_RELEASE = 8
+OP_TELEMETRY = 9
 
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 
 ST_OK = 0
 ST_ERROR = 1
@@ -161,6 +183,7 @@ def _unpack_lease(remaining: int):
             (remaining >> 40) & 0x7FFFFF)
 
 _REQ_BODY = struct.Struct("<BII")    # op, a, b (after the u32 len)
+_REQ_BODY4 = struct.Struct("<BIIQ")  # v4: op, a, b, trace_id
 _RESP = struct.Struct("<IBBq")       # len, status, allowed, remaining
 
 # v2-only statuses carry these errnos when downgraded for a v1 client.
@@ -237,6 +260,8 @@ class SidecarServer:
         self.drained_total = 0       # frames answered SHUTTING_DOWN
         self.refused_total = 0       # accepts over max_connections
         self.futures_abandoned = 0   # futures a dead client left behind
+        self.telemetry_frames_total = 0   # TELEMETRY frames received
+        self.telemetry_dropped_total = 0  # dropped (drain/no plane/bad)
         self.last_shed_s = 0.0       # monotonic stamp of the last shed
         reg = meter_registry
         self._m_conns = (reg.gauge(
@@ -513,20 +538,34 @@ class SidecarServer:
     def _begin_frame(self, frame: bytes, st: _ConnState):
         """Phase 1 of a pipelined burst: TRY_ACQUIRE frames are submitted
         to the micro-batcher and return their FUTURE; everything else
-        (and every validation failure) resolves immediately to bytes."""
+        (and every validation failure) resolves immediately to bytes.
+        TELEMETRY frames are response-less and return b''."""
         resp = self._resp
         if len(frame) < _REQ_BODY.size:
             self._count_malformed()
             return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
         try:
-            op, a, b = _REQ_BODY.unpack_from(frame)
-            key_bytes = frame[_REQ_BODY.size:]
-            if self.max_key_bytes and len(key_bytes) > self.max_key_bytes:
+            tid = 0
+            if st.version >= 4 and frame[0] != OP_HELLO:
+                # v4 frame extension: a u64 trace id rides between the
+                # header and the key bytes (HELLO keeps the v1 shape —
+                # it IS the negotiation frame).
+                if len(frame) < _REQ_BODY4.size:
+                    self._count_malformed()
+                    return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
+                op, a, b, tid = _REQ_BODY4.unpack_from(frame)
+                key_bytes = frame[_REQ_BODY4.size:]
+            else:
+                op, a, b = _REQ_BODY.unpack_from(frame)
+                key_bytes = frame[_REQ_BODY.size:]
+            if op != OP_TELEMETRY and self.max_key_bytes \
+                    and len(key_bytes) > self.max_key_bytes:
                 self._count_malformed()
                 return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
             if op == OP_HELLO:
                 # min(client, server): a v2 client stays on v2 — it
-                # never sees the v3 ops or statuses.
+                # never sees the v3 ops or statuses (nor the v4 frame
+                # extension).
                 st.version = min(int(a), PROTOCOL_VERSION) if a >= 2 else 1
                 return _mk_resp(ST_OK, st.version, self.max_frame_bytes)
             if op == OP_PING:
@@ -534,6 +573,14 @@ class SidecarServer:
                     return resp(st, ST_OK, 0, 0)
                 return resp(st, ST_OK,
                             1 if self.storage.is_available() else 0, 0)
+            if op == OP_TELEMETRY:
+                if st.version < 4:
+                    self._count_malformed()
+                    return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
+                # Response-less by contract: fold (or drop) and emit
+                # nothing — a report must never cost a round trip.
+                self._fold_telemetry(key_bytes)
+                return b""
             lease_op = op in (OP_LEASE, OP_RENEW, OP_RELEASE)
             if lease_op and st.version < 3:
                 # The lease ops do not exist below v3: a v2 (or v1)
@@ -558,11 +605,19 @@ class SidecarServer:
             if entry is None:
                 return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
             algo, _cfg = entry
+            if tid:
+                # An explicit wire trace id: the client asked for this
+                # trace — force-sample it and stamp the ingress hop.
+                lineage = getattr(self.storage, "lineage", None)
+                if lineage is not None:
+                    lineage.force(tid)
+                    lineage.record(tid, "sidecar", op=op, lid=int(a),
+                                   version=st.version)
             if lease_op:
-                return self._lease_frame(st, op, a, b, key)
+                return self._lease_frame(st, op, a, b, key, tid)
             if op == OP_TRY_ACQUIRE:
                 return self._begin_acquire(st, algo, a, key,
-                                           max(int(b), 1))
+                                           max(int(b), 1), tid)
             if op == OP_AVAILABLE:
                 avail = int(self.storage.available_many(algo, a, [key])[0])
                 return resp(st, ST_OK, 0, avail)
@@ -572,8 +627,20 @@ class SidecarServer:
         except Exception:  # noqa: BLE001 — protocol errors must not kill the conn
             return resp(st, ST_ERROR, 0, ERR_INTERNAL)
 
+    def _fold_telemetry(self, blob: bytes) -> None:
+        """Fold one TELEMETRY frame into the fleet plane (best-effort:
+        drained, plane-less, or malformed reports are dropped+counted,
+        and the op never answers either way)."""
+        self.telemetry_frames_total += 1
+        plane = getattr(self.storage, "telemetry", None)
+        if plane is None or self._draining:
+            self.telemetry_dropped_total += 1
+            return
+        if plane.fold(blob) < 0:
+            self.telemetry_dropped_total += 1
+
     def _lease_frame(self, st: _ConnState, op: int, lid: int, b: int,
-                     key: str) -> bytes:
+                     key: str, trace_id: int = 0) -> bytes:
         """One v3 lease op against the attached LeaseManager.  Resolves
         synchronously (a lease frame amortizes over a whole budget, so
         it does not ride the pipelined decision path)."""
@@ -582,15 +649,18 @@ class SidecarServer:
         try:
             if op == OP_LEASE:
                 g = self._leases.grant(lid, key,
-                                       requested=int(b) & 0xFFFF)
+                                       requested=int(b) & 0xFFFF,
+                                       trace_id=trace_id)
             elif op == OP_RENEW:
                 g = self._leases.renew(lid, key, used=int(b) & 0xFFFF,
-                                       requested=(int(b) >> 16) & 0xFFFF)
+                                       requested=(int(b) >> 16) & 0xFFFF,
+                                       trace_id=trace_id)
                 if g is None:
                     return self._resp(st, ST_LEASE_REVOKED, 0,
                                       _pack_lease(0, 0, 0))
             else:  # OP_RELEASE
-                self._leases.release(lid, key, used=int(b) & 0xFFFF)
+                self._leases.release(lid, key, used=int(b) & 0xFFFF,
+                                     trace_id=trace_id)
                 return self._resp(st, ST_OK, 1, 0)
             return self._resp(st, ST_OK, 1 if g.granted > 0 else 0,
                               _pack_lease(g.granted, g.ttl_ms, g.epoch))
@@ -600,12 +670,15 @@ class SidecarServer:
             return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
 
     def _begin_acquire(self, st: _ConnState, algo: str, lid: int, key: str,
-                       permits: int):
+                       permits: int, trace_id: int = 0):
         """Submit one decision frame, enforcing the pipeline cap and
         relaying the batcher's own admission control in-protocol."""
         n_inflight = sum(1 for p in st.pending if not isinstance(p, bytes))
         if self.max_pipeline and n_inflight >= self.max_pipeline:
             self._count_pipeline_shed()
+            plane = getattr(self.storage, "telemetry", None)
+            if plane is not None:
+                plane.note_shed(lid, 1)
             # The burst drains within roughly one micro-batch flush; the
             # hint mirrors the batcher's queue_full estimate.
             batcher = getattr(self.storage, "_batcher", None)
@@ -614,7 +687,8 @@ class SidecarServer:
         acquire_async = getattr(self.storage, "acquire_async", None)
         try:
             if acquire_async is not None:
-                fut = acquire_async(algo, lid, key, permits)
+                fut = acquire_async(algo, lid, key, permits,
+                                    trace_id=trace_id)
                 self._track_submit(1)
                 return fut
             out = self.storage.acquire(algo, lid, key, permits)
@@ -710,24 +784,32 @@ class LeaseWire(NamedTuple):
 class SidecarClient:
     """Minimal pipelining client (reference for other-language ports).
 
-    Speaks protocol v3 by default: sends HELLO at connect and records the
+    Speaks protocol v4 by default: sends HELLO at connect and records the
     negotiated version + the server's frame cap.  ``protocol=1`` skips
     the handshake (byte-compatible with the pre-v2 client); a v1 server
     answering HELLO with an error also downgrades the client to v1, and
-    a v2 server negotiates the connection down to v2 (no lease ops).
+    a v2/v3 server negotiates the connection down (no lease ops below
+    v3; no trace ids / telemetry below v4).
 
     The lease methods (``lease_grant``/``lease_renew``/``lease_release``)
-    make this a ``leases/client.py:LeaseClient`` transport: burn
-    decisions locally, renew one frame per budget.
+    plus :meth:`telemetry_report` make this a full
+    ``leases/client.py:LeaseClient`` transport: burn decisions locally,
+    renew one frame per budget, flush burn telemetry response-less.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 protocol: int = PROTOCOL_VERSION):
+                 protocol: int = PROTOCOL_VERSION,
+                 telemetry_send_timeout: float = 0.25):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rbuf = b""
         self.server_version = 1
         self.server_max_frame = 0
+        # Drop-don't-block: one TELEMETRY send may stall at most this
+        # long; a failed send marks telemetry down for this connection
+        # (a partial write would desync the stream, so never retry).
+        self._telemetry_send_timeout = float(telemetry_send_timeout)
+        self._telemetry_down = False
         if protocol >= 2:
             # The HELLO response carries the negotiated version in the
             # `allowed` byte — read it raw (no bool coercion).  Sends the
@@ -749,9 +831,18 @@ class SidecarClient:
         self._sock.close()
 
     # -- framing --------------------------------------------------------------
-    @staticmethod
-    def _frame(op: int, lid: int, permits: int, key: str) -> bytes:
-        body = struct.pack("<BII", op, lid, permits) + key.encode()
+    def _frame(self, op: int, lid: int, permits: int, key: str,
+               trace_id: int = 0,
+               key_bytes: Optional[bytes] = None) -> bytes:
+        """One request frame in the connection's negotiated format: the
+        v4 shape carries a u64 trace id after the header (HELLO always
+        keeps the v1 shape — it predates negotiation)."""
+        raw = key.encode() if key_bytes is None else key_bytes
+        if self.server_version >= 4 and op != OP_HELLO:
+            body = _REQ_BODY4.pack(op, lid, permits,
+                                   int(trace_id) & ((1 << 64) - 1)) + raw
+        else:
+            body = _REQ_BODY.pack(op, lid, permits) + raw
         return struct.pack("<I", len(body)) + body
 
     def _read_raw(self) -> Tuple[int, int, int]:
@@ -791,8 +882,10 @@ class SidecarClient:
                            f"errno={remaining})")
 
     # -- API ------------------------------------------------------------------
-    def try_acquire(self, lid: int, key: str, permits: int = 1) -> bool:
-        self._send(self._frame(OP_TRY_ACQUIRE, lid, permits, key))
+    def try_acquire(self, lid: int, key: str, permits: int = 1,
+                    trace_id: int = 0) -> bool:
+        self._send(self._frame(OP_TRY_ACQUIRE, lid, permits, key,
+                               trace_id=trace_id))
         status, allowed, remaining = self._read_responses(1)[0]
         self._check(status, remaining)
         return allowed
@@ -809,13 +902,13 @@ class SidecarClient:
         return self._read_responses(len(keys))
 
     # -- token leases (protocol v3) -------------------------------------------
-    def _lease_roundtrip(self, op: int, lid: int, b: int,
-                         key: str) -> Optional[LeaseWire]:
+    def _lease_roundtrip(self, op: int, lid: int, b: int, key: str,
+                         trace_id: int = 0) -> Optional[LeaseWire]:
         if self.server_version < 3:
             raise RuntimeError(
                 f"server negotiated protocol v{self.server_version}; "
                 "lease ops need v3")
-        self._send(self._frame(op, lid, b, key))
+        self._send(self._frame(op, lid, b, key, trace_id=trace_id))
         status, allowed, remaining = self._read_raw()
         if status == ST_LEASE_REVOKED:
             return None
@@ -823,28 +916,65 @@ class SidecarClient:
         del allowed
         return LeaseWire(*_unpack_lease(remaining))
 
-    def lease_grant(self, lid: int, key: str,
-                    requested: int = 0) -> Optional[LeaseWire]:
+    def lease_grant(self, lid: int, key: str, requested: int = 0,
+                    trace_id: int = 0) -> Optional[LeaseWire]:
         """Charge a per-key budget; ``granted == 0`` means the key stays
         on the per-decision path for ``ttl_ms`` (retry hint)."""
         return self._lease_roundtrip(OP_LEASE, lid,
-                                     min(int(requested), 0xFFFF), key)
+                                     min(int(requested), 0xFFFF), key,
+                                     trace_id=trace_id)
 
     def lease_renew(self, lid: int, key: str, used: int,
-                    requested: int = 0) -> Optional[LeaseWire]:
+                    requested: int = 0,
+                    trace_id: int = 0) -> Optional[LeaseWire]:
         """Report ``used`` burns + re-charge; None when REVOKED (the
         fence epoch advanced — re-grant via :meth:`lease_grant`)."""
         b = (min(int(used), 0xFFFF)
              | min(int(requested), 0xFFFF) << 16)
-        return self._lease_roundtrip(OP_RENEW, lid, b, key)
+        return self._lease_roundtrip(OP_RENEW, lid, b, key,
+                                     trace_id=trace_id)
 
-    def lease_release(self, lid: int, key: str, used: int) -> None:
+    def lease_release(self, lid: int, key: str, used: int,
+                      trace_id: int = 0) -> None:
         """Close a lease: final burn report, unused budget credited."""
         if self.server_version < 3:
             return
         self._send(self._frame(OP_RELEASE, lid,
-                               min(int(used), 0xFFFF), key))
+                               min(int(used), 0xFFFF), key,
+                               trace_id=trace_id))
         self._read_raw()
+
+    # -- telemetry (protocol v4, response-less) -------------------------------
+    def telemetry_supported(self) -> bool:
+        return self.server_version >= 4 and not self._telemetry_down
+
+    def telemetry_report(self, blob: bytes) -> bool:
+        """Ship one burn report; NO response is read (the op is
+        response-less by contract).  Drop-don't-block: a send that
+        cannot complete within ``telemetry_send_timeout`` (or errors)
+        returns False and marks telemetry down for this connection — a
+        partial write would desync the stream, so it is never retried.
+        Callers count False as a dropped flush and keep accumulating."""
+        if not self.telemetry_supported():
+            return False
+        frame = self._frame(OP_TELEMETRY, 0, 0, "", key_bytes=bytes(blob))
+        if self.server_max_frame and len(frame) - 4 > self.server_max_frame:
+            return False
+        prev = None
+        try:
+            prev = self._sock.gettimeout()
+            self._sock.settimeout(self._telemetry_send_timeout)
+            self._sock.sendall(frame)
+            return True
+        except OSError:
+            self._telemetry_down = True
+            return False
+        finally:
+            if prev is not None:
+                try:
+                    self._sock.settimeout(prev)
+                except OSError:
+                    pass
 
     def available(self, lid: int, key: str) -> int:
         self._send(self._frame(OP_AVAILABLE, lid, 0, key))

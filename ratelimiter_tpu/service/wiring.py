@@ -230,6 +230,13 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
                 "ratelimiter.cache.hybrid.unconfirmed_cap", 64),
             serving_cache_guard_ms=props.get_float(
                 "ratelimiter.cache.hybrid.guard_ms", 5.0),
+            # Fleet telemetry plane + trace lineage (ARCHITECTURE §13e).
+            usage_max_tenants=props.get_int(
+                "ratelimiter.usage.max_tenants", 256),
+            telemetry_max_clients=props.get_int(
+                "ratelimiter.telemetry.max_clients", 1024),
+            lineage_capacity=props.get_int(
+                "ratelimiter.obs.lineage_capacity", 256),
         )
     raise ValueError(f"unknown storage.backend: {backend!r}")
 
@@ -260,9 +267,19 @@ def _maybe_breaker(storage: RateLimitStorage, props: AppProperties,
             and getattr(storage, "supports_device_batching", False)):
         from ratelimiter_tpu.storage.degraded import DegradedHostLimiter
 
+        # Walk the wrapper chain for the raw storage's telemetry plane
+        # so degraded decisions stay in the fleet counters.
+        plane, inner, seen = None, storage, set()
+        while inner is not None and id(inner) not in seen:
+            seen.add(id(inner))
+            plane = getattr(inner, "telemetry", None)
+            if plane is not None:
+                break
+            inner = getattr(inner, "_inner", None)
         fallback = DegradedHostLimiter(
             registry=registry,
-            max_keys=props.get_int("ratelimiter.degraded.max_keys", 65536))
+            max_keys=props.get_int("ratelimiter.degraded.max_keys", 65536),
+            telemetry=plane)
     breaker = CircuitBreakerStorage(
         storage,
         failure_threshold=props.get_int("breaker.failure_threshold", 8),

@@ -415,7 +415,7 @@ def ingress_drill(
         oracle_sw = SlidingWindowOracle(cfg_sw)
         oracle_tb = TokenBucketOracle(cfg_tb)
         healthy = sc.SidecarClient("127.0.0.1", server.port)
-        assert healthy.server_version == 3, "handshake failed"
+        assert healthy.server_version >= 3, "handshake failed"
 
         def healthy_wave() -> None:
             """Pipelined decisions on the DIRECT path, oracle-checked."""
@@ -444,7 +444,9 @@ def ingress_drill(
         assert batcher.queue_depth() == 0
 
         # -- fault 1: malformed frames, sent directly --------------------
-        atk = sc.SidecarClient("127.0.0.1", server.port)
+        # Pinned to v3: the hand-built frames below use the headerless
+        # pre-v4 layout, so the connection must negotiate it.
+        atk = sc.SidecarClient("127.0.0.1", server.port, protocol=3)
         declared = 100_000  # far over max_frame_bytes=512
         bad = [
             frame(1, lid_atk, 1, b"x" * 128),             # key too long

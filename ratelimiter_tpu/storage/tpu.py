@@ -38,7 +38,10 @@ import numpy as np
 
 from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.engine.batcher import MicroBatcher
-from ratelimiter_tpu.engine.errors import consume_pending_clears
+from ratelimiter_tpu.engine.errors import (
+    OverloadedError,
+    consume_pending_clears,
+)
 from ratelimiter_tpu.engine.engine import DeviceEngine
 from ratelimiter_tpu.engine.state import LimiterTable
 from ratelimiter_tpu.storage.base import RateLimitStorage
@@ -609,6 +612,9 @@ class TpuBatchedStorage(RateLimitStorage):
         serving_cache_max_keys: int = 65536,
         serving_cache_unconfirmed_cap: int = 64,
         serving_cache_guard_ms: float = 5.0,
+        usage_max_tenants: int = 256,
+        telemetry_max_clients: int = 1024,
+        lineage_capacity: int = 256,
     ):
         self._clock_ms = clock_ms
         # Observability (ARCHITECTURE §13).  The stage/latency histograms
@@ -749,6 +755,27 @@ class TpuBatchedStorage(RateLimitStorage):
         from ratelimiter_tpu.utils.tracing import DecisionTrace
 
         self.trace = DecisionTrace()
+        # Fleet telemetry plane (observability/telemetry.py): fleet-true
+        # ratelimiter.decisions.* counters + the per-tenant usage ring
+        # (fed from micro drains, stream chunks, sheds, degraded-path
+        # decisions, and client telemetry reports), and the trace-id
+        # lineage ring sampled ids accumulate hops in.  Both are part of
+        # the always-on observability layer (None with it off).
+        self.telemetry = None
+        self.lineage = None
+        if self._obs:
+            from ratelimiter_tpu.observability import (
+                TelemetryPlane,
+                TraceLineage,
+            )
+
+            self.telemetry = TelemetryPlane(
+                meter_registry, clock_ms=clock_ms,
+                max_clients=telemetry_max_clients)
+            self.telemetry.usage.max_tenants = max(int(usage_max_tenants),
+                                                   1)
+            self.lineage = TraceLineage(capacity=lineage_capacity,
+                                        sample_n=int(trace_sample))
         # Request-lifecycle tracer (observability/trace.py): the batcher
         # stamps enqueue/assembly/device/resolve and this aggregates them
         # into the ratelimiter.latency.* histograms, sampling 1-in-N full
@@ -759,7 +786,8 @@ class TpuBatchedStorage(RateLimitStorage):
 
             self._tracer = LatencyTracer(
                 meter_registry, trace=self.trace,
-                sample_n=int(trace_sample), recorder=self._recorder)
+                sample_n=int(trace_sample), recorder=self._recorder,
+                lineage=self.lineage)
         # Optional stream instrumentation (VERDICT r2 #1): when a caller
         # sets this to a list, the streaming loops append one record per
         # chunk — {mode, n, u, wire_bytes, assign_s, host_s, fetch_s} — so
@@ -837,7 +865,8 @@ class TpuBatchedStorage(RateLimitStorage):
         def _dispatcher(fn):
             def run(s, l, p):
                 stamp = _stamp()
-                return (fn(s, l, p, stamp), time.perf_counter(), stamp)
+                return (fn(s, l, p, stamp), time.perf_counter(), stamp,
+                        np.asarray(l, dtype=np.int64))
 
             return run
 
@@ -859,17 +888,25 @@ class TpuBatchedStorage(RateLimitStorage):
                     t2 = time.perf_counter()
                     tracer.record_sub("pack", (t1 - t0) * 1e6)
                     tracer.record_sub("layout", (t2 - t1) * 1e6)
-                return (handle, t1, stamp)
+                # Copy the lid lanes out for per-tenant accounting at
+                # drain time: the staging buffer recycles once the drain
+                # completes, so the drainer must not hold a view.
+                return (handle, t1, stamp, buf[1, :n].copy())
 
             return run if micro_ok else None
 
         def _drainer(algo, fn, staged_fn=None):
             def run(handle_t0, n):
-                handle, t0, stamp = handle_t0
+                handle, t0, stamp, lids = handle_t0
                 out = fn(handle, n)
                 dt_us = (time.perf_counter() - t0) * 1e6
                 self._record_dispatch(algo, n, int(out["allowed"].sum()),
                                       dt_us)
+                if self.telemetry is not None:
+                    # Per-tenant fleet accounting: one bincount pass per
+                    # batch, never per decision.
+                    self.telemetry.note_batch(lids, out["allowed"],
+                                              now_ms=stamp)
                 if self._serving is not None:
                     # The hybrid serving tier needs the dispatch stamp to
                     # adopt exact per-key state (cache/hybrid.py).
@@ -977,27 +1014,41 @@ class TpuBatchedStorage(RateLimitStorage):
         return lid
 
     def acquire(self, algo: str, lid: int, key: str, permits: int,
-                deadline_ms: float | None = None) -> dict:
+                deadline_ms: float | None = None,
+                trace_id: int = 0) -> dict:
         """Single decision through the micro-batcher (blocks until the batch
         containing this request lands; bounded by max_delay_ms).
 
         ``deadline_ms`` overrides the storage-wide queue-deadline budget
         for this request (admission control; engine/batcher.py)."""
         return self.acquire_async(algo, lid, key, permits,
-                                  deadline_ms=deadline_ms).result()
+                                  deadline_ms=deadline_ms,
+                                  trace_id=trace_id).result()
 
     def acquire_async(self, algo: str, lid: int, key: str, permits: int,
-                      deadline_ms: float | None = None):
+                      deadline_ms: float | None = None,
+                      trace_id: int = 0):
         """Future-returning :meth:`acquire` — the pipelining ingress
         primitive (service/sidecar.py): a connection handler submits
         every frame of a pipelined batch before resolving any, so all
         of them coalesce into the same micro-batch flush instead of
         paying one batcher round trip each.
 
+        ``trace_id``: a 64-bit trace id carried end to end (0 = mint
+        one here when lineage sampling is armed) — sampled ids record
+        batcher/shard/resolve hops (observability/telemetry.py).
+
         With the hybrid serving tier enabled, a tracked key's decision
         may resolve host-side immediately (see cache/hybrid.py): a pure
         reject touches no device at all; a mutating decision rides the
         next micro-batch asynchronously as its device confirmation."""
+        lin = self.lineage
+        if not trace_id and lin is not None and lin.sample_n > 0:
+            from ratelimiter_tpu.observability.telemetry import (
+                mint_trace_id,
+            )
+
+            trace_id = mint_trace_id()
         serving = self._serving
         if serving is not None:
             fut = self._serve_host_side(algo, lid, key, permits)
@@ -1010,9 +1061,15 @@ class TpuBatchedStorage(RateLimitStorage):
                 "index", (time.perf_counter() - t0) * 1e6)
         # The pin (taken atomically inside the assign) holds until the
         # submit registers the slot in pending_slots.
-        with self._pins_released(self._index[algo], [slot]):
-            fut = self._batcher.submit(algo, slot, lid, permits,
-                                       deadline_ms=deadline_ms)
+        try:
+            with self._pins_released(self._index[algo], [slot]):
+                fut = self._batcher.submit(algo, slot, lid, permits,
+                                           deadline_ms=deadline_ms,
+                                           trace_id=trace_id)
+        except OverloadedError:
+            if self.telemetry is not None:
+                self.telemetry.note_shed(lid, 1)
+            raise
         if serving is not None:
             serving.watch_miss(algo, lid, key, permits, slot, fut)
         return fut
@@ -1080,10 +1137,15 @@ class TpuBatchedStorage(RateLimitStorage):
                 "index", (time.perf_counter() - t0) * 1e6)
         for evicted in clears:
             self._batcher.add_clear(algo, int(evicted))
-        with self._pins_released(index, slots):
-            return self._batcher.submit_many(
-                algo, slots, np.full(n, lid, dtype=np.int64), permits,
-                deadline_ms=deadline_ms)
+        try:
+            with self._pins_released(index, slots):
+                return self._batcher.submit_many(
+                    algo, slots, np.full(n, lid, dtype=np.int64), permits,
+                    deadline_ms=deadline_ms)
+        except OverloadedError:
+            if self.telemetry is not None:
+                self.telemetry.note_shed(lid, n)
+            raise
 
     def acquire_many(
         self, algo: str, lid_per_req: Sequence[int], keys: Sequence[str],
@@ -1457,7 +1519,8 @@ class TpuBatchedStorage(RateLimitStorage):
                         rec["fetch_at"] = [round(tf0 - t_pass0, 6),
                                            round(tf1 - t_pass0, 6)]
                     self._record_dispatch(algo, count, n_allowed, dt_us,
-                                          path=f"relay|{mode}")
+                                          path=f"relay|{mode}",
+                                          lid=None if multi_lid else lid)
             finally:
                 # Staging buffers are reusable only after the fetch: the
                 # upload that read them is certainly consumed by then.
@@ -1830,7 +1893,7 @@ class TpuBatchedStorage(RateLimitStorage):
                     rec["fetch_at"] = [round(tf0 - t_pass0, 6),
                                        round(tf1 - t_pass0, 6)]
                 self._record_dispatch(algo, count, n_allowed, dt_us,
-                                      path=f"relay_w|{kind}")
+                                      path=f"relay_w|{kind}", lid=lid)
 
         # Chunk plan election — same machinery as _stream_relay (first
         # pass measures at the growth schedule; later passes may run a
@@ -2045,7 +2108,8 @@ class TpuBatchedStorage(RateLimitStorage):
                     rec["fetch_s"] = round(tf1 - tf0, 6)
                 self._record_dispatch(algo, count, n_allowed, dt_us,
                                       path="flat|scan" if k_scan
-                                      else "flat|sorted")
+                                      else "flat|sorted",
+                                      lid=None if multi_lid else lid)
 
         fut = None  # prefetched next-chunk assignment (holds pins)
         try:
@@ -2275,7 +2339,8 @@ class TpuBatchedStorage(RateLimitStorage):
             n_allowed = int(got.sum())
             with rec_lock:
                 self._record_dispatch(algo, cnt, n_allowed, dt_us,
-                                      path="sharded|flat")
+                                      path="sharded|flat",
+                                      lid=None if multi_lid else lid)
 
         pool = self._shard_pool(n_sh)
         try:
@@ -2579,7 +2644,8 @@ class TpuBatchedStorage(RateLimitStorage):
                                 max(rec.get("fetch_s", 0.0), tf1 - tf0), 6)
                     self._record_dispatch(algo, ns, int(alw),
                                           (tf1 - t0) * 1e6,
-                                          path=f"sharded|{mode}", shard=s)
+                                          path=f"sharded|{mode}", shard=s,
+                                          lid=None if multi_lid else lid)
                 finally:
                     lane.staging.give(buf)
 
@@ -3239,14 +3305,35 @@ class TpuBatchedStorage(RateLimitStorage):
 
     def _record_dispatch(self, algo: str, n: int, allowed: int,
                          dt_us: float, path: str = "micro",
-                         **extra) -> None:
+                         lid=None, **extra) -> None:
         """Latency histogram + enriched decision trace + SLO anomaly
         hook for a completed dispatch.  ``path`` names the dispatch
         route (micro / relay|digest / relay|split / flat / sharded|...);
-        ``extra`` carries enrichments like the shard id."""
+        ``extra`` carries enrichments like the shard id.  ``lid`` (a
+        single-tenant dispatch's limiter id) feeds the per-tenant usage
+        ring; mixed-tenant micro batches feed it from their drainer
+        instead."""
         if not self._obs:
             return
         self._latency.record_us(dt_us)
+        if lid is not None and self.telemetry is not None:
+            self.telemetry.note_server(int(lid), n, allowed)
+        lin = self.lineage
+        if (lin is not None and lin.sample_n > 0 and path != "micro"):
+            # Stream chunks: mint one trace id per dispatch; a sampled
+            # one records its shard/path hop and enriches the trace
+            # entry — the per-shard-lane leg of the lineage.
+            from ratelimiter_tpu.observability.telemetry import (
+                mint_trace_id,
+                trace_hex,
+            )
+
+            tid = mint_trace_id()
+            if lin.sampled(tid):
+                lin.record(tid, "shard", path=path,
+                           shard=extra.get("shard", 0), algo=algo,
+                           batch=n, device_us=round(dt_us, 1))
+                extra = dict(extra, trace=trace_hex(tid))
         self.trace.record(algo, n, allowed, dt_us, path=path, **extra)
         rec = self._recorder
         if rec is not None and rec.slo_us > 0.0 and dt_us > rec.slo_us:

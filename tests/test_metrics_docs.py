@@ -84,5 +84,14 @@ def test_catalog_regex_expands_families():
                      "ratelimiter.requests.allowed",
                      "ratelimiter.lease.granted",
                      "ratelimiter.lease.local_decisions",
-                     "ratelimiter.lease.over_admission"):
+                     "ratelimiter.lease.over_admission",
+                     "ratelimiter.decisions.allowed",
+                     "ratelimiter.decisions.denied",
+                     "ratelimiter.decisions.shed",
+                     "ratelimiter.decisions.lease_local",
+                     "ratelimiter.telemetry.reports",
+                     "ratelimiter.telemetry.rejected",
+                     "ratelimiter.telemetry.staleness_ms",
+                     "ratelimiter.telemetry.local_latency",
+                     "ratelimiter.tenant.admitted"):
         assert expected in names, expected

@@ -17,10 +17,15 @@ from ratelimiter_tpu.observability import (
 # ---------------------------------------------------------------------------
 
 def test_prometheus_golden():
-    """Exact output for one counter, one gauge, one histogram — pins the
-    format (name sanitization, HELP escaping, bucket ladder, sum/count)."""
+    """Exact output for two counters, one gauge, one histogram — pins
+    the format (name sanitization, HELP escaping + the description-table
+    fallback for meters registered without one, bucket ladder,
+    sum/count)."""
     reg = MeterRegistry()
     reg.counter("ratelimiter.requests.allowed", "Allowed requests").add(42)
+    # Registered WITHOUT a description: HELP comes from the
+    # METRIC_HELP description table.
+    reg.counter("ratelimiter.cache.hits").add(7)
     reg.gauge("ratelimiter.replication.lag_ms", "Replication lag").set(1.5)
     t = reg.timer("ratelimiter.storage.latency",
                   "Dispatch latency\nsecond line \\ backslash")
@@ -28,6 +33,9 @@ def test_prometheus_golden():
         t.record_us(v)
     got = render_prometheus(reg)
     expected = "\n".join([
+        "# HELP ratelimiter_cache_hits_total Local TTL-cache hits",
+        "# TYPE ratelimiter_cache_hits_total counter",
+        "ratelimiter_cache_hits_total 7",
         "# HELP ratelimiter_replication_lag_ms Replication lag",
         "# TYPE ratelimiter_replication_lag_ms gauge",
         "ratelimiter_replication_lag_ms 1.5",
@@ -95,6 +103,31 @@ def test_prometheus_name_sanitization():
     reg.counter("ratelimiter.weird-name.v2", "d").add(1)
     out = render_prometheus(reg)
     assert "ratelimiter_weird_name_v2_total 1" in out
+
+
+def test_prometheus_labeled_collector_golden():
+    """Collector-provided labeled families render after the registry's
+    meters, with label keys sorted and values escaped."""
+
+    class FakeCollector:
+        @staticmethod
+        def prometheus_samples():
+            return [(
+                "ratelimiter.tenant.admitted", "counter", "Per-tenant",
+                [({"tenant": "3"}, 10),
+                 ({"tenant": "7", "key_class": 'a"b\\c\nd'}, 2)],
+            )]
+
+    reg = MeterRegistry()
+    reg.counter("ratelimiter.requests.allowed", "Allowed").add(1)
+    out = render_prometheus(reg, collectors=(FakeCollector(),))
+    assert out.endswith("\n".join([
+        "# HELP ratelimiter_tenant_admitted_total Per-tenant",
+        "# TYPE ratelimiter_tenant_admitted_total counter",
+        'ratelimiter_tenant_admitted_total{tenant="3"} 10',
+        'ratelimiter_tenant_admitted_total'
+        '{key_class="a\\"b\\\\c\\nd",tenant="7"} 2',
+    ]) + "\n"), out
 
 
 # ---------------------------------------------------------------------------

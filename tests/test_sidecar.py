@@ -106,9 +106,15 @@ def test_error_paths(sidecar):
 # ---------------------------------------------------------------------------
 
 def test_v3_handshake_negotiates(sidecar):
+    from ratelimiter_tpu.service import sidecar as sc
+
     server, _ = sidecar
     client = SidecarClient("127.0.0.1", server.port)
-    assert client.server_version == 3
+    assert client.server_version == sc.PROTOCOL_VERSION
+    # A v3-pinned client negotiates exactly v3 (no v4 frame extension).
+    pinned = SidecarClient("127.0.0.1", server.port, protocol=3)
+    assert pinned.server_version == 3
+    pinned.close()
     assert client.server_max_frame == server.max_frame_bytes
     client.close()
 
@@ -141,7 +147,7 @@ def test_unknown_op_on_v3_connection_is_bad_frame(sidecar):
     server, _ = sidecar
     lid = server.register("tb", RateLimitConfig(
         max_permits=100, window_ms=60_000, refill_rate=50.0))
-    client = SidecarClient("127.0.0.1", server.port)
+    client = SidecarClient("127.0.0.1", server.port, protocol=3)
     assert client.server_version == 3
     client._send(client._frame(42, lid, 0, "k"))
     status, _, errno = client._read_raw()

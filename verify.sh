@@ -96,14 +96,15 @@ else
   fail=1
 fi
 
-echo "running observability overhead gate (full layer <= 2% of hot path)..."
+echo "running observability overhead gate (full layer incl. telemetry plane + usage ring <= 2% of hot path)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
     bench/observability_overhead.py --n 2097152 --rounds 5 \
     --assert-budget 0.02 > /dev/null; then
   echo "  ok  observability overhead budget"
 else
   echo "  FAILED  observability overhead budget (stage timers + trace +"
-  echo "          flight recorder cost more than 2% of the headline stream)"
+  echo "          flight recorder + fleet telemetry/usage ring cost more"
+  echo "          than 2% of the headline stream)"
   fail=1
 fi
 
@@ -141,14 +142,16 @@ else
   fail=1
 fi
 
-echo "running lease loopback gate (>= 10x wire-frame reduction at equal+ throughput)..."
+echo "running lease loopback gate (>= 10x wire-frame reduction + telemetry reconciliation)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/lease_loopback.py \
     --assert-ratio > /dev/null; then
-  echo "  ok  lease wire-frame reduction"
+  echo "  ok  lease wire-frame reduction + fleet-counter reconciliation"
 else
   echo "  FAILED  lease loopback (fewer than 10x frames saved per decision"
-  echo "          vs the per-decision v2 path, or leased throughput fell"
-  echo "          below the v2 baseline)"
+  echo "          vs the per-decision v2 path, leased throughput below the"
+  echo "          v2 baseline, fleet decision counters not reconciling with"
+  echo "          client ground truth, or a leased trace missing its"
+  echo "          client->sidecar->batcher->shard lineage)"
   fail=1
 fi
 
