@@ -89,12 +89,24 @@ def main() -> None:
     parser.add_argument("--shards", type=int, default=4)
     parser.add_argument("--slots-per-shard", type=int, default=1 << 14)
     parser.add_argument("--probe-interval-ms", type=float, default=100.0)
+    parser.add_argument("--probe-rpc", action="store_true",
+                        help="route every liveness probe through a "
+                             "loopback control-RPC round trip "
+                             "(replication/control.py) — the cross-host "
+                             "topology's probe path; the same steady-"
+                             "state budget must hold")
     parser.add_argument("--assert-budget", type=float, default=None,
                         metavar="FRAC",
                         help="fail if the direct orchestrator fraction "
                              "of the orchestrated pass exceeds this "
                              "(e.g. 0.02)")
     args = parser.parse_args()
+
+    # Thread wakeup latency dominates a loopback RPC on a saturated
+    # core: the default 5 ms GIL switch interval turns a ~100 us round
+    # trip into multi-ms scheduling stalls.  1 ms is the same setting
+    # bench/local_latency_slo.py uses for the same reason.
+    sys.setswitchinterval(0.001)
 
     import numpy as np
 
@@ -136,18 +148,56 @@ def main() -> None:
                 # this gate isolates the ORCHESTRATOR's probes.
                 interval_ms=3_600_000.0)
             router = ShardFailoverRouter(storage)
+            probe = None
+            rpc = None
+            if args.probe_rpc:
+                # The cross-host probe path: ONE control-RPC round trip
+                # per node per tick against a loopback ControlServer
+                # answering every shard's verdict from the router's
+                # non-blocking health view (exactly the unit the remote
+                # topology pays: the orchestrator probes a NODE's
+                # control port, not each shard separately) — the wire +
+                # scheduling cost without a second process in the gate.
+                from ratelimiter_tpu.replication.control import (
+                    ControlClient,
+                    ControlServer,
+                )
+
+                def probe_all() -> dict:
+                    return {"healthy": {
+                        str(q): v != "failed"
+                        for q, v in router.shard_health().items()}}
+
+                server = ControlServer({"probe_all": probe_all}).start()
+                client = ControlClient("127.0.0.1", server.port,
+                                       timeout=1.0)
+                rpc = (server, client)
+                cache = {"at": -1e9, "verdicts": {}}
+
+                def probe(q):
+                    now = time.monotonic()
+                    if (now - cache["at"]) * 1000.0 \
+                            >= args.probe_interval_ms / 2.0:
+                        cache["at"] = now
+                        try:
+                            cache["verdicts"] = client.call(
+                                "probe_all").get("healthy", {})
+                        except Exception:  # noqa: BLE001 — probe failure
+                            cache["verdicts"] = {}
+                    return bool(cache["verdicts"].get(str(q), False))
             orch = FailoverOrchestrator(
                 router, mesh_set, repl, standby_factory=factory,
+                probe=probe,
                 config=OrchestratorConfig(
                     probe_interval_ms=args.probe_interval_ms))
             meter = TickMeter(orch)
             orch.start()
-            handle = (orch, repl, mesh_set, router, meter)
+            handle = (orch, repl, mesh_set, router, meter, rpc)
         return storage, lid, handle
 
     base_storage, base_lid, _ = build(False)
     orch_storage, orch_lid, handle = build(True)
-    orch, repl, mesh_set, router, meter = handle
+    orch, repl, mesh_set, router, meter, rpc = handle
     for s, l in ((base_storage, base_lid), (orch_storage, orch_lid)):
         for _ in range(2):
             s.acquire_stream_ids("tb", l, key_ids)  # warm shapes/plans
@@ -185,6 +235,7 @@ def main() -> None:
         "shards": args.shards,
         "rounds": args.rounds,
         "probe_interval_ms": args.probe_interval_ms,
+        "probe_path": "control-rpc" if args.probe_rpc else "in-process",
         "off_rps": round(args.n / best["off"]),
         "on_rps": round(args.n / best["on"]),
         "paired_overhead_pct": paired_pct,
@@ -198,6 +249,9 @@ def main() -> None:
     router.close()
     mesh_set.close()
     base_storage.close()
+    if rpc is not None:
+        rpc[1].close()
+        rpc[0].stop()
     print(json.dumps(report, indent=2))
     if args.assert_budget is not None:
         budget_pct = 100.0 * args.assert_budget

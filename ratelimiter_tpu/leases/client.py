@@ -116,6 +116,7 @@ class LeaseClient:
                  clock_ms=None, direct_fallback: bool = True,
                  telemetry: bool = True,
                  telemetry_flush_ms: float = 250.0,
+                 telemetry_rearm_ms: float = 5000.0,
                  key_class=None,
                  trace_lineage: bool = False):
         self._t = transport
@@ -137,6 +138,14 @@ class LeaseClient:
         self.telemetry_flush_ms = float(telemetry_flush_ms)
         self.telemetry_flushes = 0    # reports shipped
         self.telemetry_dropped = 0    # reports dropped (never blocked on)
+        # lease.telemetry_rearmed: latch recoveries — a transport whose
+        # telemetry went down (one failed write latches it for that
+        # CONNECTION) is reconnected + re-HELLO'd at a bounded cadence;
+        # each success re-arms burn reporting instead of leaving it
+        # silently dead for the life of the client.
+        self.telemetry_rearmed = 0
+        self.telemetry_rearm_ms = float(telemetry_rearm_ms)
+        self._last_rearm = 0
         self._last_flush = int(self._clock_ms())
         if telemetry and hasattr(transport, "telemetry_report"):
             from ratelimiter_tpu.observability.telemetry import (
@@ -203,10 +212,24 @@ class LeaseClient:
     def _flush_telemetry(self, now: int) -> None:
         """Ship the accumulated report.  Drop-don't-block: a failed
         send loses that report's counts (counted in
-        ``telemetry_dropped``) and never retries inline."""
+        ``telemetry_dropped``) and never retries inline.  A transport
+        whose telemetry latched down is re-armed here (reconnect +
+        re-HELLO) at a bounded cadence — never more often than
+        ``telemetry_rearm_ms`` — so one bad write costs at most one
+        re-arm window of reports, not the client's lifetime."""
         telem = self._telem
         if telem is None or not telem.pending():
             return
+        if getattr(self._t, "_telemetry_down", False) \
+                and hasattr(self._t, "reconnect") \
+                and now - self._last_rearm >= self.telemetry_rearm_ms:
+            self._last_rearm = now
+            try:
+                rearmed = bool(self._t.reconnect())
+            except Exception:  # noqa: BLE001 — telemetry never propagates
+                rearmed = False
+            if rearmed:
+                self.telemetry_rearmed += 1
         self._last_flush = now
         blob = telem.encode_and_reset()
         try:

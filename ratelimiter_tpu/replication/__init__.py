@@ -27,6 +27,19 @@ refuse with ``FencedError``), drives the proven promotion path with
 bounded retry, and re-seeds a fresh standby so the system returns to
 N+1 — zero manual actuator calls (``ratelimiter.orchestrator.*``).
 
+The topology spans PROCESSES AND HOSTS (replication/control.py +
+remote.py + hostproc.py, ARCHITECTURE §10c): a small control-plane RPC
+(PROBE / FENCE / LEASE / PROMOTE / RESTORE over length-prefixed JSON)
+lets the same orchestrator drive shard primaries and standbys running
+as separate OS processes, with a DISTRIBUTED fence: the orchestrator
+grants each serving backend an epoch lease and renews it while probes
+answer (relayed through the standby's mailbox when only the
+orchestrator's own link is partitioned), a primary whose lease expires
+SELF-FENCES within one TTL, and a promoted replacement always carries
+a strictly higher epoch — bounded over-admission with no quorum
+library.  ``storage/chaos.py:cross_host_failover_drill`` proves it with
+real subprocesses under injected partitions.
+
 Wiring (service/wiring.py) is config-gated and OFF by default:
 
     replication.enabled     = true
@@ -38,6 +51,14 @@ Wiring (service/wiring.py) is config-gated and OFF by default:
     replication.interval_ms = 200                      (primary)
 """
 
+from ratelimiter_tpu.replication.control import (
+    ControlClient,
+    ControlError,
+    ControlServer,
+    LeaseMailbox,
+    primary_handlers,
+    standby_handlers,
+)
 from ratelimiter_tpu.replication.log import (
     ReplicationLog,
     device_journal_elected,
@@ -45,8 +66,17 @@ from ratelimiter_tpu.replication.log import (
     make_journal,
 )
 from ratelimiter_tpu.replication.orchestrator import (
+    BackendLeaseChannel,
     FailoverOrchestrator,
     OrchestratorConfig,
+)
+from ratelimiter_tpu.replication.remote import (
+    FanoutLeaseChannel,
+    RemoteBackend,
+    RemoteReceiver,
+    RemoteShardDirectory,
+    RemoteStandbySet,
+    standby_witness,
 )
 from ratelimiter_tpu.replication.replicator import Replicator
 from ratelimiter_tpu.replication.sharded import (
@@ -74,11 +104,21 @@ from ratelimiter_tpu.replication.wire import (
 )
 
 __all__ = [
+    "BackendLeaseChannel",
+    "ControlClient",
+    "ControlError",
+    "ControlServer",
     "DEFAULT_FRAME_BUDGET",
     "FailoverOrchestrator",
+    "FanoutLeaseChannel",
     "FrameArchive",
+    "LeaseMailbox",
     "OrchestratorConfig",
     "InProcessSink",
+    "RemoteBackend",
+    "RemoteReceiver",
+    "RemoteShardDirectory",
+    "RemoteStandbySet",
     "ReplicationLog",
     "ReplicationServer",
     "ReplicationStateError",
@@ -96,4 +136,7 @@ __all__ = [
     "encode_frame",
     "engine_state_fingerprint",
     "make_journal",
+    "primary_handlers",
+    "standby_handlers",
+    "standby_witness",
 ]

@@ -1,0 +1,279 @@
+"""Cross-host node process: one shard primary or one standby, runnable
+as ``python -m ratelimiter_tpu.replication.hostproc``.
+
+This is the process the multi-process topology (ARCHITECTURE §10c) is
+made of.  A PRIMARY node serves decisions over a sidecar (wire protocol
+v4, optional token leases), ships its replication stream to its standby
+(``--repl-target``), exposes the control port (PROBE / FENCE / LEASE /
+RESTORE / SHIP), and runs the LEASE KEEPER: when the orchestrator's
+direct renewals stop arriving, the keeper fetches the newest deposited
+grant from the standby's mailbox over the replication-side link — so a
+primary partitioned only from the ORCHESTRATOR keeps serving, while one
+partitioned from everything runs its lease down and self-fences within
+one TTL.  A STANDBY node applies the replication stream, answers the
+witness probe (``repl_rx_age_ms``), holds the lease mailbox, and serves
+the remote-promotion RPC — a successful PROMOTE starts a sidecar over
+the now-serving storage and reports its port for clients to re-point.
+
+The process prints ONE JSON line on stdout when ready (ports included)
+and exits when stdin closes — the launcher (a drill, an init system
+wrapper) owns its lifetime through the pipe.
+
+``storage/chaos.py:cross_host_failover_drill`` spawns these as real OS
+subprocesses with ``FaultInjectingProxy`` links between them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+
+def _build_limiters(spec_json: str) -> List[dict]:
+    spec = json.loads(spec_json) if spec_json else []
+    if not isinstance(spec, list):
+        raise ValueError("--limiters must be a JSON list")
+    return spec
+
+
+def _make_lease_manager(storage, props: Optional[dict] = None):
+    from ratelimiter_tpu.leases import LeaseManager
+
+    props = props or {}
+    return LeaseManager(
+        storage,
+        default_budget=int(props.get("default_budget", 64)),
+        max_budget=int(props.get("max_budget", 1024)),
+        ttl_ms=float(props.get("ttl_ms", 2000.0)),
+        deny_ttl_ms=float(props.get("deny_ttl_ms", 25.0)),
+    )
+
+
+class LeaseKeeper:
+    """Primary-side relay fetcher: while a serving lease is installed,
+    poll the standby's mailbox and apply any deposit that would EXTEND
+    the local deadline (a stale deposit can only shorten it and is
+    skipped — the lease still expires on the original schedule).
+
+    Age accounting makes the relay skew-free: the deposit's ``age_ms``
+    is measured on the STANDBY's clock between orchestrator deposit and
+    our fetch, so the applied TTL is ``ttl - age - slack`` — always at
+    or under what the orchestrator believes it granted, never past it.
+    """
+
+    def __init__(self, storage, standby_ctl, poll_ms: float = 100.0,
+                 slack_ms: float = 25.0):
+        self.storage = storage
+        self.ctl = standby_ctl
+        self.poll_ms = float(poll_ms)
+        self.slack_ms = float(slack_ms)
+        self.fetches = 0
+        self.applied = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="lease-keeper", daemon=True)
+
+    def start(self) -> "LeaseKeeper":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_ms / 1000.0):
+            try:
+                self._poll_once()
+            except Exception:  # noqa: BLE001 — the keeper never dies;
+                # a broken relay just lets the lease run down (by design)
+                pass
+
+    def _poll_once(self) -> None:
+        info = self.storage.serving_lease_info()
+        if not info["installed"]:
+            return  # no lease granted yet, or already expired/fenced
+        resp = self.ctl.try_call("lease_fetch")
+        self.fetches += 1
+        if resp is None or not resp.get("ok") or not resp.get("deposited"):
+            return
+        effective = (float(resp["ttl_ms"]) - float(resp["age_ms"])
+                     - self.slack_ms)
+        if effective <= info["ttl_remaining_ms"]:
+            return  # stale deposit: applying it would SHORTEN the lease
+        try:
+            self.storage.grant_serving_lease(int(resp["epoch"]), effective)
+            self.applied += 1
+        except ValueError:
+            # Stale epoch or fenced storage: the deposit is from an old
+            # generation (or we already self-fenced) — never resurrect.
+            pass
+
+
+def run_primary(args) -> int:
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.replication.control import (
+        ControlClient,
+        ControlServer,
+        primary_handlers,
+    )
+    from ratelimiter_tpu.replication.log import ReplicationLog
+    from ratelimiter_tpu.replication.replicator import Replicator
+    from ratelimiter_tpu.replication.transport import SocketSink
+    from ratelimiter_tpu.service.sidecar import SidecarServer
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    storage = TpuBatchedStorage(num_slots=args.num_slots,
+                                max_delay_ms=0.2)
+    sidecar = SidecarServer(storage, host=args.host, port=0,
+                            drain_timeout_ms=200.0)
+    if args.lease:
+        sidecar.attach_leases(_make_lease_manager(storage))
+    lids = []
+    for spec in _build_limiters(args.limiters):
+        algo = spec.pop("algo")
+        lids.append(sidecar.register(algo, RateLimitConfig(**spec)))
+    sidecar.start()
+
+    replicator = None
+    if args.repl_target:
+        host, _, port = args.repl_target.rpartition(":")
+        sink = SocketSink(host or "127.0.0.1", int(port), timeout=2.0,
+                          max_retries=1, backoff_ms=20.0,
+                          ack_timeout=args.ack_timeout_ms / 1000.0,
+                          dead_after=2)
+        replicator = Replicator(ReplicationLog(storage), sink,
+                                interval_ms=args.repl_interval_ms).start()
+
+    keeper = None
+    if args.standby_control:
+        host, _, port = args.standby_control.rpartition(":")
+        keeper = LeaseKeeper(
+            storage, ControlClient(host or "127.0.0.1", int(port),
+                                   timeout=0.5),
+            poll_ms=args.keeper_poll_ms).start()
+
+    control = ControlServer(
+        primary_handlers(storage, replicator=replicator),
+        host=args.host).start()
+
+    print(json.dumps({"ready": True, "role": "primary",
+                      "control_port": control.port,
+                      "sidecar_port": sidecar.port,
+                      "lids": lids}), flush=True)
+    _wait_for_eof()
+    if keeper is not None:
+        keeper.stop()
+    if replicator is not None:
+        replicator.close()
+    control.stop()
+    sidecar.stop()
+    storage.close()
+    return 0
+
+
+def run_standby(args) -> int:
+    from ratelimiter_tpu.replication.control import (
+        ControlServer,
+        LeaseMailbox,
+        standby_handlers,
+    )
+    from ratelimiter_tpu.replication.standby import StandbyReceiver
+    from ratelimiter_tpu.replication.transport import ReplicationServer
+    from ratelimiter_tpu.service.sidecar import SidecarServer
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    storage = TpuBatchedStorage(num_slots=args.num_slots,
+                                max_delay_ms=0.2)
+    receiver = StandbyReceiver(storage)
+    repl_server = ReplicationServer(receiver, host=args.host).start()
+    promoted_sidecar: dict = {}
+
+    def on_promote() -> dict:
+        # The shadow is now the serving primary for this shard's
+        # keyspace: open the front door and expose every limiter the
+        # replication stream registered (lids mean the same policies as
+        # on the dead primary — StandbyReceiver verified that on apply).
+        sidecar = SidecarServer(storage, host=args.host, port=0,
+                                drain_timeout_ms=200.0)
+        if args.lease:
+            sidecar.attach_leases(_make_lease_manager(storage))
+        for lid, (algo, cfg) in sorted(storage._configs.items()):
+            sidecar.expose(lid, algo, cfg)
+        sidecar.start()
+        promoted_sidecar["server"] = sidecar
+        return {"serve_port": sidecar.port}
+
+    control = ControlServer(
+        standby_handlers(storage, receiver, repl_server=repl_server,
+                         mailbox=LeaseMailbox(), on_promote=on_promote),
+        host=args.host).start()
+
+    print(json.dumps({"ready": True, "role": "standby",
+                      "control_port": control.port,
+                      "repl_port": repl_server.port}), flush=True)
+    _wait_for_eof()
+    control.stop()
+    repl_server.stop()
+    sidecar = promoted_sidecar.get("server")
+    if sidecar is not None:
+        sidecar.stop()
+    storage.close()
+    return 0
+
+
+def _wait_for_eof() -> None:
+    """Block until the launcher closes our stdin (its handle on our
+    lifetime); also returns if stdin was never a pipe."""
+    try:
+        while sys.stdin.buffer.read(4096):
+            pass
+    except (OSError, ValueError):
+        time.sleep(3600.0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--role", choices=("primary", "standby"),
+                        required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--num-slots", type=int, default=512)
+    parser.add_argument("--limiters", default="",
+                        help="JSON list of limiter specs to register "
+                             "(primary; algo + RateLimitConfig kwargs)")
+    parser.add_argument("--lease", action="store_true",
+                        help="attach a token-lease manager to the "
+                             "sidecar (v3 LEASE/RENEW/RELEASE)")
+    parser.add_argument("--repl-target", default="",
+                        help="host:port of the standby's replication "
+                             "listener (primary)")
+    parser.add_argument("--standby-control", default="",
+                        help="host:port of the standby's CONTROL port "
+                             "(primary; enables the lease-relay keeper)")
+    parser.add_argument("--repl-interval-ms", type=float, default=100.0)
+    # Generous by default: the standby's FIRST frame apply jit-compiles
+    # write_rows, and an ack deadline under that compile time reads as a
+    # dead link on a cold cache (the props default is 5000 too).
+    parser.add_argument("--ack-timeout-ms", type=float, default=5000.0)
+    parser.add_argument("--keeper-poll-ms", type=float, default=100.0)
+    args = parser.parse_args(argv)
+    # Persistent XLA compile cache: the node's dispatch shapes are the
+    # standard micro-batch buckets, so a warm cache turns per-process
+    # jit compiles into disk loads (utils/compile_cache.py).
+    try:
+        from ratelimiter_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(None)
+    except Exception:  # noqa: BLE001 — cold compiles still work
+        pass
+    if args.role == "primary":
+        return run_primary(args)
+    return run_standby(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -97,6 +97,21 @@ class OrchestratorConfig:
     promote_backoff_ms: float = 50.0
     # Re-seed a fresh standby after promotion (N+1 restoration).
     reseed: bool = True
+    # Distributed fence lease (cross-host topology, ARCHITECTURE §10c):
+    # when > 0, the orchestrator grants each serving backend an epoch
+    # lease of this TTL and renews it while probes answer — a primary
+    # partitioned from the orchestrator (and from the standby-relayed
+    # renewal path) SELF-FENCES within one TTL, which bounds the zombie's
+    # over-admission without quorum machinery.  0 keeps PR 9's process-
+    # local fencing (single-host topologies never pay).  Pick a TTL at
+    # or above detection_budget_ms: a shorter one can expire a healthy
+    # primary's lease during an ordinary flap-damped hysteresis window.
+    fence_lease_ttl_ms: float = 0.0
+    # Slack added when waiting out an unreachable zombie's lease before
+    # promoting (covers grant-delivery latency; clocks are not assumed
+    # synchronized — the wait runs entirely on the orchestrator's clock
+    # from its own last-grant timestamp).
+    fence_wait_slack_ms: float = 100.0
 
     @property
     def detection_budget_ms(self) -> float:
@@ -107,12 +122,26 @@ class OrchestratorConfig:
             + self.hysteresis_ms
 
 
+class BackendLeaseChannel:
+    """Serving-lease channel over a backend object held in-process (a
+    local storage, or a replication/remote.py:RemoteBackend proxying a
+    control port).  No relay leg — pair with a FanoutLeaseChannel
+    (replication/remote.py) when a standby mailbox exists."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    def grant(self, epoch: int, ttl_ms: float) -> None:
+        self.backend.grant_serving_lease(int(epoch), float(ttl_ms))
+
+
 class _ShardWatch:
     """Per-shard state-machine bookkeeping."""
 
     __slots__ = ("state", "since", "since_wall_ms", "consecutive",
                  "probe_failures", "suspect_since", "promote_attempts",
-                 "candidate_idx", "last_error")
+                 "candidate_idx", "last_error", "lease_granted_at",
+                 "fence_wait_until")
 
     def __init__(self, now: float):
         self.state = MONITORING
@@ -124,6 +153,13 @@ class _ShardWatch:
         self.promote_attempts = 0
         self.candidate_idx = 0
         self.last_error: Optional[str] = None
+        # Orchestrator-clock stamp of the newest serving-lease grant (or
+        # relay deposit) this shard's backend may hold — the FENCING wait
+        # for an unreachable zombie runs from here.
+        self.lease_granted_at = now
+        # FENCING holds until this orchestrator-clock time (0 = no wait:
+        # the explicit fence landed, or leases are off).
+        self.fence_wait_until = 0.0
 
 
 class FailoverOrchestrator:
@@ -155,6 +191,8 @@ class FailoverOrchestrator:
                  config: Optional[OrchestratorConfig] = None,
                  probe: Optional[Callable[[int], bool]] = None,
                  spares: Optional[Dict[int, List[object]]] = None,
+                 lease_channels: Optional[Dict[int, object]] = None,
+                 witness: Optional[Callable[[int], str]] = None,
                  registry=None, recorder=None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
@@ -165,6 +203,22 @@ class FailoverOrchestrator:
         self.cfg = config or OrchestratorConfig()
         self._probe = probe or self._default_probe
         self._spares = {int(q): list(v) for q, v in (spares or {}).items()}
+        # Serving-lease channels (cfg.fence_lease_ttl_ms > 0): per-shard
+        # objects with ``grant(epoch, ttl_ms)`` (direct to the serving
+        # backend) and optionally ``deposit(epoch, ttl_ms)`` (park the
+        # grant at the shard's standby for the primary to fetch over the
+        # replication-side path — replication/control.py:LeaseMailbox).
+        self._lease_channels = dict(lease_channels or {})
+        # Second witness (cross-host): ``witness(q)`` answers "alive" /
+        # "dead" / "unknown" from a vantage point OTHER than the
+        # orchestrator's own probe link — in the reference topology, the
+        # shard's standby reporting how recently the primary's
+        # replication frames/heartbeats landed.  "alive" VETOES fencing:
+        # a primary the orchestrator cannot reach but the standby can is
+        # partitioned-from-the-orchestrator, not dead, and replacing it
+        # is exactly the two-primaries trap.  None (default) keeps PR 9
+        # behavior: the probe verdict alone drives the state machine.
+        self._witness = witness
         self._clock = clock
         self._sleep = sleep
         self.n_shards = int(router.n_shards)
@@ -175,6 +229,8 @@ class FailoverOrchestrator:
         self.false_alarms = 0
         self.reseeds = 0
         self.failed_closed = 0
+        self.witness_vetoes = 0
+        self.leases_granted = 0
         # Storages this orchestrator fenced (their rejected counts roll
         # up into the fence_rejected gauge) and per-shard re-seed
         # replication streams (flat Replicator, driven from tick()).
@@ -210,9 +266,14 @@ class FailoverOrchestrator:
                 "ratelimiter.orchestrator.reseeds",
                 "Fresh standbys re-seeded after a promotion (back to "
                 "N+1)")
+            self._m_vetoes = registry.counter(
+                "ratelimiter.orchestrator.witness_vetoes",
+                "Fencings vetoed by the standby witness (primary "
+                "partitioned from the orchestrator, not dead)")
         else:
             self._m_state = self._m_promotions = None
             self._m_false = self._m_fence_rej = self._m_reseeds = None
+            self._m_vetoes = None
 
     # -- probes ----------------------------------------------------------------
     def _default_probe(self, q: int) -> bool:
@@ -296,9 +357,11 @@ class FailoverOrchestrator:
             self._drive_reseed_stream(q)
             if self._probe(q):
                 w.consecutive = 0
+                self._lease_grant(q)
                 return
             w.consecutive += 1
             w.probe_failures += 1
+            self._lease_relay(q)
             if w.consecutive >= self.cfg.suspect_threshold:
                 w.suspect_since = now
                 self._transition(q, SUSPECT,
@@ -314,17 +377,35 @@ class FailoverOrchestrator:
                 self._recorder.record("orchestrator.false_alarm", shard=q,
                                       suspect_ms=round(
                                           (now - w.suspect_since) * 1000, 1))
+                self._lease_grant(q)
                 self._transition(q, MONITORING)
                 return
             w.consecutive += 1
             w.probe_failures += 1
+            self._lease_relay(q)
             if (now - w.suspect_since) * 1000.0 >= self.cfg.hysteresis_ms:
+                if self._witness_alive(q):
+                    # Second witness overrules the probe: the primary's
+                    # replication heartbeats still land at its standby,
+                    # so it is partitioned FROM US, not dead.  Fencing
+                    # or promoting now would raise a second primary next
+                    # to a live one — hold, keep its lease relayed.
+                    self.witness_vetoes += 1
+                    if self._m_vetoes is not None:
+                        self._m_vetoes.increment()
+                    self._recorder.record("orchestrator.witness_veto",
+                                          shard=q)
+                    w.consecutive = 0
+                    self._transition(q, MONITORING)
+                    return
                 self._transition(q, FENCING)
                 self._fence(q)
-                w.promote_attempts = 0
-                w.candidate_idx = 0
-                self._transition(q, PROMOTING)
-                self._try_promote(q)
+                self._maybe_enter_promoting(q, now)
+        elif w.state == FENCING:
+            # Waiting out an unreachable zombie's serving lease before
+            # installing its replacement (the explicit fence RPC could
+            # not be delivered — the lease expiry IS the fence).
+            self._maybe_enter_promoting(q, now)
         elif w.state == PROMOTING:
             self._try_promote(q)
         elif w.state == RESTORED:
@@ -341,6 +422,78 @@ class FailoverOrchestrator:
         # unfencing a shard the machine already declared dead twice
         # is exactly the two-primaries trap.
 
+    # -- serving leases (the distributed fence; cfg.fence_lease_ttl_ms) --------
+    def _lease_grant(self, q: int, epoch: Optional[int] = None) -> None:
+        """Renew shard q's serving lease: direct grant to the serving
+        backend plus (when the channel supports it) a relay deposit at
+        the shard's standby.  Epoch = current fence generation + 1, so a
+        replacement promoted after any future fence always carries a
+        strictly higher epoch than every lease granted before it."""
+        ch = self._lease_channels.get(q)
+        if ch is None or self.cfg.fence_lease_ttl_ms <= 0:
+            return
+        ttl = self.cfg.fence_lease_ttl_ms
+        ep = int(self.fence_epoch + 1 if epoch is None else epoch)
+        ok = False
+        try:
+            ch.grant(ep, ttl)
+            ok = True
+        except Exception as exc:  # noqa: BLE001 — a failed renewal is
+            # exactly what the lease is for; the backend runs down.
+            self._watch[q].last_error = str(exc)[:200]
+        dep = getattr(ch, "deposit", None)
+        if dep is not None:
+            try:
+                dep(ep, ttl)
+                ok = True
+            except Exception:  # noqa: BLE001 — relay is best-effort
+                pass
+        if ok:
+            self._watch[q].lease_granted_at = self._clock()
+            self.leases_granted += 1
+
+    def _lease_relay(self, q: int) -> None:
+        """Probe failed but the shard may still be alive (partition on
+        OUR link): while the standby witness vouches for it, keep its
+        lease renewed through the relay mailbox only — the primary
+        fetches it over the replication-side path it still has.  Without
+        a witness (or with a dead/unknown verdict) nothing is renewed
+        and the lease runs down toward self-fence."""
+        ch = self._lease_channels.get(q)
+        if ch is None or self.cfg.fence_lease_ttl_ms <= 0:
+            return
+        dep = getattr(ch, "deposit", None)
+        if dep is None or not self._witness_alive(q):
+            return
+        try:
+            dep(int(self.fence_epoch + 1), self.cfg.fence_lease_ttl_ms)
+            self._watch[q].lease_granted_at = self._clock()
+            self.leases_granted += 1
+        except Exception:  # noqa: BLE001 — relay is best-effort
+            pass
+
+    def _witness_alive(self, q: int) -> bool:
+        if self._witness is None:
+            return False
+        try:
+            return self._witness(q) == "alive"
+        except Exception:  # noqa: BLE001 — an erroring witness proves
+            # nothing; only a positive "alive" vetoes.
+            return False
+
+    def _maybe_enter_promoting(self, q: int, now: float) -> None:
+        """Leave FENCING for PROMOTING once it is SAFE: immediately when
+        the explicit fence landed, otherwise only after the zombie's
+        last-granted serving lease has provably expired (orchestrator
+        clock, from our own grant stamp, plus slack)."""
+        w = self._watch[q]
+        if now < w.fence_wait_until:
+            return
+        w.promote_attempts = 0
+        w.candidate_idx = 0
+        self._transition(q, PROMOTING)
+        self._try_promote(q)
+
     # -- FENCING ---------------------------------------------------------------
     def _fence(self, q: int) -> None:
         """Bump the monotonic fencing epoch and install it on whatever
@@ -350,6 +503,7 @@ class FailoverOrchestrator:
         q's keys on the old backend."""
         self.fence_epoch += 1
         old = self.router.replacements.get(q)
+        installed = False
         try:
             if old is not None:
                 # A previously-promoted flat replacement died: fence the
@@ -358,15 +512,40 @@ class FailoverOrchestrator:
                 self._fenced_storages.append(old)
             else:
                 # First failover of this shard: scope the fence to q on
-                # the sharded primary — survivors keep serving.
-                self.router.primary.fence(self.fence_epoch, shards=(q,))
-                if self.router.primary not in self._fenced_storages:
-                    self._fenced_storages.append(self.router.primary)
-        except Exception as exc:  # noqa: BLE001 — a dead primary may
-            # refuse even the fence call; the router's fail-closed deny
-            # still bounds admission, so proceed (recorded).
+                # the shard's primary — survivors keep serving.  A
+                # cross-host directory resolves per-shard backends via
+                # ``shard_primary`` (each is wholly one shard, so the
+                # scoping is a no-op there); the in-process router keeps
+                # the single sharded primary.
+                prim = (self.router.shard_primary(q)
+                        if hasattr(self.router, "shard_primary")
+                        else self.router.primary)
+                prim.fence(self.fence_epoch, shards=(q,))
+                if prim not in self._fenced_storages:
+                    self._fenced_storages.append(prim)
+            installed = True
+        except Exception as exc:  # noqa: BLE001 — a dead or PARTITIONED
+            # primary may refuse (or never receive) the fence call; the
+            # router's fail-closed deny still bounds routed admission,
+            # and with serving leases on, the zombie's own lease expiry
+            # bounds its direct admission (the wait below).
             _log.warning("fence install on shard %d backend failed: %s",
                          q, exc)
+        w = self._watch[q]
+        w.fence_wait_until = 0.0
+        if not installed and self.cfg.fence_lease_ttl_ms > 0:
+            # The fence RPC could not be delivered: the zombie's serving
+            # lease IS the fence.  Hold PROMOTING until every grant we
+            # (or our relay deposits) issued has provably expired —
+            # measured on OUR clock from OUR last-grant stamp, so no
+            # cross-host clock agreement is assumed.
+            w.fence_wait_until = w.lease_granted_at + (
+                self.cfg.fence_lease_ttl_ms
+                + self.cfg.fence_wait_slack_ms) / 1000.0
+            self._recorder.record(
+                "orchestrator.fence_wait", shard=q,
+                wait_ms=round(max(
+                    w.fence_wait_until - self._clock(), 0.0) * 1000.0, 1))
         self.router.fail_shard(q)
         if self.replicator is not None:
             # Stop shipping into the standby we are about to promote —
@@ -429,6 +608,7 @@ class FailoverOrchestrator:
                 self._recorder.record("orchestrator.promoted", shard=q,
                                       epoch=rx.last_epoch,
                                       fence_epoch=self.fence_epoch)
+                self._lease_adopt(q, promoted)
                 if self.cfg.reseed and self.standby_factory is not None:
                     self._transition(q, RESTORED)
                     self._start_reseed(q, promoted)
@@ -444,6 +624,28 @@ class FailoverOrchestrator:
         self._recorder.record("orchestrator.failed_closed", shard=q,
                               error=w.last_error)
         self._transition(q, FAILED)
+
+    def _lease_adopt(self, q: int, backend) -> None:
+        """A replacement now serves shard q: hand it a fresh serving
+        lease at a STRICTLY higher epoch than every lease the zombie
+        ever held (fence_epoch was bumped in _fence, so +1 is past the
+        zombie's generation), and point q's lease channel at it so the
+        MONITORING renewals flow to the right process."""
+        if self.cfg.fence_lease_ttl_ms <= 0 \
+                or q not in self._lease_channels:
+            return
+        grant = getattr(backend, "grant_serving_lease", None)
+        if grant is None:
+            return
+        try:
+            grant(self.fence_epoch + 1, self.cfg.fence_lease_ttl_ms)
+            self._lease_channels[q] = BackendLeaseChannel(backend)
+            self._watch[q].lease_granted_at = self._clock()
+            self.leases_granted += 1
+        except Exception as exc:  # noqa: BLE001 — the next MONITORING
+            # tick retries through the (now swapped or original) channel
+            _log.warning("serving-lease grant to shard %d replacement "
+                         "failed: %s", q, exc)
 
     # -- RESTORED (re-seed) ----------------------------------------------------
     def _start_reseed(self, q: int, promoted_storage) -> None:
@@ -526,7 +728,19 @@ class FailoverOrchestrator:
             w.candidate_idx = 0
             w.promote_attempts = 0
             w.last_error = None
+            w.fence_wait_until = 0.0
             self._transition(q, MONITORING)
+            # Re-arm the repaired primary's serving lease (its old one
+            # is void — self-fenced or explicitly fenced — and lift_fence
+            # above cleared the fence, so a fresh generation re-enables
+            # the expiry bound before traffic routes back).
+            prim = (self.router.shard_primary(q)
+                    if hasattr(self.router, "shard_primary")
+                    else self.router.primary)
+            if self.cfg.fence_lease_ttl_ms > 0 \
+                    and q in self._lease_channels:
+                self._lease_channels[q] = BackendLeaseChannel(prim)
+                self._lease_grant(q)
             self._recorder.record("orchestrator.unfenced", shard=q,
                                   epoch=self.fence_epoch)
             self._export_metrics()
@@ -553,6 +767,8 @@ class FailoverOrchestrator:
             "false_alarms": self.false_alarms,
             "reseeds": self.reseeds,
             "failed_closed": self.failed_closed,
+            "witness_vetoes": self.witness_vetoes,
+            "leases_granted": self.leases_granted,
             "fence_rejected": self.total_fence_rejected(),
             "config": dataclasses.asdict(self.cfg),
             "shards": {

@@ -165,8 +165,13 @@ class SocketSink:
                 self._link = LINK_DEAD
 
     def link_state(self) -> str:
-        with self._lock:
-            return self._link
+        # Deliberately LOCK-FREE (one atomic attribute read): the send
+        # path holds the main lock through its whole retry/backoff loop
+        # — many seconds against a partitioned standby — and the control
+        # plane (probe handlers, /actuator status, the orchestrator)
+        # polls this as a liveness signal.  A liveness read that blocks
+        # on the data plane would wedge exactly when it matters most.
+        return self._link
 
     def heartbeat(self) -> bool:
         """One zero-length liveness frame; the standby acks it without
@@ -259,6 +264,13 @@ class ReplicationServer:
 
     def __init__(self, receiver, host: str = "0.0.0.0", port: int = 0):
         self.receiver = receiver
+        # Monotonic stamp of the LAST complete frame OR heartbeat from
+        # the primary — the standby-side witness signal: an orchestrator
+        # that cannot reach the primary asks this standby "when did you
+        # last hear from it?" to tell a dead primary from one merely
+        # partitioned off the orchestrator's own link (control.py
+        # standby_handlers reports it as ``repl_rx_age_ms``).
+        self._last_rx_mono: float | None = None
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -281,6 +293,7 @@ class ReplicationServer:
                             break
                         frame = buf[_LEN.size:_LEN.size + length]
                         buf = buf[_LEN.size + length:]
+                        outer._last_rx_mono = time.monotonic()
                         if length == 0:
                             # Heartbeat: liveness ack, nothing to apply.
                             out += bytes([ACK_OK])
@@ -305,6 +318,14 @@ class ReplicationServer:
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="replication-rx",
             daemon=True)
+
+    def rx_age_ms(self) -> float | None:
+        """Milliseconds since the primary's last frame or heartbeat
+        landed here (None before first contact)."""
+        last = self._last_rx_mono
+        if last is None:
+            return None
+        return (time.monotonic() - last) * 1000.0
 
     def start(self) -> "ReplicationServer":
         self._thread.start()

@@ -85,7 +85,10 @@ def health_payload(ctx: AppContext) -> dict:
     """UP / DEGRADED / SHEDDING / DOWN, most severe condition wins.
 
     - DOWN: the backend is unavailable (or the breaker is open with no
-      degraded fallback and fail-open off) — only DOWN returns 503.
+      degraded fallback and fail-open off), OR the orchestrator holds a
+      shard in terminal ``FAILED`` (fail-closed, every standby
+      candidate exhausted — an outage for that keyspace until an
+      operator unfences, not a degradation) — only DOWN returns 503.
     - DEGRADED: the breaker is open/half-open; decisions are served by
       the degraded host limiter (or fail-open).  ALSO: a sharded
       deployment with a failed or promoted-replacement shard — the
@@ -138,14 +141,28 @@ def health_payload(ctx: AppContext) -> dict:
             payload["shards_detail"] = {
                 str(q): v for q, v in status_fn().items()}
     orch = getattr(ctx, "orchestrator", None)
+    failed_terminal: list = []
     if orch is not None:
         st = orch.orchestrator.status()
+        # Terminal FAILED = the orchestrator exhausted every standby
+        # candidate and failed the shard closed: that keyspace is denying
+        # 100% of its traffic with NO recovery in flight — an outage, not
+        # a degradation (the operator exit is /actuator/orchestrator/
+        # unfence).
+        failed_terminal = sorted(
+            q for q, s in st["shards"].items() if s["state"] == "FAILED")
         payload["orchestrator"] = {
             "fence_epoch": st["fence_epoch"],
             "promotions": st["promotions"],
             "false_alarms": st["false_alarms"],
+            "failed_shards": failed_terminal,
             "states": {q: s["state"] for q, s in st["shards"].items()},
         }
+        if "shards_detail" in payload:
+            for q, s in st["shards"].items():
+                detail = payload["shards_detail"].get(str(q))
+                if detail is not None:
+                    detail["orchestrator_state"] = s["state"]
     shedding = False
     window_s = ctx.props.get_float(
         "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
@@ -177,7 +194,12 @@ def health_payload(ctx: AppContext) -> dict:
         if breaker.fallback is not None:
             payload["degraded"] = {
                 "touched_keys": len(breaker.fallback.touched())}
-    if breaker is not None and breaker.state != "closed":
+    if failed_terminal:
+        # A fail-closed shard with no standby left outranks every other
+        # condition: part of the keyspace is hard-down until an operator
+        # unfences, so the instance must read DOWN (503) for it.
+        payload["status"] = "DOWN"
+    elif breaker is not None and breaker.state != "closed":
         degraded_serving = (breaker.fallback is not None
                             or ctx.fail_open)
         payload["status"] = "DEGRADED" if degraded_serving else "DOWN"

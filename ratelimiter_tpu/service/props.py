@@ -183,6 +183,26 @@ DEFAULTS = {
     "ratelimiter.orchestrator.promote_retries": "3",
     "ratelimiter.orchestrator.promote_backoff_ms": "50",
     "ratelimiter.orchestrator.reseed": "true",
+    # Distributed fence lease (ARCHITECTURE §10c): > 0 makes the
+    # orchestrator grant the serving storage an epoch lease of this TTL,
+    # renewed while probes answer — a primary partitioned from its
+    # orchestrator self-fences within one TTL (bounded over-admission
+    # with no quorum machinery).  0 keeps the PR 9 process-local fence.
+    # Keep the TTL at or above the detection budget
+    # ((suspect_threshold+1)*probe_interval + hysteresis) or a healthy
+    # flap can expire the lease mid-hysteresis.  fence_wait_slack_ms
+    # pads the wait for an UNREACHABLE zombie's lease to expire before
+    # its replacement is installed.
+    "ratelimiter.orchestrator.fence_lease_ttl_ms": "0",
+    "ratelimiter.orchestrator.fence_wait_slack_ms": "100",
+    # Control-plane RPC port (replication/control.py; 0 = off).  Exposes
+    # PROBE / FENCE / LEASE / RESTORE over length-prefixed JSON so a
+    # REMOTE orchestrator (or an operator's script) can drive this
+    # process's fence/lease authority — the cross-host topology's
+    # per-node surface.  Binds ratelimiter.control.host (default
+    # loopback; set to a mesh-reachable address in a real deployment).
+    "ratelimiter.control.port": "0",
+    "ratelimiter.control.host": "127.0.0.1",
 }
 
 # Typed keys: anything listed here is parse-checked at construction.
@@ -203,6 +223,7 @@ _INT_KEYS = (
     "ratelimiter.obs.lineage_capacity",
     "ratelimiter.orchestrator.suspect_threshold",
     "ratelimiter.orchestrator.promote_retries",
+    "ratelimiter.control.port",
     "ratelimiter.cache.hybrid.max_keys",
     "ratelimiter.cache.hybrid.unconfirmed_cap",
     "ratelimiter.lease.default_budget",
@@ -223,6 +244,8 @@ _FLOAT_KEYS = (
     "ratelimiter.orchestrator.probe_interval_ms",
     "ratelimiter.orchestrator.hysteresis_ms",
     "ratelimiter.orchestrator.promote_backoff_ms",
+    "ratelimiter.orchestrator.fence_lease_ttl_ms",
+    "ratelimiter.orchestrator.fence_wait_slack_ms",
     "ratelimiter.microbatch.flush_floor_ms",
     "ratelimiter.cache.hybrid.ttl_ms",
     "ratelimiter.cache.hybrid.guard_ms",

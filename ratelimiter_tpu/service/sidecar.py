@@ -800,26 +800,57 @@ class SidecarClient:
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  protocol: int = PROTOCOL_VERSION,
                  telemetry_send_timeout: float = 0.25):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._rbuf = b""
-        self.server_version = 1
-        self.server_max_frame = 0
+        self._host = host
+        self._port = int(port)
+        self._timeout = float(timeout)
+        self._protocol = int(protocol)
         # Drop-don't-block: one TELEMETRY send may stall at most this
         # long; a failed send marks telemetry down for this connection
         # (a partial write would desync the stream, so never retry).
         self._telemetry_send_timeout = float(telemetry_send_timeout)
         self._telemetry_down = False
-        if protocol >= 2:
+        self._connect_and_hello()
+
+    def _connect_and_hello(self) -> None:
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=self._timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rbuf = b""
+        self.server_version = 1
+        self.server_max_frame = 0
+        if self._protocol >= 2:
             # The HELLO response carries the negotiated version in the
             # `allowed` byte — read it raw (no bool coercion).  Sends the
             # CALLER'S protocol (a v2-pinned client must negotiate v2,
             # not whatever this module's ceiling is).
-            self._send(self._frame(OP_HELLO, int(protocol), 0, ""))
+            self._send(self._frame(OP_HELLO, self._protocol, 0, ""))
             status, version, max_frame = self._read_raw()
             if status == ST_OK and version:
                 self.server_version = int(version)
                 self.server_max_frame = int(max_frame)
+
+    def reconnect(self) -> bool:
+        """Tear the connection down and re-establish it (fresh socket +
+        re-HELLO).  On success the telemetry latch is RE-ARMED: the
+        latch exists because a PARTIAL telemetry write desyncs a shared
+        stream, but a brand-new negotiated connection has no desynced
+        history — one failed write no longer disables burn reporting for
+        the life of the client.  Returns False (latch stays down) when
+        the reconnect itself fails.
+
+        Only call between pipelined bursts: any unread in-flight
+        responses on the old connection are discarded."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            self._connect_and_hello()
+        except (OSError, ConnectionError):
+            self._telemetry_down = True
+            return False
+        self._telemetry_down = False
+        return True
 
     def _send(self, payload: bytes) -> None:
         try:

@@ -125,8 +125,13 @@ class AppContext:
     # Token-lease manager (ratelimiter.lease.enabled) — serves the
     # sidecar's v3 LEASE/RENEW/RELEASE ops and in-process LeaseClients.
     leases: object = None
+    # Control-plane RPC listener (ratelimiter.control.port) — this
+    # node's remote fence/lease/probe/promote authority surface.
+    control: object = None
 
     def close(self) -> None:
+        if self.control is not None:
+            self.control.stop()
         if self.sidecar is not None:
             self.sidecar.stop()
         if self.replication is not None:
@@ -456,6 +461,47 @@ def _maybe_replication(storage: RateLimitStorage, props: AppProperties,
     raise ValueError(f"unknown replication.role: {role!r}")
 
 
+def _maybe_control(storage: RateLimitStorage, props: AppProperties,
+                   replication: ReplicationHandle | None):
+    """Config-gated control-plane RPC port (OFF by default).
+
+    Exposes THIS process's fence/lease/probe authority over the small
+    length-prefixed-JSON wire (replication/control.py) so a remote
+    orchestrator — or an operator with a socket — can PROBE it, FENCE
+    it, grant/renew its serving lease, and RESTORE (unfence) it.  A
+    standby-role process additionally serves the remote-promotion RPC
+    and the lease-relay mailbox (its ``repl_rx_age_ms`` is the witness
+    signal).  Always binds the RAW device storage: fencing authority is
+    node-local and must not route through failover wrappers."""
+    port = props.get_int("ratelimiter.control.port", 0)
+    if port <= 0:
+        return None
+    if not hasattr(storage, "fence"):
+        import logging
+
+        logging.getLogger("ratelimiter").warning(
+            "ratelimiter.control.port set but the %s backend has no "
+            "fence/lease surface; control port disabled",
+            type(storage).__name__)
+        return None
+    from ratelimiter_tpu.replication.control import (
+        ControlServer,
+        primary_handlers,
+        standby_handlers,
+    )
+
+    host = props.get("ratelimiter.control.host") or "127.0.0.1"
+    if replication is not None and replication.receiver is not None:
+        handlers = standby_handlers(storage, replication.receiver,
+                                    repl_server=replication.server)
+    else:
+        handlers = primary_handlers(
+            storage,
+            replicator=(replication.replicator
+                        if replication is not None else None))
+    return ControlServer(handlers, host=host, port=port).start()
+
+
 def _maybe_orchestrator(storage: RateLimitStorage, props: AppProperties,
                         registry: MeterRegistry):
     """Config-gated self-healing failover (OFF by default).
@@ -484,6 +530,7 @@ def _maybe_orchestrator(storage: RateLimitStorage, props: AppProperties,
             "N); orchestrator disabled", type(storage).__name__)
         return None, storage
     from ratelimiter_tpu.replication import (
+        BackendLeaseChannel,
         FailoverOrchestrator,
         OrchestratorConfig,
         ShardedReplicationLog,
@@ -505,6 +552,17 @@ def _maybe_orchestrator(storage: RateLimitStorage, props: AppProperties,
         registry=registry,
     ).start()
     router = ShardFailoverRouter(storage)
+    # Distributed fence lease (ARCHITECTURE §10c): with a TTL set, every
+    # shard's channel grants the one in-process primary — the lease then
+    # guards "the orchestrator loop is alive and talking to us" (a hung
+    # or killed orchestrator self-fences the storage within one TTL
+    # instead of leaving fencing authority silently dead).  Cross-host
+    # deployments build remote channels (replication/remote.py) instead.
+    lease_ttl = props.get_float(
+        "ratelimiter.orchestrator.fence_lease_ttl_ms", 0.0)
+    lease_channels = ({q: BackendLeaseChannel(storage)
+                       for q in range(int(engine.n_shards))}
+                      if lease_ttl > 0 else None)
     orch = FailoverOrchestrator(
         router, mesh_set, repl, standby_factory=standby_factory,
         config=OrchestratorConfig(
@@ -519,7 +577,11 @@ def _maybe_orchestrator(storage: RateLimitStorage, props: AppProperties,
             promote_backoff_ms=props.get_float(
                 "ratelimiter.orchestrator.promote_backoff_ms", 50.0),
             reseed=props.get_bool("ratelimiter.orchestrator.reseed", True),
+            fence_lease_ttl_ms=lease_ttl,
+            fence_wait_slack_ms=props.get_float(
+                "ratelimiter.orchestrator.fence_wait_slack_ms", 100.0),
         ),
+        lease_channels=lease_channels,
         registry=registry,
     ).start()
     handle = OrchestratorHandle(orchestrator=orch, router=router,
@@ -553,6 +615,7 @@ def build_app(props: AppProperties | None = None,
     sidecar = None
     orchestrator = None
     leases = None
+    control = None
     if own_storage:
         # Self-healing failover (the orchestrator owns its OWN per-shard
         # replication into an in-process standby mesh, so it supersedes
@@ -573,6 +636,10 @@ def build_app(props: AppProperties | None = None,
             # around it.
             replication = _maybe_replication(storage, props, registry)
         sidecar = _maybe_sidecar(storage, props, registry)
+        # Control port over the RAW storage's fence/lease authority
+        # (plus the standby receiver's promote surface when this node
+        # runs replication.role=standby).
+        control = _maybe_control(storage, props, replication)
         if props.get_bool("warmup.enabled", True):
             warmup_shapes(storage,
                           max_batch=props.get_int("batcher.max_batch", 8192))
@@ -659,4 +726,5 @@ def build_app(props: AppProperties | None = None,
         recorder=recorder,
         orchestrator=orchestrator,
         leases=leases,
+        control=control,
     )

@@ -134,10 +134,13 @@ class FaultInjectingProxy:
       forwarding,
     - ``"kill"``      — abruptly close both sides after ``after``
       forwarded bytes (a client dying mid-pipeline),
-    - ``"partition"`` — drop bytes in BOTH directions without closing
-      either socket (no RST, no FIN): the network-partition shape — the
-      peer looks silently gone, exactly what an ack deadline/heartbeat
-      must detect (``partition()`` / ``heal()`` are shorthands),
+    - ``"partition"`` — drop bytes without closing either socket (no
+      RST, no FIN): the network-partition shape — the peer looks
+      silently gone, exactly what an ack deadline/heartbeat must detect
+      (``partition()`` / ``heal()`` are shorthands).  ``direction=``
+      scopes the cut: ``"both"`` (default), ``"up"`` (client->server),
+      or ``"down"`` (server->client only — the HALF-OPEN link where
+      sends land but acks vanish),
     - ``"flap"``      — alternate partitioned and healthy every half
       ``period_s`` (a flaky link that heals before any single probe
       window closes — what the orchestrator's hysteresis must damp).
@@ -213,19 +216,30 @@ class FaultInjectingProxy:
         ``after``: client bytes forwarded before the fault engages
         (default 0); ``n``: garbage byte count; ``delay_ms``: per-byte
         delay for ``"delay"``; ``period_s``: full flap cycle for
-        ``"flap"`` (half up, half partitioned)."""
+        ``"flap"`` (half up, half partitioned); ``direction``: which
+        pump(s) a ``"partition"`` cuts — ``"both"`` (default), ``"up"``
+        (client->server dropped, responses flow), or ``"down"``
+        (server->client dropped: the HALF-OPEN link — sends land, acks
+        vanish — that only an ack deadline can detect)."""
         if mode not in (None, "truncate", "delay", "garbage", "kill",
                         "partition", "flap"):
             raise ValueError(f"unknown fault mode: {mode!r}")
+        direction = params.get("direction", "both")
+        if direction not in ("both", "up", "down"):
+            raise ValueError(f"unknown partition direction: {direction!r}")
         with self._lock:
             self._fault = (mode, dict(params))
             if mode == "flap":
                 self._flap_t0 = time.monotonic()
 
-    def partition(self) -> None:
-        """Drop both directions on every connection, live — no RST, no
-        FIN: the silent network partition.  ``heal()`` restores."""
-        self.set_fault("partition")
+    def partition(self, direction: str = "both") -> None:
+        """Drop ``direction`` on every connection, live — no RST, no
+        FIN: the silent network partition.  ``direction="down"`` makes
+        the link HALF-OPEN (client bytes still arrive at the server,
+        its acks/responses are swallowed) — the asymmetric-partition
+        shape a one-byte-ack protocol can only catch via its ack
+        deadline.  ``heal()`` restores."""
+        self.set_fault("partition", direction=direction)
 
     def flap(self, period_s: float) -> None:
         """Alternate healthy/partitioned every ``period_s / 2``, live."""
@@ -235,14 +249,16 @@ class FaultInjectingProxy:
         """Back to transparent passthrough (ends a partition/flap)."""
         self.set_fault(None)
 
-    def _link_cut(self) -> bool:
-        """Live verdict: are bytes currently being dropped?  (Only the
-        partition/flap modes — the snapshotted ingress faults keep their
-        per-connection semantics.)"""
+    def _link_cut(self, direction: str = "both") -> bool:
+        """Live verdict: are bytes currently being dropped in
+        ``direction`` ("up" = client->server, "down" = server->client)?
+        (Only the partition/flap modes — the snapshotted ingress faults
+        keep their per-connection semantics.)"""
         with self._lock:
             mode, params = self._fault
             if mode == "partition":
-                return True
+                cut = params.get("direction", "both")
+                return cut == "both" or cut == direction
             if mode == "flap":
                 period = float(params.get("period_s", 0.2))
                 phase = (time.monotonic() - self._flap_t0) % period
@@ -272,7 +288,7 @@ class FaultInjectingProxy:
                 except OSError:
                     pass
                 return
-            if self._link_cut():
+            if self._link_cut("down"):
                 with self._lock:
                     self.faults_injected += 1
                 continue  # dropped: no RST, no FIN — silence
@@ -294,7 +310,7 @@ class FaultInjectingProxy:
                 return
             if not chunk:
                 return
-            if self._link_cut():
+            if self._link_cut("up"):
                 with self._lock:
                     self.faults_injected += 1
                 continue  # partition/flap: dropped — silence, no close
@@ -1846,6 +1862,427 @@ def orchestrator_flap_drill(
         echo.server_close()
         router.close()
         mesh_set.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-host failover drill: real OS processes, injected partitions
+# ---------------------------------------------------------------------------
+
+def cross_host_failover_drill(
+    num_slots: int = 512,
+    n_keys: int = 24,
+    waves: int = 3,
+    pipeline: int = 16,
+    seed: int = 0,
+    probe_interval_ms: float = 100.0,
+    suspect_threshold: int = 3,
+    hysteresis_ms: float = 300.0,
+    lease_ttl_ms: float = 1200.0,
+    witness_fresh_ms: float = 500.0,
+    lease_budget: int = 12,
+    boot_timeout_s: float = 180.0,
+    registry=None,
+) -> dict:
+    """Cross-host failover with shard primary, standby, and orchestrator
+    in SEPARATE OS PROCESSES (ARCHITECTURE §10c) — this process plays
+    the orchestrator; the primary and standby are real subprocesses
+    (``replication/hostproc.py``) joined by TCP through
+    :class:`FaultInjectingProxy` links, so a ``partition()`` is a real
+    silent byte-drop between processes, not a mock.
+
+    Proves the ISSUE 14 contract:
+
+    - **orchestrator-partitioned-from-healthy-shard -> nothing happens**:
+      with only the orchestrator->primary control link cut, the standby
+      witness (replication heartbeats still landing) VETOES fencing, the
+      serving lease keeps renewing via the standby relay path (deposit
+      -> mailbox -> primary's lease keeper), and after longer than a
+      full lease TTL the primary is still serving bit-identically: zero
+      promotions, zero fences, zero self-fences.
+    - **partitioned primary self-fences within one lease TTL**: with the
+      primary fully isolated (control + replication + relay links all
+      cut) its lease runs down and the first decision past the deadline
+      self-fences — measured from the partition instant by a
+      partition-side client (the zombie's own clients), within one TTL
+      plus slack.  Decisions it admitted before that are the documented
+      over-admission window: per key at most ``max_permits`` per window
+      (storage/degraded.py's bound), and a leased client's local burns
+      are bounded by its outstanding budget at the cut.
+    - **promotion waits out the zombie's lease, then lands**: the fence
+      RPC cannot be delivered, so the orchestrator holds FENCING until
+      every grant it issued has provably expired, then drives the
+      remote-promotion RPC; the promoted standby opens a sidecar and
+      serves the SAME keyspace bit-identical to ``semantics/oracle.py``.
+    - **token leases are revoked-or-honored**: a renewal of the zombie-
+      era lease against the promoted server is REVOKED (it carries a
+      strictly higher fence epoch) and the re-grant lands with that
+      higher epoch — never honored across the promotion boundary.
+
+    Bit-identity across processes uses TIME-INSENSITIVE policies (token
+    bucket with ``refill_rate=0``, sliding window with a multi-decade
+    window) so wall-clock skew between the subprocesses and this
+    process's oracle cannot change any decision.
+
+    Returns a report dict; raises AssertionError on any violated claim.
+    """
+    import json as json_mod
+    import os
+    import subprocess
+    import sys
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.replication.control import ControlClient
+    from ratelimiter_tpu.replication.orchestrator import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+    )
+    from ratelimiter_tpu.replication.remote import (
+        FanoutLeaseChannel,
+        RemoteBackend,
+        RemoteReceiver,
+        RemoteShardDirectory,
+        RemoteStandbySet,
+        standby_witness,
+    )
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.service import sidecar as sc
+
+    rng = random.Random(seed)
+    # Time-insensitive policies (docstring): decisions depend only on
+    # arrival ORDER, so the oracle needs no cross-process clock.  2^30
+    # ms (~12.4 days, the config ceiling) means the drill runs inside
+    # one never-rolling window with a fresh (zero) previous window —
+    # sliding-window position weighting contributes exactly 0 on both
+    # sides regardless of stamp skew.
+    GIANT_WINDOW = 1 << 30
+    # A refill rate whose FIXED-POINT form is exactly 0 fp-units/ms:
+    # positive for the oracle's validation, but both sides add exactly
+    # zero tokens per elapsed ms — the bucket is order-only.
+    cfg_tb = RateLimitConfig(max_permits=30, window_ms=GIANT_WINDOW,
+                             refill_rate=1e-9)
+    assert cfg_tb.refill_rate_fp == 0, "drill needs an order-only bucket"
+    cfg_sw = RateLimitConfig(max_permits=18, window_ms=GIANT_WINDOW,
+                             enable_local_cache=False)
+    limiters_spec = json_mod.dumps([
+        {"algo": "tb", "max_permits": cfg_tb.max_permits,
+         "window_ms": cfg_tb.window_ms, "refill_rate": cfg_tb.refill_rate},
+        {"algo": "sw", "max_permits": cfg_sw.max_permits,
+         "window_ms": cfg_sw.window_ms},
+    ])
+    NOW = 1_753_000_000_000  # fixed oracle stamp (its window never rolls)
+
+    procs: list = []
+    proxies: list = []
+    clients: list = []
+    orch = None
+
+    def spawn(args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.replication.hostproc",
+             *args],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env)
+        procs.append(proc)
+        box: dict = {}
+
+        def rd():
+            box["line"] = proc.stdout.readline()
+
+        t = threading.Thread(target=rd, daemon=True)
+        t.start()
+        t.join(boot_timeout_s)
+        line = box.get("line")
+        if not line:
+            proc.terminate()
+            raise RuntimeError(
+                f"hostproc {args} did not become ready within "
+                f"{boot_timeout_s}s")
+        return proc, json_mod.loads(line)
+
+    def proxy_for(port):
+        p = FaultInjectingProxy(port, seed=seed).start()
+        proxies.append(p)
+        return p
+
+    def poll(pred, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    report = {"decisions": 0, "mismatches": 0, "zombie_allows": {}}
+    try:
+        # -- topology -----------------------------------------------------
+        _, standby_info = spawn(["--role", "standby",
+                                 "--num-slots", str(num_slots), "--lease"])
+        p_repl = proxy_for(standby_info["repl_port"])      # primary->standby data
+        p_relay = proxy_for(standby_info["control_port"])  # primary->standby relay
+        _, primary_info = spawn([
+            "--role", "primary", "--num-slots", str(num_slots), "--lease",
+            "--limiters", limiters_spec,
+            "--repl-target", f"127.0.0.1:{p_repl.port}",
+            "--standby-control", f"127.0.0.1:{p_relay.port}",
+            "--repl-interval-ms", "100",
+        ])
+        lid_tb, lid_sw = primary_info["lids"]
+        p_ctl = proxy_for(primary_info["control_port"])    # orch->primary control
+
+        def ctl(port, timeout=0.5):
+            c = ControlClient("127.0.0.1", port, timeout=timeout)
+            clients.append(c)
+            return c
+
+        # The orchestrator's view: primary through ITS (cuttable) link,
+        # standby direct (that link is never the one partitioned here).
+        primary_backend = RemoteBackend(ctl(p_ctl.port))
+        directory = RemoteShardDirectory({0: primary_backend})
+        rx = RemoteReceiver(ctl(standby_info["control_port"], timeout=2.0),
+                            promote_timeout_s=60.0)
+        standby_set = RemoteStandbySet([rx])
+        witness = standby_witness({0: ctl(standby_info["control_port"])},
+                                  fresh_ms=witness_fresh_ms)
+        lease_channels = {0: FanoutLeaseChannel(
+            primary_backend, ctl(standby_info["control_port"]))}
+        # Drill-side DIRECT taps (assertions only, never partitioned).
+        prim_direct = ctl(primary_info["control_port"], timeout=2.0)
+
+        def probe(q):
+            backend = directory.serving(q)
+            return backend is not None and backend.is_available()
+
+        orch = FailoverOrchestrator(
+            directory, standby_set, None, standby_factory=None,
+            config=OrchestratorConfig(
+                probe_interval_ms=probe_interval_ms,
+                suspect_threshold=suspect_threshold,
+                hysteresis_ms=hysteresis_ms,
+                promote_retries=2, promote_backoff_ms=100.0,
+                reseed=False,
+                fence_lease_ttl_ms=lease_ttl_ms,
+                fence_wait_slack_ms=150.0),
+            probe=probe, witness=witness, lease_channels=lease_channels,
+            registry=registry).start()
+
+        # -- healthy phase ------------------------------------------------
+        oracle_tb = TokenBucketOracle(cfg_tb)
+        oracle_sw = SlidingWindowOracle(cfg_sw)
+        client = sc.SidecarClient("127.0.0.1", primary_info["sidecar_port"])
+        assert client.server_version >= 3, "primary handshake failed"
+
+        def wave(via, n=None):
+            """One pipelined oracle-checked wave on the main keyspace."""
+            keys = [f"k{rng.randrange(n_keys)}"
+                    for _ in range(n or pipeline)]
+            perms = [rng.choice([1, 1, 2, 3]) for _ in keys]
+            for lid, oracle in ((lid_tb, oracle_tb), (lid_sw, oracle_sw)):
+                got = via.acquire_batch(lid, keys, perms)
+                for j, (status, allowed, rem) in enumerate(got):
+                    assert status == sc.ST_OK, (lid, j, status, rem)
+                    d = oracle.try_acquire(keys[j], perms[j], NOW)
+                    report["decisions"] += 1
+                    if allowed != d.allowed or (
+                            lid == lid_tb and int(rem) != d.remaining_hint):
+                        report["mismatches"] += 1
+
+        for _ in range(max(waves, 1)):
+            wave(client)
+        poll(lambda: prim_direct.call_ok("probe")["lease"]["installed"],
+             10.0, "the orchestrator's first serving-lease grant")
+        assert not prim_direct.call_ok("probe")["lease"]["expired"]
+        # Let replication settle (the standby's first frame apply pays
+        # the write_rows compile) before any partition goes in — the
+        # witness freshness signal must be steady from here on.
+        poll(lambda: rx.consistent and rx.last_epoch >= 1, 60.0,
+             "standby consistency after the healthy phase")
+
+        # -- scenario A: orchestrator partitioned from a HEALTHY shard ----
+        fences_before = orch.fence_epoch
+        p_ctl.partition()
+        t_cut_a = time.monotonic()
+        # Hold the partition past a full lease TTL (only the standby-
+        # relayed renewals can then be keeping the primary leased) AND
+        # past at least one full veto cycle — each failing probe blocks
+        # for the control timeout, so a SUSPECT->veto round is several
+        # times the nominal probe cadence.
+        need_s = lease_ttl_ms / 1000.0 * 1.5
+        while (time.monotonic() - t_cut_a < need_s
+               or (orch.witness_vetoes < 1
+                   and time.monotonic() - t_cut_a < 20.0)):
+            time.sleep(0.1)
+            wave(client, n=4)  # the healthy primary keeps serving, exact
+        hold_s = time.monotonic() - t_cut_a
+        st = orch.status()
+        assert st["promotions"] == 0, (
+            "orchestrator promoted against a healthy-but-unreachable "
+            f"shard: {st}")
+        assert orch.fence_epoch == fences_before, (
+            "orchestrator fenced a healthy-but-unreachable shard")
+        assert st["witness_vetoes"] >= 1, (
+            f"no witness veto recorded during the control partition: {st}")
+        lease_a = prim_direct.call_ok("probe")["lease"]
+        assert lease_a["installed"] and not lease_a["expired"], (
+            f"relay renewals did not keep the healthy primary leased: "
+            f"{lease_a}")
+        assert not lease_a["self_fenced"]
+        report["scenario_a"] = {
+            "held_s": round(hold_s, 2),
+            "witness_vetoes": st["witness_vetoes"],
+            "lease": lease_a,
+        }
+        p_ctl.heal()
+        poll(lambda: orch.status()["shards"][0]["state"] == "MONITORING"
+             and directory.shard_health()[0] == "active", 10.0,
+             "recovery after the control partition healed")
+        wave(client)
+
+        # -- scenario B: the primary is PARTITIONED (fully isolated) ------
+        # Token lease: grant + local burns, THEN the pre-cut sync, so the
+        # reserve charge is in the replica when the partition hits; the
+        # cut follows immediately, well inside the lease's server TTL.
+        from ratelimiter_tpu.leases.client import LeaseClient
+
+        lease_transport = sc.SidecarClient("127.0.0.1",
+                                           primary_info["sidecar_port"])
+        burner = LeaseClient(lease_transport, lid_tb, budget=lease_budget,
+                             direct_fallback=False, telemetry=False)
+        for _ in range(3):
+            assert burner.try_acquire("lz") is True
+        old_epoch = burner._leases["lz"].epoch
+        assert old_epoch >= 1, "grant carried no fence-generation epoch"
+        prim_direct.call_ok("ship")  # pin the replica byte-exact
+        poll(lambda: rx.consistent and rx.last_epoch >= 1, 10.0,
+             "standby consistency before the kill")
+        outstanding = burner._leases["lz"].remaining
+        p_ctl.partition()
+        p_repl.partition()
+        p_relay.partition()
+        t_cut = time.monotonic()
+
+        # The zombie's own clients (this drill, on direct connections)
+        # keep hitting it: fresh z-keys so the zombie's post-cut state
+        # never touches the replicated keyspace the oracle tracks.
+        zombie_allows: dict = {}
+        burns_after_cut = 0
+        while burner._leases.get("lz") is not None \
+                and burner._leases["lz"].remaining > 0:
+            assert burner.try_acquire("lz") is True
+            burns_after_cut += 1
+        assert burns_after_cut <= outstanding, (
+            "a leased client burned past its outstanding budget")
+        t_fence = None
+        zi = 0
+        while time.monotonic() - t_cut < lease_ttl_ms / 1000.0 + 2.0:
+            zkey = f"z{zi % 8}"
+            zi += 1
+            try:
+                if client.try_acquire(lid_tb, zkey):
+                    zombie_allows[zkey] = zombie_allows.get(zkey, 0) + 1
+            except (RuntimeError, ConnectionError, sc.SidecarShedError,
+                    sc.SidecarSendError):
+                t_fence = time.monotonic()
+                break
+            time.sleep(0.02)
+        assert all(proc.poll() is None for proc in procs), (
+            "a node process died during the partition — the refusal "
+            "below would be a crash, not a self-fence")
+        assert t_fence is not None, (
+            "the isolated primary never self-fenced (lease expiry did "
+            "not bite)")
+        fence_after_s = t_fence - t_cut
+        assert fence_after_s <= lease_ttl_ms / 1000.0 + 0.75, (
+            f"self-fence took {fence_after_s:.2f}s; lease TTL is "
+            f"{lease_ttl_ms / 1000.0:.2f}s")
+        assert all(n <= cfg_tb.max_permits
+                   for n in zombie_allows.values()), (
+            f"zombie over-admitted past the per-key bound: "
+            f"{zombie_allows}")
+        report["zombie_allows"] = zombie_allows
+        lease_b = prim_direct.call_ok("probe")["lease"]
+        assert lease_b["self_fenced"], f"zombie not self-fenced: {lease_b}"
+
+        # The orchestrator: SUSPECT -> (witness dead, no veto) ->
+        # FENCING (fence RPC undeliverable -> wait out the lease) ->
+        # PROMOTING -> remote promotion.
+        poll(lambda: orch.promotions >= 1
+             and directory.shard_health()[0] == "promoted",
+             60.0, "the remote promotion")
+        t_promoted = time.monotonic()
+        assert t_promoted >= t_fence, (
+            "replacement installed before the zombie's lease expired")
+        assert orch.fence_epoch == fences_before + 1
+        serve_port = standby_set.receivers[0].serve_port
+        assert serve_port, "promoted standby opened no serving port"
+
+        # Post-promotion: same keyspace, same oracle, bit-identical.
+        promoted_client = sc.SidecarClient("127.0.0.1", serve_port)
+        for _ in range(max(waves, 1)):
+            wave(promoted_client)
+
+        # Token leases across the boundary: the zombie-era lease is
+        # REVOKED by the promoted server (strictly higher epoch), and
+        # the re-grant carries that higher epoch.
+        lease_wire = sc.SidecarClient("127.0.0.1", serve_port)
+        revoked = lease_wire.lease_renew(lid_tb, "lz", used=0,
+                                         requested=lease_budget)
+        assert revoked is None, (
+            "promoted server honored a zombie-era lease renewal")
+        fresh = lease_wire.lease_grant(lid_tb, "lz",
+                                       requested=lease_budget)
+        assert fresh is not None and fresh.epoch > old_epoch, (
+            f"re-grant epoch {fresh and fresh.epoch} not past the "
+            f"zombie-era epoch {old_epoch}")
+        promoted_lease = RemoteBackend(
+            ctl(standby_info["control_port"])).serving_lease_info()
+        assert promoted_lease["installed"] \
+            and not promoted_lease["expired"], promoted_lease
+
+        report["scenario_b"] = {
+            "self_fence_after_s": round(fence_after_s, 3),
+            "promotion_after_s": round(t_promoted - t_cut, 3),
+            "lease_ttl_s": lease_ttl_ms / 1000.0,
+            "burns_after_cut": burns_after_cut,
+            "outstanding_at_cut": outstanding,
+            "old_epoch": old_epoch,
+            "new_epoch": fresh.epoch,
+        }
+        report["status"] = orch.status()
+        if report["mismatches"]:
+            raise AssertionError(
+                f"cross-host drill diverged from the oracle: {report}")
+        return report
+    finally:
+        if orch is not None:
+            orch.close()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for p in proxies:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in procs:
+            try:
+                proc.stdin.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
 
 
 # ---------------------------------------------------------------------------

@@ -683,6 +683,18 @@ class TpuBatchedStorage(RateLimitStorage):
         self._fence_all = False
         self._fenced_shards: frozenset = frozenset()
         self.fence_rejected = 0
+        # Distributed fence lease (cross-host failover, ARCHITECTURE
+        # §10c): the orchestrator grants this storage the right to serve
+        # at a fence epoch for a bounded TTL and renews it while probes
+        # answer.  A storage whose lease EXPIRES — partitioned from its
+        # orchestrator and from the standby-relayed renewal path — SELF-
+        # FENCES: it stops deciding within one TTL of the last renewal,
+        # which is what bounds a partitioned zombie's over-admission
+        # without any quorum machinery.  _lease_deadline_ms == 0 means no
+        # lease is installed; the hot-path cost is then one falsy check.
+        self._lease_epoch = 0
+        self._lease_deadline_ms = 0
+        self.lease_self_fenced = False
         # The engine decides the index shape: flat LRU for single device,
         # per-shard LRU (key pinned to shard by hash) for a sharded engine.
         # The native index checkpoints at fingerprint level by default;
@@ -3441,6 +3453,9 @@ class TpuBatchedStorage(RateLimitStorage):
         self._fence_epoch = epoch
         if shards is None:
             self._fence_all = True
+            # An explicit fence supersedes the serving lease: the lease
+            # expiry check is moot once every decision is refused.
+            self._lease_deadline_ms = 0
         else:
             self._fenced_shards = self._fenced_shards | frozenset(
                 int(q) for q in shards)
@@ -3463,6 +3478,10 @@ class TpuBatchedStorage(RateLimitStorage):
         if shards is None:
             self._fence_all = False
             self._fenced_shards = frozenset()
+            # Operator re-arm: a lift also clears a lease self-fence (the
+            # operator verified no replacement owns this keyspace); the
+            # next orchestrator grant re-installs the lease.
+            self.lease_self_fenced = False
         else:
             self._fenced_shards = self._fenced_shards - frozenset(
                 int(q) for q in shards)
@@ -3470,9 +3489,73 @@ class TpuBatchedStorage(RateLimitStorage):
             self._recorder.record("fence.lifted", epoch=int(epoch))
 
     def fence_info(self) -> Dict:
-        return {"epoch": self._fence_epoch, "all": self._fence_all,
+        # The epoch reported here stamps token leases (leases/manager.py)
+        # — it must cover the SERVING-lease epoch too, so a client lease
+        # granted under generation E is revoked after a promotion hands
+        # the keyspace to a replacement carrying E+1.
+        return {"epoch": max(self._fence_epoch, self._lease_epoch),
+                "all": self._fence_all,
                 "shards": sorted(self._fenced_shards),
                 "rejected": self.fence_rejected}
+
+    # ------------------------------------------------------------------------
+    # Serving lease: the distributed fence (replication/control.py)
+    # ------------------------------------------------------------------------
+    def grant_serving_lease(self, epoch: int, ttl_ms: float) -> Dict:
+        """Install or renew the serving lease: this storage may decide
+        until ``ttl_ms`` from NOW (its own clock — the grant is relative,
+        so orchestrator/primary wall clocks need not be synchronized).
+
+        ``epoch`` is the fence generation the grant belongs to; a grant
+        must never regress it (a stale orchestrator instance replaying
+        an old generation cannot extend a zombie), and a grant can never
+        resurrect a fenced storage — once ``fence()`` ran or the lease
+        expired, only the operator ``lift_fence`` path re-arms serving.
+        """
+        epoch = int(epoch)
+        if self._fence_all:
+            raise ValueError(
+                "storage is fenced; a serving lease cannot resurrect it "
+                "(operator lift_fence first)")
+        if epoch < self._lease_epoch:
+            raise ValueError(
+                f"serving-lease epoch {epoch} is behind the installed "
+                f"epoch {self._lease_epoch}; grants are monotonic")
+        self._lease_epoch = epoch
+        self._lease_deadline_ms = int(self._clock_ms()) + int(ttl_ms)
+        return self.serving_lease_info()
+
+    def serving_lease_info(self) -> Dict:
+        now = int(self._clock_ms())
+        installed = bool(self._lease_deadline_ms)
+        return {
+            "epoch": self._lease_epoch,
+            "installed": installed,
+            "ttl_remaining_ms": (max(self._lease_deadline_ms - now, 0)
+                                 if installed else 0),
+            "expired": bool(installed and now >= self._lease_deadline_ms),
+            "self_fenced": self.lease_self_fenced,
+        }
+
+    def _lease_expired_fence(self) -> None:
+        """The serving lease ran out: self-fence.  The orchestrator that
+        granted it is either dead or partitioned from us AND from the
+        standby relay — either way a replacement may be serving, and the
+        decisions we would admit past this point are exactly the
+        unbounded half of the split-brain.  Everything admitted BEFORE
+        this point is the documented over-admission window: at most one
+        lease TTL of traffic, per key at most ``max_permits`` per window
+        (the storage/degraded.py bound)."""
+        self._fence_all = True
+        self._fence_epoch = max(self._fence_epoch, self._lease_epoch)
+        self._lease_deadline_ms = 0
+        self.lease_self_fenced = True
+        if self._recorder is not None:
+            self._recorder.record("fence.lease_expired",
+                                  epoch=self._lease_epoch)
+        self._fence_reject("serving lease expired; orchestrator "
+                           "unreachable — a replacement may own this "
+                           "keyspace")
 
     def _fence_reject(self, detail: str):
         self.fence_rejected += 1
@@ -3666,9 +3749,16 @@ class TpuBatchedStorage(RateLimitStorage):
         """Refuse decisions while a standby promotion is swapping the
         key->slot indexes, and refuse them FOREVER once this storage is
         whole-fenced (two attribute checks on the hot path; see
-        :meth:`promote_from_replica` and :meth:`fence`)."""
+        :meth:`promote_from_replica` and :meth:`fence`).  With a serving
+        lease installed (cross-host topology) this is also where expiry
+        bites: the first decision past the lease deadline self-fences —
+        every dispatch surface funnels through here, so a partitioned
+        zombie's in-flight dispatches lose the race within one check."""
         if self._fence_all:
             self._fence_reject("whole-storage fence")
+        if self._lease_deadline_ms \
+                and int(self._clock_ms()) >= self._lease_deadline_ms:
+            self._lease_expired_fence()
         if self._promoting:
             from ratelimiter_tpu.storage.errors import (
                 PromotionInProgressError,

@@ -1,0 +1,539 @@
+"""Cross-host failover (ISSUE 14): control-plane RPC, the distributed
+fence lease, the standby witness, asymmetric partitions, and the
+multi-process drill.
+
+Layers under test, bottom-up:
+
+- the control wire (replication/control.py): framed-JSON dispatch,
+  in-protocol refusals, the lease-relay mailbox's skew-free age
+  accounting;
+- the serving lease on TpuBatchedStorage: monotonic epoch grants, the
+  self-fence on expiry (every dispatch surface funnels through it), no
+  resurrection of a fenced storage, operator lift re-arms;
+- the orchestrator's cross-host behaviors on a simulated clock with
+  fake backends: the standby witness VETOES fencing while the primary's
+  replication heartbeats still land, and FENCING waits out an
+  unreachable zombie's lease before PROMOTING;
+- asymmetric partitions: FaultInjectingProxy.partition(direction=) cuts
+  one pump only; a half-open link (sends land, acks vanish) reads DEAD
+  on SocketSink.link_state() while the receiving side proves the bytes
+  arrived, and the orchestrator's default probe counts the resulting
+  ship-error growth as a probe failure;
+- satellites: SidecarClient.reconnect re-arms the telemetry latch and
+  LeaseClient counts it (telemetry_rearmed); terminal-FAILED shards
+  turn /actuator/health DOWN with the failed ids listed;
+- the full thing: cross_host_failover_drill with shard, standby, and
+  orchestrator in separate OS processes.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from ratelimiter_tpu import RateLimitConfig
+from ratelimiter_tpu.replication import (
+    ControlClient,
+    ControlServer,
+    FailoverOrchestrator,
+    LeaseMailbox,
+    OrchestratorConfig,
+    ReplicationServer,
+    SocketSink,
+    StandbyReceiver,
+)
+from ratelimiter_tpu.replication.remote import RemoteShardDirectory
+from ratelimiter_tpu.storage import TpuBatchedStorage
+from ratelimiter_tpu.storage.chaos import FaultInjectingProxy
+from ratelimiter_tpu.storage.errors import FencedError
+
+T0 = 1_753_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# Control wire
+# ---------------------------------------------------------------------------
+
+def test_control_wire_roundtrip_and_refusals():
+    calls = []
+
+    def echo(**kw):
+        calls.append(kw)
+        return {"echo": kw}
+
+    def boom():
+        raise RuntimeError("handler exploded")
+
+    server = ControlServer({"echo": echo, "boom": boom}).start()
+    client = ControlClient("127.0.0.1", server.port, timeout=2.0)
+    try:
+        resp = client.call("echo", a=1, b="x")
+        assert resp["ok"] and resp["echo"] == {"a": 1, "b": "x"}
+        # Unknown op and a raising handler both answer IN-PROTOCOL —
+        # the port never wedges or drops the connection for them.
+        assert client.call("nope")["ok"] is False
+        boomed = client.call("boom")
+        assert boomed["ok"] is False and "handler exploded" in boomed["error"]
+        assert client.call("echo", c=2)["ok"]  # same conn still serves
+        with pytest.raises(RuntimeError, match="refused"):
+            client.call_ok("boom")
+        assert server.requests_served >= 4
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_lease_mailbox_age_is_relative():
+    box = LeaseMailbox()
+    assert box.fetch() == {"deposited": False}
+    box.deposit(epoch=3, ttl_ms=500.0)
+    time.sleep(0.03)
+    got = box.fetch()
+    assert got["deposited"] and got["epoch"] == 3
+    # Age is measured on the MAILBOX's clock between deposit and fetch:
+    # the relay needs no synchronized wall clocks anywhere.
+    assert 25.0 <= got["age_ms"] < 5000.0
+    box.deposit(epoch=4, ttl_ms=500.0)
+    assert box.fetch()["epoch"] == 4  # newest deposit wins
+
+
+# ---------------------------------------------------------------------------
+# Serving lease (storage layer)
+# ---------------------------------------------------------------------------
+
+def test_serving_lease_grants_are_monotonic_and_expiry_self_fences():
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    lid = storage.register_limiter("tb", RateLimitConfig(
+        max_permits=10, window_ms=1000, refill_rate=5.0))
+    assert storage.serving_lease_info()["installed"] is False
+    storage.grant_serving_lease(2, 500.0)
+    # fence_info's epoch covers the lease epoch: token leases granted
+    # now are stamped with the serving generation.
+    assert storage.fence_info()["epoch"] == 2
+    assert bool(storage.acquire("tb", lid, "a", 1)["allowed"]) is True
+    with pytest.raises(ValueError, match="monotonic"):
+        storage.grant_serving_lease(1, 500.0)
+    # A renewal at the SAME epoch extends the deadline.
+    clock["t"] += 400
+    storage.grant_serving_lease(2, 500.0)
+    clock["t"] += 400  # past the first deadline, inside the renewed one
+    assert storage.acquire("tb", lid, "a", 1)["allowed"] in (True, False)
+    # Expiry: the first decision past the deadline self-fences, and
+    # every surface after it refuses.
+    clock["t"] += 600
+    with pytest.raises(FencedError):
+        storage.acquire("tb", lid, "a", 1)
+    info = storage.serving_lease_info()
+    assert info["self_fenced"] is True
+    with pytest.raises(FencedError):
+        storage.acquire_many("tb", [lid], ["a"], [1])
+    # No resurrection: a late grant cannot un-fence.
+    with pytest.raises(ValueError, match="resurrect"):
+        storage.grant_serving_lease(9, 500.0)
+    # The operator exit: lift_fence re-arms, then a fresh generation
+    # serves again.
+    storage.lift_fence(9)
+    storage.grant_serving_lease(9, 500.0)
+    assert storage.serving_lease_info()["self_fenced"] is False
+    assert len(storage.acquire_many("tb", [lid], ["a"], [1])["allowed"]) == 1
+    storage.close()
+
+
+def test_explicit_fence_supersedes_serving_lease():
+    clock = {"t": T0}
+    storage = TpuBatchedStorage(num_slots=128, clock_ms=lambda: clock["t"])
+    storage.grant_serving_lease(1, 500.0)
+    storage.fence(5)
+    # The fence voided the lease (no double accounting) and a grant
+    # cannot resurrect the fenced storage.
+    assert storage.serving_lease_info()["installed"] is False
+    with pytest.raises(ValueError, match="resurrect"):
+        storage.grant_serving_lease(6, 500.0)
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: witness veto + fence-wait (fakes, simulated clock)
+# ---------------------------------------------------------------------------
+
+class _FakeBackend:
+    def __init__(self, fence_reachable=True):
+        self.fence_reachable = fence_reachable
+        self.fences = []
+        self.grants = []
+
+    def fence(self, epoch, shards=None):
+        if not self.fence_reachable:
+            raise ConnectionError("partitioned: fence undeliverable")
+        self.fences.append((int(epoch), shards))
+        return int(epoch)
+
+    def grant_serving_lease(self, epoch, ttl_ms):
+        self.grants.append((int(epoch), float(ttl_ms)))
+
+
+class _FakeRouter:
+    def __init__(self, backend):
+        self.n_shards = 1
+        self.primary = backend
+        self.replacements = {}
+        self.failed = set()
+
+    def shard_primary(self, q):
+        return self.primary
+
+    def shard_health(self):
+        return {0: "failed" if 0 in self.failed
+                else "promoted" if 0 in self.replacements else "active"}
+
+    def fail_shard(self, q):
+        self.failed.add(int(q))
+
+    def install_replacement(self, q, backend):
+        self.replacements[int(q)] = backend
+        self.failed.discard(int(q))
+
+    def _backend(self, q):
+        if q in self.failed:
+            return None
+        return self.replacements.get(int(q), self.primary)
+
+
+class _FakeReceiver:
+    def __init__(self):
+        self.consistent = True
+        self.promoted = False
+        self.last_epoch = 7
+        self.backend = _FakeBackend()
+
+    def promote(self, force=False):
+        self.promoted = True
+        return self.backend
+
+
+def _fake_orch(backend, witness=None, **cfg_kw):
+    rx = _FakeReceiver()
+    router = _FakeRouter(backend)
+    standby_set = types.SimpleNamespace(receivers=[rx],
+                                        replace=lambda *a: None)
+    sim = {"s": 0.0}
+    cfg = OrchestratorConfig(probe_interval_ms=50.0, suspect_threshold=2,
+                             hysteresis_ms=100.0, promote_backoff_ms=1.0,
+                             reseed=False, **cfg_kw)
+    probe_ok = {"v": True}
+    # An installed replacement answers probes (else the machine would
+    # immediately re-suspect what it just promoted).
+    orch = FailoverOrchestrator(
+        router, standby_set, None, config=cfg,
+        probe=lambda q: probe_ok["v"] or bool(router.replacements),
+        witness=witness,
+        lease_channels={0: types.SimpleNamespace(
+            grant=backend.grant_serving_lease)},
+        clock=lambda: sim["s"], sleep=lambda s: None)
+
+    def tick(n=1):
+        for _ in range(n):
+            sim["s"] += cfg.probe_interval_ms / 1000.0
+            orch.tick()
+
+    return orch, router, rx, probe_ok, tick, sim
+
+
+def test_witness_veto_holds_fencing_while_primary_heartbeats_land():
+    backend = _FakeBackend()
+    verdict = {"v": "alive"}
+    orch, router, rx, probe_ok, tick, _ = _fake_orch(
+        backend, witness=lambda q: verdict["v"], fence_lease_ttl_ms=400.0)
+    tick(2)
+    assert backend.grants, "healthy ticks granted no serving lease"
+    probe_ok["v"] = False
+    # Probe says dead; the standby still hears the primary -> every
+    # hysteresis expiry is VETOED, nothing fences, nothing promotes.
+    tick(12)
+    st = orch.status()
+    assert st["witness_vetoes"] >= 1
+    assert orch.fence_epoch == 0 and orch.promotions == 0
+    assert not backend.fences and not router.failed
+    assert st["shards"][0]["state"] in ("MONITORING", "SUSPECT")
+    # The witness flips to dead (heartbeats stopped landing): the same
+    # probe verdict now fences and promotes.
+    verdict["v"] = "dead"
+    tick(12)
+    assert orch.fence_epoch == 1 and orch.promotions == 1
+    assert rx.promoted and router.replacements[0] is rx.backend
+    # The replacement was handed a lease at a STRICTLY higher epoch
+    # than anything the zombie ever held.
+    assert rx.backend.grants and rx.backend.grants[0][0] == 2
+    assert all(ep < 2 for ep, _ in backend.grants)
+
+
+def test_fencing_waits_out_an_unreachable_zombies_lease():
+    backend = _FakeBackend(fence_reachable=False)
+    orch, router, rx, probe_ok, tick, sim = _fake_orch(
+        backend, witness=lambda q: "dead",
+        fence_lease_ttl_ms=1000.0, fence_wait_slack_ms=100.0)
+    tick(2)  # healthy: leases granted
+    granted_at = orch._watch[0].lease_granted_at
+    probe_ok["v"] = False
+    tick(6)  # SUSPECT -> hysteresis -> FENCING (fence RPC fails)
+    st = orch.status()["shards"][0]["state"]
+    assert st == "FENCING", st
+    assert orch.fence_epoch == 1  # epoch bumped even though undeliverable
+    assert router.failed == {0}   # routed traffic fails closed meanwhile
+    assert orch.promotions == 0, (
+        "promoted before the zombie's lease could have expired")
+    # FENCING holds until granted_at + ttl + slack ON THE ORCHESTRATOR'S
+    # CLOCK, then promotion proceeds.
+    wait_until = granted_at + 1.1
+    while sim["s"] < wait_until - 0.05:
+        tick(1)
+        assert orch.promotions == 0, f"promoted early at {sim['s']}"
+    tick(3)
+    assert orch.promotions == 1 and rx.promoted
+
+
+def test_witness_without_verdict_never_vetoes():
+    backend = _FakeBackend()
+    orch, router, rx, probe_ok, tick, _ = _fake_orch(
+        backend, witness=lambda q: "unknown")
+    probe_ok["v"] = False
+    tick(12)
+    # "unknown" proves nothing: the probe verdict drives the machine
+    # exactly as without a witness.
+    assert orch.promotions == 1 and orch.status()["witness_vetoes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Asymmetric partitions (half-open links)
+# ---------------------------------------------------------------------------
+
+def test_half_open_link_reads_dead_while_bytes_still_land():
+    storage = TpuBatchedStorage(num_slots=128)
+    receiver = StandbyReceiver(storage)
+    server = ReplicationServer(receiver, host="127.0.0.1").start()
+    proxy = FaultInjectingProxy(server.port).start()
+    sink = SocketSink("127.0.0.1", proxy.port, timeout=2.0,
+                      max_retries=0, ack_timeout=0.3, dead_after=2)
+    try:
+        assert sink.heartbeat() is True
+        assert sink.link_state() == "up"
+        rx_before = server.rx_age_ms()
+        assert rx_before is not None
+        # Cut ONLY the server->client direction: sends still LAND at
+        # the standby, acks vanish — the half-open link shape.
+        proxy.partition(direction="down")
+        assert sink.heartbeat() is False
+        assert sink.heartbeat() is False
+        assert sink.link_state() == "dead", (
+            "ack loss on a half-open link must read DEAD")
+        # Proof the bytes arrived: the standby's rx stamp kept fresh
+        # through the 'dead' verdict (the witness-side distinction
+        # between 'standby cannot answer' and 'primary stopped talking').
+        assert server.rx_age_ms() < 2000.0
+        proxy.heal()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not sink.heartbeat():
+            time.sleep(0.05)
+        assert sink.link_state() == "up"
+    finally:
+        sink.close()
+        proxy.stop()
+        server.stop()
+        storage.close()
+
+
+def test_partition_direction_validation():
+    proxy = FaultInjectingProxy(1)  # never started; control surface only
+    with pytest.raises(ValueError, match="direction"):
+        proxy.partition(direction="sideways")
+
+
+def test_default_probe_counts_ship_error_growth_as_failure():
+    backend = _FakeBackend()
+    router = _FakeRouter(backend)
+    replicator = types.SimpleNamespace(
+        shard_errors=[0], shard_link_state=lambda q: "up")
+    orch = FailoverOrchestrator(
+        router, types.SimpleNamespace(receivers=[_FakeReceiver()],
+                                      replace=lambda *a: None),
+        replicator, clock=lambda: 0.0, sleep=lambda s: None)
+    assert orch._default_probe(0) is True
+    # A half-open replication link fails ships; the error-streak growth
+    # IS the probe signal for the primary (non-blocking by design).
+    replicator.shard_errors[0] += 1
+    assert orch._default_probe(0) is False
+    assert orch._default_probe(0) is True  # no growth since last look
+
+
+# ---------------------------------------------------------------------------
+# Remote directory bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_remote_directory_tracks_serving_backend():
+    class _B:
+        def is_available(self):
+            return True
+
+        def close(self):
+            pass
+
+    primary, replacement = _B(), _B()
+    d = RemoteShardDirectory({0: primary})
+    assert d.serving(0) is primary
+    assert d.shard_health() == {0: "active"}
+    d.fail_shard(0)
+    assert d.serving(0) is None  # fail-closed window
+    assert d.shard_health() == {0: "failed"}
+    assert d.shard_status()[0]["state"] == "failed"
+    d.install_replacement(0, replacement)
+    assert d.serving(0) is replacement
+    assert d.degraded_shards() == [0]
+    d.repair_shard(0)
+    assert d.serving(0) is primary
+    assert d.shard_health() == {0: "active"}
+
+
+# ---------------------------------------------------------------------------
+# Satellite: telemetry re-arm after reconnect
+# ---------------------------------------------------------------------------
+
+def test_telemetry_latch_rearms_after_reconnect():
+    from ratelimiter_tpu.leases.client import LeaseClient
+    from ratelimiter_tpu.service.sidecar import SidecarClient, SidecarServer
+
+    storage = TpuBatchedStorage(num_slots=128, max_delay_ms=0.2)
+    server = SidecarServer(storage, host="127.0.0.1",
+                           drain_timeout_ms=200.0).start()
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    client = SidecarClient("127.0.0.1", server.port)
+    burner = LeaseClient(client, lid, telemetry=True,
+                         telemetry_flush_ms=0.0, telemetry_rearm_ms=0.0)
+    try:
+        assert client.telemetry_supported()
+        # Kill the socket under the client: the next telemetry write
+        # fails and LATCHES the connection's telemetry down.
+        client._sock.close()
+        burner._telem.record_burn(lid, "k", 1, 1.0)
+        burner._flush_telemetry(T0)
+        assert burner.telemetry_dropped == 1
+        assert client._telemetry_down is True
+        assert not client.telemetry_supported()
+        # The next flush re-arms: reconnect + re-HELLO succeeds against
+        # the live server, the latch clears, the report ships.
+        burner._telem.record_burn(lid, "k", 1, 1.0)
+        burner._flush_telemetry(T0 + 1)
+        assert burner.telemetry_rearmed == 1
+        assert client._telemetry_down is False
+        assert client.server_version >= 4
+        assert burner.telemetry_flushes == 1
+        # The decision path works on the fresh connection too.
+        assert client.try_acquire(lid, "k2") is True
+    finally:
+        client.close()
+        server.stop()
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: terminal FAILED shards are DOWN
+# ---------------------------------------------------------------------------
+
+def _fake_ctx(shard_states):
+    from ratelimiter_tpu.service.props import AppProperties
+
+    status = {
+        "fence_epoch": 1, "promotions": 0, "false_alarms": 0,
+        "shards": {q: {"state": s} for q, s in shard_states.items()},
+    }
+    storage = types.SimpleNamespace(is_available=lambda: True)
+    return types.SimpleNamespace(
+        storage=storage, registry=None, props=AppProperties(),
+        breaker=None, sidecar=None, recorder=None, fail_open=True,
+        orchestrator=types.SimpleNamespace(
+            orchestrator=types.SimpleNamespace(status=lambda: status)))
+
+
+def test_health_terminal_failed_shard_is_down():
+    from ratelimiter_tpu.service.app import health_payload
+
+    payload = health_payload(_fake_ctx({0: "FAILED", 1: "MONITORING"}))
+    assert payload["status"] == "DOWN"
+    assert payload["orchestrator"]["failed_shards"] == [0]
+    # A shard mid-promotion (recovery in flight) is NOT an outage.
+    payload = health_payload(_fake_ctx({0: "PROMOTING", 1: "MONITORING"}))
+    assert payload["status"] != "DOWN"
+    assert payload["orchestrator"]["failed_shards"] == []
+
+
+# ---------------------------------------------------------------------------
+# Wiring: the per-node control port
+# ---------------------------------------------------------------------------
+
+def test_wiring_control_port_serves_fence_authority():
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import build_app
+
+    ctx = build_app(AppProperties({
+        "storage.num_slots": "256",
+        "parallel.shard": "off",
+        "warmup.enabled": "false",
+        "ratelimiter.control.port": "0",
+    }))
+    try:
+        assert ctx.control is None  # port 0 = off (the default)
+    finally:
+        ctx.close()
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:  # grab a free port for the config
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ctx = build_app(AppProperties({
+        "storage.num_slots": "256",
+        "parallel.shard": "off",
+        "warmup.enabled": "false",
+        "ratelimiter.control.port": str(port),
+    }))
+    try:
+        assert ctx.control is not None and ctx.control.port == port
+        client = ControlClient("127.0.0.1", port, timeout=2.0)
+        probe = client.call_ok("probe")
+        assert probe["role"] == "primary" and probe["available"]
+        client.call_ok("lease", epoch=1, ttl_ms=60_000.0)
+        assert client.call_ok("probe")["fence"]["epoch"] == 1
+        client.close()
+    finally:
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# The multi-process drill
+# ---------------------------------------------------------------------------
+
+def test_cross_host_failover_drill_fast():
+    from ratelimiter_tpu.storage.chaos import cross_host_failover_drill
+
+    report = cross_host_failover_drill()
+    assert report["mismatches"] == 0
+    assert report["scenario_a"]["witness_vetoes"] >= 1
+    b = report["scenario_b"]
+    assert b["self_fence_after_s"] <= b["lease_ttl_s"] + 0.75
+    assert b["promotion_after_s"] >= b["self_fence_after_s"]
+    assert b["new_epoch"] > b["old_epoch"]
+    assert report["status"]["promotions"] == 1
+
+
+@pytest.mark.slow
+def test_cross_host_soak_slow():
+    """Three full kill/partition cycles, fresh processes each — proves
+    the drill's topology builds and tears down cleanly under repetition
+    (each cycle is one partition-A + partition-B sequence)."""
+    from ratelimiter_tpu.storage.chaos import cross_host_failover_drill
+
+    for cycle in range(3):
+        report = cross_host_failover_drill(seed=cycle)
+        assert report["mismatches"] == 0, (cycle, report)
+        assert report["status"]["promotions"] == 1, (cycle, report)

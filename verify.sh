@@ -130,6 +130,20 @@ else
   fail=1
 fi
 
+echo "running cross-host failover drill (real subprocesses, partitions, fence lease)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_cross_host.py::test_cross_host_failover_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  cross-host failover drill"
+else
+  echo "  FAILED  cross-host failover drill (a partitioned primary out-"
+  echo "          lived its serving lease, the witness failed to veto a"
+  echo "          false fencing, the remote promotion broke bit-identity,"
+  echo "          or a zombie-era token lease was honored across the"
+  echo "          promotion boundary)"
+  fail=1
+fi
+
 echo "running fast lease failover drill (leases honored-or-revoked, bounded over-admission)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_leases.py::test_lease_failover_drill_fast \
@@ -155,13 +169,14 @@ else
   fail=1
 fi
 
-echo "running orchestrator idle overhead gate (probe loop <= 2% steady-state)..."
+echo "running orchestrator idle overhead gate (RPC probe path <= 2% steady-state)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
-    bench/orchestrator_overhead.py --n 1048576 --rounds 3 \
+    bench/orchestrator_overhead.py --n 1048576 --rounds 3 --probe-rpc \
     --assert-budget 0.02 > /dev/null; then
-  echo "  ok  orchestrator idle overhead budget"
+  echo "  ok  orchestrator idle overhead budget (control-RPC probes)"
 else
-  echo "  FAILED  orchestrator idle overhead budget (the probe loop costs"
+  echo "  FAILED  orchestrator idle overhead budget (the probe loop —"
+  echo "          one control-RPC round trip per node per tick — costs"
   echo "          more than 2% steady-state CPU at its cadence)"
   fail=1
 fi
@@ -220,6 +235,7 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
       tests/test_replication.py::test_failover_soak_slow \
       tests/test_shard_replication.py::test_shard_failover_soak_slow \
       tests/test_orchestrator.py::test_orchestrator_soak_slow \
+      tests/test_cross_host.py::test_cross_host_soak_slow \
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
       tests/test_sidecar_chaos.py::test_ingress_soak_slow \
