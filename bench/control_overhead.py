@@ -1,0 +1,152 @@
+"""Steady-state cost of the adaptive policy control plane.
+
+The ISSUE 15 contract (the PR 9/13 gate idiom): an ENABLED controller
+over a converged fleet — every tenant healthy, no actuations — costs
+<= 2% of steady-state CPU at its configured cadence, including the
+generation checks the lease path pays per grant/renewal.
+
+Measurement (bench/orchestrator_overhead.py pattern): the GATED number
+is the **direct steady-state fraction** — mean wall seconds of a
+controller ``tick()`` over a realistically-populated telemetry plane
+(``--tenants`` tenants tracked, fed by a real device stream pass)
+times the tick rate, plus the per-grant generation check
+(``LimiterTable.row_generation`` + ``policy_info``) at a pessimistic
+grant rate.  This is deterministic where an end-to-end paired diff is
+noise-bound on a small shared host, and errs conservative: the ticks
+run on their own thread in production, so a fully-overlapped tick
+still counts.  The paired end-to-end ratio is also reported (unGATED).
+
+    JAX_PLATFORMS=cpu python bench/control_overhead.py \
+        --assert-budget 0.02
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def timed_pass(storage, lid, key_ids) -> float:
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        storage.acquire_stream_ids("tb", lid, key_ids)
+        return time.perf_counter() - t0
+    finally:
+        gc.enable()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n", type=int, default=1 << 19,
+                        help="requests per stream pass")
+    parser.add_argument("--keys", type=int, default=1 << 13)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--tenants", type=int, default=64)
+    parser.add_argument("--interval-ms", type=float, default=1000.0)
+    parser.add_argument("--ticks", type=int, default=200,
+                        help="tick() calls to average")
+    parser.add_argument("--grants-per-s", type=float, default=1000.0,
+                        help="pessimistic lease grant/renewal rate for "
+                             "the generation-check term")
+    parser.add_argument("--assert-budget", type=float, default=None,
+                        metavar="FRAC")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from ratelimiter_tpu.control import (
+        AdaptivePolicyController,
+        ControlConfig,
+    )
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    registry = MeterRegistry()
+    st = TpuBatchedStorage(num_slots=1 << 16, meter_registry=registry,
+                           table_capacity=args.tenants + 8)
+    lids = [st.register_limiter(
+        "tb", RateLimitConfig(max_permits=1000, window_ms=60_000,
+                              refill_rate=100.0))
+        for _ in range(args.tenants)]
+    rng = np.random.default_rng(7)
+    key_ids = rng.zipf(1.1, size=args.n).astype(np.int64) % args.keys
+
+    # Populate the telemetry plane: every tenant tracked (the tick's
+    # all_signals sweep is O(tenants)), via real dispatch accounting.
+    for lid in lids:
+        st.acquire_many_ids("tb", lid,
+                            np.arange(64, dtype=np.int64),
+                            np.ones(64, dtype=np.int64))
+
+    controller = AdaptivePolicyController(
+        st, ControlConfig(interval_ms=args.interval_ms),
+        registry=registry)
+    controller.tick()  # warm (adopts every lid)
+
+    # -- gated: direct steady-state fraction -------------------------------
+    t0 = time.perf_counter()
+    for _ in range(args.ticks):
+        controller.tick()
+    tick_s = (time.perf_counter() - t0) / args.ticks
+
+    table = st.table
+    reps = 20000
+    t0 = time.perf_counter()
+    for i in range(reps):
+        table.row_generation(lids[i % len(lids)])
+    gen_check_s = (time.perf_counter() - t0) / reps
+
+    ticks_per_s = 1000.0 / max(args.interval_ms, 1.0)
+    fraction = tick_s * ticks_per_s + gen_check_s * args.grants_per_s
+
+    # -- unGATED: paired end-to-end ratio ----------------------------------
+    timed_pass(st, lids[0], key_ids)  # warm compile
+    base, ctl = [], []
+    for r in range(args.rounds):
+        order = (("base", "ctl") if r % 2 == 0 else ("ctl", "base"))
+        for mode in order:
+            if mode == "ctl":
+                controller.start()
+                ctl.append(timed_pass(st, lids[0], key_ids))
+                controller.stop()
+            else:
+                base.append(timed_pass(st, lids[0], key_ids))
+
+    report = {
+        "tick_us": round(tick_s * 1e6, 1),
+        "gen_check_us": round(gen_check_s * 1e6, 3),
+        "ticks_per_s": ticks_per_s,
+        "grants_per_s": args.grants_per_s,
+        "steady_state_fraction": round(fraction, 6),
+        "tenants": args.tenants,
+        "adjustments": controller.adjustments_total,
+        "paired_base_s": [round(x, 4) for x in base],
+        "paired_ctl_s": [round(x, 4) for x in ctl],
+        "paired_ratio": round(
+            (sum(ctl) / len(ctl)) / (sum(base) / len(base)), 4),
+    }
+    print(json.dumps(report, indent=2))
+    controller.close()
+    st.close()
+
+    if args.assert_budget is not None \
+            and fraction > args.assert_budget:
+        print(f"ASSERTION FAILED: controller steady-state fraction "
+              f"{fraction:.4f} > budget {args.assert_budget}",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,21 @@ class HybridServingCache:
         with self.lock:
             self._configs[int(lid)] = (algo, config)
 
+    def update_policy(self, lid: int, algo: str,
+                      config: RateLimitConfig) -> None:
+        """Live policy update (storage.set_policy calls this BEFORE the
+        device row moves): every entry tracking the lid is dropped — a
+        host serve racing the update must not answer under the old rate
+        — and the lid's oracle is rebuilt so re-adoption replays the
+        NEW policy's arithmetic."""
+        with self.lock:
+            self._configs[int(lid)] = (algo, config)
+            self._oracles.pop((algo, int(lid)), None)
+            stale = [ek for ek in self._entries
+                     if ek[0] == algo and ek[1] == int(lid)]
+            for ek in stale:
+                self._drop(ek)
+
     def _oracle(self, algo: str, lid: int):
         k = (algo, int(lid))
         oracle = self._oracles.get(k)

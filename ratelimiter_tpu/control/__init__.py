@@ -1,0 +1,17 @@
+"""Adaptive policy control plane (ROADMAP item 3, ARCHITECTURE §15).
+
+Closes the loop from observation (the fleet telemetry plane's
+``UsageSignals``) to actuation (``LimiterTable.set_policy`` row-wise
+device updates): per-tenant AIMD limits, a hierarchical global
+aggregate cap, operator pinning, and lease-backed concurrency slots.
+"""
+
+from ratelimiter_tpu.control.controller import (
+    AdaptivePolicyController,
+    ControlConfig,
+)
+
+__all__ = [
+    "AdaptivePolicyController",
+    "ControlConfig",
+]

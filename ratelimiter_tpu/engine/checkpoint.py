@@ -259,16 +259,103 @@ def restore_engine_state(engine, ckpt: Dict) -> None:
 
 
 def _limiter_table_dump(storage) -> Dict:
-    """Registered limiter policies, keyed by lid (import-side validation)."""
+    """Registered limiter policies, keyed by lid (import-side validation).
+
+    Each row carries its policy generation (``gen``; 0 = as registered)
+    so a standby replaying the dump can tell a LIVE policy update —
+    which it must apply via ``set_policy`` at the primary's stamp — from
+    registration drift, which stays a hard error (ARCHITECTURE §15)."""
+    table = getattr(storage, "table", None)
     return {
         str(lid): {
             "algo": algo,
             "max_permits": cfg.max_permits,
             "window_ms": cfg.window_ms,
             "refill_rate": cfg.refill_rate,
+            "gen": (table.row_generation(lid) if table is not None
+                    and hasattr(table, "row_generation") else 0),
         }
         for lid, (algo, cfg) in storage._configs.items()
     }
+
+
+def apply_limiter_policies(storage, limiters: Dict, *,
+                           register_missing: bool = False) -> None:
+    """Reconcile a limiter dump against a target storage.
+
+    - Missing lids are registered in lid order when ``register_missing``
+      (the standby-bootstrap path); otherwise they are a hard error.
+    - Shape drift (algo or window) always raises — replicated rows
+      would silently mis-decide under a different window.
+    - RATE drift with a strictly newer ``gen`` is a live policy update
+      (ARCHITECTURE §15): applied via ``set_policy`` at the dump's
+      exact generation stamp, so a promoted standby serves the
+      post-update generation.  Rate drift without a newer generation is
+      true registration drift and raises, as before.
+    """
+    from ratelimiter_tpu.core.config import RateLimitConfig
+
+    have = storage._configs
+    table = getattr(storage, "table", None)
+    for lid in sorted(limiters, key=int):
+        cfg = limiters[lid]
+        lid_i = int(lid)
+        src_gen = int(cfg.get("gen", 0))
+        if lid_i not in have:
+            if not register_missing:
+                raise ValueError(
+                    f"limiter id {lid_i} is not registered on the "
+                    "target; register identical limiters in the same "
+                    "order first")
+            got = storage.register_limiter(
+                cfg["algo"],
+                RateLimitConfig(max_permits=cfg["max_permits"],
+                                window_ms=cfg["window_ms"],
+                                refill_rate=cfg["refill_rate"]))
+            if got != lid_i:
+                raise ValueError(
+                    f"standby assigned lid {got} where the primary has "
+                    f"{lid_i}; register limiters in the same order on "
+                    "both sides (or let replication do all registration)")
+            if src_gen > 0 and table is not None \
+                    and hasattr(table, "set_policy"):
+                # Freshly registered from a dump that already carries a
+                # live update: stamp the primary's generation.
+                storage.set_policy(lid_i, RateLimitConfig(
+                    max_permits=cfg["max_permits"],
+                    window_ms=cfg["window_ms"],
+                    refill_rate=cfg["refill_rate"]), generation=src_gen)
+            continue
+        algo, existing = have[lid_i]
+        if algo != cfg["algo"] or existing.window_ms != cfg["window_ms"]:
+            raise ValueError(
+                f"limiter {lid_i} diverges from the dump in its "
+                "algo/window shape; replicated state cannot be served "
+                "under a different window")
+        rates_match = (existing.max_permits == cfg["max_permits"]
+                       and existing.refill_rate == cfg["refill_rate"])
+        local_gen = (table.row_generation(lid_i)
+                     if table is not None
+                     and hasattr(table, "row_generation") else 0)
+        if rates_match:
+            if src_gen > local_gen and table is not None \
+                    and hasattr(table, "bump_generation"):
+                table.bump_generation(src_gen)
+            continue
+        if src_gen > local_gen and hasattr(storage, "set_policy"):
+            storage.set_policy(lid_i, RateLimitConfig(
+                max_permits=cfg["max_permits"],
+                window_ms=cfg["window_ms"],
+                refill_rate=cfg["refill_rate"],
+                enable_local_cache=existing.enable_local_cache,
+                local_cache_ttl_ms=existing.local_cache_ttl_ms,
+            ), generation=src_gen)
+            continue
+        raise ValueError(
+            f"limiter {lid_i} mismatch: the target's rates diverge from "
+            "the dump's registration with no newer policy generation to "
+            "justify it; register identical limiters in the same order "
+            "(live set_policy updates carry their generation and apply)")
 
 
 def export_keys(storage) -> Dict:
@@ -353,15 +440,12 @@ def import_keys(storage, dump: Dict) -> None:
             f"unsupported export format: {dump.get('format')}")
     # Limiter ids inside index keys are SOURCE lids; they must mean the
     # same policy in the target or imported state attaches to the wrong
-    # limiter (or to none).
-    target = _limiter_table_dump(storage)
-    for lid, src_cfg in dump.get("limiters", {}).items():
-        dst_cfg = target.get(lid)
-        if dst_cfg != src_cfg:
-            raise ValueError(
-                f"limiter id {lid} mismatch: export has {src_cfg}, "
-                f"target has {dst_cfg}; register identical limiters in the "
-                "same order before importing")
+    # limiter (or to none).  Rate drift carrying a newer policy
+    # generation is a live update and is adopted (the exported keys'
+    # state was consumed under the dump's policies); anything else
+    # refuses before touching the target.
+    apply_limiter_policies(storage, dump.get("limiters", {}),
+                           register_missing=False)
     # Capacity pre-check: every key not already present needs a free slot.
     # For sharded targets the check is PER SHARD — capacity there is not
     # fungible (a key's shard is fixed by hash), so a global count could

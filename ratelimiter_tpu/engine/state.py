@@ -29,6 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.utils.logging import get_logger
+
+_log = get_logger("engine.state")
 
 
 class SWState(NamedTuple):
@@ -105,6 +108,16 @@ class LimiterTable:
         self._rate_fp = np.zeros(self._capacity, dtype=np.int64)
         self._ttl2_ms = np.ones(self._capacity, dtype=np.int64)
         self._device: TableArrays | None = None
+        # Policy generation (control/, ARCHITECTURE §15): a monotonic
+        # counter bumped by every live set_policy, plus the generation
+        # each row last changed at.  Decisions are evaluated against the
+        # table contents at dispatch time; the generation is the
+        # fence_info-style metadata that lets the oracle, the hybrid
+        # serving cache, degraded-mode seeds and replicated standbys all
+        # agree on WHICH policy admitted a decision.
+        self._generation = 0
+        self._row_gen = np.zeros(self._capacity, dtype=np.int64)
+        self.implicit_grows = 0
 
     def register(self, config: RateLimitConfig) -> int:
         """Add a policy row; returns its limiter id.
@@ -138,14 +151,97 @@ class LimiterTable:
                 )
             return lid
 
+    def set_policy(self, lid: int, config: RateLimitConfig,
+                   generation: Optional[int] = None) -> int:
+        """Live-update one registered policy row; returns the new policy
+        generation.
+
+        ``generation`` installs an externally-dictated stamp instead of
+        bumping the local counter — replication uses it so a standby
+        replaying the primary's policy updates reports the PRIMARY's
+        generation numbers, not its own replay count.
+
+        Only the RATES move (max_permits / cap_fp / rate_fp): the window
+        — and with it ttl2 and every window-derived shape the kernels
+        bake in (bucket rollover, lease TTL clamps, relay word layout
+        via max_permits_registered is rate-derived and still checked by
+        callers) — is immutable, so a policy update is three scalar
+        device updates exactly like :meth:`register`'s row writes, never
+        a table rebuild or a step recompile.  Concurrent dispatches see
+        either the old row or the new one atomically (the mirror swap
+        happens under the table lock the dispatch-side ``device_arrays``
+        read takes).
+        """
+        config.validate()
+        with self._lock:
+            i = int(lid)
+            if not (self.SENTINEL_ROWS <= i < self._n):
+                raise KeyError(f"no limiter registered under lid={lid}")
+            if config.window_ms != int(self._window_ms[i]):
+                raise ValueError(
+                    f"set_policy cannot change the window (lid={lid}: "
+                    f"{self._window_ms[i]} ms -> {config.window_ms} ms); "
+                    "the window is part of the state shape — register a "
+                    "new limiter instead")
+            self._max_permits[i] = config.max_permits
+            self._cap_fp[i] = config.max_permits_fp
+            self._rate_fp[i] = config.refill_rate_fp
+            if generation is None:
+                self._generation += 1
+                self._row_gen[i] = self._generation
+            else:
+                self._generation = max(self._generation, int(generation))
+                self._row_gen[i] = int(generation)
+            if self._device is not None:
+                d = self._device
+                self._device = TableArrays(
+                    max_permits=d.max_permits.at[i].set(config.max_permits),
+                    window_ms=d.window_ms,
+                    cap_fp=d.cap_fp.at[i].set(config.max_permits_fp),
+                    rate_fp=d.rate_fp.at[i].set(config.refill_rate_fp),
+                    ttl2_ms=d.ttl2_ms,
+                )
+            return self._generation
+
+    @property
+    def generation(self) -> int:
+        """Monotonic policy generation (0 until the first set_policy)."""
+        with self._lock:
+            return self._generation
+
+    def row_generation(self, lid: int) -> int:
+        """Generation the row last changed at (0 = as registered)."""
+        with self._lock:
+            return int(self._row_gen[int(lid)])
+
+    def bump_generation(self, generation: int) -> None:
+        """Adopt an externally-dictated generation floor (replication:
+        a standby applying a primary's limiter dump must never report
+        an older generation than the policies it now serves)."""
+        with self._lock:
+            if int(generation) > self._generation:
+                self._generation = int(generation)
+
     def _grow(self) -> None:
         new_cap = self._capacity * 2
-        for name in ("_max_permits", "_window_ms", "_cap_fp", "_rate_fp", "_ttl2_ms"):
+        for name in ("_max_permits", "_window_ms", "_cap_fp", "_rate_fp",
+                     "_ttl2_ms", "_row_gen"):
             old = getattr(self, name)
             fresh = np.ones(new_cap, dtype=np.int64) if name in ("_window_ms", "_ttl2_ms") \
                 else np.zeros(new_cap, dtype=np.int64)
             fresh[: self._capacity] = old
             setattr(self, name, fresh)
+        # An implicit grow is decision-safe (the mirror rebuilds under
+        # the lock and the new lid is unused until register returns) but
+        # NOT free: the table shape change silently recompiles every
+        # step signature and re-uploads the whole mirror mid-traffic.
+        # Pre-size via ratelimiter.table.capacity instead.
+        self.implicit_grows += 1
+        _log.warning(
+            "limiter table grew %d -> %d under traffic: the device step "
+            "recompiles for the new table shape; pre-size with "
+            "ratelimiter.table.capacity to avoid the stall",
+            self._capacity, new_cap)
         self._capacity = new_cap
 
     @property

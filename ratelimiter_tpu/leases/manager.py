@@ -84,7 +84,8 @@ class LeaseManager:
                  recorder=None,
                  record_ops: bool = False,
                  storm_threshold: int = 8,
-                 storm_window_ms: float = 2000.0):
+                 storm_window_ms: float = 2000.0,
+                 max_concurrent: int = 0):
         self.storage = storage
         self.default_budget = max(int(default_budget), 1)
         self.max_budget = max(int(max_budget), 1)
@@ -154,6 +155,19 @@ class LeaseManager:
         self.expired_total = 0
         self.local_decisions_total = 0
         self.over_admission_total = 0
+        # Concurrency slots (control/, ARCHITECTURE §15): per-lid caps
+        # on the tenant's aggregate outstanding lease budget — lease
+        # grants ARE the slots, so max_concurrent is enforced by the
+        # accounting this manager already keeps, no new device surface.
+        self._concurrency: dict = {}
+        # Fleet-wide default cap (ratelimiter.control.max_concurrent;
+        # 0/None = unbounded); per-lid set_concurrency_cap overrides.
+        self.default_concurrency = (int(max_concurrent)
+                                    if max_concurrent else None)
+        self.concurrency_refused_total = 0
+        # Policy-generation rebases: renewals whose budget predated a
+        # live policy update and was re-reserved under the new rate.
+        self.policy_rebased_total = 0
 
     # -- small helpers ---------------------------------------------------------
     def _algo_cfg(self, lid: int):
@@ -170,6 +184,46 @@ class LeaseManager:
             return int(fn()["epoch"])
         except Exception:  # noqa: BLE001 — epoch is best-effort metadata
             return 0
+
+    def _policy_gen(self, lid: int) -> int:
+        """The lid's current policy-row generation (0 when the storage
+        has no policy table — e.g. a bare memory backend)."""
+        table = getattr(self.storage, "table", None)
+        if table is None or not hasattr(table, "row_generation"):
+            return 0
+        try:
+            return int(table.row_generation(int(lid)))
+        except Exception:  # noqa: BLE001 — generation is metadata
+            return 0
+
+    # -- concurrency slots (control/) ------------------------------------------
+    def set_concurrency_cap(self, lid: int, max_concurrent) -> None:
+        """Bound one tenant's aggregate outstanding lease budget (lease
+        grants as concurrency slots).  ``None`` lifts the cap.  A cap
+        cut below the current outstanding budget does not revoke
+        anything immediately — each lease shrinks (or is refused) at
+        its next renewal, the same lazy convergence policy updates
+        use."""
+        with self._lock:
+            if max_concurrent is None:
+                self._concurrency.pop(int(lid), None)
+            else:
+                self._concurrency[int(lid)] = max(int(max_concurrent), 0)
+
+    def concurrency_caps(self) -> dict:
+        with self._lock:
+            return dict(self._concurrency)
+
+    def _slot_clamp(self, algo: str, lid: int, req: int,
+                    exclude_key=None) -> int:
+        """Clamp a grant/renewal request to the tenant's free slots;
+        <= 0 means refuse (the key stays on the per-decision path)."""
+        cap = self._concurrency.get(int(lid), self.default_concurrency)
+        if cap is None:
+            return req
+        free = cap - self.table.outstanding_budget_for(
+            algo, lid, exclude_key=exclude_key)
+        return min(req, free)
 
     def _bump(self, meter, attr: str, n: int = 1) -> None:
         if n <= 0:
@@ -256,6 +310,13 @@ class LeaseManager:
                                       existing.epoch)
             req = int(requested) or self.default_budget
             req = max(1, min(req, self.max_budget, cfg.max_permits))
+            req = self._slot_clamp(algo, lid, req)
+            if req <= 0:
+                # Concurrency slots exhausted: the tenant's outstanding
+                # lease budget is at max_concurrent — refuse, the key
+                # stays on the per-decision path until slots free up.
+                self.concurrency_refused_total += 1
+                return LeaseGrant(0, int(self.deny_ttl_ms), self._epoch())
             self._trace(trace_id, "batcher", op="flush+reserve")
             try:
                 out = self.storage.lease_reserve(algo, lid, key, req)
@@ -276,7 +337,8 @@ class LeaseManager:
             ttl = self._ttl_for(algo, cfg, out["stamp"])
             lease = Lease(algo=algo, lid=int(lid), key=key, budget=granted,
                           ws=int(out["ws"]), epoch=epoch,
-                          deadline_ms=now + ttl, granted_total=granted)
+                          deadline_ms=now + ttl, granted_total=granted,
+                          policy_gen=self._policy_gen(lid))
             if not self.table.put(lease):
                 # Table full: undo the charge and refuse — bounded state.
                 self._credit(lease, granted)
@@ -348,6 +410,26 @@ class LeaseManager:
                 return None
             req = int(requested) or lease.budget
             req = max(1, min(req, self.max_budget, cfg.max_permits))
+            cur_gen = self._policy_gen(lid)
+            if cur_gen > lease.policy_gen:
+                # A live policy update landed since the last charge: the
+                # re-reserve below runs against the NEW device rate and
+                # the clamp above already used the new config — count
+                # the rebase so drills can assert the budget turnover.
+                self.policy_rebased_total += 1
+            req = self._slot_clamp(algo, lid, req, exclude_key=key)
+            if req <= 0:
+                # The tenant's concurrency cap shrank below this lease:
+                # credit the unused budget back and revoke to the
+                # per-decision path (the lazy convergence contract).
+                self.concurrency_refused_total += 1
+                self.table.pop(algo, lid, key)
+                try:
+                    self._credit(lease, unused)
+                except (FencedError, StorageException):
+                    pass
+                self._gauge()
+                return LeaseGrant(0, int(self.deny_ttl_ms), cur_epoch)
             self._trace(trace_id, "batcher", op="credit+reserve")
             try:
                 self._credit(lease, unused)
@@ -377,6 +459,7 @@ class LeaseManager:
             ttl = self._ttl_for(algo, cfg, out["stamp"])
             lease.budget = granted
             lease.ws = int(out["ws"])
+            lease.policy_gen = cur_gen
             lease.epoch = self._epoch()
             lease.deadline_ms = now + ttl
             lease.granted_total += granted
@@ -441,4 +524,7 @@ class LeaseManager:
             "local_decisions": self.local_decisions_total,
             "over_admission": self.over_admission_total,
             "revocation_storms": self.revocation_storms,
+            "concurrency_refused": self.concurrency_refused_total,
+            "policy_rebased": self.policy_rebased_total,
+            "concurrency_caps": self.concurrency_caps(),
         }

@@ -34,6 +34,10 @@ class Lease:
     granted_total: int = 0   # permits charged over the lease's lifetime
     used_total: int = 0      # burns the client has reported back
     renewals: int = 0
+    # Policy generation (control/, ARCHITECTURE §15) the budget was
+    # charged under: a renewal at an older generation re-reserves under
+    # the NEW rate (credit + fresh clamp against the updated config).
+    policy_gen: int = 0
 
     def expired(self, now_ms: int) -> bool:
         return now_ms >= self.deadline_ms
@@ -87,6 +91,19 @@ class LeaseTable:
         itself bounded by that key's remaining-window budget)."""
         with self._lock:
             return sum(v.budget for v in self._leases.values())
+
+    def outstanding_budget_for(self, algo: str, lid: int,
+                               exclude_key: Optional[str] = None) -> int:
+        """One tenant's outstanding lease budget — the accounting behind
+        concurrency slots (control/, ARCHITECTURE §15): with lease
+        grants as slots, ``max_concurrent`` per tenant is enforced by
+        bounding this sum.  ``exclude_key`` leaves one lease out (a
+        renewal replaces its own budget, which must not count against
+        itself).  O(outstanding leases) under the lock — grants are the
+        cold path (decisions burn client-side)."""
+        with self._lock:
+            return sum(v.budget for (a, l, k), v in self._leases.items()
+                       if a == algo and l == int(lid) and k != exclude_key)
 
     def __iter__(self) -> Iterator[Lease]:
         with self._lock:

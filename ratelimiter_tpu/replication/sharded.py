@@ -650,6 +650,28 @@ class ShardFailoverRouter:
                 return None
             return self.replacements.get(q, self.primary)
 
+    # -- policy actuation (control/, ARCHITECTURE §15) -------------------------
+    def set_policy(self, lid, config, generation=None):
+        """Broadcast a live policy update to EVERY serving backend: the
+        primary assigns the generation, promoted replacements install
+        the SAME stamp — so decisions keep one generation order across
+        a failover boundary (the replication stream already carries
+        updates that happened BEFORE a promotion; this covers the ones
+        that happen after)."""
+        gen = self.primary.set_policy(lid, config, generation=generation)
+        with self._lock:
+            replacements = list(self.replacements.values())
+        for backend in replacements:
+            if backend is self.primary:
+                continue
+            try:
+                backend.set_policy(lid, config, generation=gen)
+            except KeyError:
+                # A replacement that never saw the lid registered cannot
+                # serve it either (registration replicates first) — skip.
+                pass
+        return gen
+
     def acquire_many(self, algo, lid_per_req, keys, permits):
         shard = self._shard_of_keys(lid_per_req, keys)
         lids = np.asarray(lid_per_req)

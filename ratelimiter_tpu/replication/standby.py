@@ -37,7 +37,6 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.replication.wire import decode_frame
 
 
@@ -147,32 +146,17 @@ class StandbyReceiver:
 
     def _register_limiters(self, limiters: Dict) -> None:
         """Replay the primary's limiter registrations (lid order) and
-        verify rows already registered still agree — a drifted policy
-        would silently mis-decide every replicated row of that tenant."""
-        have = self.storage._configs
-        for lid in sorted(limiters, key=int):
-            cfg = limiters[lid]
-            lid_i = int(lid)
-            if lid_i in have:
-                algo, existing = have[lid_i]
-                if (algo != cfg["algo"]
-                        or existing.max_permits != cfg["max_permits"]
-                        or existing.window_ms != cfg["window_ms"]
-                        or existing.refill_rate != cfg["refill_rate"]):
-                    raise ValueError(
-                        f"standby limiter {lid_i} diverges from the "
-                        "primary's registration")
-                continue
-            got = self.storage.register_limiter(
-                cfg["algo"],
-                RateLimitConfig(max_permits=cfg["max_permits"],
-                                window_ms=cfg["window_ms"],
-                                refill_rate=cfg["refill_rate"]))
-            if got != lid_i:
-                raise ValueError(
-                    f"standby assigned lid {got} where the primary has "
-                    f"{lid_i}; register limiters in the same order on "
-                    "both sides (or let replication do all registration)")
+        verify rows already registered still agree.  A row that differs
+        only in its RATES and carries a newer policy generation is a
+        live policy update (ARCHITECTURE §15) and is applied at the
+        primary's stamp — a promoted standby must serve the post-update
+        generation; shape drift (algo/window) or an unexplained rate
+        difference stays a hard error, since a drifted policy would
+        silently mis-decide every replicated row of that tenant."""
+        from ratelimiter_tpu.engine.checkpoint import apply_limiter_policies
+
+        apply_limiter_policies(self.storage, limiters,
+                               register_missing=True)
 
     # -- failover -------------------------------------------------------------
     def promote(self, force: bool = False):

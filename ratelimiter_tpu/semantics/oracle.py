@@ -90,6 +90,20 @@ class SlidingWindowOracle:
         self.config = config
         self._buckets: Dict[Tuple[str, int], Tuple[int, int]] = {}
 
+    def reconfigure(self, config: RateLimitConfig) -> None:
+        """Adopt a live policy update (control/, ARCHITECTURE §15): only
+        the rates move — the window is part of the state shape (bucket
+        keys, PEXPIRE deadlines) and is immutable, exactly the
+        ``LimiterTable.set_policy`` contract.  Stored bucket state is
+        untouched: the device keeps every counter across a policy
+        update, so the oracle must too — a generation-schedule replay
+        feeds the same updates at the same boundaries and stays
+        bit-identical."""
+        config.validate()
+        if config.window_ms != self.config.window_ms:
+            raise ValueError("reconfigure cannot change the window")
+        self.config = config
+
     # -- storage model --------------------------------------------------------
     def _get_bucket(self, key: str, window_start: int, now_ms: int) -> int:
         entry = self._buckets.get((key, window_start))
@@ -224,6 +238,20 @@ class TokenBucketOracle:
             )
         self.config = config
         self._buckets: Dict[str, Tuple[int, int, int]] = {}
+
+    def reconfigure(self, config: RateLimitConfig) -> None:
+        """Adopt a live policy update (see SlidingWindowOracle
+        .reconfigure): capacity and refill rate move, window (the TTL
+        shape) does not; stored fixed-point state is untouched — a
+        bucket holding more than the NEW capacity reads as exactly the
+        new capacity (the ``min(cap, ...)`` in :meth:`_refilled`),
+        which is the device kernel's own refill arithmetic."""
+        config.validate()
+        if config.window_ms != self.config.window_ms:
+            raise ValueError("reconfigure cannot change the window")
+        if config.refill_rate <= 0:
+            raise ValueError("Token bucket requires positive refillRate")
+        self.config = config
 
     def _load(self, key: str, now_ms: int) -> Tuple[int, int]:
         """Returns (tokens_fp, last_refill) applying lazy init on absent or

@@ -44,6 +44,7 @@ from ratelimiter_tpu.utils.logging import get_logger
 _log = get_logger("service.app")
 
 _RESET_RE = re.compile(r"^/(?:api/)?admin/reset/([^/]+)$")
+_PIN_RE = re.compile(r"^/actuator/policies/(\d+)/pin$")
 
 
 def _now_ms() -> int:
@@ -163,6 +164,19 @@ def health_payload(ctx: AppContext) -> dict:
                 detail = payload["shards_detail"].get(str(q))
                 if detail is not None:
                     detail["orchestrator_state"] = s["state"]
+    controller = getattr(ctx, "controller", None)
+    if controller is not None:
+        # Control-plane mirror (ARCHITECTURE §15): pinned lids and the
+        # policy generation belong in the health payload so an operator
+        # can see a frozen or actively-scaling control loop without a
+        # second request.
+        st = controller.status()
+        payload["control"] = {
+            "generation": st["generation"],
+            "global_scale": st["global_scale"],
+            "pinned": st["pinned"],
+            "adjustments": st["adjustments"],
+        }
     shedding = False
     window_s = ctx.props.get_float(
         "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
@@ -315,6 +329,8 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._prometheus()
         if self.path.startswith("/actuator/tenants"):
             return self._tenants()
+        if self.path == "/actuator/policies":
+            return self._policies()
         if self.path.startswith("/actuator/flightrecorder"):
             return self._flightrecorder()
         if self.path == "/actuator/replication":
@@ -362,6 +378,35 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             payload["leases"] = leases.status()
         return self._json(200, payload)
 
+    def _policies(self):
+        """Per-lid effective policy, generation and controller state
+        (ARCHITECTURE §15 — the control plane's operator face).  Serves
+        the storage's policy_info even with the controller off, so the
+        generation metadata is always inspectable."""
+        info_fn = _find_surface(self.ctx.storage, "policy_info")
+        payload: dict = {"enabled": False}
+        if info_fn is not None:
+            payload.update(info_fn())
+        controller = getattr(self.ctx, "controller", None)
+        if controller is not None:
+            payload["enabled"] = True
+            payload["controller"] = controller.status()
+        return self._json(200, payload)
+
+    def _pin_policy(self, lid: str):
+        """Operator override: freeze a lid out of the control loop
+        (body ``{"pinned": false}`` releases it)."""
+        controller = getattr(self.ctx, "controller", None)
+        if controller is None:
+            return self._json(409, {"error": "adaptive control not "
+                                             "enabled"})
+        pinned = bool(self._body().get("pinned", True))
+        try:
+            out = controller.pin(int(lid), pinned)
+        except (KeyError, ValueError) as exc:
+            return self._json(404, {"error": str(exc)})
+        return self._json(200, out)
+
     def _flightrecorder(self):
         """Flight-recorder snapshot; ``?kind=`` (exact or dotted
         prefix), ``?since_ms=`` (wall-clock ms), and ``?last=`` filter
@@ -400,6 +445,9 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._promote()
         if self.path == "/actuator/orchestrator/unfence":
             return self._unfence()
+        m = _PIN_RE.match(self.path)
+        if m:
+            return self._pin_policy(m.group(1))
         self._json(404, {"error": "not found"})
 
     def _unfence(self):

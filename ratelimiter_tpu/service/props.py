@@ -203,6 +203,36 @@ DEFAULTS = {
     # loopback; set to a mesh-reachable address in a real deployment).
     "ratelimiter.control.port": "0",
     "ratelimiter.control.host": "127.0.0.1",
+    # Adaptive policy control plane (control/, ARCHITECTURE §15): OFF by
+    # default.  When enabled, a tick-driven AIMD controller adjusts each
+    # tenant's effective rate between an operator floor
+    # (floor_fraction * the registered ceiling) and the ceiling —
+    # additive raises while the tenant's denied+shed share of its
+    # observed load stays under target_excess, multiplicative cuts
+    # (decrease_factor) on overload — actuated as live set_policy row
+    # updates stamped with a monotonic policy generation.
+    # global_cap_per_s adds the hierarchical aggregate cap (0 = off):
+    # when fleet observed load exceeds it, every tenant's effective
+    # rate is scaled by cap/admitted.  Operators pin lids out of the
+    # loop via POST /actuator/policies/<lid>/pin.
+    "ratelimiter.control.enabled": "false",
+    "ratelimiter.control.interval_ms": "1000",
+    "ratelimiter.control.window_ms": "2000",
+    "ratelimiter.control.target_excess": "0.5",
+    "ratelimiter.control.increase_fraction": "0.1",
+    "ratelimiter.control.decrease_factor": "0.5",
+    "ratelimiter.control.floor_fraction": "0.1",
+    "ratelimiter.control.global_cap_per_s": "0",
+    # Concurrency slots (leases as slots, ARCHITECTURE §15): bound every
+    # tenant's aggregate outstanding lease budget to this many permits
+    # (0 = unbounded).  Per-lid overrides via
+    # LeaseManager.set_concurrency_cap.
+    "ratelimiter.control.max_concurrent": "0",
+    # Policy-table capacity (rows).  The table grows implicitly when
+    # full, but a mid-traffic grow recompiles the device step for the
+    # new table shape (LimiterTable._grow warns) — pre-size to the
+    # expected tenant count.
+    "ratelimiter.table.capacity": "64",
 }
 
 # Typed keys: anything listed here is parse-checked at construction.
@@ -229,6 +259,9 @@ _INT_KEYS = (
     "ratelimiter.lease.default_budget",
     "ratelimiter.lease.max_budget",
     "ratelimiter.lease.max_leases",
+    "ratelimiter.control.window_ms",
+    "ratelimiter.control.max_concurrent",
+    "ratelimiter.table.capacity",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -251,6 +284,12 @@ _FLOAT_KEYS = (
     "ratelimiter.cache.hybrid.guard_ms",
     "ratelimiter.lease.ttl_ms",
     "ratelimiter.lease.deny_ttl_ms",
+    "ratelimiter.control.interval_ms",
+    "ratelimiter.control.target_excess",
+    "ratelimiter.control.increase_fraction",
+    "ratelimiter.control.decrease_factor",
+    "ratelimiter.control.floor_fraction",
+    "ratelimiter.control.global_cap_per_s",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
@@ -260,6 +299,7 @@ _BOOL_KEYS = (
     "ratelimiter.microbatch.adaptive_flush",
     "ratelimiter.cache.hybrid.enabled",
     "ratelimiter.lease.enabled",
+    "ratelimiter.control.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

@@ -128,8 +128,13 @@ class AppContext:
     # Control-plane RPC listener (ratelimiter.control.port) — this
     # node's remote fence/lease/probe/promote authority surface.
     control: object = None
+    # Adaptive policy controller (ratelimiter.control.enabled) — the
+    # AIMD loop behind GET /actuator/policies (ARCHITECTURE §15).
+    controller: object = None
 
     def close(self) -> None:
+        if self.controller is not None:
+            self.controller.close()
         if self.control is not None:
             self.control.stop()
         if self.sidecar is not None:
@@ -199,7 +204,8 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
                 mesh = make_mesh(devices)
                 engine = ShardedDeviceEngine(
                     slots_per_shard=max(num_slots // len(devices), 1),
-                    table=LimiterTable(),
+                    table=LimiterTable(capacity=props.get_int(
+                        "ratelimiter.table.capacity", 64)),
                     mesh=mesh,
                 )
         return TpuBatchedStorage(
@@ -242,6 +248,9 @@ def build_storage(props: AppProperties, meter_registry=None) -> RateLimitStorage
                 "ratelimiter.telemetry.max_clients", 1024),
             lineage_capacity=props.get_int(
                 "ratelimiter.obs.lineage_capacity", 256),
+            # Pre-sized policy table (an implicit mid-traffic grow
+            # recompiles the device step — engine/state.py:_grow).
+            table_capacity=props.get_int("ratelimiter.table.capacity", 64),
         )
     raise ValueError(f"unknown storage.backend: {backend!r}")
 
@@ -350,11 +359,65 @@ def _maybe_leases(storage: RateLimitStorage, sidecar, props: AppProperties,
         ttl_ms=props.get_float("ratelimiter.lease.ttl_ms", 2000.0),
         deny_ttl_ms=props.get_float("ratelimiter.lease.deny_ttl_ms", 25.0),
         max_leases=props.get_int("ratelimiter.lease.max_leases", 65536),
+        # Concurrency slots (ARCHITECTURE §15): bound every tenant's
+        # aggregate outstanding lease budget (0 = unbounded).
+        max_concurrent=props.get_int("ratelimiter.control.max_concurrent",
+                                     0),
         registry=registry,
     )
     if sidecar is not None:
         sidecar.attach_leases(manager)
     return manager
+
+
+def _maybe_controller(serving: RateLimitStorage, props: AppProperties,
+                      registry: MeterRegistry, breaker, recorder):
+    """Config-gated adaptive policy control plane (OFF by default;
+    ARCHITECTURE §15).
+
+    Builds the tick-driven AIMD controller over the SERVING storage
+    (the failover router when the orchestrator is on — policy updates
+    must broadcast to promoted replacements exactly like decisions),
+    observing the fleet telemetry plane's ``UsageSignals`` and the
+    breaker's overload state, actuating live ``set_policy`` row updates.
+    """
+    if not props.get_bool("ratelimiter.control.enabled", False):
+        return None
+    if not hasattr(serving, "set_policy") \
+            or getattr(serving, "telemetry", None) is None:
+        import logging
+
+        logging.getLogger("ratelimiter").warning(
+            "ratelimiter.control.enabled but the %s backend has no "
+            "set_policy/telemetry surface; adaptive control disabled",
+            type(serving).__name__)
+        return None
+    from ratelimiter_tpu.control import (
+        AdaptivePolicyController,
+        ControlConfig,
+    )
+
+    return AdaptivePolicyController(
+        serving,
+        ControlConfig(
+            interval_ms=props.get_float("ratelimiter.control.interval_ms",
+                                        1000.0),
+            window_ms=props.get_int("ratelimiter.control.window_ms", 2000),
+            target_excess=props.get_float(
+                "ratelimiter.control.target_excess", 0.5),
+            increase_fraction=props.get_float(
+                "ratelimiter.control.increase_fraction", 0.1),
+            decrease_factor=props.get_float(
+                "ratelimiter.control.decrease_factor", 0.5),
+            floor_fraction=props.get_float(
+                "ratelimiter.control.floor_fraction", 0.1),
+            global_cap_per_s=props.get_float(
+                "ratelimiter.control.global_cap_per_s", 0.0),
+        ),
+        breaker=breaker,
+        registry=registry,
+        recorder=recorder,
+    ).start()
 
 
 def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
@@ -616,6 +679,7 @@ def build_app(props: AppProperties | None = None,
     orchestrator = None
     leases = None
     control = None
+    controller = None
     if own_storage:
         # Self-healing failover (the orchestrator owns its OWN per-shard
         # replication into an in-process standby mesh, so it supersedes
@@ -679,6 +743,16 @@ def build_app(props: AppProperties | None = None,
         wrapped, breaker = _maybe_breaker(_maybe_chaos(storage, props),
                                           props, registry)
         storage = _maybe_retry(wrapped, props)
+        # Degraded-mode seeds must follow live policy updates: an outage
+        # after a set_policy approximates under the generation that is
+        # actually serving, not the boot-time registration.
+        if breaker is not None and breaker.fallback is not None \
+                and hasattr(serving, "add_policy_listener"):
+            serving.add_policy_listener(breaker.fallback.update_policy)
+        # The adaptive controller actuates on the SERVING storage
+        # (router when present) and reads the breaker's overload state.
+        controller = _maybe_controller(serving, props, registry, breaker,
+                                       recorder)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache
@@ -727,4 +801,5 @@ def build_app(props: AppProperties | None = None,
         orchestrator=orchestrator,
         leases=leases,
         control=control,
+        controller=controller,
     )

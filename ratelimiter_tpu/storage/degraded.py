@@ -82,6 +82,20 @@ class DegradedHostLimiter:
         with self._lock:
             self._configs[int(lid)] = (algo, config)
 
+    def update_policy(self, lid: int, algo: str, config: RateLimitConfig,
+                      generation: int = 0) -> None:
+        """Live policy update (control/, ARCHITECTURE §15): adopt the
+        new rates so an outage DURING or AFTER a policy change seeds
+        its approximation from the generation that is actually serving.
+        A live oracle (mid-episode update) reconfigures in place — its
+        seeded per-key state stays, exactly like the device's counters
+        across the same boundary."""
+        with self._lock:
+            self._configs[int(lid)] = (algo, config)
+            oracle = self._oracles.get(int(lid))
+            if oracle is not None:
+                oracle.reconfigure(config)
+
     def _oracle(self, algo: str, lid: int):
         entry = self._configs.get(int(lid))
         if entry is None or entry[0] != algo:

@@ -46,6 +46,9 @@ from ratelimiter_tpu.engine.engine import DeviceEngine
 from ratelimiter_tpu.engine.state import LimiterTable
 from ratelimiter_tpu.storage.base import RateLimitStorage
 from ratelimiter_tpu.storage.memory import InMemoryStorage
+from ratelimiter_tpu.utils.logging import get_logger
+
+log = get_logger("storage.tpu")
 
 
 # Per-dispatch lane cap for the SORTED flat step (ops/flat.py): its
@@ -615,6 +618,7 @@ class TpuBatchedStorage(RateLimitStorage):
         usage_max_tenants: int = 256,
         telemetry_max_clients: int = 1024,
         lineage_capacity: int = 256,
+        table_capacity: int = 0,
     ):
         self._clock_ms = clock_ms
         # Observability (ARCHITECTURE §13).  The stage/latency histograms
@@ -663,13 +667,25 @@ class TpuBatchedStorage(RateLimitStorage):
         self._staging = _StagingPool()
         if engine is not None and table is None:
             table = engine.table
-        self.table = table if table is not None else LimiterTable()
+        # table_capacity pre-sizes the policy table (ratelimiter.table.
+        # capacity): an implicit mid-traffic grow is decision-safe but
+        # recompiles the step for the new table shape — see
+        # LimiterTable._grow.
+        self.table = table if table is not None else LimiterTable(
+            capacity=table_capacity if table_capacity > 0 else 64)
         self.engine = engine if engine is not None else DeviceEngine(num_slots, self.table)
         if host_parallel is None:  # auto-elect (explicit kwarg wins; 0 off)
             host_parallel = self._auto_host_parallel(checkpointable)
         self._host_parallel = (int(host_parallel)
                                if host_parallel and host_parallel > 1 else 0)
         self._configs: Dict[int, Tuple[str, RateLimitConfig]] = {}
+        # Policy-update listeners (control plane, ARCHITECTURE §15):
+        # parties holding a policy-derived mirror — the degraded host
+        # limiter's oracles, the lease manager's clamps — subscribe here
+        # and are told (lid, algo, config, generation) AFTER the device
+        # row moved.  The hybrid serving cache is handled inline (its
+        # invalidation must precede the row write, like reset_key).
+        self._policy_listeners: List[Callable] = []
         # Standby-promotion window flag: decisions are refused (typed,
         # retryable) while promote_from_replica swaps the indexes.
         self._promoting = False
@@ -1024,6 +1040,67 @@ class TpuBatchedStorage(RateLimitStorage):
         if self._serving is not None:
             self._serving.register(lid, algo, config)
         return lid
+
+    # ------------------------------------------------------------------------
+    # Live policy updates (control/, ARCHITECTURE §15)
+    # ------------------------------------------------------------------------
+    def set_policy(self, lid: int, config: RateLimitConfig,
+                   generation: int | None = None) -> int:
+        """Live-update one limiter's policy; returns the policy
+        generation the update installed.
+
+        Semantics: every decision stamped BEFORE this call returns was
+        evaluated under the old row, every decision after under the new
+        one — pending micro-batch traffic is flushed first so the
+        generation boundary is exact (a queued request never silently
+        jumps generations between submit and dispatch).  The device row
+        moves via three scalar updates (LimiterTable.set_policy —
+        window/algo shape immutable), so no recompile and no table
+        re-upload.  The hybrid serving tier forgets the lid's adopted
+        state BEFORE the row moves (a host serve racing the update must
+        not answer from the old policy), and registered policy
+        listeners (degraded limiter, lease manager) are notified after.
+
+        ``generation`` is for replication only: a standby replaying the
+        primary's updates installs the primary's stamps.
+        """
+        entry = self._configs.get(int(lid))
+        if entry is None:
+            raise KeyError(f"no limiter registered under lid={lid}")
+        algo, _old = entry
+        config.validate()
+        if self._serving is not None:
+            self._serving.update_policy(int(lid), algo, config)
+        self._batcher.flush()
+        gen = self.table.set_policy(int(lid), config,
+                                    generation=generation)
+        self._configs[int(lid)] = (algo, config)
+        for listener in self._policy_listeners:
+            try:
+                listener(int(lid), algo, config, gen)
+            except Exception:  # noqa: BLE001 — a broken mirror must not
+                # poison the actuation path; the listener logs itself.
+                log.exception("policy listener failed for lid=%d", lid)
+        return gen
+
+    def add_policy_listener(self, listener) -> None:
+        """Subscribe ``listener(lid, algo, config, generation)`` to live
+        policy updates (called after the device row moved)."""
+        self._policy_listeners.append(listener)
+
+    def policy_info(self) -> Dict:
+        """Policy-generation metadata (the fence_info analog): the
+        table-wide monotonic generation plus each lid's row stamp."""
+        return {
+            "generation": self.table.generation,
+            "lids": {int(lid): {
+                "algo": algo,
+                "generation": self.table.row_generation(lid),
+                "max_permits": cfg.max_permits,
+                "window_ms": cfg.window_ms,
+                "refill_rate": cfg.refill_rate,
+            } for lid, (algo, cfg) in self._configs.items()},
+        }
 
     def acquire(self, algo: str, lid: int, key: str, permits: int,
                 deadline_ms: float | None = None,

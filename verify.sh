@@ -169,6 +169,30 @@ else
   fail=1
 fi
 
+echo "running tenant-storm gate (adaptive limits hold goodput where static collapse)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/tenant_storm.py \
+    --assert-adaptive > /dev/null; then
+  echo "  ok  tenant storm (adaptive >= 0.8x pre-storm goodput, static below,"
+  echo "      decisions bit-identical to the generation-aware oracle)"
+else
+  echo "  FAILED  tenant storm (adaptive limits failed to hold well-behaved"
+  echo "          goodput in the 0.8x band through the storm, the static arm"
+  echo "          did not collapse, no recovery was observed, or a decision"
+  echo "          diverged from the generation-aware oracle)"
+  fail=1
+fi
+
+echo "running control-plane overhead gate (controller tick + generation checks <= 2%)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/control_overhead.py \
+    --assert-budget 0.02 > /dev/null; then
+  echo "  ok  control-plane overhead budget"
+else
+  echo "  FAILED  control-plane overhead budget (a converged controller's"
+  echo "          tick sweep + per-grant generation checks cost more than"
+  echo "          2% of steady-state CPU at the configured cadence)"
+  fail=1
+fi
+
 echo "running orchestrator idle overhead gate (RPC probe path <= 2% steady-state)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
     bench/orchestrator_overhead.py --n 1048576 --rounds 3 --probe-rpc \
@@ -230,6 +254,14 @@ else
 fi
 
 if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "running full tenant-storm soak (RUN_SLOW=1)..."
+  if timeout -k 10 900 env JAX_PLATFORMS=cpu python bench/tenant_storm.py \
+      --assert-adaptive --soak > /dev/null; then
+    echo "  ok  tenant-storm soak"
+  else
+    echo "  FAILED  tenant-storm soak"
+    fail=1
+  fi
   echo "running slow failover + overload + outage + ingress soaks (RUN_SLOW=1)..."
   if timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
       tests/test_replication.py::test_failover_soak_slow \
