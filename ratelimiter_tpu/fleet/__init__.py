@@ -1,0 +1,43 @@
+"""Fleet autopilot (ARCHITECTURE §16): the deployment layer above the
+failover orchestrator.
+
+- :mod:`executor` — the process-execution boundary (``LocalExecutor``
+  runs ``hostproc`` as local subprocesses; anything with the same
+  duck-typed surface — a container runtime, a remote agent — slots in
+  unchanged).
+- :mod:`manager` — the :class:`~manager.NodeManager`: spawns, adopts,
+  probes, and retires nodes, tracking per-node lifecycle state
+  (SPAWNING → READY → SERVING → DRAINING → RETIRED/FAILED).
+- :mod:`autopilot` — the :class:`~autopilot.FleetAutopilot`: watches
+  the orchestrator's standby set and, when a promotion consumes a
+  standby, spawns a fresh one, drives the control-RPC re-seed, and
+  hands the consistent replica back — the cell returns to N+1 with
+  zero operator calls.
+"""
+
+from ratelimiter_tpu.fleet.autopilot import FleetAutopilot
+from ratelimiter_tpu.fleet.executor import LocalExecutor, SpawnError
+from ratelimiter_tpu.fleet.manager import (
+    DRAINING,
+    FAILED,
+    READY,
+    RETIRED,
+    SERVING,
+    SPAWNING,
+    Node,
+    NodeManager,
+)
+
+__all__ = [
+    "DRAINING",
+    "FAILED",
+    "FleetAutopilot",
+    "LocalExecutor",
+    "Node",
+    "NodeManager",
+    "READY",
+    "RETIRED",
+    "SERVING",
+    "SPAWNING",
+    "SpawnError",
+]

@@ -1,0 +1,165 @@
+"""Process-execution boundary for the fleet layer.
+
+The :class:`~ratelimiter_tpu.fleet.manager.NodeManager` never touches
+``subprocess`` directly — it talks to an EXECUTOR duck type::
+
+    spawn(args, boot_timeout_s=None) -> (handle, ready: dict)
+    alive(handle) -> bool
+    terminate(handle, grace_s=...)   # graceful: stdin EOF first
+    kill(handle)                     # hard kill (drills' primary kill)
+
+so "where a node runs" (local subprocess today; a container runtime or
+a remote exec agent later) is swappable without touching lifecycle
+logic.  :class:`LocalExecutor` is the subprocess implementation: it
+launches ``python -m ratelimiter_tpu.replication.hostproc`` with a
+stdin pipe (the node's lifetime handle — hostproc exits on stdin EOF),
+reads the ONE ready-JSON line off stdout under a boot deadline, and
+surfaces every boot pathology as :class:`SpawnError` (timeout, early
+exit, malformed line) instead of a hang.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+from ratelimiter_tpu.utils.logging import get_logger
+
+_log = get_logger("fleet.executor")
+
+_HOSTPROC_ARGV = [sys.executable, "-m",
+                  "ratelimiter_tpu.replication.hostproc"]
+
+
+class SpawnError(RuntimeError):
+    """A node failed to boot: no ready line within the deadline, the
+    process exited first, or the line was not valid JSON."""
+
+
+class ProcessHandle:
+    """The LocalExecutor's opaque handle: one hostproc subprocess."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"ProcessHandle(pid={self.proc.pid})"
+
+
+class LocalExecutor:
+    """Run nodes as local OS subprocesses.
+
+    ``argv_prefix`` defaults to the hostproc module runner; tests
+    override it (e.g. ``[sys.executable, "-c", ...]``) to exercise the
+    boot-pathology paths without a real node.  ``JAX_PLATFORMS=cpu`` is
+    forced unless the caller's env already pins a platform — fleet
+    nodes on one dev host must not fight over an accelerator.
+    """
+
+    def __init__(self, argv_prefix: Optional[List[str]] = None,
+                 env: Optional[dict] = None,
+                 boot_timeout_s: float = 180.0):
+        self.argv_prefix = list(argv_prefix if argv_prefix is not None
+                                else _HOSTPROC_ARGV)
+        self.env = dict(env or {})
+        self.boot_timeout_s = float(boot_timeout_s)
+
+    def spawn(self, args: List[str],
+              boot_timeout_s: Optional[float] = None,
+              ) -> Tuple[ProcessHandle, dict]:
+        """Launch a node and block for its ready line; returns the
+        lifetime handle plus the parsed ready JSON.  Raises
+        :class:`SpawnError` on any boot pathology (the half-started
+        process is torn down first — no orphans)."""
+        timeout = float(boot_timeout_s if boot_timeout_s is not None
+                        else self.boot_timeout_s)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(self.env)
+        proc = subprocess.Popen(
+            self.argv_prefix + list(args),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            env=env, text=True)
+        handle = ProcessHandle(proc)
+        box: dict = {}
+
+        def _read() -> None:
+            try:
+                box["line"] = proc.stdout.readline()
+            except Exception:  # noqa: BLE001 — reported as empty below
+                box["line"] = ""
+
+        reader = threading.Thread(target=_read, name="node-boot-reader",
+                                  daemon=True)
+        reader.start()
+        reader.join(timeout)
+        if "line" not in box:
+            self.kill(handle)
+            raise SpawnError(
+                f"node {self.argv_prefix + list(args)!r} printed no "
+                f"ready line within {timeout:.1f}s")
+        line = (box["line"] or "").strip()
+        if not line:
+            rc = proc.poll()
+            self.kill(handle)
+            raise SpawnError(
+                f"node exited (rc={rc}) before printing a ready line")
+        try:
+            ready = json.loads(line)
+        except json.JSONDecodeError as exc:
+            self.kill(handle)
+            raise SpawnError(
+                f"malformed ready line {line!r}: {exc}") from exc
+        if not isinstance(ready, dict):
+            self.kill(handle)
+            raise SpawnError(f"ready line is not a JSON object: {line!r}")
+        return handle, ready
+
+    def alive(self, handle: ProcessHandle) -> bool:
+        return handle.proc.poll() is None
+
+    def terminate(self, handle: ProcessHandle,
+                  grace_s: float = 10.0) -> None:
+        """Graceful retirement: close stdin (hostproc's exit signal),
+        wait out the grace period, then escalate terminate -> kill."""
+        proc = handle.proc
+        try:
+            if proc.stdin is not None:
+                proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=grace_s)
+            return
+        except subprocess.TimeoutExpired:
+            _log.warning("node pid=%d ignored stdin EOF for %.1fs; "
+                         "terminating", proc.pid, grace_s)
+        proc.terminate()
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5.0)
+
+    def kill(self, handle: ProcessHandle) -> None:
+        """Hard kill (no stdin courtesy): SIGKILL and reap."""
+        proc = handle.proc
+        try:
+            if proc.stdin is not None:
+                proc.stdin.close()
+        except OSError:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:  # pragma: no cover — kernel owes
+            pass                           # us a reaped SIGKILL

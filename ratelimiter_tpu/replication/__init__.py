@@ -56,6 +56,7 @@ from ratelimiter_tpu.replication.control import (
     ControlError,
     ControlServer,
     LeaseMailbox,
+    mux_handlers,
     primary_handlers,
     standby_handlers,
 )
@@ -76,6 +77,7 @@ from ratelimiter_tpu.replication.remote import (
     RemoteReceiver,
     RemoteShardDirectory,
     RemoteStandbySet,
+    parse_ready,
     standby_witness,
 )
 from ratelimiter_tpu.replication.replicator import Replicator
@@ -136,6 +138,8 @@ __all__ = [
     "encode_frame",
     "engine_state_fingerprint",
     "make_journal",
+    "mux_handlers",
+    "parse_ready",
     "primary_handlers",
     "standby_handlers",
     "standby_witness",

@@ -279,6 +279,58 @@ class LeaseMailbox:
                     "age_ms": round(age_ms, 3)}
 
 
+def mux_handlers(per_shard: Dict[int, Dict[str, Callable]],
+                 extra: Optional[Dict[str, Callable]] = None) -> Dict:
+    """Multiplex several shards' handler tables behind ONE control port.
+
+    A multi-shard node (``hostproc --shards k``) runs k independent
+    shard storages in one process but must not burn k listener ports and
+    k orchestrator connections: every op gains an optional ``shard``
+    field (default 0, so single-shard callers and old drills keep
+    working verbatim) and dispatches to that shard's table.  An unknown
+    shard or an op the shard does not serve is answered in-protocol.
+
+    ``probe_all`` answers EVERY shard's probe in one round trip —
+    ``{"shards": {"0": {probe..., "ok": true}, ...}}`` — so a manager
+    watching a k-shard node pays one RPC per NODE per tick, not one per
+    shard (the per-RPC GIL cost is the orchestrator probe loop's long
+    pole; see bench/orchestrator_overhead.py).
+    """
+    shards = {int(q): dict(table) for q, table in per_shard.items()}
+
+    def _dispatch(op: str) -> Callable[..., dict]:
+        def call(shard: int = 0, **kw) -> dict:
+            table = shards.get(int(shard))
+            if table is None:
+                raise ValueError(f"unknown shard {shard}")
+            fn = table.get(op)
+            if fn is None:
+                raise ValueError(f"op {op!r} not served by shard {shard}")
+            return fn(**kw) or {}
+        return call
+
+    def probe_all() -> dict:
+        out: Dict[str, dict] = {}
+        for q in sorted(shards):
+            fn = shards[q].get("probe")
+            if fn is None:
+                continue
+            try:
+                out[str(q)] = {"ok": True, **(fn() or {})}
+            except Exception as exc:  # noqa: BLE001 — per-shard verdict
+                out[str(q)] = {"ok": False,
+                               "error": f"{type(exc).__name__}: {exc}"}
+        return {"shards": out}
+
+    ops: set = set()
+    for table in shards.values():
+        ops.update(table)
+    handlers: Dict[str, Callable] = {op: _dispatch(op) for op in ops}
+    handlers["probe_all"] = probe_all
+    handlers.update(extra or {})
+    return handlers
+
+
 def primary_handlers(storage, replicator=None,
                      extra: Optional[Dict[str, Callable]] = None) -> Dict:
     """Control ops a shard-primary process exposes.

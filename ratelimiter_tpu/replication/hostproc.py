@@ -1,26 +1,39 @@
-"""Cross-host node process: one shard primary or one standby, runnable
-as ``python -m ratelimiter_tpu.replication.hostproc``.
+"""Cross-host node process: shard primaries or standbys, runnable as
+``python -m ratelimiter_tpu.replication.hostproc``.
 
 This is the process the multi-process topology (ARCHITECTURE §10c) is
-made of.  A PRIMARY node serves decisions over a sidecar (wire protocol
-v4, optional token leases), ships its replication stream to its standby
-(``--repl-target``), exposes the control port (PROBE / FENCE / LEASE /
-RESTORE / SHIP), and runs the LEASE KEEPER: when the orchestrator's
-direct renewals stop arriving, the keeper fetches the newest deposited
-grant from the standby's mailbox over the replication-side link — so a
-primary partitioned only from the ORCHESTRATOR keeps serving, while one
-partitioned from everything runs its lease down and self-fences within
-one TTL.  A STANDBY node applies the replication stream, answers the
-witness probe (``repl_rx_age_ms``), holds the lease mailbox, and serves
-the remote-promotion RPC — a successful PROMOTE starts a sidecar over
-the now-serving storage and reports its port for clients to re-point.
+made of.  A node hosts ``--shards k`` independent shard storages (k=1
+by default — the PR 14 topology unchanged).  A PRIMARY node serves
+decisions over one sidecar per shard (wire protocol v4, optional token
+leases), ships each shard's replication stream to its standby
+(``--repl-target``, comma-separated for k>1), exposes ONE control port
+multiplexing every shard (PROBE / PROBE_ALL / FENCE / LEASE / RESTORE /
+SHIP / RETARGET), and runs the LEASE KEEPER per shard: when the
+orchestrator's direct renewals stop arriving, the keeper fetches the
+newest deposited grant from the standby's mailbox over the replication-
+side link — so a primary partitioned only from the ORCHESTRATOR keeps
+serving, while one partitioned from everything runs its lease down and
+self-fences within one TTL.  A STANDBY node applies the replication
+streams, answers the witness probe (``repl_rx_age_ms``), holds the
+lease mailboxes, and serves the remote-promotion RPC — a successful
+PROMOTE starts a sidecar over the now-serving storage and reports its
+port for clients to re-point.
 
-The process prints ONE JSON line on stdout when ready (ports included)
-and exits when stdin closes — the launcher (a drill, an init system
-wrapper) owns its lifetime through the pipe.
+RETARGET is the fleet autopilot's re-seed primitive (ARCHITECTURE §16):
+point this shard's replication stream at a NEW standby's listener —
+swap the sink under the existing replicator (primary), or build one on
+a promoted storage that never had one (post-promotion standby) — then
+force a full re-baseline frame and ship it synchronously.  An
+unpromoted standby refuses (re-seeding from a shadow would fork the
+authority chain).
 
-``storage/chaos.py:cross_host_failover_drill`` spawns these as real OS
-subprocesses with ``FaultInjectingProxy`` links between them.
+The process prints ONE JSON line on stdout when ready (ports, explicit
+``lid_base``, ``version``, and shard count included) and exits when
+stdin closes — the launcher (fleet/NodeManager, a drill, an init
+system wrapper) owns its lifetime through the pipe.
+
+``storage/chaos.py`` spawns these as real OS subprocesses with
+``FaultInjectingProxy`` links between them.
 """
 
 from __future__ import annotations
@@ -30,14 +43,39 @@ import json
 import sys
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 
-def _build_limiters(spec_json: str) -> List[dict]:
+def _build_limiters(spec_json: str, shards: int) -> List[List[dict]]:
+    """Parse ``--limiters``: a JSON list of limiter specs applied to
+    EVERY shard, or a list of k lists for per-shard policies."""
     spec = json.loads(spec_json) if spec_json else []
     if not isinstance(spec, list):
         raise ValueError("--limiters must be a JSON list")
-    return spec
+    if spec and all(isinstance(s, list) for s in spec):
+        if len(spec) != shards:
+            raise ValueError(
+                f"--limiters gave {len(spec)} per-shard lists for "
+                f"--shards {shards}")
+        return spec
+    return [list(spec) for _ in range(shards)]
+
+
+def _split_targets(arg: str, shards: int) -> List[str]:
+    """Split a comma-separated ``host:port`` list, one per shard
+    (empty string = that shard ships nowhere)."""
+    if not arg:
+        return [""] * shards
+    targets = [t.strip() for t in arg.split(",")]
+    if len(targets) == 1 and shards > 1:
+        raise ValueError(
+            f"--repl-target gave 1 target for --shards {shards}; pass "
+            f"a comma-separated list, one per shard")
+    if len(targets) != shards:
+        raise ValueError(
+            f"--repl-target gave {len(targets)} targets for "
+            f"--shards {shards}")
+    return targets
 
 
 def _make_lease_manager(storage, props: Optional[dict] = None):
@@ -63,14 +101,18 @@ class LeaseKeeper:
     is measured on the STANDBY's clock between orchestrator deposit and
     our fetch, so the applied TTL is ``ttl - age - slack`` — always at
     or under what the orchestrator believes it granted, never past it.
+
+    ``shard`` addresses the mailbox on a multiplexed standby control
+    port (None keeps the bare op for raw single-shard handler tables).
     """
 
     def __init__(self, storage, standby_ctl, poll_ms: float = 100.0,
-                 slack_ms: float = 25.0):
+                 slack_ms: float = 25.0, shard: Optional[int] = None):
         self.storage = storage
         self.ctl = standby_ctl
         self.poll_ms = float(poll_ms)
         self.slack_ms = float(slack_ms)
+        self.shard = shard
         self.fetches = 0
         self.applied = 0
         self._stop = threading.Event()
@@ -97,7 +139,8 @@ class LeaseKeeper:
         info = self.storage.serving_lease_info()
         if not info["installed"]:
             return  # no lease granted yet, or already expired/fenced
-        resp = self.ctl.try_call("lease_fetch")
+        kw = {} if self.shard is None else {"shard": int(self.shard)}
+        resp = self.ctl.try_call("lease_fetch", **kw)
         self.fetches += 1
         if resp is None or not resp.get("ok") or not resp.get("deposited"):
             return
@@ -114,11 +157,71 @@ class LeaseKeeper:
             pass
 
 
+def _shard_extras(storage, box: dict, args,
+                  allowed: Optional[Callable[[], bool]] = None) -> Dict:
+    """The per-shard ``ship`` + ``retarget`` ops, reading the shard's
+    replicator through a mutable ``box`` so a replicator created or
+    re-pointed AFTER the handler table was built is still the one the
+    ops drive (a closure over the boot-time object would go stale the
+    moment retarget runs)."""
+    from ratelimiter_tpu.replication.log import ReplicationLog
+    from ratelimiter_tpu.replication.replicator import Replicator
+    from ratelimiter_tpu.replication.transport import SocketSink
+
+    def ship() -> dict:
+        storage.flush()
+        repl = box.get("replicator")
+        shipped = repl.ship_now() if repl is not None else 0
+        return {"frames": int(shipped)}
+
+    def retarget(host: str, port: int,
+                 interval_ms: Optional[float] = None) -> dict:
+        if allowed is not None and not allowed():
+            raise RuntimeError(
+                "retarget refused: shard is an unpromoted standby "
+                "(re-seeding from a shadow would fork authority)")
+        interval = float(interval_ms if interval_ms is not None
+                         else args.repl_interval_ms)
+        sink = SocketSink(host, int(port), timeout=2.0, max_retries=1,
+                          backoff_ms=20.0,
+                          ack_timeout=args.ack_timeout_ms / 1000.0,
+                          dead_after=2)
+        repl = box.get("replicator")
+        if repl is not None:
+            # Sink swap under a stopped pipeline: stop() leaves the
+            # replicator restartable (threads joined, stop flag
+            # cleared), so the SAME object carries its counters across
+            # the re-point and every handler that captured it stays
+            # valid.
+            repl.stop()
+            try:
+                repl.sink.close()
+            except Exception:  # noqa: BLE001 — old link teardown
+                pass
+            repl.sink = sink
+            repl.interval_ms = interval
+        else:
+            repl = Replicator(ReplicationLog(storage), sink,
+                              interval_ms=interval)
+            box["replicator"] = repl
+        # The new peer has empty state: re-baseline with a full frame
+        # and ship it synchronously so the caller's success means "the
+        # new standby holds a consistent snapshot", not "queued".
+        repl.log.request_full()
+        repl.start()
+        storage.flush()
+        frames = repl.ship_now()
+        return {"target": f"{host}:{int(port)}", "frames": int(frames)}
+
+    return {"ship": ship, "retarget": retarget}
+
+
 def run_primary(args) -> int:
     from ratelimiter_tpu.core.config import RateLimitConfig
     from ratelimiter_tpu.replication.control import (
         ControlClient,
         ControlServer,
+        mux_handlers,
         primary_handlers,
     )
     from ratelimiter_tpu.replication.log import ReplicationLog
@@ -127,52 +230,71 @@ def run_primary(args) -> int:
     from ratelimiter_tpu.service.sidecar import SidecarServer
     from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
-    storage = TpuBatchedStorage(num_slots=args.num_slots,
-                                max_delay_ms=0.2)
-    sidecar = SidecarServer(storage, host=args.host, port=0,
-                            drain_timeout_ms=200.0)
-    if args.lease:
-        sidecar.attach_leases(_make_lease_manager(storage))
-    lids = []
-    for spec in _build_limiters(args.limiters):
-        algo = spec.pop("algo")
-        lids.append(sidecar.register(algo, RateLimitConfig(**spec)))
-    sidecar.start()
-
-    replicator = None
-    if args.repl_target:
-        host, _, port = args.repl_target.rpartition(":")
-        sink = SocketSink(host or "127.0.0.1", int(port), timeout=2.0,
-                          max_retries=1, backoff_ms=20.0,
-                          ack_timeout=args.ack_timeout_ms / 1000.0,
-                          dead_after=2)
-        replicator = Replicator(ReplicationLog(storage), sink,
-                                interval_ms=args.repl_interval_ms).start()
-
-    keeper = None
+    specs = _build_limiters(args.limiters, args.shards)
+    targets = _split_targets(args.repl_target, args.shards)
+    standby_ctl = None
     if args.standby_control:
         host, _, port = args.standby_control.rpartition(":")
-        keeper = LeaseKeeper(
-            storage, ControlClient(host or "127.0.0.1", int(port),
-                                   timeout=0.5),
-            poll_ms=args.keeper_poll_ms).start()
+        standby_ctl = ControlClient(host or "127.0.0.1", int(port),
+                                    timeout=0.5)
 
-    control = ControlServer(
-        primary_handlers(storage, replicator=replicator),
-        host=args.host).start()
+    per_shard: Dict[int, Dict] = {}
+    storages, sidecars, boxes, keepers = [], [], [], []
+    lids_per_shard: List[List[int]] = []
+    for q in range(args.shards):
+        storage = TpuBatchedStorage(num_slots=args.num_slots,
+                                    max_delay_ms=0.2)
+        sidecar = SidecarServer(storage, host=args.host, port=0,
+                                drain_timeout_ms=200.0)
+        if args.lease:
+            sidecar.attach_leases(_make_lease_manager(storage))
+        lids = []
+        for spec in specs[q]:
+            spec = dict(spec)
+            algo = spec.pop("algo")
+            lids.append(sidecar.register(algo, RateLimitConfig(**spec)))
+        sidecar.start()
+        box: dict = {"replicator": None}
+        if targets[q]:
+            host, _, port = targets[q].rpartition(":")
+            sink = SocketSink(host or "127.0.0.1", int(port), timeout=2.0,
+                              max_retries=1, backoff_ms=20.0,
+                              ack_timeout=args.ack_timeout_ms / 1000.0,
+                              dead_after=2)
+            box["replicator"] = Replicator(
+                ReplicationLog(storage), sink,
+                interval_ms=args.repl_interval_ms).start()
+        if standby_ctl is not None:
+            keepers.append(LeaseKeeper(
+                storage, standby_ctl, poll_ms=args.keeper_poll_ms,
+                shard=q).start())
+        per_shard[q] = primary_handlers(
+            storage, replicator=box["replicator"],
+            extra=_shard_extras(storage, box, args))
+        storages.append(storage)
+        sidecars.append(sidecar)
+        boxes.append(box)
+        lids_per_shard.append(lids)
 
-    print(json.dumps({"ready": True, "role": "primary",
-                      "control_port": control.port,
-                      "sidecar_port": sidecar.port,
-                      "lids": lids}), flush=True)
+    control = ControlServer(mux_handlers(per_shard),
+                            host=args.host).start()
+    print(json.dumps(_ready_line(
+        "primary", control, args,
+        sidecar_ports=[s.port for s in sidecars],
+        lids=lids_per_shard)), flush=True)
     _wait_for_eof()
-    if keeper is not None:
+    for keeper in keepers:
         keeper.stop()
-    if replicator is not None:
-        replicator.close()
+    for box in boxes:
+        if box["replicator"] is not None:
+            box["replicator"].close()
     control.stop()
-    sidecar.stop()
-    storage.close()
+    for sidecar in sidecars:
+        sidecar.stop()
+    for storage in storages:
+        storage.close()
+    if standby_ctl is not None:
+        standby_ctl.close()
     return 0
 
 
@@ -180,6 +302,7 @@ def run_standby(args) -> int:
     from ratelimiter_tpu.replication.control import (
         ControlServer,
         LeaseMailbox,
+        mux_handlers,
         standby_handlers,
     )
     from ratelimiter_tpu.replication.standby import StandbyReceiver
@@ -187,43 +310,96 @@ def run_standby(args) -> int:
     from ratelimiter_tpu.service.sidecar import SidecarServer
     from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
 
-    storage = TpuBatchedStorage(num_slots=args.num_slots,
-                                max_delay_ms=0.2)
-    receiver = StandbyReceiver(storage)
-    repl_server = ReplicationServer(receiver, host=args.host).start()
-    promoted_sidecar: dict = {}
+    per_shard: Dict[int, Dict] = {}
+    storages, repl_servers, boxes = [], [], []
+    promoted_sidecars: List[dict] = []
+    for q in range(args.shards):
+        storage = TpuBatchedStorage(num_slots=args.num_slots,
+                                    max_delay_ms=0.2)
+        receiver = StandbyReceiver(storage)
+        repl_server = ReplicationServer(receiver, host=args.host).start()
+        promoted_sidecar: dict = {}
 
-    def on_promote() -> dict:
-        # The shadow is now the serving primary for this shard's
-        # keyspace: open the front door and expose every limiter the
-        # replication stream registered (lids mean the same policies as
-        # on the dead primary — StandbyReceiver verified that on apply).
-        sidecar = SidecarServer(storage, host=args.host, port=0,
-                                drain_timeout_ms=200.0)
-        if args.lease:
-            sidecar.attach_leases(_make_lease_manager(storage))
-        for lid, (algo, cfg) in sorted(storage._configs.items()):
-            sidecar.expose(lid, algo, cfg)
-        sidecar.start()
-        promoted_sidecar["server"] = sidecar
-        return {"serve_port": sidecar.port}
+        def on_promote(storage=storage,
+                       promoted_sidecar=promoted_sidecar) -> dict:
+            # The shadow is now the serving primary for this shard's
+            # keyspace: open the front door and expose every limiter the
+            # replication stream registered (lids mean the same policies
+            # as on the dead primary — StandbyReceiver verified that on
+            # apply).
+            sidecar = SidecarServer(storage, host=args.host, port=0,
+                                    drain_timeout_ms=200.0)
+            if args.lease:
+                sidecar.attach_leases(_make_lease_manager(storage))
+            for lid, (algo, cfg) in sorted(storage._configs.items()):
+                sidecar.expose(lid, algo, cfg)
+            sidecar.start()
+            promoted_sidecar["server"] = sidecar
+            return {"serve_port": sidecar.port}
 
-    control = ControlServer(
-        standby_handlers(storage, receiver, repl_server=repl_server,
-                         mailbox=LeaseMailbox(), on_promote=on_promote),
-        host=args.host).start()
+        box: dict = {"replicator": None}
+        per_shard[q] = standby_handlers(
+            storage, receiver, repl_server=repl_server,
+            mailbox=LeaseMailbox(), on_promote=on_promote,
+            extra=_shard_extras(
+                storage, box, args,
+                allowed=lambda receiver=receiver: receiver.promoted))
+        storages.append(storage)
+        repl_servers.append(repl_server)
+        boxes.append(box)
+        promoted_sidecars.append(promoted_sidecar)
 
-    print(json.dumps({"ready": True, "role": "standby",
-                      "control_port": control.port,
-                      "repl_port": repl_server.port}), flush=True)
+    control = ControlServer(mux_handlers(per_shard),
+                            host=args.host).start()
+    print(json.dumps(_ready_line(
+        "standby", control, args,
+        repl_ports=[s.port for s in repl_servers])), flush=True)
     _wait_for_eof()
+    for box in boxes:
+        if box["replicator"] is not None:
+            box["replicator"].close()
     control.stop()
-    repl_server.stop()
-    sidecar = promoted_sidecar.get("server")
-    if sidecar is not None:
-        sidecar.stop()
-    storage.close()
+    for repl_server in repl_servers:
+        repl_server.stop()
+    for promoted_sidecar in promoted_sidecars:
+        sidecar = promoted_sidecar.get("server")
+        if sidecar is not None:
+            sidecar.stop()
+    for storage in storages:
+        storage.close()
     return 0
+
+
+def _ready_line(role: str, control, args,
+                sidecar_ports: Optional[List[int]] = None,
+                repl_ports: Optional[List[int]] = None,
+                lids: Optional[List[List[int]]] = None) -> dict:
+    """The one-line ready JSON.  ``lid_base`` is EXPLICIT (the smallest
+    lid any shard registered) so launchers assert agreement instead of
+    relying on the storage's lids-start-at-1 convention; k=1 keeps the
+    PR 14 scalar field names so old drills parse unchanged."""
+    info = {"ready": True, "role": role, "control_port": control.port,
+            "version": args.version, "shards": args.shards}
+    if lids and any(lids):
+        bases = sorted({min(ls) for ls in lids if ls})
+        if len(bases) != 1:
+            raise RuntimeError(f"shards disagree on lid base: {bases}")
+        info["lid_base"] = bases[0]
+    if args.shards == 1:
+        if sidecar_ports:
+            info["sidecar_port"] = sidecar_ports[0]
+        if repl_ports:
+            info["repl_port"] = repl_ports[0]
+        if lids:
+            info["lids"] = lids[0]
+    else:
+        if sidecar_ports:
+            info["sidecar_ports"] = sidecar_ports
+        if repl_ports:
+            info["repl_ports"] = repl_ports
+        if lids:
+            info["lids"] = lids
+    return info
 
 
 def _wait_for_eof() -> None:
@@ -242,15 +418,24 @@ def main(argv=None) -> int:
                         required=True)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--num-slots", type=int, default=512)
+    parser.add_argument("--shards", type=int, default=1,
+                        help="independent shard storages hosted by this "
+                             "node behind ONE multiplexed control port")
+    parser.add_argument("--version", default="v0",
+                        help="deploy version tag echoed in the ready "
+                             "line and the fleet actuator (rolling "
+                             "upgrades assert on it)")
     parser.add_argument("--limiters", default="",
                         help="JSON list of limiter specs to register "
-                             "(primary; algo + RateLimitConfig kwargs)")
+                             "(primary; algo + RateLimitConfig kwargs), "
+                             "or a list of per-shard lists")
     parser.add_argument("--lease", action="store_true",
                         help="attach a token-lease manager to the "
                              "sidecar (v3 LEASE/RENEW/RELEASE)")
     parser.add_argument("--repl-target", default="",
                         help="host:port of the standby's replication "
-                             "listener (primary)")
+                             "listener (primary; comma-separated, one "
+                             "per shard, for --shards > 1)")
     parser.add_argument("--standby-control", default="",
                         help="host:port of the standby's CONTROL port "
                              "(primary; enables the lease-relay keeper)")
@@ -261,6 +446,8 @@ def main(argv=None) -> int:
     parser.add_argument("--ack-timeout-ms", type=float, default=5000.0)
     parser.add_argument("--keeper-poll-ms", type=float, default=100.0)
     args = parser.parse_args(argv)
+    if args.shards < 1:
+        parser.error("--shards must be >= 1")
     # Persistent XLA compile cache: the node's dispatch shapes are the
     # standard micro-batch buckets, so a warm cache turns per-process
     # jit compiles into disk loads (utils/compile_cache.py).

@@ -193,6 +193,8 @@ class FailoverOrchestrator:
                  spares: Optional[Dict[int, List[object]]] = None,
                  lease_channels: Optional[Dict[int, object]] = None,
                  witness: Optional[Callable[[int], str]] = None,
+                 witness_fresh_ms: Optional[float] = None,
+                 repl_heartbeat_ms: Optional[float] = None,
                  registry=None, recorder=None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
@@ -274,6 +276,51 @@ class FailoverOrchestrator:
             self._m_state = self._m_promotions = None
             self._m_false = self._m_fence_rej = self._m_reseeds = None
             self._m_vetoes = None
+        self._validate_timing(witness_fresh_ms, repl_heartbeat_ms)
+
+    def _validate_timing(self, witness_fresh_ms: Optional[float],
+                         repl_heartbeat_ms: Optional[float]) -> None:
+        """Warn-at-construction for the two silent misconfigurations
+        the cross-host drills keep tripping over (CHANGES.md PR 14):
+        a ``witness_fresh_ms`` outside (replication heartbeat interval,
+        detection budget) makes the second witness either read idle
+        gaps as death or veto a real one, and a fence lease shorter
+        than the detection budget can expire a HEALTHY primary's lease
+        inside an ordinary flap-damped hysteresis window.  Both are
+        tuning hazards, not contract violations — warn loudly (log +
+        flight event), never raise."""
+        budget = self.cfg.detection_budget_ms
+
+        def _warn(problem: str, **fields) -> None:
+            _log.warning("orchestrator misconfiguration: %s (%s)",
+                         problem,
+                         ", ".join(f"{k}={v}" for k, v in fields.items()))
+            self._recorder.record("orchestrator.misconfigured",
+                                  problem=problem, **fields)
+
+        if witness_fresh_ms is not None:
+            fresh = float(witness_fresh_ms)
+            if repl_heartbeat_ms is not None \
+                    and fresh <= float(repl_heartbeat_ms):
+                _warn("witness_fresh_ms at or under the replication "
+                      "heartbeat interval — idle replication gaps will "
+                      "read as primary death and the witness can never "
+                      "veto",
+                      witness_fresh_ms=fresh,
+                      repl_heartbeat_ms=float(repl_heartbeat_ms))
+            if fresh >= budget:
+                _warn("witness_fresh_ms at or past the detection "
+                      "budget — a really-dead primary's last heartbeat "
+                      "still reads fresh when FENCING is due, vetoing "
+                      "the first fencing attempt",
+                      witness_fresh_ms=fresh,
+                      detection_budget_ms=budget)
+        ttl = float(self.cfg.fence_lease_ttl_ms)
+        if 0.0 < ttl < budget:
+            _warn("fence_lease_ttl_ms under the detection budget — a "
+                  "healthy primary's serving lease can expire during "
+                  "an ordinary flap-damped hysteresis window",
+                  fence_lease_ttl_ms=ttl, detection_budget_ms=budget)
 
     # -- probes ----------------------------------------------------------------
     def _default_probe(self, q: int) -> bool:
@@ -746,6 +793,13 @@ class FailoverOrchestrator:
             self._export_metrics()
             return {"shard": q, "state": MONITORING,
                     "fence_epoch": self.fence_epoch}
+
+    def set_lease_channel(self, q: int, channel) -> None:
+        """Swap shard ``q``'s serving-lease channel (the fleet
+        autopilot re-points the relay leg at a freshly re-seeded
+        standby's mailbox after an automated replacement)."""
+        with self._tick_lock:
+            self._lease_channels[int(q)] = channel
 
     # -- metrics / status ------------------------------------------------------
     def _export_metrics(self) -> None:

@@ -50,46 +50,107 @@ def _wall_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
-class RemoteBackend:
-    """Duck-typed storage proxy over a control port."""
+def parse_ready(info: dict) -> dict:
+    """Validate a hostproc ready line and normalize pre-fleet fields.
 
-    def __init__(self, ctl: ControlClient, label: str = ""):
+    The explicit ``lid_base`` field replaces the lids-start-at-1
+    convention: when the line carries registered lids at all, the base
+    must be present AND agree with ``min(lids)`` — a launcher that
+    would have silently mis-addressed every limiter fails loudly here
+    instead.  Lines from pre-fleet nodes (no ``shards``/``version``)
+    normalize to one v0 shard.
+    """
+    if not isinstance(info, dict) or not info.get("ready"):
+        raise ValueError(f"not a hostproc ready line: {info!r}")
+    if "control_port" not in info:
+        raise ValueError("ready line missing control_port")
+    role = info.get("role")
+    if role not in ("primary", "standby"):
+        raise ValueError(f"ready line has unknown role {role!r}")
+    lids = info.get("lids") or []
+    flat = [lid for entry in lids
+            for lid in (entry if isinstance(entry, list) else [entry])]
+    if flat:
+        base = info.get("lid_base")
+        if base is None:
+            raise ValueError(
+                "ready line registered lids but carries no lid_base — "
+                "refusing to assume the lids-start-at-1 convention")
+        if min(flat) != int(base):
+            raise ValueError(
+                f"ready line lid_base {base} disagrees with min(lids) "
+                f"{min(flat)}")
+    info.setdefault("shards", 1)
+    info.setdefault("version", "v0")
+    return info
+
+
+class RemoteBackend:
+    """Duck-typed storage proxy over a control port.
+
+    ``shard`` addresses one shard of a multi-shard node (hostproc
+    ``--shards k`` multiplexes k shard storages behind one control
+    port); None keeps the bare ops for single-shard nodes and raw
+    handler tables."""
+
+    def __init__(self, ctl: ControlClient, label: str = "",
+                 shard: Optional[int] = None):
         self.ctl = ctl
+        self.shard = shard
         self.label = label or f"{ctl.host}:{ctl.port}"
+        if shard is not None:
+            self.label += f"/s{int(shard)}"
+
+    def _kw(self, **kw) -> dict:
+        if self.shard is not None:
+            kw["shard"] = int(self.shard)
+        return kw
 
     def fence(self, epoch: int, shards=None) -> int:
         """Install a whole-storage fence.  ``shards`` is accepted for
-        interface parity and ignored: the process behind this port IS
+        interface parity and ignored: the storage behind this proxy IS
         exactly one shard of the cross-host topology, so whole-storage
         and shard-scoped fencing coincide."""
         del shards
-        self.ctl.call_ok("fence", epoch=int(epoch))
+        self.ctl.call_ok("fence", **self._kw(epoch=int(epoch)))
         return int(epoch)
 
     def lift_fence(self, epoch: int, shards=None) -> None:
         del shards
-        self.ctl.call_ok("restore", epoch=int(epoch))
+        self.ctl.call_ok("restore", **self._kw(epoch=int(epoch)))
 
     def grant_serving_lease(self, epoch: int, ttl_ms: float) -> dict:
-        return self.ctl.call_ok("lease", epoch=int(epoch),
-                                ttl_ms=float(ttl_ms))
+        return self.ctl.call_ok("lease", **self._kw(epoch=int(epoch),
+                                                    ttl_ms=float(ttl_ms)))
+
+    def retarget(self, host: str, port: int,
+                 interval_ms: Optional[float] = None,
+                 timeout_s: float = 30.0) -> dict:
+        """Re-point this shard's replication stream at a new standby
+        listener and synchronously ship a full re-baseline frame (the
+        fleet autopilot's re-seed primitive).  Generous timeout: the
+        receiving side jit-compiles its first frame apply."""
+        kw = self._kw(host=str(host), port=int(port))
+        if interval_ms is not None:
+            kw["interval_ms"] = float(interval_ms)
+        return self.ctl.call_ok("retarget", timeout=float(timeout_s), **kw)
 
     def fence_info(self) -> dict:
-        return self.ctl.call_ok("probe").get("fence", {})
+        return self.ctl.call_ok("probe", **self._kw()).get("fence", {})
 
     def serving_lease_info(self) -> dict:
-        return self.ctl.call_ok("probe").get("lease", {})
+        return self.ctl.call_ok("probe", **self._kw()).get("lease", {})
 
     def is_available(self) -> bool:
         try:
-            resp = self.ctl.call("probe")
+            resp = self.ctl.call("probe", **self._kw())
         except ControlError:
             return False
         return bool(resp.get("ok")) and bool(resp.get("available"))
 
     def probe(self) -> Optional[dict]:
         """Raw probe payload, or None when unreachable."""
-        return self.ctl.try_call("probe")
+        return self.ctl.try_call("probe", **self._kw())
 
     def close(self) -> None:
         self.ctl.close()
@@ -107,8 +168,10 @@ class RemoteReceiver:
     """
 
     def __init__(self, ctl: ControlClient, cache_ttl_s: float = 0.05,
-                 promote_timeout_s: float = 30.0):
+                 promote_timeout_s: float = 30.0,
+                 shard: Optional[int] = None):
         self.ctl = ctl
+        self.shard = shard
         self.cache_ttl_s = float(cache_ttl_s)
         self.promote_timeout_s = float(promote_timeout_s)
         self._status: dict = {}
@@ -118,11 +181,16 @@ class RemoteReceiver:
         self.serve_port: Optional[int] = None
         self.promote_info: dict = {}
 
+    def _kw(self, **kw) -> dict:
+        if self.shard is not None:
+            kw["shard"] = int(self.shard)
+        return kw
+
     def _refresh(self) -> dict:
         with self._lock:
             now = time.monotonic()
             if now - self._status_at >= self.cache_ttl_s:
-                resp = self.ctl.try_call("probe")
+                resp = self.ctl.try_call("probe", **self._kw())
                 if resp is not None and resp.get("ok"):
                     self._status = resp
                 else:
@@ -152,8 +220,8 @@ class RemoteReceiver:
         already promoted, promotion in flight — the orchestrator's
         bounded retry handles it) and returns a RemoteBackend for the
         storage that is now serving."""
-        resp = self.ctl.call("promote", force=bool(force),
-                             timeout=self.promote_timeout_s)
+        resp = self.ctl.call("promote", timeout=self.promote_timeout_s,
+                             **self._kw(force=bool(force)))
         if not resp.get("ok"):
             raise RuntimeError(
                 f"remote promote refused by {self.ctl.host}:"
@@ -163,7 +231,8 @@ class RemoteReceiver:
         with self._lock:
             self._status = dict(self._status, promoted=True)
             self._status_at = time.monotonic()
-        return RemoteBackend(self.ctl, label="promoted-standby")
+        return RemoteBackend(self.ctl, label="promoted-standby",
+                             shard=self.shard)
 
     def close(self) -> None:
         self.ctl.close()
@@ -307,19 +376,22 @@ class FanoutLeaseChannel:
     relay the primary fetches from when the orchestrator cannot reach it
     directly — replication/control.py:LeaseMailbox)."""
 
-    def __init__(self, backend, standby_ctl: ControlClient):
+    def __init__(self, backend, standby_ctl: ControlClient,
+                 shard: Optional[int] = None):
         self.backend = backend
         self.standby_ctl = standby_ctl
+        self.shard = shard
 
     def grant(self, epoch: int, ttl_ms: float) -> None:
         self.backend.grant_serving_lease(int(epoch), float(ttl_ms))
 
     def deposit(self, epoch: int, ttl_ms: float) -> None:
+        kw = {} if self.shard is None else {"shard": int(self.shard)}
         self.standby_ctl.call_ok("lease_deposit", epoch=int(epoch),
-                                 ttl_ms=float(ttl_ms))
+                                 ttl_ms=float(ttl_ms), **kw)
 
 
-def standby_witness(standby_ctls: Dict[int, ControlClient],
+def standby_witness(standby_ctls: Dict[int, object],
                     fresh_ms: float = 400.0) -> Callable[[int], str]:
     """Build the orchestrator's second-witness callable: shard q's
     verdict comes from its STANDBY's control port — "alive" when the
@@ -328,21 +400,32 @@ def standby_witness(standby_ctls: Dict[int, ControlClient],
     itself is unreachable or has never heard from the primary.  Only
     "alive" vetoes a fencing (an unknown vantage point proves nothing).
 
+    Entries are a bare :class:`ControlClient` (single-shard standby) or
+    a ``(ControlClient, shard)`` tuple addressing one shard of a multi-
+    shard node.  The dict is read AT CALL TIME, so the fleet autopilot
+    retargets a shard's witness by mutating the entry in place — no
+    orchestrator rewiring.
+
     ``fresh_ms`` must comfortably exceed the primary's replication
     heartbeat interval (or idle gaps read as death) and sit below the
     orchestrator's detection budget (or a real death is vetoed once
     before the staleness shows)."""
 
     def witness(q: int) -> str:
-        ctl = standby_ctls.get(int(q))
-        if ctl is None:
+        entry = standby_ctls.get(int(q))
+        if entry is None:
             return "unknown"
+        if isinstance(entry, tuple):
+            ctl, shard = entry
+            kw = {"shard": int(shard)}
+        else:
+            ctl, kw = entry, {}
         # One retry: an "unknown" verdict cannot veto, so a single
         # dropped poll against a live standby must not let a healthy-
         # but-unreachable primary slip through to FENCING.
-        resp = ctl.try_call("probe")
+        resp = ctl.try_call("probe", **kw)
         if resp is None or not resp.get("ok"):
-            resp = ctl.try_call("probe")
+            resp = ctl.try_call("probe", **kw)
         if resp is None or not resp.get("ok"):
             return "unknown"
         age = resp.get("repl_rx_age_ms")

@@ -177,6 +177,22 @@ def health_payload(ctx: AppContext) -> dict:
             "pinned": st["pinned"],
             "adjustments": st["adjustments"],
         }
+    fleet = getattr(ctx, "fleet", None)
+    fleet_degraded: list = []
+    if fleet is not None:
+        # Fleet fold (ARCHITECTURE §16): a FAILED node means keyspace
+        # moved (or is moving) off a dead process; a DRAINING node is
+        # capacity scheduled out mid-rolling-upgrade.  Either is
+        # degraded capacity for the cell this process manages — never
+        # DOWN (the orchestrator's terminal-FAILED covers hard-down).
+        fleet_degraded = fleet.degraded_nodes()
+        payload["fleet"] = {
+            "live_nodes": fleet.live_nodes(),
+            "degraded_nodes": fleet_degraded,
+            "respawns": fleet.respawns,
+            "reseeds": fleet.reseeds,
+            "upgrade_steps": fleet.upgrade_steps,
+        }
     shedding = False
     window_s = ctx.props.get_float(
         "ratelimiter.overload.shed_health_window_ms", 5000.0) / 1000.0
@@ -219,9 +235,10 @@ def health_payload(ctx: AppContext) -> dict:
         payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
     elif not storage_up:
         payload["status"] = "DOWN"
-    elif degraded_shards:
+    elif degraded_shards or fleet_degraded:
         # One shard failed or running on a promoted replacement while
-        # the survivors serve: degraded capacity, not an outage.
+        # the survivors serve — or a managed fleet node is FAILED/
+        # DRAINING: degraded capacity, not an outage.
         payload["status"] = "DEGRADED"
     elif shedding:
         payload["status"] = "SHEDDING"
@@ -343,6 +360,11 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             if orch is None:
                 return self._json(200, {"enabled": False})
             return self._json(200, orch.status())
+        if self.path == "/actuator/fleet":
+            fleet = getattr(self.ctx, "fleet", None)
+            if fleet is None:
+                return self._json(200, {"enabled": False})
+            return self._json(200, {"enabled": True, **fleet.status()})
         if self.path.startswith("/actuator/trace"):
             trace = getattr(self.ctx.storage, "trace", None)
             if trace is None:

@@ -233,6 +233,24 @@ DEFAULTS = {
     # new table shape (LimiterTable._grow warns) — pre-size to the
     # expected tenant count.
     "ratelimiter.table.capacity": "64",
+    # Fleet autopilot (fleet/, ARCHITECTURE §16): OFF by default.  When
+    # enabled, this process runs a NodeManager that probes its managed
+    # hostproc nodes every probe_interval_ms (one muxed probe_all RPC
+    # per NODE), declares a node FAILED after probe_fail_threshold
+    # consecutive probe misses or a process exit, and surfaces the
+    # fleet on GET /actuator/fleet (FAILED/DRAINING nodes fold the
+    # health state machine to DEGRADED).  boot_timeout_s bounds a
+    # spawned node's wait for its ready line; reseed_deadline_s bounds
+    # every automated cross-host re-seed job (a job past it is failed
+    # loudly instead of wedging the cell at N+0); node_version is the
+    # deploy version tag replacement nodes are spawned at — a rolling
+    # upgrade bumps it, then drains nodes.
+    "ratelimiter.fleet.enabled": "false",
+    "ratelimiter.fleet.probe_interval_ms": "500",
+    "ratelimiter.fleet.probe_fail_threshold": "3",
+    "ratelimiter.fleet.boot_timeout_s": "180",
+    "ratelimiter.fleet.reseed_deadline_s": "120",
+    "ratelimiter.fleet.node_version": "v0",
 }
 
 # Typed keys: anything listed here is parse-checked at construction.
@@ -262,6 +280,7 @@ _INT_KEYS = (
     "ratelimiter.control.window_ms",
     "ratelimiter.control.max_concurrent",
     "ratelimiter.table.capacity",
+    "ratelimiter.fleet.probe_fail_threshold",
 )
 _FLOAT_KEYS = (
     "batcher.max_delay_ms", "chaos.failure_rate", "chaos.latency_ms",
@@ -290,6 +309,9 @@ _FLOAT_KEYS = (
     "ratelimiter.control.decrease_factor",
     "ratelimiter.control.floor_fraction",
     "ratelimiter.control.global_cap_per_s",
+    "ratelimiter.fleet.probe_interval_ms",
+    "ratelimiter.fleet.boot_timeout_s",
+    "ratelimiter.fleet.reseed_deadline_s",
 )
 _BOOL_KEYS = (
     "ratelimiter.fail_open", "warmup.enabled", "replication.enabled",
@@ -300,6 +322,7 @@ _BOOL_KEYS = (
     "ratelimiter.cache.hybrid.enabled",
     "ratelimiter.lease.enabled",
     "ratelimiter.control.enabled",
+    "ratelimiter.fleet.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")
 

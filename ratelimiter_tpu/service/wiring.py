@@ -131,8 +131,13 @@ class AppContext:
     # Adaptive policy controller (ratelimiter.control.enabled) — the
     # AIMD loop behind GET /actuator/policies (ARCHITECTURE §15).
     controller: object = None
+    # Fleet NodeManager (ratelimiter.fleet.enabled) — node lifecycle +
+    # autopilot substrate behind GET /actuator/fleet (ARCHITECTURE §16).
+    fleet: object = None
 
     def close(self) -> None:
+        if self.fleet is not None:
+            self.fleet.close()
         if self.controller is not None:
             self.controller.close()
         if self.control is not None:
@@ -420,6 +425,27 @@ def _maybe_controller(serving: RateLimitStorage, props: AppProperties,
     ).start()
 
 
+def _maybe_fleet(props: AppProperties, registry: MeterRegistry, recorder):
+    """Config-gated fleet NodeManager (OFF by default; ARCHITECTURE
+    §16).  Starts the probe cadence with an empty fleet — nodes are
+    spawned/adopted by operator tooling (or a FleetAutopilot attached
+    at runtime); the service plane contributes the actuator surface,
+    the health fold, and the ``ratelimiter.fleet.*`` metrics."""
+    if not props.get_bool("ratelimiter.fleet.enabled", False):
+        return None
+    from ratelimiter_tpu.fleet import LocalExecutor, NodeManager
+
+    return NodeManager(
+        executor=LocalExecutor(boot_timeout_s=props.get_float(
+            "ratelimiter.fleet.boot_timeout_s", 180.0)),
+        probe_interval_ms=props.get_float(
+            "ratelimiter.fleet.probe_interval_ms", 500.0),
+        probe_fail_threshold=props.get_int(
+            "ratelimiter.fleet.probe_fail_threshold", 3),
+        registry=registry, recorder=recorder,
+    ).start()
+
+
 def _maybe_retry(storage: RateLimitStorage, props: AppProperties):
     """Per-op retry around the (possibly chaos-wrapped) backend — the
     RedisRateLimitStorage.java:155-178 analog, composed so transient faults
@@ -680,6 +706,7 @@ def build_app(props: AppProperties | None = None,
     leases = None
     control = None
     controller = None
+    fleet = None
     if own_storage:
         # Self-healing failover (the orchestrator owns its OWN per-shard
         # replication into an in-process standby mesh, so it supersedes
@@ -753,6 +780,7 @@ def build_app(props: AppProperties | None = None,
         # (router when present) and reads the breaker's overload state.
         controller = _maybe_controller(serving, props, registry, breaker,
                                        recorder)
+        fleet = _maybe_fleet(props, registry, recorder)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache
@@ -802,4 +830,5 @@ def build_app(props: AppProperties | None = None,
         leases=leases,
         control=control,
         controller=controller,
+        fleet=fleet,
     )

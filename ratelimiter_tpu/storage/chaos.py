@@ -2285,6 +2285,411 @@ def cross_host_failover_drill(
                     proc.kill()
 
 
+def rolling_upgrade_drill(
+    num_slots: int = 256,
+    n_keys: int = 24,
+    waves: int = 2,
+    pipeline: int = 12,
+    seed: int = 0,
+    zipf_s: float = 1.1,
+    probe_interval_ms: float = 100.0,
+    suspect_threshold: int = 3,
+    hysteresis_ms: float = 300.0,
+    lease_ttl_ms: float = 1200.0,
+    witness_fresh_ms: float = 500.0,
+    reseed_deadline_s: float = 90.0,
+    boot_timeout_s: float = 180.0,
+    full: bool = False,
+    registry=None,
+) -> dict:
+    """Zero-loss rolling upgrade of a LIVE 2-shard cross-host cell
+    (ARCHITECTURE §16): every node is replaced one at a time while
+    Zipf-distributed traffic keeps flowing, with a mid-upgrade hard
+    kill of the serving node thrown in — and every decision the cell
+    emits stays bit-identical to ``semantics/oracle.py``.
+
+    Topology (``full=False``, the fast CI shape): one 2-shard primary
+    node ``P`` and one 2-shard standby node ``S``, both at ``--version
+    v1``, run as real ``hostproc`` subprocesses under a
+    :class:`~ratelimiter_tpu.fleet.manager.NodeManager`; this process
+    plays the orchestrator + FleetAutopilot.  ``full=True`` (the slow
+    soak) splits the primaries onto two single-shard nodes — a 3-node
+    cell, drained one node at a time.
+
+    The ladder:
+
+    1. **Graceful standby swap** — spawn ``S2`` at v2, RETARGET both
+       shards' replication streams at it (control-RPC full re-baseline,
+       no restart of the primary), hand the consistent v2 replicas to
+       the orchestrator (StandbySet + witness + lease-relay rewire via
+       ``FleetAutopilot.install_standby``), retire ``S``.  Traffic
+       never pauses.
+    2. **Drain the serving node(s)** — ``mark_draining`` flips the
+       drain-aware probe/witness: the orchestrator fences (deliverable
+       — the node is healthy, just scheduled out) and promotes each
+       shard onto the v2 standby.  The autopilot notices each consumed
+       standby and — with ZERO operator calls — spawns a fresh v2
+       node, re-targets the new serving side's stream at it, and hands
+       the consistent replica back: the cell is N+1 again, inside
+       ``reseed_deadline_s`` (asserted per job).
+    3. **Mid-upgrade primary kill** — SIGKILL the node that now serves
+       both shards.  Fence undeliverable -> the orchestrator waits out
+       the serving lease TTL, promotes the re-seeded standbys, and the
+       autopilot re-seeds AGAIN.  Decisions pinned before the kill
+       (explicit ``ship``) are all in the replicas: zero decision loss.
+
+    End state: every live node is at v2, every shard is promoted with
+    a consistent unpromoted standby (N+1), and the full decision
+    stream — across two handovers per shard — matched the oracle
+    bit-for-bit.  Raises AssertionError on any violated claim.
+    """
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.fleet import (
+        DRAINING as NODE_DRAINING,
+        FleetAutopilot,
+        LocalExecutor,
+        NodeManager,
+    )
+    from ratelimiter_tpu.replication.control import ControlClient
+    from ratelimiter_tpu.replication.orchestrator import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+    )
+    from ratelimiter_tpu.replication.remote import (
+        FanoutLeaseChannel,
+        RemoteBackend,
+        RemoteReceiver,
+        RemoteShardDirectory,
+        RemoteStandbySet,
+        standby_witness,
+    )
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.service import sidecar as sc
+
+    rng = random.Random(seed)
+    # Same order-only policies as cross_host_failover_drill: decisions
+    # depend only on arrival ORDER, so subprocess clock skew cannot
+    # move a single verdict.
+    GIANT_WINDOW = 1 << 30
+    cfg_tb = RateLimitConfig(max_permits=30, window_ms=GIANT_WINDOW,
+                             refill_rate=1e-9)
+    assert cfg_tb.refill_rate_fp == 0, "drill needs an order-only bucket"
+    cfg_sw = RateLimitConfig(max_permits=18, window_ms=GIANT_WINDOW,
+                             enable_local_cache=False)
+    limiters = [
+        {"algo": "tb", "max_permits": cfg_tb.max_permits,
+         "window_ms": cfg_tb.window_ms, "refill_rate": cfg_tb.refill_rate},
+        {"algo": "sw", "max_permits": cfg_sw.max_permits,
+         "window_ms": cfg_sw.window_ms},
+    ]
+    NOW = 1_753_000_000_000  # fixed oracle stamp (its window never rolls)
+    # Zipf(s) traffic over the keyspace; keys land on shards by parity.
+    zipf_w = [1.0 / float(r + 1) ** zipf_s for r in range(n_keys)]
+
+    clients: list = []
+    mgr = None
+    orch = None
+
+    def ctl(port, timeout=0.5):
+        c = ControlClient("127.0.0.1", port, timeout=timeout)
+        clients.append(c)
+        return c
+
+    def poll(pred, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    report = {"decisions": 0, "mismatches": 0,
+              "mode": "full" if full else "fast"}
+    try:
+        # -- topology: the v1 cell under fleet management -----------------
+        mgr = NodeManager(
+            executor=LocalExecutor(boot_timeout_s=boot_timeout_s),
+            probe_interval_ms=probe_interval_ms,
+            probe_timeout_s=1.0, registry=registry)
+        s_node = mgr.spawn("S", "standby", shards=2, version="v1",
+                           num_slots=num_slots, repl_interval_ms=100.0,
+                           boot_timeout_s=boot_timeout_s)
+        placements = {}
+        if full:
+            for q in (0, 1):
+                p_node = mgr.spawn(
+                    f"P{q}", "primary", shards=1, version="v1",
+                    num_slots=num_slots, limiters=limiters,
+                    repl_targets=[f"127.0.0.1:{s_node.repl_ports()[q]}"],
+                    repl_interval_ms=100.0, boot_timeout_s=boot_timeout_s)
+                placements[q] = (p_node.name, 0)
+                mgr.mark_serving(p_node.name)
+        else:
+            p_node = mgr.spawn(
+                "P", "primary", shards=2, version="v1",
+                num_slots=num_slots, limiters=limiters,
+                repl_targets=[f"127.0.0.1:{pt}"
+                              for pt in s_node.repl_ports()],
+                repl_interval_ms=100.0, boot_timeout_s=boot_timeout_s)
+            placements = {0: ("P", 0), 1: ("P", 1)}
+            mgr.mark_serving("P")
+
+        def lids_of(node, shard_on_node):
+            v = node.ready["lids"]
+            if v and isinstance(v[0], list):
+                return list(v[shard_on_node])
+            return list(v)
+
+        lids, cli, backends = {}, {}, {}
+        for q, (pname, pshard) in placements.items():
+            node = mgr.node(pname)
+            lids[q] = lids_of(node, pshard)
+            cli[q] = sc.SidecarClient("127.0.0.1",
+                                      node.sidecar_ports()[pshard])
+            assert cli[q].server_version >= 3, "primary handshake failed"
+            backends[q] = RemoteBackend(ctl(node.control_port),
+                                        label=pname, shard=pshard)
+
+        directory = RemoteShardDirectory(backends)
+        rxs = [RemoteReceiver(ctl(s_node.control_port, timeout=2.0),
+                              promote_timeout_s=60.0, shard=q)
+               for q in (0, 1)]
+        standby_set = RemoteStandbySet(rxs)
+        witness_ctls = {q: (ctl(s_node.control_port), q) for q in (0, 1)}
+        inner_witness = standby_witness(witness_ctls,
+                                        fresh_ms=witness_fresh_ms)
+        lease_channels = {
+            q: FanoutLeaseChannel(backends[q],
+                                  ctl(s_node.control_port), shard=q)
+            for q in (0, 1)}
+
+        pilot = FleetAutopilot(
+            mgr, None, standby_set, witness_ctls,
+            node_defaults=dict(host="127.0.0.1", num_slots=num_slots,
+                               repl_interval_ms=100.0,
+                               boot_timeout_s=boot_timeout_s),
+            version="v2", reseed_deadline_s=reseed_deadline_s)
+        witness = pilot.witness_wrap(inner_witness)
+
+        def probe(q):
+            # Drain-fold: a shard still on its ORIGINAL backend whose
+            # serving node is DRAINING probes "down" so the
+            # orchestrator promotes away.  Once a replacement is
+            # installed the fold is bypassed — the autopilot's binding
+            # swap may trail the promotion by a manager tick, and the
+            # stale DRAINING read must not re-suspect a shard that
+            # already moved.
+            if directory.replacements.get(q) is None:
+                entry = pilot.serving_placement(q)
+                if entry is not None:
+                    node = mgr.nodes.get(entry[0])
+                    if node is not None and node.state == NODE_DRAINING:
+                        return False
+            backend = directory.serving(q)
+            return backend is not None and backend.is_available()
+
+        orch = FailoverOrchestrator(
+            directory, standby_set, None, standby_factory=None,
+            config=OrchestratorConfig(
+                probe_interval_ms=probe_interval_ms,
+                suspect_threshold=suspect_threshold,
+                hysteresis_ms=hysteresis_ms,
+                promote_retries=2, promote_backoff_ms=100.0,
+                reseed=False,
+                fence_lease_ttl_ms=lease_ttl_ms,
+                fence_wait_slack_ms=150.0),
+            probe=probe, witness=witness, lease_channels=lease_channels,
+            witness_fresh_ms=witness_fresh_ms,
+            repl_heartbeat_ms=100.0,
+            registry=registry).start()
+        pilot.orch = orch
+        for q, placement in placements.items():
+            pilot.bind(q, placement, ("S", q))
+        mgr.attach(pilot)
+        mgr.start()
+
+        # -- oracle-checked Zipf traffic ----------------------------------
+        oracles = {q: (TokenBucketOracle(cfg_tb),
+                       SlidingWindowOracle(cfg_sw)) for q in (0, 1)}
+
+        def wave(n=None):
+            ids = rng.choices(range(n_keys), weights=zipf_w,
+                              k=n or pipeline)
+            perms = [rng.choice([1, 1, 2, 3]) for _ in ids]
+            by_shard = {0: [], 1: []}
+            for kid, pm in zip(ids, perms):
+                by_shard[kid % 2].append((f"k{kid}", pm))
+            for q, items in by_shard.items():
+                if not items:
+                    continue
+                keys = [k for k, _ in items]
+                ps = [pm for _, pm in items]
+                for slot, oracle in enumerate(oracles[q]):
+                    got = cli[q].acquire_batch(lids[q][slot], keys, ps)
+                    for j, (status, allowed, rem) in enumerate(got):
+                        assert status == sc.ST_OK, (q, slot, j, status)
+                        d = oracle.try_acquire(keys[j], ps[j], NOW)
+                        report["decisions"] += 1
+                        if allowed != d.allowed or (
+                                slot == 0
+                                and int(rem) != d.remaining_hint):
+                            report["mismatches"] += 1
+
+        def ship(q):
+            """Pin shard q's replica byte-exact (the zero-loss cut
+            protocol: pause -> ship -> cut)."""
+            pname, pshard = pilot.serving_placement(q)
+            node = mgr.node(pname)
+            ctl(node.control_port, timeout=15.0).call_ok(
+                "ship", shard=pshard, timeout=15.0)
+
+        # -- healthy phase ------------------------------------------------
+        for _ in range(max(waves, 1)):
+            wave()
+        poll(lambda: all(r.consistent and r.last_epoch >= 1 for r in rxs),
+             60.0, "v1 standby consistency after the healthy phase")
+        poll(lambda: all(
+            directory.serving(q).serving_lease_info()["installed"]
+            for q in (0, 1)), 10.0, "the first serving-lease grants")
+
+        # -- step 1: graceful standby swap S -> S2 (v2) -------------------
+        s2 = mgr.spawn("S2", "standby", shards=2, version="v2",
+                       num_slots=num_slots, repl_interval_ms=100.0,
+                       boot_timeout_s=boot_timeout_s)
+        for q in (0, 1):
+            backends[q].retarget("127.0.0.1", s2.repl_ports()[q],
+                                 timeout_s=60.0)
+            r = RemoteReceiver(ctl(s2.control_port, timeout=2.0),
+                               promote_timeout_s=60.0, shard=q)
+            poll(lambda r=r: r.consistent and not r.promoted, 30.0,
+                 f"v2 standby consistency for shard {q}")
+            pilot.install_standby(q, "S2", q, r,
+                                  serving_backend=backends[q])
+        mgr.retire("S")
+        mgr.note_upgrade_step()
+        for _ in range(max(waves, 1)):
+            wave()
+
+        # -- step 2: drain the serving node(s); autopilot re-seeds --------
+        drain_list = ["P0", "P1"] if full else ["P"]
+        for pname in drain_list:
+            qs = [q for q in (0, 1)
+                  if pilot.serving_placement(q)[0] == pname]
+            for q in qs:
+                ship(q)
+            cur_rx = {q: standby_set.receivers[q] for q in qs}
+            poll(lambda: all(cur_rx[q].consistent for q in qs), 10.0,
+                 f"replicas pinned before draining {pname}")
+            promos_before = orch.promotions
+            reseeds_before = mgr.reseeds
+            t_drain = time.monotonic()
+            mgr.mark_draining(pname)
+            poll(lambda: orch.promotions >= promos_before + len(qs)
+                 and all(directory.shard_health()[q] == "promoted"
+                         for q in qs),
+                 30.0, f"graceful promote-away from {pname}")
+            promote_s = time.monotonic() - t_drain
+            for q in qs:
+                poll(lambda q=q: cur_rx[q].serve_port, 10.0,
+                     f"promoted serve port for shard {q}")
+                cli[q] = sc.SidecarClient("127.0.0.1",
+                                          cur_rx[q].serve_port)
+            for _ in range(max(waves, 1)):
+                wave()
+            poll(lambda: mgr.reseeds >= reseeds_before + len(qs),
+                 reseed_deadline_s + 60.0,
+                 f"automated re-seed to N+1 after draining {pname}")
+            for q in qs:
+                r = standby_set.receivers[q]
+                assert r.consistent and not r.promoted, (
+                    f"shard {q} re-seed handed back an unusable standby")
+            mgr.retire(pname)
+            mgr.note_upgrade_step()
+            report[f"drain_{pname}"] = {"promote_s": round(promote_s, 3)}
+            for _ in range(max(waves, 1)):
+                wave()
+
+        # -- step 3: mid-upgrade hard kill of the serving node ------------
+        victim = pilot.serving_placement(0)[0]
+        assert victim == "S2" \
+            and pilot.serving_placement(1)[0] == victim, (
+                "upgrade ladder did not converge on the v2 node")
+        for q in (0, 1):
+            ship(q)
+        cur_rx = {q: standby_set.receivers[q] for q in (0, 1)}
+        poll(lambda: all(cur_rx[q].consistent for q in (0, 1)), 10.0,
+             "fresh standbys pinned before the kill")
+        promos_before = orch.promotions
+        reseeds_before = mgr.reseeds
+        t_kill = time.monotonic()
+        mgr.kill(victim)
+        poll(lambda: orch.promotions >= promos_before + 2
+             and all(directory.shard_health()[q] == "promoted"
+                     for q in (0, 1)),
+             60.0, "promotion after the mid-upgrade primary kill")
+        kill_promote_s = time.monotonic() - t_kill
+        # The fence was undeliverable, so the promotion must have
+        # waited out the serving lease the dead node still held.
+        assert kill_promote_s >= lease_ttl_ms / 1000.0 * 0.5, (
+            f"promotion after the kill landed in {kill_promote_s:.2f}s "
+            f"— inside the {lease_ttl_ms / 1000.0:.2f}s lease TTL the "
+            f"dead node could still have been serving under")
+        for q in (0, 1):
+            poll(lambda q=q: cur_rx[q].serve_port, 10.0,
+                 f"post-kill serve port for shard {q}")
+            cli[q] = sc.SidecarClient("127.0.0.1", cur_rx[q].serve_port)
+        for _ in range(max(waves, 1)):
+            wave()
+        poll(lambda: mgr.reseeds >= reseeds_before + 2,
+             reseed_deadline_s + 60.0,
+             "automated re-seed to N+1 after the kill")
+        for _ in range(max(waves, 1)):
+            wave()
+
+        # -- end state: v2 fleet, N+1 everywhere, zero divergence ---------
+        for name in mgr.live_nodes():
+            node = mgr.node(name)
+            assert node.version == "v2", (
+                f"live node {name} still at {node.version}")
+        for q in (0, 1):
+            r = standby_set.receivers[q]
+            assert r.consistent and not r.promoted, (
+                f"shard {q} ended without a consistent standby (N+0)")
+            assert directory.shard_health()[q] == "promoted"
+        assert not pilot.failed_jobs, pilot.failed_jobs
+        assert pilot.completed and all(
+            c["elapsed_s"] <= reseed_deadline_s
+            for c in pilot.completed), (
+            f"a re-seed job overran its deadline: {pilot.completed}")
+        expected_steps = 3 if full else 2
+        assert mgr.upgrade_steps == expected_steps, mgr.upgrade_steps
+        assert orch.promotions == 4, orch.status()
+        assert mgr.reseeds == 4 and mgr.respawns == 4, mgr.status()
+        report.update(
+            promotions=orch.promotions, respawns=mgr.respawns,
+            reseeds=mgr.reseeds, upgrade_steps=mgr.upgrade_steps,
+            kill_promote_s=round(kill_promote_s, 3),
+            reseed_elapsed_s=[c["elapsed_s"] for c in pilot.completed],
+            fleet=mgr.status(), orchestrator=orch.status())
+        if report["mismatches"]:
+            raise AssertionError(
+                f"rolling upgrade diverged from the oracle: {report}")
+        return report
+    finally:
+        if orch is not None:
+            orch.close()
+        if mgr is not None:
+            mgr.close()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
 # ---------------------------------------------------------------------------
 # Sustained-outage drill (breaker open -> degraded -> resync -> bit-identical)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,505 @@
+"""Fleet autopilot (ISSUE 16): node lifecycle, the executor boundary's
+boot pathologies, the strict ready-line contract, automated re-seed
+bookkeeping, and the service-plane fold.
+
+Layers under test, bottom-up:
+
+- parse_ready (replication/remote.py): the explicit ``lid_base``
+  contract — registered lids without a base (or a disagreeing one)
+  fail loudly instead of silently assuming the lids-start-at-1
+  convention; pre-fleet lines normalize to one v0 shard;
+- mux_handlers (replication/control.py): shard-addressed dispatch and
+  the one-RPC-per-node ``probe_all``;
+- LocalExecutor (fleet/executor.py): every boot pathology —
+  spawn timeout, early exit, malformed/non-object ready line — is a
+  typed SpawnError, and a REAL hostproc node honors stdin EOF (clean
+  rc=0 through retire());
+- NodeManager (fleet/manager.py): lifecycle transitions and their
+  refusals, double-adopt refusal (name and control endpoint), the
+  probe-fail streak and process-exit paths to FAILED;
+- FleetAutopilot (fleet/autopilot.py): the drain-aware witness wrap
+  and the re-seed deadline (a wedged job FAILS loudly, never wedges
+  the tick);
+- FailoverOrchestrator._validate_timing: the misconfiguration warnings
+  (flight events, never raises);
+- service plane: GET /actuator/fleet and the FAILED/DRAINING ->
+  DEGRADED health fold;
+- the full thing: rolling_upgrade_drill — every node of a live 2-shard
+  cell replaced under Zipf traffic with a mid-upgrade primary kill,
+  bit-identical to the oracle, N+1 at the end.
+"""
+
+import sys
+import threading
+import types
+
+import pytest
+
+from ratelimiter_tpu.fleet import (
+    DRAINING,
+    FAILED,
+    LocalExecutor,
+    NodeManager,
+    READY,
+    RETIRED,
+    SERVING,
+    SpawnError,
+)
+from ratelimiter_tpu.fleet.autopilot import FleetAutopilot
+from ratelimiter_tpu.replication.control import mux_handlers
+from ratelimiter_tpu.replication.remote import parse_ready
+
+
+class _Recorder:
+    """Flight-recorder stub: captures (kind, fields) tuples."""
+
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+class _Ctl:
+    """ControlClient stub with a scripted probe answer."""
+
+    def __init__(self, answer="ok", shards=1):
+        self.answer = answer
+        self.shards = shards
+        self.closed = False
+        self.calls = []
+
+    def try_call(self, op, timeout=None, **kw):
+        self.calls.append(op)
+        if self.answer == "dead":
+            return None
+        if op == "probe_all":
+            if self.answer == "bare":
+                return None  # pre-fleet node: no mux, fall back
+            return {"ok": True, "shards": {
+                str(q): {"ok": True, "available": True}
+                for q in range(self.shards)}}
+        if op == "probe":
+            return {"ok": True, "available": True}
+        return None
+
+    def close(self):
+        self.closed = True
+
+
+class _DeadExecutor:
+    """Executor whose processes are never alive (exit-detection path)."""
+
+    def alive(self, handle):
+        return False
+
+    def terminate(self, handle, grace_s=10.0):
+        pass
+
+    def kill(self, handle):
+        pass
+
+
+_READY = {"ready": True, "role": "primary", "control_port": 7001}
+
+
+def _manager(**kw):
+    kw.setdefault("recorder", _Recorder())
+    return NodeManager(executor=kw.pop("executor", _DeadExecutor()), **kw)
+
+
+# ---------------------------------------------------------------------------
+# parse_ready: the explicit lid_base contract
+# ---------------------------------------------------------------------------
+
+def test_parse_ready_requires_lid_base_with_lids():
+    with pytest.raises(ValueError, match="no lid_base"):
+        parse_ready({"ready": True, "role": "primary",
+                     "control_port": 1, "lids": [3, 4]})
+
+
+def test_parse_ready_rejects_disagreeing_lid_base():
+    with pytest.raises(ValueError, match="disagrees with min"):
+        parse_ready({"ready": True, "role": "primary", "control_port": 1,
+                     "lids": [3, 4], "lid_base": 1})
+
+
+def test_parse_ready_flattens_multi_shard_lid_lists():
+    info = parse_ready({"ready": True, "role": "standby",
+                        "control_port": 1, "shards": 2,
+                        "lids": [[5, 6], [5, 6]], "lid_base": 5})
+    assert info["shards"] == 2
+
+
+def test_parse_ready_normalizes_pre_fleet_lines():
+    # A pre-fleet node's line (no shards/version) is one v0 shard;
+    # scalar lids back-compat rides the same path.
+    info = parse_ready({"ready": True, "role": "primary",
+                        "control_port": 1, "lids": [1, 2], "lid_base": 1})
+    assert info["shards"] == 1 and info["version"] == "v0"
+
+
+@pytest.mark.parametrize("line, match", [
+    ({"role": "primary", "control_port": 1}, "not a hostproc ready"),
+    ({"ready": True, "role": "primary"}, "missing control_port"),
+    ({"ready": True, "role": "witness", "control_port": 1},
+     "unknown role"),
+    ("ready", "not a hostproc ready"),
+])
+def test_parse_ready_rejects_malformed_lines(line, match):
+    with pytest.raises(ValueError, match=match):
+        parse_ready(line)
+
+
+# ---------------------------------------------------------------------------
+# mux_handlers: shard addressing + probe_all
+# ---------------------------------------------------------------------------
+
+def test_mux_dispatch_and_probe_all():
+    handlers = mux_handlers({
+        0: {"probe": lambda: {"available": True},
+            "poke": lambda x: {"shard": 0, "x": x}},
+        1: {"probe": lambda: {"available": False}},
+    }, extra={"version": lambda: {"v": "v1"}})
+    # Default shard is 0 (single-shard callers keep working verbatim).
+    assert handlers["poke"](x=9) == {"shard": 0, "x": 9}
+    out = handlers["probe_all"]()["shards"]
+    assert out["0"] == {"ok": True, "available": True}
+    assert out["1"] == {"ok": True, "available": False}
+    assert handlers["version"]() == {"v": "v1"}
+    with pytest.raises(ValueError, match="unknown shard"):
+        handlers["probe"](shard=7)
+    with pytest.raises(ValueError, match="not served by shard"):
+        handlers["poke"](shard=1, x=1)
+
+
+def test_probe_all_isolates_a_raising_shard():
+    handlers = mux_handlers({
+        0: {"probe": lambda: {"available": True}},
+        1: {"probe": lambda: (_ for _ in ()).throw(RuntimeError("boom"))},
+    })
+    out = handlers["probe_all"]()["shards"]
+    assert out["0"]["ok"] is True
+    assert out["1"]["ok"] is False and "boom" in out["1"]["error"]
+
+
+# ---------------------------------------------------------------------------
+# LocalExecutor: boot pathologies through argv_prefix overrides
+# ---------------------------------------------------------------------------
+
+def _pathological(script, timeout):
+    return LocalExecutor(argv_prefix=[sys.executable, "-c", script],
+                         boot_timeout_s=timeout)
+
+
+def test_spawn_timeout_is_a_spawn_error():
+    ex = _pathological("import time; time.sleep(60)", 0.5)
+    with pytest.raises(SpawnError, match="no ready line within"):
+        ex.spawn([])
+
+
+def test_early_exit_is_a_spawn_error():
+    ex = _pathological("raise SystemExit(3)", 10.0)
+    # rc may lag the EOF (the child is not reaped yet when readline
+    # returns), so only the pathology class is asserted, not the code.
+    with pytest.raises(SpawnError, match="before printing a ready line"):
+        ex.spawn([])
+
+
+def test_malformed_ready_line_is_a_spawn_error():
+    ex = _pathological("print('not json'); import time; time.sleep(60)",
+                       10.0)
+    with pytest.raises(SpawnError, match="malformed ready line"):
+        ex.spawn([])
+
+
+def test_non_object_ready_line_is_a_spawn_error():
+    ex = _pathological("print('[1, 2]'); import time; time.sleep(60)",
+                       10.0)
+    with pytest.raises(SpawnError, match="not a JSON object"):
+        ex.spawn([])
+
+
+def test_hostproc_honors_stdin_eof():
+    """A REAL standby node spawned through the manager retires with a
+    clean rc=0 on stdin EOF — the graceful half of every rolling-
+    upgrade step."""
+    mgr = NodeManager(probe_interval_ms=60_000.0, recorder=_Recorder())
+    try:
+        node = mgr.spawn("n", "standby", shards=1, num_slots=128,
+                         boot_timeout_s=180.0)
+        assert node.state == READY and node.role == "standby"
+        mgr.retire("n", grace_s=20.0)
+        assert node.state == RETIRED
+        assert node.handle.proc.returncode == 0, (
+            "hostproc ignored stdin EOF (escalated to terminate/kill)")
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# NodeManager lifecycle
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_transitions_and_refusals():
+    rec = _Recorder()
+    mgr = _manager(recorder=rec)
+    node = mgr.adopt("a", dict(_READY), ctl=_Ctl())
+    assert node.state == READY
+    mgr.mark_serving("a")
+    assert node.state == SERVING
+    mgr.mark_draining("a")
+    assert node.state == DRAINING
+    assert mgr.degraded_nodes() == ["a"]
+    with pytest.raises(ValueError, match="cannot serve"):
+        mgr.mark_serving("a")  # DRAINING never un-drains back to SERVING
+    mgr.retire("a")
+    assert node.state == RETIRED and node.ctl.closed
+    with pytest.raises(ValueError, match="cannot drain"):
+        mgr.mark_draining("a")
+    mgr.retire("a")  # terminal retire is idempotent
+    assert [k for k in rec.kinds() if k == "fleet.transition"], rec.events
+
+
+def test_adopt_refuses_duplicate_name_and_endpoint():
+    mgr = _manager()
+    mgr.adopt("a", dict(_READY), ctl=_Ctl())
+    with pytest.raises(ValueError, match="already managed"):
+        mgr.adopt("a", {"ready": True, "role": "primary",
+                        "control_port": 7002}, ctl=_Ctl())
+    with pytest.raises(ValueError, match="double-adopt"):
+        mgr.adopt("b", dict(_READY), ctl=_Ctl())
+    # A FAILED node releases its endpoint: the replacement can re-bind.
+    mgr.fail("a")
+    mgr.adopt("b", dict(_READY), ctl=_Ctl())
+    assert mgr.live_nodes() == ["b"]
+
+
+def test_probe_fail_streak_declares_failed():
+    mgr = _manager(probe_fail_threshold=3)
+    ctl = _Ctl(answer="dead")
+    node = mgr.adopt("a", dict(_READY), ctl=ctl)
+    mgr.tick()
+    mgr.tick()
+    assert node.state == READY and node.probe_fail_streak == 2
+    mgr.tick()
+    assert node.state == FAILED and ctl.closed
+    assert "3 consecutive probe failures" in node.last_error
+    assert mgr.degraded_nodes() == ["a"]
+    streak = node.probe_fail_streak
+    mgr.tick()  # terminal nodes are left alone
+    assert node.probe_fail_streak == streak
+
+
+def test_process_exit_declares_failed():
+    mgr = _manager()
+    node = mgr.adopt("a", dict(_READY), ctl=_Ctl(), handle=object())
+    mgr.tick()
+    assert node.state == FAILED and node.last_error == "process exited"
+
+
+def test_probe_all_and_bare_probe_fallback():
+    mgr = _manager()
+    muxed = mgr.adopt("m", {"ready": True, "role": "primary",
+                            "control_port": 7001, "shards": 2},
+                      ctl=_Ctl(shards=2))
+    bare = mgr.adopt("b", {"ready": True, "role": "primary",
+                           "control_port": 7002}, ctl=_Ctl(answer="bare"))
+    mgr.tick()
+    assert sorted(muxed.last_probe) == ["0", "1"]
+    assert list(bare.last_probe) == ["0"]  # pre-fleet single-shard shape
+    assert bare.ctl.calls == ["probe_all", "probe"]
+    st = mgr.status()["nodes"]
+    assert st["m"]["state"] == READY and st["b"]["state"] == READY
+
+
+# ---------------------------------------------------------------------------
+# FleetAutopilot: drain-aware witness + the re-seed deadline
+# ---------------------------------------------------------------------------
+
+def _autopilot(mgr, standby_set, clock, **kw):
+    orch = kw.pop("orch", types.SimpleNamespace(
+        router=types.SimpleNamespace(serving=lambda q: object()),
+        cfg=types.SimpleNamespace(fence_lease_ttl_ms=0.0)))
+    return FleetAutopilot(mgr, orch, standby_set, witness_ctls={},
+                          recorder=kw.pop("recorder", _Recorder()),
+                          clock=lambda: clock["t"], **kw)
+
+
+def test_witness_wrap_folds_draining_to_dead():
+    mgr = types.SimpleNamespace(
+        nodes={"P": types.SimpleNamespace(state=DRAINING)})
+    standby_set = types.SimpleNamespace(n_shards=2, receivers=[])
+    pilot = _autopilot(mgr, standby_set, {"t": 0.0})
+    pilot.bind(0, ("P", 0), ("S", 0))
+    witness = pilot.witness_wrap(lambda q: "alive")
+    assert witness(0) == "dead"  # serving node is scheduled out
+    assert witness(1) == "alive"  # unbound shard defers to the inner
+    mgr.nodes["P"].state = SERVING
+    assert witness(0) == "alive"
+
+
+def test_reseed_deadline_fails_loudly_without_wedging():
+    class _Mgr:
+        nodes = {}
+
+        def mark_serving(self, name):
+            pass
+
+        def spawn(self, *a, **kw):
+            raise RuntimeError("no capacity")
+
+    rx = types.SimpleNamespace(promoted=True, consistent=False)
+    standby_set = types.SimpleNamespace(n_shards=1, receivers=[rx])
+    clock = {"t": 0.0}
+    rec = _Recorder()
+    pilot = _autopilot(_Mgr(), standby_set, clock, recorder=rec,
+                       reseed_deadline_s=5.0)
+    pilot.bind(0, ("P", 0), ("S", 0))
+    pilot.tick()
+    assert pilot.status()["jobs"]["0"]["state"] == "spawn"
+    assert "RuntimeError: no capacity" in pilot._jobs[0]["error"]
+    # The consumed standby became the serving binding.
+    assert pilot.serving_placement(0) == ("S", 0)
+    clock["t"] = 6.0
+    pilot.tick()  # past the deadline: loud failure, job slot released
+    assert pilot._jobs == {}
+    assert len(pilot.failed_jobs) == 1
+    assert pilot.failed_jobs[0]["q"] == 0
+    assert "no capacity" in pilot.failed_jobs[0]["error"]
+    assert "fleet.reseed_deadline" in rec.kinds()
+    pilot.tick()  # the standby is still consumed: a fresh job reopens
+    assert pilot.status()["jobs"]["0"]["state"] == "spawn"
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator timing validation (warn, never raise)
+# ---------------------------------------------------------------------------
+
+def _orch(rec, cfg_kw=None, **kw):
+    from ratelimiter_tpu.replication.orchestrator import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+    )
+
+    router = types.SimpleNamespace(n_shards=1)
+    return FailoverOrchestrator(
+        router, None, None,
+        config=OrchestratorConfig(probe_interval_ms=100.0,
+                                  suspect_threshold=3,
+                                  hysteresis_ms=500.0,
+                                  **(cfg_kw or {})),
+        recorder=rec, **kw)
+
+
+def _problems(rec):
+    return [f["problem"] for k, f in rec.events
+            if k == "orchestrator.misconfigured"]
+
+
+def test_misconfiguration_warnings_fire_at_construction():
+    rec = _Recorder()
+    # Budget = 4 probes * 100ms + 500ms hysteresis = 900ms.
+    _orch(rec, witness_fresh_ms=100.0, repl_heartbeat_ms=100.0)
+    assert any("under the replication" in p for p in _problems(rec))
+
+    rec = _Recorder()
+    _orch(rec, witness_fresh_ms=900.0, repl_heartbeat_ms=100.0)
+    assert any("at or past the detection" in p for p in _problems(rec))
+
+    rec = _Recorder()
+    _orch(rec, cfg_kw={"fence_lease_ttl_ms": 800.0})
+    assert any("fence_lease_ttl_ms" in p for p in _problems(rec))
+
+
+def test_well_configured_orchestrator_records_nothing():
+    rec = _Recorder()
+    _orch(rec, cfg_kw={"fence_lease_ttl_ms": 2000.0},
+          witness_fresh_ms=400.0, repl_heartbeat_ms=100.0)
+    assert _problems(rec) == []
+
+
+# ---------------------------------------------------------------------------
+# Service plane: GET /actuator/fleet + the health fold
+# ---------------------------------------------------------------------------
+
+def test_fleet_actuator_and_health_fold():
+    import http.client
+    import json as _json
+
+    from ratelimiter_tpu.service.app import health_payload, make_server
+    from ratelimiter_tpu.service.props import AppProperties
+    from ratelimiter_tpu.service.wiring import build_app
+
+    # OFF by default: no manager wired, no fleet section in health.
+    ctx0 = build_app(AppProperties({"storage.backend": "memory"}))
+    try:
+        assert ctx0.fleet is None
+        assert "fleet" not in health_payload(ctx0)
+    finally:
+        ctx0.close()
+
+    ctx = build_app(AppProperties({
+        "storage.backend": "memory",
+        "ratelimiter.fleet.enabled": "true",
+        "ratelimiter.fleet.probe_interval_ms": "60000",
+    }))
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        ctx.fleet.adopt("n1", dict(_READY), ctl=_Ctl())
+        payload = health_payload(ctx)
+        assert payload["status"] == "UP"
+        assert payload["fleet"]["live_nodes"] == ["n1"]
+        assert payload["fleet"]["degraded_nodes"] == []
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10)
+        conn.request("GET", "/actuator/fleet")
+        body = _json.loads(conn.getresponse().read())
+        conn.close()
+        assert body["enabled"] is True
+        assert body["nodes"]["n1"]["state"] == READY
+
+        # FAILED folds the cell to DEGRADED — capacity moved or moving,
+        # never DOWN (the orchestrator's terminal-FAILED covers that).
+        ctx.fleet.fail("n1", "declared dead by test")
+        payload = health_payload(ctx)
+        assert payload["status"] == "DEGRADED"
+        assert payload["fleet"]["degraded_nodes"] == ["n1"]
+    finally:
+        srv.shutdown()
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# The multi-process drill
+# ---------------------------------------------------------------------------
+
+def test_rolling_upgrade_drill_fast():
+    from ratelimiter_tpu.storage.chaos import rolling_upgrade_drill
+
+    report = rolling_upgrade_drill()
+    assert report["mismatches"] == 0 and report["decisions"] > 0
+    assert report["promotions"] == 4
+    assert report["respawns"] == 4 and report["reseeds"] == 4
+    assert report["upgrade_steps"] == 2
+    # The mid-upgrade kill's fence was undeliverable: promotion waited
+    # out the dead node's serving lease.
+    assert report["kill_promote_s"] >= 0.6
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_soak_slow():
+    """The 3-node cell (single-shard primaries P0/P1 + standby S):
+    three drain steps instead of two, every other invariant identical."""
+    from ratelimiter_tpu.storage.chaos import rolling_upgrade_drill
+
+    report = rolling_upgrade_drill(full=True)
+    assert report["mismatches"] == 0
+    assert report["upgrade_steps"] == 3
+    assert report["promotions"] == 4 and report["reseeds"] == 4

@@ -47,6 +47,8 @@ def test_all_registered_meters_are_documented():
         "ratelimiter.lease.enabled": "true",
         "ratelimiter.control.enabled": "true",
         "ratelimiter.control.interval_ms": "60000",
+        "ratelimiter.fleet.enabled": "true",
+        "ratelimiter.fleet.probe_interval_ms": "60000",
         "ratelimiter.obs.trace_sample": "4",
     })
     ctx = build_app(props)
@@ -95,5 +97,9 @@ def test_catalog_regex_expands_families():
                      "ratelimiter.telemetry.rejected",
                      "ratelimiter.telemetry.staleness_ms",
                      "ratelimiter.telemetry.local_latency",
-                     "ratelimiter.tenant.admitted"):
+                     "ratelimiter.tenant.admitted",
+                     "ratelimiter.fleet.nodes",
+                     "ratelimiter.fleet.respawns",
+                     "ratelimiter.fleet.reseeds",
+                     "ratelimiter.fleet.upgrade_steps"):
         assert expected in names, expected

@@ -144,6 +144,19 @@ else
   fail=1
 fi
 
+echo "running rolling-upgrade drill (fleet autopilot, zero-loss node replacement)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py::test_rolling_upgrade_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  rolling-upgrade drill"
+else
+  echo "  FAILED  rolling-upgrade drill (a node replacement lost a"
+  echo "          decision, the autopilot failed to re-seed the cell"
+  echo "          back to N+1 inside its deadline, or the mid-upgrade"
+  echo "          kill's promotion raced the dead node's serving lease)"
+  fail=1
+fi
+
 echo "running fast lease failover drill (leases honored-or-revoked, bounded over-admission)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_leases.py::test_lease_failover_drill_fast \
@@ -202,6 +215,17 @@ else
   echo "  FAILED  orchestrator idle overhead budget (the probe loop —"
   echo "          one control-RPC round trip per node per tick — costs"
   echo "          more than 2% steady-state CPU at its cadence)"
+  fail=1
+fi
+
+echo "running fleet manager idle overhead gate (probe loop <= 2% steady-state)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
+    bench/fleet_overhead.py --assert-budget 0.02 > /dev/null; then
+  echo "  ok  fleet manager idle overhead budget"
+else
+  echo "  FAILED  fleet manager idle overhead budget (the NodeManager's"
+  echo "          probe loop — one muxed probe_all RPC per node per tick"
+  echo "          — costs more than 2% steady-state CPU at its cadence)"
   fail=1
 fi
 
@@ -268,6 +292,7 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
       tests/test_shard_replication.py::test_shard_failover_soak_slow \
       tests/test_orchestrator.py::test_orchestrator_soak_slow \
       tests/test_cross_host.py::test_cross_host_soak_slow \
+      tests/test_fleet.py::test_rolling_upgrade_soak_slow \
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
       tests/test_sidecar_chaos.py::test_ingress_soak_slow \
