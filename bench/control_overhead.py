@@ -18,6 +18,18 @@ still counts.  The paired end-to-end ratio is also reported (unGATED).
 
     JAX_PLATFORMS=cpu python bench/control_overhead.py \
         --assert-budget 0.02
+
+``--fleet`` swaps the single-storage controller for the ISSUE 17
+fleet plane: N member nodes each serving ``controller_handlers`` over
+a REAL control-RPC socket, one elected ``FleetControlPlane`` leader,
+and the gated tick is the whole fleet cadence — election maintenance
+(majority seat renewal), the fleet-summed signals sweep, and the
+controller's AIMD pass — at the configured interval.  The per-grant
+generation check is unchanged in fleet mode (nodes check their own
+local table), so the same pessimistic grant-rate term applies.
+
+    JAX_PLATFORMS=cpu python bench/control_overhead.py \
+        --fleet --assert-budget 0.02
 """
 
 from __future__ import annotations
@@ -45,6 +57,114 @@ def timed_pass(storage, lid, key_ids) -> float:
         gc.enable()
 
 
+def fleet_main(args) -> None:
+    """Fleet-mode arm: the controller ticks over an elected
+    FleetControlPlane whose members are real control-RPC sockets."""
+    import numpy as np
+
+    from ratelimiter_tpu.control import (
+        AdaptivePolicyController,
+        ControlConfig,
+        ControllerElection,
+        FleetControlPlane,
+    )
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.metrics import MeterRegistry
+    from ratelimiter_tpu.replication.control import (
+        ControlClient,
+        ControlServer,
+        controller_handlers,
+    )
+    from ratelimiter_tpu.replication.remote import RemoteBackend
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    registry = MeterRegistry()
+    cfg = RateLimitConfig(max_permits=1000, window_ms=60_000,
+                          refill_rate=100.0)
+    storages, servers, members = [], [], {}
+    lids = None
+    for i in range(args.fleet_nodes):
+        st = TpuBatchedStorage(num_slots=1 << 14,
+                               table_capacity=args.tenants + 8)
+        node_lids = [st.register_limiter("tb", cfg)
+                     for _ in range(args.tenants)]
+        if lids is None:
+            lids = node_lids
+        assert node_lids == lids, "members must register identically"
+        # Populate every node's telemetry plane: the fleet signals
+        # sweep serializes O(tenants) rows per member per tick.
+        for lid in node_lids:
+            st.acquire_many_ids("tb", lid,
+                                np.arange(64, dtype=np.int64),
+                                np.ones(64, dtype=np.int64))
+        srv = ControlServer(controller_handlers(st)).start()
+        members[f"n{i}"] = RemoteBackend(
+            ControlClient("127.0.0.1", srv.port, timeout=5.0),
+            label=f"n{i}")
+        storages.append(st)
+        servers.append(srv)
+
+    plane = FleetControlPlane(
+        "ctrl-bench", members,
+        limiters={lid: ("tb", cfg) for lid in lids})
+    election = ControllerElection([plane], registry=registry)
+    election.tick()
+    assert plane.is_leader, "bench plane failed to elect"
+    controller = AdaptivePolicyController(
+        plane, ControlConfig(interval_ms=args.interval_ms),
+        registry=registry)
+    election.tick()
+    controller.tick()  # warm (adopts every lid fleet-wide)
+
+    # -- gated: direct steady-state fraction (whole fleet cadence) ---------
+    t0 = time.perf_counter()
+    for _ in range(args.ticks):
+        election.tick()     # majority seat renewal
+        controller.tick()   # fleet signals sweep + AIMD pass
+    tick_s = (time.perf_counter() - t0) / args.ticks
+
+    # Per-grant generation check: node-LOCAL in fleet mode too.
+    table = storages[0].table
+    reps = 20000
+    t0 = time.perf_counter()
+    for i in range(reps):
+        table.row_generation(lids[i % len(lids)])
+    gen_check_s = (time.perf_counter() - t0) / reps
+
+    ticks_per_s = 1000.0 / max(args.interval_ms, 1.0)
+    fraction = tick_s * ticks_per_s + gen_check_s * args.grants_per_s
+
+    report = {
+        "mode": "fleet",
+        "nodes": args.fleet_nodes,
+        "tenants": args.tenants,
+        "leader": plane.node,
+        "epoch": plane.epoch,
+        "fleet_tick_us": round(tick_s * 1e6, 1),
+        "gen_check_us": round(gen_check_s * 1e6, 3),
+        "ticks_per_s": ticks_per_s,
+        "grants_per_s": args.grants_per_s,
+        "steady_state_fraction": round(fraction, 6),
+        "adjustments": controller.adjustments_total,
+        "rpc_requests_served": sum(s.requests_served for s in servers),
+    }
+    print(json.dumps(report, indent=2))
+    controller.close()
+    election.close()
+    plane.close()
+    for srv in servers:
+        srv.stop()
+    for st in storages:
+        st.close()
+
+    if args.assert_budget is not None \
+            and fraction > args.assert_budget:
+        print(f"ASSERTION FAILED: fleet controller steady-state fraction "
+              f"{fraction:.4f} > budget {args.assert_budget}",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--n", type=int, default=1 << 19,
@@ -60,7 +180,16 @@ def main() -> None:
                              "the generation-check term")
     parser.add_argument("--assert-budget", type=float, default=None,
                         metavar="FRAC")
+    parser.add_argument("--fleet", action="store_true",
+                        help="measure the ISSUE 17 fleet plane instead: "
+                             "elected leader over real control-RPC "
+                             "member sockets")
+    parser.add_argument("--fleet-nodes", type=int, default=2)
     args = parser.parse_args()
+
+    if args.fleet:
+        fleet_main(args)
+        return
 
     import numpy as np
 

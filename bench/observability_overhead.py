@@ -80,25 +80,49 @@ def leased_arm(storage, reps: int) -> dict:
     lid = storage.register_limiter("tb", cfg)
     mgr = LeaseManager(storage, default_budget=4096, max_budget=4096,
                        ttl_ms=60_000.0)
-    keys = [f"tenant{i}:burner" for i in range(8)]
-    rates = {}
-    for mode in ("off", "on"):
+    # "on" is the shipping config (sampled stamping: one perf_counter
+    # pair per flush interval); "every" re-arms the stamp each burn —
+    # the pre-sampling behavior — to show what the sampling buys.
+    # Per-mode key namespaces: the manager grants ONE burner per key,
+    # so concurrent clients must not contend for the same leases.
+    modes = ("off", "on", "every")
+    clients, mode_keys = {}, {}
+    for mode in modes:
+        keys = [f"{mode}:tenant{i}:burner" for i in range(8)]
         cli = LeaseClient(DirectTransport(mgr), lid, budget=4096,
-                          telemetry=(mode == "on"),
+                          telemetry=(mode != "off"),
                           telemetry_flush_ms=50.0)
         for k in keys:
             assert cli.try_acquire(k)   # warm: grants charged
-        t0 = _time.perf_counter()
-        for i in range(reps):
-            cli.try_acquire(keys[i & 7])
-        wall = _time.perf_counter() - t0
+        clients[mode] = cli
+        mode_keys[mode] = keys
+    # Interleaved best-of rounds (the replication_overhead idiom): a
+    # shared host's scheduler noise swamps a single pass; the best
+    # round per mode is the least-perturbed measurement.
+    rates = {m: 0.0 for m in modes}
+    for r in range(3):
+        for mode in modes[r % 3:] + modes[:r % 3]:
+            cli, keys = clients[mode], mode_keys[mode]
+            telem = cli._telem
+            t0 = _time.perf_counter()
+            if mode == "every":
+                for i in range(reps):
+                    cli.try_acquire(keys[i & 7])
+                    telem.stamp_pending = True  # force the per-burn pair
+            else:
+                for i in range(reps):
+                    cli.try_acquire(keys[i & 7])
+            wall = _time.perf_counter() - t0
+            rates[mode] = max(rates[mode], reps / wall)
+    for cli in clients.values():
         cli.release_all()
-        rates[mode] = reps / wall
     return {
         "reps": reps,
         "local_rps_telemetry_off": round(rates["off"]),
         "local_rps_telemetry_on": round(rates["on"]),
+        "local_rps_stamp_every_burn": round(rates["every"]),
         "leased_throughput_ratio": round(rates["on"] / rates["off"], 3),
+        "stamp_every_burn_ratio": round(rates["every"] / rates["off"], 3),
     }
 
 
@@ -131,6 +155,12 @@ def main() -> None:
                         help="fail if the direct observability fraction "
                              "of the on-mode pass exceeds this (e.g. "
                              "0.02)")
+    parser.add_argument("--assert-leased-ratio", type=float, default=None,
+                        metavar="RATIO",
+                        help="fail if the leased arm's telemetry-on/off "
+                             "throughput ratio drops below this (the "
+                             "sampled perf_counter stamping keeps local "
+                             "burns near free)")
     args = parser.parse_args()
 
     import numpy as np
@@ -230,6 +260,21 @@ def main() -> None:
                 f"{budget_pct}% budget")
         print(f"observability decision-path cost {got}% within the "
               f"{budget_pct}% budget")
+    if args.assert_leased_ratio is not None:
+        got = leased["leased_throughput_ratio"]
+        every = leased["stamp_every_burn_ratio"]
+        if got < args.assert_leased_ratio:
+            raise SystemExit(
+                f"leased telemetry-on throughput is {got}x the off "
+                f"baseline — below the {args.assert_leased_ratio}x "
+                f"floor (per-burn perf_counter stamping regressed?)")
+        if got <= every:
+            raise SystemExit(
+                f"sampled stamping ({got}x) is no faster than stamping "
+                f"every burn ({every}x) — the sampling is not engaging")
+        print(f"leased telemetry on/off ratio {got}x >= "
+              f"{args.assert_leased_ratio}x floor "
+              f"(stamp-every-burn: {every}x)")
 
 
 if __name__ == "__main__":

@@ -4,14 +4,25 @@ Closes the loop from observation (the fleet telemetry plane's
 ``UsageSignals``) to actuation (``LimiterTable.set_policy`` row-wise
 device updates): per-tenant AIMD limits, a hierarchical global
 aggregate cap, operator pinning, and lease-backed concurrency slots.
+``control/fleet.py`` makes the same loop fleet-true: epoch-fenced
+controller leadership over the control RPC, cross-host signal
+aggregation, and monotone-generation policy broadcast.
 """
 
 from ratelimiter_tpu.control.controller import (
     AdaptivePolicyController,
     ControlConfig,
 )
+from ratelimiter_tpu.control.fleet import (
+    ControllerElection,
+    FleetControlPlane,
+    NotLeader,
+)
 
 __all__ = [
     "AdaptivePolicyController",
     "ControlConfig",
+    "ControllerElection",
+    "FleetControlPlane",
+    "NotLeader",
 ]

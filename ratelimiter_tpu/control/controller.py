@@ -18,12 +18,17 @@ loop from observation to actuation:
   overload verdict — the tenant hammering far past its limit, sheds
   landing on it, or the breaker open — cuts it multiplicatively
   (``decrease_factor``), clamped to the operator floor.  Hierarchical
-  enforcement adds a **global aggregate cap**: when the fleet's
-  observed load exceeds ``global_cap_per_s`` and its admitted rate is
-  above the cap, every tenant's effective rate is scaled by
-  ``cap / fleet_admitted`` (the AIMD floor protects well-behaved
-  tenants; the scale bounds the aggregate while AIMD reallocates the
-  cut onto whoever is storming).
+  enforcement adds a **global aggregate cap**: when the fleet's RAW
+  observed load exceeds ``global_cap_per_s``, every tenant's effective
+  rate is scaled by ``cap / fleet_observed``.  Scaling by observed
+  load (not admitted rate) is deliberate: in a shed-heavy storm the
+  admitted rate can sit UNDER the cap while arrivals are far above it,
+  and an admitted-rate trigger would never engage — under-throttling
+  exactly when the aggregate needs protecting.  The scale is
+  floor-protected per tenant (``max(fraction * scale, floor)``), so a
+  hammering fleet cannot squeeze a well-behaved tenant below its
+  operator floor while AIMD reallocates the cut onto whoever is
+  storming.
 - **Actuation**: ``storage.set_policy(lid, config)`` — three scalar
   device row updates stamped with a monotonic policy generation
   (``LimiterTable.set_policy``); the window/algo shape never moves.
@@ -90,10 +95,16 @@ class ControlConfig:
     # Default operator floor, as a fraction of the ceiling (per-lid
     # overrides via configure()).
     floor_fraction: float = 0.1
-    # Hierarchical global cap on the fleet's aggregate admitted rate
-    # (decisions/s); 0 disables.  Engages when fleet observed load
-    # exceeds it AND admitted exceeds it.
+    # Hierarchical global cap on the fleet's aggregate load
+    # (decisions/s); 0 disables.  Engages on RAW observed load — not
+    # admitted rate, which a shed-heavy storm keeps under the cap
+    # while arrivals are far above it.
     global_cap_per_s: float = 0.0
+    # Telemetry staleness bound (ms); 0 disables.  When the plane's
+    # ``staleness_ms`` exceeds it (a partitioned reporter, a dead
+    # member link), the controller FREEZES raises — stale signals must
+    # never justify giving a tenant more — while cuts stay allowed.
+    staleness_bound_ms: float = 0.0
     # Tenants below this observed load get no verdict (their fraction
     # holds; raising an idle tenant would be guessing).
     min_load_per_s: float = 0.5
@@ -109,6 +120,8 @@ class ControlConfig:
             raise ValueError("floor_fraction must be in (0, 1]")
         if not (0.0 <= self.target_excess < 1.0):
             raise ValueError("target_excess must be in [0, 1)")
+        if self.staleness_bound_ms < 0:
+            raise ValueError("staleness_bound_ms must be >= 0")
         return self
 
 
@@ -161,6 +174,8 @@ class AdaptivePolicyController:
         self.global_scale = 1.0
         self.global_cap_engagements = 0
         self._cap_event_ms = 0
+        self.signals_stale_ticks = 0
+        self._stale_event_ms = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if recorder is not None:
@@ -262,14 +277,34 @@ class AdaptivePolicyController:
             if self._breaker is not None:
                 breaker_open = getattr(self._breaker, "state",
                                        "closed") != "closed"
+            # -- staleness freeze -----------------------------------------
+            # Stale observations must never justify RAISING a limit (a
+            # partitioned reporter's last window could hide a storm);
+            # cuts remain allowed — acting on overload evidence is safe
+            # even if it is old.
+            stale = False
+            if cfg.staleness_bound_ms > 0:
+                staleness = float(self._plane.staleness_ms())
+                stale = staleness > cfg.staleness_bound_ms
+                if stale:
+                    self.signals_stale_ticks += 1
+                    if now - self._stale_event_ms > cfg.event_coalesce_ms:
+                        self._stale_event_ms = now
+                        self._recorder.record(
+                            "control.signals_stale",
+                            staleness_ms=round(staleness, 1),
+                            bound_ms=cfg.staleness_bound_ms)
             # -- hierarchical global cap ----------------------------------
             fleet_observed = sum(s.observed_load for s in signals.values())
             fleet_admitted = sum(s.goodput for s in signals.values())
             scale = 1.0
             if (cfg.global_cap_per_s > 0
-                    and fleet_observed > cfg.global_cap_per_s
-                    and fleet_admitted > cfg.global_cap_per_s):
-                scale = cfg.global_cap_per_s / fleet_admitted
+                    and fleet_observed > cfg.global_cap_per_s):
+                # Raw OBSERVED load is the trigger and the divisor: a
+                # shed-heavy storm keeps the admitted rate under the
+                # cap while arrivals are far above it, so admitted-rate
+                # scaling would never engage (the PR 15 gap).
+                scale = cfg.global_cap_per_s / fleet_observed
                 self.global_cap_engagements += 1
                 if now - self._cap_event_ms > cfg.event_coalesce_ms:
                     self._cap_event_ms = now
@@ -278,6 +313,10 @@ class AdaptivePolicyController:
                         observed_per_s=round(fleet_observed, 1),
                         admitted_per_s=round(fleet_admitted, 1),
                         scale=round(scale, 4))
+            if stale and scale > self.global_scale:
+                # A relaxing cap is a raise too: hold the tighter scale
+                # until the plane reports fresh signals.
+                scale = self.global_scale
             self.global_scale = scale
             if self._m_scale is not None:
                 self._m_scale.set(scale)
@@ -299,7 +338,7 @@ class AdaptivePolicyController:
                     st.fraction = max(st.floor_frac,
                                       st.fraction * cfg.decrease_factor)
                     st.verdict = CUTTING
-                elif st.fraction < 1.0:
+                elif st.fraction < 1.0 and not stale:
                     st.fraction = min(1.0,
                                       st.fraction + cfg.increase_fraction)
                     st.verdict = RAISING
@@ -314,7 +353,9 @@ class AdaptivePolicyController:
     def _actuate(self, lid: int, st: _LidState, scale: float,
                  now: int) -> None:
         """Apply the lid's effective policy iff it changed."""
-        eff = st.fraction * scale
+        # Floor-protected: the global scale must not squeeze a tenant
+        # below its operator floor (AIMD reallocates the cut instead).
+        eff = max(st.fraction * scale, st.floor_frac)
         ceiling = st.ceiling
         permits = max(1, round(ceiling.max_permits * eff))
         refill = round(ceiling.refill_rate * eff, 6)
@@ -356,7 +397,9 @@ class AdaptivePolicyController:
             table = getattr(self.storage, "table", None)
             lids = {}
             for lid, st in sorted(self._lids.items()):
-                eff = st.fraction * (1.0 if st.pinned else self.global_scale)
+                eff = (st.fraction if st.pinned
+                       else max(st.fraction * self.global_scale,
+                                st.floor_frac))
                 applied = st.applied or (st.ceiling.max_permits,
                                          round(st.ceiling.refill_rate, 6))
                 lids[str(lid)] = {
@@ -381,6 +424,7 @@ class AdaptivePolicyController:
                 "global_scale": round(self.global_scale, 4),
                 "global_cap_per_s": self.config.global_cap_per_s,
                 "global_cap_engagements": self.global_cap_engagements,
+                "signals_stale_ticks": self.signals_stale_ticks,
                 "adjustments": self.adjustments_total,
                 "pinned": [l for l, s in sorted(self._lids.items())
                            if s.pinned],
@@ -400,8 +444,15 @@ class AdaptivePolicyController:
         while not self._stop.wait(interval_s):
             try:
                 self.tick()
-            except Exception:  # noqa: BLE001 — the loop must survive
-                _log.exception("controller tick failed")
+            except Exception as exc:  # noqa: BLE001 — the loop must survive
+                if type(exc).__name__ == "NotLeader":
+                    # Fleet mode while not holding the cell: the
+                    # actuation refusal is the CORRECT behaviour, and
+                    # the election loop repairs leadership — not an
+                    # error worth a stack trace per tick.
+                    _log.debug("controller tick deferred: %s", exc)
+                else:
+                    _log.exception("controller tick failed")
 
     def stop(self) -> None:
         self._stop.set()

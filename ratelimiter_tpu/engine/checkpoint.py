@@ -279,6 +279,17 @@ def _limiter_table_dump(storage) -> Dict:
     }
 
 
+def limiter_policy_dump(storage) -> Dict:
+    """Public form of :func:`_limiter_table_dump`: the storage's policy
+    rows in exactly the shape the control-plane ``set_policy`` op (and
+    :func:`apply_limiter_policies`) consumes.  The fleet controller's
+    broadcast and anti-entropy paths (``control/fleet.py``) are built
+    on this — one row format end to end, so a checkpoint restore, a
+    replication bootstrap, and a leader broadcast all converge a node
+    through the same idempotent apply."""
+    return _limiter_table_dump(storage)
+
+
 def apply_limiter_policies(storage, limiters: Dict, *,
                            register_missing: bool = False) -> None:
     """Reconcile a limiter dump against a target storage.

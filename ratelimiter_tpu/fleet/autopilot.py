@@ -73,6 +73,11 @@ class FleetAutopilot:
         self.version = str(version)
         self.reseed_deadline_s = float(reseed_deadline_s)
         self._clock = clock
+        # Optional ControllerElection (control/fleet.py): a node handed
+        # back into the cell is announced so the controller leader
+        # anti-entropies it to the fleet's policy generation before it
+        # can serve a stale one.
+        self.election = None
         # q -> (node_name, shard_on_node): who serves / shadows shard q.
         self._serving: Dict[int, tuple] = {}
         self._standby: Dict[int, tuple] = {}
@@ -229,6 +234,16 @@ class FleetAutopilot:
                 q, FanoutLeaseChannel(serving_backend, node.ctl,
                                       shard=int(shard)))
         self._standby[int(q)] = (node.name, int(shard))
+        if self.election is not None:
+            from ratelimiter_tpu.replication.remote import RemoteBackend
+
+            # The join-side half of the generation-convergence
+            # invariant (ARCHITECTURE §15): the fresh node is converged
+            # to the leader's generation before anything can read a
+            # stale policy from it.
+            self.election.note_join(
+                node.name, RemoteBackend(node.ctl, label=node.name,
+                                         shard=int(shard)))
 
     def _finalize(self, q: int, job: dict) -> None:
         node = self.manager.node(job["node"])

@@ -353,6 +353,12 @@ class NodeManager:
 
     # -- autopilot + counters --------------------------------------------------
     def attach(self, autopilot) -> None:
+        """Drive ``autopilot.tick()`` from this manager's probe tick.
+        Anything with ``tick()`` (+ optional ``status()``) rides the
+        cadence: FleetAutopilot re-seed jobs, NodeLifecycle plans, and
+        the ControllerElection (control/fleet.py) — so controller
+        leader death is detected and repaired on the SAME tick that
+        notices the node died, with no extra threads."""
         self._autopilots.append(autopilot)
 
     def note_reseed(self) -> None:

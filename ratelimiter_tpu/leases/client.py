@@ -164,7 +164,13 @@ class LeaseClient:
     def try_acquire(self, key: str, permits: int = 1) -> bool:
         permits = max(int(permits), 1)
         telem = self._telem
-        t0 = time.perf_counter() if telem is not None else 0.0
+        # Sampled stamping: the perf_counter pair costs ~1 µs per local
+        # burn — the dominant telemetry overhead on a path whose whole
+        # budget is a few µs.  Only the first record of each flush
+        # interval pays it (ClientTelemetry.stamp_pending re-arms on
+        # flush); every other burn records counts latency-free.
+        stamp = telem is not None and telem.stamp_pending
+        t0 = time.perf_counter() if stamp else 0.0
         now = int(self._clock_ms())
         lease = self._leases.get(key)
         if lease is not None and now < lease.deadline \
@@ -174,8 +180,9 @@ class LeaseClient:
             self.local_decisions += 1
             self.allowed_by_key[key] += permits
             if telem is not None:
-                telem.record_burn(self.lid, key, permits,
-                                  (time.perf_counter() - t0) * 1e6)
+                telem.record_burn(
+                    self.lid, key, permits,
+                    (time.perf_counter() - t0) * 1e6 if stamp else None)
                 self._maybe_flush(now)
             return True
         lease = self._refresh(key, lease, now)
@@ -187,8 +194,9 @@ class LeaseClient:
             if telem is not None:
                 # The first burn of a fresh budget: local too (the wire
                 # op charged the BUDGET, not this decision).
-                telem.record_burn(self.lid, key, permits,
-                                  (time.perf_counter() - t0) * 1e6)
+                telem.record_burn(
+                    self.lid, key, permits,
+                    (time.perf_counter() - t0) * 1e6 if stamp else None)
             return True
         if self.direct_fallback:
             self.wire_ops += 1
@@ -198,8 +206,9 @@ class LeaseClient:
             return allowed
         self.local_denies += 1
         if telem is not None:
-            telem.record_deny(self.lid, key,
-                              (time.perf_counter() - t0) * 1e6)
+            telem.record_deny(
+                self.lid, key,
+                (time.perf_counter() - t0) * 1e6 if stamp else None)
             self._maybe_flush(now)
         return False
 

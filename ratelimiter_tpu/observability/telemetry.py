@@ -239,6 +239,15 @@ class ClientTelemetry:
         self._hist_total_us = 0
         self.allowed = 0
         self.denied = 0
+        # Sampled latency stamping (one stamp per flush interval): the
+        # perf_counter pair costs ~1 µs per local burn — material on a
+        # path whose whole budget is a few µs (PR 13's bench note).
+        # The caller checks ``stamp_pending`` and only pays the pair
+        # while a sample is wanted; the first latency-carrying record
+        # clears it, and the next flush re-arms it.  The histogram
+        # becomes one sample per client per flush interval — the shape
+        # survives, the per-burn cost does not.
+        self.stamp_pending = True
 
     def _row(self, lid: int, key: str) -> List[int]:
         row = self._row_cache.get((lid, key))
@@ -258,20 +267,25 @@ class ClientTelemetry:
         return row
 
     def record_burn(self, lid: int, key: str, permits: int,
-                    latency_us: float) -> None:
+                    latency_us: Optional[float] = None) -> None:
         row = self._row(lid, key)
         row[0] += 1
         row[2] += int(permits)
         self.allowed += 1
-        self._hist[latency_bucket(latency_us)] += 1
-        self._hist_total_us += int(latency_us)
+        if latency_us is not None:
+            self._hist[latency_bucket(latency_us)] += 1
+            self._hist_total_us += int(latency_us)
+            self.stamp_pending = False
 
-    def record_deny(self, lid: int, key: str, latency_us: float) -> None:
+    def record_deny(self, lid: int, key: str,
+                    latency_us: Optional[float] = None) -> None:
         row = self._row(lid, key)
         row[1] += 1
         self.denied += 1
-        self._hist[latency_bucket(latency_us)] += 1
-        self._hist_total_us += int(latency_us)
+        if latency_us is not None:
+            self._hist[latency_bucket(latency_us)] += 1
+            self._hist_total_us += int(latency_us)
+            self.stamp_pending = False
 
     def pending(self) -> bool:
         return bool(self.allowed or self.denied)
@@ -297,6 +311,7 @@ class ClientTelemetry:
         self._hist_total_us = 0
         self.allowed = 0
         self.denied = 0
+        self.stamp_pending = True   # re-arm: one sample per interval
         return b"".join(parts)
 
 

@@ -152,6 +152,35 @@ class RemoteBackend:
         """Raw probe payload, or None when unreachable."""
         return self.ctl.try_call("probe", **self._kw())
 
+    # -- fleet control plane (ARCHITECTURE §15) ------------------------------
+    # Thin forwarders for the controller-leadership ops every node role
+    # serves; control/fleet.py drives these through its member set.
+
+    def controller_claim(self, node: str, epoch: int,
+                         ttl_ms: float = 3000.0) -> dict:
+        """Claim/renew controller authority on this node's seat.  A
+        refusal is IN-PROTOCOL (granted=False + the seat's epoch), so
+        callers distinguish "outvoted" from "unreachable"."""
+        return self.ctl.call_ok("controller_claim", **self._kw(
+            node=str(node), epoch=int(epoch), ttl_ms=float(ttl_ms)))
+
+    def set_policy_rows(self, rows: Dict, epoch: int,
+                        node: str = "") -> dict:
+        """Apply a batch of policy rows at the leader's generation
+        stamps; stale-epoch and stale-generation refusals come back
+        in-protocol (``applied=False``)."""
+        return self.ctl.call_ok("set_policy", **self._kw(
+            rows=dict(rows), epoch=int(epoch), node=str(node)))
+
+    def policy_info(self) -> dict:
+        """Policy table generation + rows + the controller seat."""
+        return self.ctl.call_ok("policy_info", **self._kw())
+
+    def signals(self, window_ms: int = 2000) -> dict:
+        """The node's serialized per-lid UsageSignals + staleness."""
+        return self.ctl.call_ok("signals",
+                                **self._kw(window_ms=int(window_ms)))
+
     def close(self) -> None:
         self.ctl.close()
 

@@ -177,6 +177,24 @@ def health_payload(ctx: AppContext) -> dict:
             "pinned": st["pinned"],
             "adjustments": st["adjustments"],
         }
+    fc = getattr(ctx, "fleet_control", None)
+    control_lagging: list = []
+    if fc is not None:
+        # Generation-convergence fold (ARCHITECTURE §15): a member node
+        # whose applied policy generation sits BEHIND the leader's last
+        # broadcast is serving stale limits — degraded correctness for
+        # its slice of the cell, never DOWN (decisions still flow).
+        # Reads the plane's cached per-node view; no RPC on the health
+        # path.
+        control_lagging = fc.lagging_nodes()
+        plane = fc.plane
+        payload["controller"] = {
+            "node": plane.node,
+            "is_leader": plane.is_leader,
+            "epoch": plane.epoch,
+            "last_broadcast_generation": plane.last_broadcast_generation,
+            "lagging_nodes": control_lagging,
+        }
     fleet = getattr(ctx, "fleet", None)
     fleet_degraded: list = []
     if fleet is not None:
@@ -235,10 +253,12 @@ def health_payload(ctx: AppContext) -> dict:
         payload["status"] = "DEGRADED" if degraded_serving else "DOWN"
     elif not storage_up:
         payload["status"] = "DOWN"
-    elif degraded_shards or fleet_degraded:
+    elif degraded_shards or fleet_degraded or control_lagging:
         # One shard failed or running on a promoted replacement while
         # the survivors serve — or a managed fleet node is FAILED/
-        # DRAINING: degraded capacity, not an outage.
+        # DRAINING, or a member serves a policy generation behind the
+        # controller leader's broadcast: degraded capacity (or
+        # correctness), not an outage.
         payload["status"] = "DEGRADED"
     elif shedding:
         payload["status"] = "SHEDDING"
@@ -365,6 +385,8 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             if fleet is None:
                 return self._json(200, {"enabled": False})
             return self._json(200, {"enabled": True, **fleet.status()})
+        if self.path == "/actuator/controller":
+            return self._controller_actuator()
         if self.path.startswith("/actuator/trace"):
             trace = getattr(self.ctx.storage, "trace", None)
             if trace is None:
@@ -414,6 +436,27 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             payload["enabled"] = True
             payload["controller"] = controller.status()
         return self._json(200, payload)
+
+    def _controller_actuator(self):
+        """Controller leadership surface (ARCHITECTURE §15): who leads
+        the cell, at what fence epoch, the last broadcast policy
+        generation, and every member node's applied generation — the
+        operator's one-request view of the generation-convergence
+        invariant.  Without fleet mode, falls back to the local
+        controller's generation view."""
+        fc = getattr(self.ctx, "fleet_control", None)
+        if fc is not None:
+            return self._json(200, fc.status())
+        controller = getattr(self.ctx, "controller", None)
+        if controller is None:
+            return self._json(200, {"enabled": False})
+        st = controller.status()
+        return self._json(200, {
+            "enabled": True, "fleet": False,
+            "generation": st["generation"],
+            "adjustments": st["adjustments"],
+            "signals_stale_ticks": st["signals_stale_ticks"],
+        })
 
     def _pin_policy(self, lid: str):
         """Operator override: freeze a lid out of the control loop

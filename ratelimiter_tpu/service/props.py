@@ -223,6 +223,30 @@ DEFAULTS = {
     "ratelimiter.control.decrease_factor": "0.5",
     "ratelimiter.control.floor_fraction": "0.1",
     "ratelimiter.control.global_cap_per_s": "0",
+    # Telemetry staleness bound for the controller (ms; 0 = off): when
+    # the plane's worst reporter staleness exceeds it, the controller
+    # FREEZES raises (stale signals must never justify giving a tenant
+    # more) while cuts stay allowed; each frozen tick emits a coalesced
+    # ``control.signals_stale`` flight event.
+    "ratelimiter.control.staleness_bound_ms": "0",
+    # Fleet-true control plane (control/fleet.py, ARCHITECTURE §15):
+    # OFF by default.  When enabled, the adaptive controller runs over
+    # a FleetControlPlane instead of the local storage: observations
+    # are the SUMMED UsageSignals of every peer (the global cap sees
+    # fleet load), and actuations broadcast generation-stamped
+    # set_policy rows to every peer — but only while this process
+    # HOLDS the cell's controller lease (a majority of peer seats at
+    # its fence epoch, renewed within ttl_ms on its own clock; losing
+    # either self-demotes and refuses to actuate).  node is this
+    # controller's identity (empty -> ctrl-<pid>); peers is a comma-
+    # separated host:port list of member control ports (empty -> this
+    # process's own ratelimiter.control.port, the single-node cell);
+    # interval_ms is the election/renewal cadence.
+    "ratelimiter.control.fleet.enabled": "false",
+    "ratelimiter.control.fleet.node": "",
+    "ratelimiter.control.fleet.peers": "",
+    "ratelimiter.control.fleet.ttl_ms": "3000",
+    "ratelimiter.control.fleet.interval_ms": "500",
     # Concurrency slots (leases as slots, ARCHITECTURE §15): bound every
     # tenant's aggregate outstanding lease budget to this many permits
     # (0 = unbounded).  Per-lid overrides via
@@ -309,6 +333,9 @@ _FLOAT_KEYS = (
     "ratelimiter.control.decrease_factor",
     "ratelimiter.control.floor_fraction",
     "ratelimiter.control.global_cap_per_s",
+    "ratelimiter.control.staleness_bound_ms",
+    "ratelimiter.control.fleet.ttl_ms",
+    "ratelimiter.control.fleet.interval_ms",
     "ratelimiter.fleet.probe_interval_ms",
     "ratelimiter.fleet.boot_timeout_s",
     "ratelimiter.fleet.reseed_deadline_s",
@@ -322,6 +349,7 @@ _BOOL_KEYS = (
     "ratelimiter.cache.hybrid.enabled",
     "ratelimiter.lease.enabled",
     "ratelimiter.control.enabled",
+    "ratelimiter.control.fleet.enabled",
     "ratelimiter.fleet.enabled",
 )
 _BOOL_TOKENS = ("1", "true", "yes", "on", "0", "false", "no", "off")

@@ -103,6 +103,38 @@ class OrchestratorHandle:
 
 
 @dataclasses.dataclass
+class FleetControlHandle:
+    """Fleet-true control wiring (ratelimiter.control.fleet.*): the
+    epoch-fenced FleetControlPlane the adaptive controller actuates
+    through, plus the ControllerElection repairing leader death."""
+
+    plane: object
+    election: object
+
+    def lagging_nodes(self) -> list:
+        """Members whose last applied policy generation sits behind the
+        leader's last broadcast — the generation-convergence invariant's
+        health-fold signal (reads the plane's cached view; no RPC)."""
+        target = int(self.plane.last_broadcast_generation)
+        if target <= 0:
+            return []
+        return sorted(
+            name for name, gen in self.plane.node_generations.items()
+            if int(gen) < target)
+
+    def status(self) -> Dict:
+        out = {"enabled": True, "fleet": True,
+               **self.plane.fleet_status()}
+        out["election"] = self.election.status()
+        out["lagging_nodes"] = self.lagging_nodes()
+        return out
+
+    def close(self) -> None:
+        self.election.close()
+        self.plane.close()
+
+
+@dataclasses.dataclass
 class AppContext:
     props: AppProperties
     storage: RateLimitStorage
@@ -134,12 +166,18 @@ class AppContext:
     # Fleet NodeManager (ratelimiter.fleet.enabled) — node lifecycle +
     # autopilot substrate behind GET /actuator/fleet (ARCHITECTURE §16).
     fleet: object = None
+    # Fleet-true control plane (ratelimiter.control.fleet.enabled) —
+    # epoch-fenced controller leadership + cross-host policy broadcast
+    # behind GET /actuator/controller (ARCHITECTURE §15).
+    fleet_control: FleetControlHandle | None = None
 
     def close(self) -> None:
         if self.fleet is not None:
             self.fleet.close()
         if self.controller is not None:
             self.controller.close()
+        if self.fleet_control is not None:
+            self.fleet_control.close()
         if self.control is not None:
             self.control.stop()
         if self.sidecar is not None:
@@ -418,11 +456,81 @@ def _maybe_controller(serving: RateLimitStorage, props: AppProperties,
                 "ratelimiter.control.floor_fraction", 0.1),
             global_cap_per_s=props.get_float(
                 "ratelimiter.control.global_cap_per_s", 0.0),
+            staleness_bound_ms=props.get_float(
+                "ratelimiter.control.staleness_bound_ms", 0.0),
         ),
         breaker=breaker,
         registry=registry,
         recorder=recorder,
     ).start()
+
+
+def _maybe_fleet_control(serving: RateLimitStorage, props: AppProperties,
+                         registry: MeterRegistry, recorder, fleet):
+    """Config-gated fleet-true control plane (OFF by default;
+    ARCHITECTURE §15).
+
+    When enabled, the adaptive controller runs over a
+    :class:`~ratelimiter_tpu.control.FleetControlPlane` instead of the
+    local serving storage: fleet-summed UsageSignals in, epoch-fenced
+    generation-stamped ``set_policy`` broadcasts out.  The companion
+    :class:`~ratelimiter_tpu.control.ControllerElection` rides the
+    fleet NodeManager's probe tick when one is running, else its own
+    cadence thread.  Returns ``(handle_or_None, controller_storage)``
+    — when enabled, the PLANE is what ``_maybe_controller`` builds on.
+    """
+    if not props.get_bool("ratelimiter.control.fleet.enabled", False):
+        return None, serving
+    import logging
+    import os
+
+    peers = [p.strip() for p in
+             (props.get("ratelimiter.control.fleet.peers") or "").split(",")
+             if p.strip()]
+    if not peers:
+        # Single-node cell: this process's own control port is the one
+        # member seat (leadership is then trivially held, but the
+        # epoch/generation discipline — and the actuator surface — are
+        # identical to the multi-host shape).
+        port = props.get_int("ratelimiter.control.port", 0)
+        if port <= 0:
+            logging.getLogger("ratelimiter").warning(
+                "ratelimiter.control.fleet.enabled needs peers or an "
+                "own ratelimiter.control.port to form a member set; "
+                "fleet control disabled")
+            return None, serving
+        host = props.get("ratelimiter.control.host") or "127.0.0.1"
+        peers = [f"{host}:{port}"]
+    from ratelimiter_tpu.control import ControllerElection, FleetControlPlane
+    from ratelimiter_tpu.replication.control import ControlClient
+    from ratelimiter_tpu.replication.remote import RemoteBackend
+
+    members = {}
+    for part in peers:
+        peer_host, _, peer_port = part.rpartition(":")
+        backend = RemoteBackend(
+            ControlClient(peer_host or "127.0.0.1", int(peer_port)),
+            label=part)
+        members[backend.label] = backend
+    node = (props.get("ratelimiter.control.fleet.node")
+            or f"ctrl-{os.getpid()}")
+    plane = FleetControlPlane(
+        node, members,
+        ttl_ms=props.get_float("ratelimiter.control.fleet.ttl_ms", 3000.0),
+        recorder=recorder)
+    election = ControllerElection(
+        [plane],
+        interval_ms=props.get_float(
+            "ratelimiter.control.fleet.interval_ms", 500.0),
+        registry=registry, recorder=recorder)
+    if fleet is not None:
+        # Re-election rides the NodeManager's probe tick — leader death
+        # is detected and repaired from the same cadence that detects
+        # node death, no second thread.
+        fleet.attach(election)
+    else:
+        election.start()
+    return FleetControlHandle(plane=plane, election=election), plane
 
 
 def _maybe_fleet(props: AppProperties, registry: MeterRegistry, recorder):
@@ -707,6 +815,7 @@ def build_app(props: AppProperties | None = None,
     control = None
     controller = None
     fleet = None
+    fleet_control = None
     if own_storage:
         # Self-healing failover (the orchestrator owns its OWN per-shard
         # replication into an in-process standby mesh, so it supersedes
@@ -776,11 +885,15 @@ def build_app(props: AppProperties | None = None,
         if breaker is not None and breaker.fallback is not None \
                 and hasattr(serving, "add_policy_listener"):
             serving.add_policy_listener(breaker.fallback.update_policy)
-        # The adaptive controller actuates on the SERVING storage
-        # (router when present) and reads the breaker's overload state.
-        controller = _maybe_controller(serving, props, registry, breaker,
-                                       recorder)
         fleet = _maybe_fleet(props, registry, recorder)
+        # The adaptive controller actuates on the SERVING storage
+        # (router when present) and reads the breaker's overload state
+        # — or, in fleet mode, on the epoch-fenced FleetControlPlane
+        # broadcasting to the whole cell.
+        fleet_control, control_target = _maybe_fleet_control(
+            serving, props, registry, recorder, fleet)
+        controller = _maybe_controller(control_target, props, registry,
+                                       breaker, recorder)
 
     limiters: Dict[str, RateLimiter] = {
         # Default API limiter: 100 req/min sliding window with local cache
@@ -831,4 +944,5 @@ def build_app(props: AppProperties | None = None,
         control=control,
         controller=controller,
         fleet=fleet,
+        fleet_control=fleet_control,
     )

@@ -2691,6 +2691,428 @@ def rolling_upgrade_drill(
 
 
 # ---------------------------------------------------------------------------
+# Partitioned-controller drill (epoch-fenced leadership under partition)
+# ---------------------------------------------------------------------------
+
+def partitioned_controller_drill(
+    num_slots: int = 256,
+    n_keys: int = 12,
+    pipeline: int = 40,
+    pre_waves: int = 3,
+    storm_waves: int = 3,
+    seed: int = 0,
+    zipf_s: float = 1.1,
+    ttl_ms: float = 900.0,
+    tick_ms: float = 50.0,
+    detection_budget_s: float = 10.0,
+    goodput_floor: float = 0.8,
+    boot_timeout_s: float = 180.0,
+    registry=None,
+) -> dict:
+    """Partition the controller LEADER mid-storm and prove the
+    epoch-fence claims (ARCHITECTURE §15): two real ``hostproc`` cells
+    under live Zipf traffic, an AIMD controller actuating over the
+    epoch-fenced :class:`~ratelimiter_tpu.control.FleetControlPlane`,
+    and a :class:`FaultInjectingProxy` cutting the leader's every
+    member link at the worst moment.
+
+    Topology: two single-shard primary nodes ``N0``/``N1`` (same
+    limiter registrations, so lids and policy rows line up) under a
+    :class:`~ratelimiter_tpu.fleet.manager.NodeManager`; controller
+    candidate ``ctrl-a`` reaches the nodes THROUGH partitionable
+    proxies, rival ``ctrl-b`` directly; a
+    :class:`~ratelimiter_tpu.control.ControllerElection` attached to
+    the manager re-elects from the probe tick (driven manually here
+    for a deterministic timeline).
+
+    The ladder:
+
+    1. **Healthy baseline** — ``ctrl-a`` wins epoch 1 with a majority
+       of seats; well-tenant Zipf waves flow to BOTH nodes and every
+       decision is checked bit-identical against a generation-aware
+       oracle (rebuilt from ``policy_info`` rows, fresh keys per wave
+       so order-only configs stay exact); per-wave goodput recorded.
+    2. **Storm + fleet-true cut** — a storm tenant hammers its sliding
+       window far past the limit on both nodes; the leader's AIMD tick
+       observes the FLEET-SUMMED signals and broadcasts a
+       generation-stamped cut that must land on every node (one
+       generation cell-wide), visible in the next wave's decisions.
+    3. **Partition mid-storm** — both of ``ctrl-a``'s member links are
+       silently cut (no RST, no FIN).  Its renewals stop landing a
+       majority, so the OWN-CLOCK lease rule demotes it within
+       ``ttl_ms``; the election then seats ``ctrl-b`` at epoch 2 and
+       converges every node to one generation — all inside
+       ``detection_budget_s``.
+    4. **Zombie writes die at the seats** — the demoted ``ctrl-a``
+       refuses to actuate (:class:`~ratelimiter_tpu.control.NotLeader`
+       BEFORE any frame leaves it), and a forced ``set_policy`` frame
+       carried at its stale epoch — after the partition heals — is
+       refused by every seat (``stale_rejected``) with ZERO rows
+       moved: policy generations and rows are byte-compared around
+       the attempt.
+    5. **Storm continues under the successor** — ``ctrl-b`` keeps
+       cutting the storm tenant at monotone generations; the
+       well tenant's storm-phase goodput stays >= ``goodput_floor`` x
+       its pre-storm mean (the control-plane failover never dents the
+       data plane).
+
+    Raises AssertionError on any violated claim; returns the report.
+    """
+    from ratelimiter_tpu.control import (
+        AdaptivePolicyController,
+        ControlConfig,
+        ControllerElection,
+        FleetControlPlane,
+        NotLeader,
+    )
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.fleet import LocalExecutor, NodeManager
+    from ratelimiter_tpu.replication.control import ControlClient
+    from ratelimiter_tpu.replication.remote import RemoteBackend
+    from ratelimiter_tpu.semantics.oracle import (
+        SlidingWindowOracle,
+        TokenBucketOracle,
+    )
+    from ratelimiter_tpu.service import sidecar as sc
+
+    rng = random.Random(seed)
+    # Order-only policies (the cross-host drill idiom): decisions
+    # depend only on arrival ORDER, so subprocess clock skew cannot
+    # move a verdict — and a FRESH key under any policy row behaves
+    # exactly like a fresh oracle built from that row.
+    GIANT_WINDOW = 1 << 30
+    cfg_well = RateLimitConfig(max_permits=30, window_ms=GIANT_WINDOW,
+                               refill_rate=1e-9)
+    assert cfg_well.refill_rate_fp == 0, "drill needs an order-only bucket"
+    cfg_storm = RateLimitConfig(max_permits=18, window_ms=GIANT_WINDOW,
+                                enable_local_cache=False)
+    limiters = [
+        {"algo": "tb", "max_permits": cfg_well.max_permits,
+         "window_ms": cfg_well.window_ms,
+         "refill_rate": cfg_well.refill_rate},
+        {"algo": "sw", "max_permits": cfg_storm.max_permits,
+         "window_ms": cfg_storm.window_ms},
+    ]
+    NOW = 1_753_000_000_000  # fixed oracle stamp (its window never rolls)
+    zipf_w = [1.0 / float(r + 1) ** zipf_s for r in range(n_keys)]
+
+    clients: list = []
+    proxies: dict = {}
+    controllers: dict = {}
+    planes: list = []
+    mgr = None
+    election = None
+    node_names = ("N0", "N1")
+
+    def ctl(port, timeout=0.5):
+        c = ControlClient("127.0.0.1", port, timeout=timeout)
+        clients.append(c)
+        return c
+
+    def poll(pred, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if mgr is not None:
+                # The probe/election heartbeat rides every wait: the
+                # leader's own-clock lease must keep renewing or
+                # self_check() would demote it for OUR idleness.
+                mgr.tick()
+            if pred():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    report = {"decisions": 0, "mismatches": 0, "waves": 0}
+    wave_seq = [0]
+    try:
+        # -- topology: two single-shard cells under fleet management ------
+        mgr = NodeManager(
+            executor=LocalExecutor(boot_timeout_s=boot_timeout_s),
+            probe_interval_ms=tick_ms, probe_timeout_s=1.0,
+            registry=registry)
+        nodes, cli = {}, {}
+        for name in node_names:
+            nodes[name] = mgr.spawn(
+                name, "primary", shards=1, version="v1",
+                num_slots=num_slots, limiters=limiters,
+                boot_timeout_s=boot_timeout_s)
+            mgr.mark_serving(name)
+            cli[name] = sc.SidecarClient(
+                "127.0.0.1", nodes[name].sidecar_ports()[0])
+            clients.append(cli[name])
+            assert cli[name].server_version >= 3, "node handshake failed"
+
+        def lids_of(node):
+            v = node.ready["lids"]
+            return list(v[0]) if v and isinstance(v[0], list) else list(v)
+
+        assert lids_of(nodes["N0"]) == lids_of(nodes["N1"]), (
+            "cells must register identical lids for fleet-wide rows")
+        lid_well, lid_storm = lids_of(nodes["N0"])
+
+        # ctrl-a reaches every member THROUGH a partitionable proxy;
+        # ctrl-b's links are direct — the partition cuts exactly one
+        # controller's world.
+        for name in node_names:
+            proxies[name] = FaultInjectingProxy(
+                nodes[name].control_port).start()
+        # Short timeouts on the proxied links: during the partition the
+        # leader's every call burns its full deadline (bytes vanish, no
+        # RST), and detection latency stacks those timeouts.
+        members_a = {
+            name: RemoteBackend(ctl(proxies[name].port, timeout=0.3),
+                                label=f"{name}-via-proxy", shard=0)
+            for name in node_names}
+        members_b = {
+            name: RemoteBackend(ctl(nodes[name].control_port),
+                                label=name, shard=0)
+            for name in node_names}
+        ceilings = {int(lid_well): ("tb", cfg_well),
+                    int(lid_storm): ("sw", cfg_storm)}
+        plane_a = FleetControlPlane("ctrl-a", members_a,
+                                    limiters=ceilings, ttl_ms=ttl_ms)
+        plane_b = FleetControlPlane("ctrl-b", members_b,
+                                    limiters=ceilings, ttl_ms=ttl_ms)
+        planes[:] = [plane_a, plane_b]
+        election = ControllerElection([plane_a, plane_b],
+                                      interval_ms=tick_ms,
+                                      registry=registry)
+        mgr.attach(election)
+        ctrl_cfg = ControlConfig(
+            interval_ms=tick_ms, window_ms=3000, target_excess=0.5,
+            decrease_factor=0.5, floor_fraction=0.1)
+        controllers["ctrl-a"] = AdaptivePolicyController(plane_a, ctrl_cfg)
+        controllers["ctrl-b"] = AdaptivePolicyController(plane_b, ctrl_cfg)
+
+        def node_info(name):
+            return members_b[name].policy_info()
+
+        def row_of(name, lid):
+            return node_info(name)["lids"][str(lid)]
+
+        def gens():
+            return {name: int(node_info(name)["generation"])
+                    for name in node_names}
+
+        # -- step 1: ctrl-a wins the cell ---------------------------------
+        mgr.tick()
+        assert plane_a.is_leader and plane_a.epoch == 1, (
+            plane_a.fleet_status())
+        assert not plane_b.is_leader
+        assert election.leader() is plane_a
+
+        def wave(goodput_log=None):
+            """One well-tenant Zipf wave against BOTH nodes, every
+            decision checked against a fresh generation-aware oracle
+            (rebuilt from the node's live policy row, fresh keys)."""
+            mgr.tick()  # keep the leader lease + election heartbeat live
+            wave_seq[0] += 1
+            report["waves"] += 1
+            ids = rng.choices(range(n_keys), weights=zipf_w, k=pipeline)
+            perms = [rng.choice([1, 1, 2, 3]) for _ in ids]
+            keys = [f"w{wave_seq[0]}:k{kid}" for kid in ids]
+            admitted = offered = 0
+            for name in node_names:
+                row = row_of(name, lid_well)
+                ocfg = RateLimitConfig(
+                    max_permits=int(row["max_permits"]),
+                    window_ms=int(row["window_ms"]),
+                    refill_rate=float(row["refill_rate"]))
+                oracle = TokenBucketOracle(ocfg)
+                got = cli[name].acquire_batch(lid_well, keys, perms)
+                for j, (status, allowed, rem) in enumerate(got):
+                    assert status == sc.ST_OK, (name, j, status)
+                    d = oracle.try_acquire(keys[j], perms[j], NOW)
+                    report["decisions"] += 1
+                    offered += 1
+                    admitted += 1 if allowed else 0
+                    if allowed != d.allowed \
+                            or int(rem) != d.remaining_hint:
+                        report["mismatches"] += 1
+            if goodput_log is not None:
+                goodput_log.append(admitted / max(offered, 1))
+
+        def storm_wave():
+            """Hammer the storm tenant far past its window on both
+            nodes (denied >> admitted: the AIMD overload verdict)."""
+            mgr.tick()  # keep the leader lease + election heartbeat live
+            wave_seq[0] += 1
+            keys = [f"s{wave_seq[0]}:hot"] * 50
+            perms = [1] * len(keys)
+            for name in node_names:
+                row = row_of(name, lid_storm)
+                ocfg = RateLimitConfig(
+                    max_permits=int(row["max_permits"]),
+                    window_ms=int(row["window_ms"]),
+                    enable_local_cache=False)
+                oracle = SlidingWindowOracle(ocfg)
+                got = cli[name].acquire_batch(lid_storm, keys, perms)
+                for j, (status, allowed, _rem) in enumerate(got):
+                    assert status == sc.ST_OK, (name, j, status)
+                    d = oracle.try_acquire(keys[j], perms[j], NOW)
+                    report["decisions"] += 1
+                    if allowed != d.allowed:
+                        report["mismatches"] += 1
+
+        pre_goodput: list = []
+        storm_goodput: list = []
+        for _ in range(max(pre_waves, 1)):
+            wave(goodput_log=pre_goodput)
+
+        # -- step 2: storm -> fleet-true AIMD cut at one generation -------
+        for _ in range(max(storm_waves, 1)):
+            storm_wave()
+        mgr.tick()  # renew the lease right before actuation self_check
+        controllers["ctrl-a"].tick()
+        assert plane_a.last_broadcast_generation >= 1, (
+            "the leader's AIMD tick observed a fleet-wide storm but "
+            "broadcast nothing")
+        cut_gen = plane_a.last_broadcast_generation
+        poll(lambda: all(g == cut_gen for g in gens().values()), 5.0,
+             "the storm cut to land on every node at one generation")
+        for name in node_names:
+            row = row_of(name, lid_storm)
+            assert int(row["max_permits"]) < cfg_storm.max_permits, (
+                f"{name} still serves the uncut storm policy: {row}")
+            assert int(row["generation"]) == cut_gen
+        wave(goodput_log=storm_goodput)  # the cut must not dent the well
+
+        # -- step 3: partition the leader mid-storm -----------------------
+        storm_wave()
+        t_cut = time.monotonic()
+        for proxy in proxies.values():
+            proxy.partition()
+        deadline = t_cut + detection_budget_s
+        while time.monotonic() < deadline:
+            mgr.tick()  # probe + election ride the SAME manager tick
+            if plane_b.is_leader and not plane_a.is_leader:
+                break
+            time.sleep(tick_ms / 1000.0)
+        detect_s = time.monotonic() - t_cut
+        assert plane_b.is_leader and not plane_a.is_leader, (
+            f"leadership not repaired within {detection_budget_s}s: "
+            f"{election.status()}")
+        assert detect_s <= detection_budget_s
+        # Own-clock demotion: the partitioned leader could not tell a
+        # rival from a dead network, so it had to assume the worst
+        # within one TTL — before ctrl-b's epoch ever reached it.
+        assert plane_a.demote_reason == "lease_expired", (
+            plane_a.demote_reason)
+        assert plane_b.epoch == plane_a.epoch + 1
+        assert detect_s * 1000.0 >= ttl_ms * 0.5, (
+            f"demotion landed in {detect_s * 1000:.0f}ms — inside half "
+            f"the {ttl_ms:.0f}ms lease TTL, which smells like a rigged "
+            f"clock, not an expiry")
+        poll(lambda: len(set(gens().values())) == 1, 5.0,
+             "generation convergence under the successor")
+        wave(goodput_log=storm_goodput)  # traffic never paused
+
+        # -- step 4: zombie writes die at the seats -----------------------
+        # (a) The demoted plane self-fences BEFORE any frame leaves it.
+        try:
+            plane_a.set_policy(int(lid_storm), cfg_storm)
+            raise AssertionError(
+                "a demoted controller actuated a policy write")
+        except NotLeader:
+            pass
+        # (b) The partition heals and the zombie's frames arrive late,
+        # carried at its superseded epoch: every seat must refuse them
+        # with ZERO rows moved.
+        for proxy in proxies.values():
+            proxy.heal()
+        before = {name: node_info(name) for name in node_names}
+        zombie_row = {str(lid_storm): {
+            "algo": "sw", "max_permits": 999,
+            "window_ms": cfg_storm.window_ms, "refill_rate": 0.0,
+            "gen": max(before[n]["generation"]
+                       for n in node_names) + 5}}
+        stale_refused = 0
+        for name in node_names:
+            resp = members_a[name].set_policy_rows(
+                zombie_row, plane_a.epoch, "ctrl-a")
+            assert resp.get("stale_epoch") and not resp.get("applied"), (
+                f"{name} accepted a write at the superseded epoch "
+                f"{plane_a.epoch}: {resp}")
+            stale_refused += 1
+        after = {name: node_info(name) for name in node_names}
+        for name in node_names:
+            assert after[name]["generation"] == \
+                before[name]["generation"], name
+            assert after[name]["lids"] == before[name]["lids"], (
+                f"{name} rows moved under a stale-epoch write")
+            seat = after[name]["controller"]
+            assert int(seat["stale_rejected"]) >= 1, seat
+            assert seat["node"] == "ctrl-b" \
+                and int(seat["epoch"]) == plane_b.epoch, seat
+
+        # -- step 5: the storm continues under the successor --------------
+        for _ in range(max(storm_waves, 1)):
+            storm_wave()
+        mgr.tick()  # renew the lease right before actuation self_check
+        controllers["ctrl-b"].tick()
+        assert plane_b.last_broadcast_generation > cut_gen, (
+            "the successor's AIMD tick did not advance the generation")
+        final_gen = plane_b.last_broadcast_generation
+        poll(lambda: all(g == final_gen for g in gens().values()), 5.0,
+             "the successor's cut to land on every node")
+        for _ in range(2):
+            wave(goodput_log=storm_goodput)
+        storm_wave()
+
+        # -- end state ----------------------------------------------------
+        pre_mean = sum(pre_goodput) / len(pre_goodput)
+        storm_mean = sum(storm_goodput) / len(storm_goodput)
+        ratio = storm_mean / max(pre_mean, 1e-9)
+        report.update(
+            detect_s=round(detect_s, 3),
+            epochs={"ctrl-a": plane_a.epoch, "ctrl-b": plane_b.epoch},
+            demote_reason=plane_a.demote_reason,
+            cut_generation=cut_gen, final_generation=final_gen,
+            stale_refused=stale_refused,
+            stale_rejected_total=sum(
+                int(node_info(n)["controller"]["stale_rejected"])
+                for n in node_names),
+            pre_goodput=round(pre_mean, 4),
+            storm_goodput=round(storm_mean, 4),
+            goodput_ratio=round(ratio, 4),
+            elections=election.elections,
+            fleet=plane_b.fleet_status())
+        assert ratio >= goodput_floor, (
+            f"well-tenant goodput fell to {ratio:.2f}x its pre-storm "
+            f"mean (floor {goodput_floor}x): the controller failover "
+            f"dented the data plane: {report}")
+        assert election.elections == 2 and plane_a.elections == 1 \
+            and plane_b.elections == 1, election.status()
+        if report["mismatches"]:
+            raise AssertionError(
+                f"decisions diverged from the generation-aware oracle: "
+                f"{report}")
+        return report
+    finally:
+        for controller in controllers.values():
+            controller.stop()
+        if election is not None:
+            election.close()
+        for plane in planes:
+            try:
+                plane.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for proxy in proxies.values():
+            try:
+                proxy.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if mgr is not None:
+            mgr.close()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
+# ---------------------------------------------------------------------------
 # Sustained-outage drill (breaker open -> degraded -> resync -> bit-identical)
 # ---------------------------------------------------------------------------
 

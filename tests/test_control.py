@@ -315,6 +315,35 @@ def test_global_cap_scales_every_tenant():
     st.close()
 
 
+def test_global_cap_engages_on_raw_observed_load_not_admitted():
+    """The shed-heavy storm regression: per-tenant limits deny most of
+    the storm, so the ADMITTED rate stays far under the cap while raw
+    arrivals are far above it.  Admitted-rate scaling would never
+    engage here; the cap must trigger and size on OBSERVED load."""
+    clock = {"t": T0}
+    st = make_storage(clock)
+    recorder = FlightRecorder(64)
+    lid = st.register_limiter("sw", RateLimitConfig(max_permits=30,
+                                                    window_ms=1000))
+    ctl = make_controller(st, clock, recorder=recorder,
+                          global_cap_per_s=120.0, target_excess=0.99)
+    admitted = 0
+    for _ in range(3):
+        clock["t"] += 1000
+        admitted = _drive(st, lid, "hot", 200, clock["t"])  # 200/s raw
+        ctl.tick()
+    assert admitted <= 30  # the per-tenant limit sheds the storm...
+    s = ctl.status()
+    assert s["global_cap_engagements"] > 0
+    assert s["global_scale"] == pytest.approx(120.0 / 200.0, rel=0.2)
+    events = [e for e in recorder.snapshot(last=64)["events"]
+              if e["kind"] == "control.global_cap_engaged"]
+    assert events and events[-1]["observed_per_s"] > 120.0
+    assert events[-1]["admitted_per_s"] < 120.0  # the old rule's blind spot
+    ctl.close()
+    st.close()
+
+
 # ---------------------------------------------------------------------------
 # Concurrency slots (leases as slots)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,465 @@
+"""Fleet-true control plane (control/fleet.py + replication/control.py,
+ARCHITECTURE §15).
+
+- ControllerSeat: the fence-epoch acceptor — higher epoch wins, lower
+  is refused in-protocol, a stale-epoch policy write is counted and
+  never applied.
+- controller_handlers over a real loopback ControlServer: claim /
+  set_policy / policy_info / signals, epoch + generation fencing.
+- FleetControlPlane: majority election, monotone-generation broadcast,
+  anti-entropy convergence, self-demotion (superseded AND own-clock
+  lease expiry), NotLeader actuation refusals.
+- ControllerElection: leader-death failover on the manager tick,
+  note_join anti-entropy, ratelimiter.control.* metrics.
+- The partitioned-controller drill (fast shape): two real hostproc
+  cells, the leader partitioned mid-storm — zero stale policy writes,
+  successor at epoch+1, one generation fleet-wide, goodput holds.
+"""
+
+import pytest
+
+from ratelimiter_tpu.control import (
+    AdaptivePolicyController,
+    ControlConfig,
+    ControllerElection,
+    FleetControlPlane,
+    NotLeader,
+)
+from ratelimiter_tpu.control.fleet import STALE_UNREACHABLE_MS
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.metrics import MeterRegistry
+from ratelimiter_tpu.observability.flightrecorder import FlightRecorder
+from ratelimiter_tpu.replication.control import (
+    ControllerSeat,
+    controller_handlers,
+)
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+T0 = 1_700_000_000_000
+
+
+def make_storage(clock, **kw):
+    kw.setdefault("num_slots", 256)
+    kw.setdefault("max_delay_ms", 0.2)
+    return TpuBatchedStorage(clock_ms=lambda: clock["t"], **kw)
+
+
+class TableBackend:
+    """In-process member: the RemoteBackend duck over a node's
+    controller_handlers table — no sockets, injected clocks."""
+
+    def __init__(self, table):
+        self.table = table
+        self.unreachable = False
+
+    def _call(self, op, **kw):
+        if self.unreachable:
+            raise OSError("partitioned")
+        return self.table[op](**kw)
+
+    def controller_claim(self, node, epoch, ttl_ms=3000.0):
+        return self._call("controller_claim", node=node, epoch=epoch,
+                          ttl_ms=ttl_ms)
+
+    def set_policy_rows(self, rows, epoch, node=""):
+        return self._call("set_policy", rows=rows, epoch=epoch, node=node)
+
+    def policy_info(self):
+        return self._call("policy_info")
+
+    def signals(self, window_ms=2000):
+        return self._call("signals", window_ms=window_ms)
+
+
+def make_cell(clock, n=2, limiter=None):
+    """n member storages + their handler tables, same registrations."""
+    limiter = limiter or RateLimitConfig(max_permits=40, window_ms=1000)
+    storages, members = [], {}
+    for i in range(n):
+        st = make_storage(clock)
+        lid = st.register_limiter("sw", limiter)
+        assert lid == 1
+        storages.append(st)
+        members[f"n{i}"] = TableBackend(controller_handlers(st))
+    return storages, members, 1, limiter
+
+
+def make_plane(members, limiter, node="ctrl-a", mono=None, **kw):
+    ceilings = {1: ("sw", limiter)}
+    if mono is not None:
+        kw["clock_ms"] = lambda: mono["t"]
+    return FleetControlPlane(node, members, limiters=ceilings, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ControllerSeat: the node-side fence
+# ---------------------------------------------------------------------------
+
+def test_seat_higher_epoch_wins_lower_refused():
+    clock = {"t": 0.0}
+    seat = ControllerSeat(clock=lambda: clock["t"])
+    assert seat.claim("a", 1)["granted"]
+    # The holder renews at its own epoch (TTL refresh).
+    assert seat.claim("a", 1)["granted"]
+    # A rival at the SAME epoch is refused: one winner per epoch.
+    refused = seat.claim("b", 1)
+    assert not refused["granted"] and refused["epoch"] == 1
+    # A strictly higher epoch supersedes even an unexpired grant.
+    assert seat.claim("b", 2)["granted"]
+    out = seat.claim("a", 1)
+    assert not out["granted"] and out["epoch"] == 2
+    info = seat.info()
+    assert info["node"] == "b" and info["epoch"] == 2
+
+
+def test_seat_stale_epoch_write_counted_never_applied():
+    clock = {"t": T0}
+    st = make_storage(clock)
+    lid = st.register_limiter("sw", RateLimitConfig(max_permits=40,
+                                                    window_ms=1000))
+    seat = ControllerSeat()
+    table = controller_handlers(st, seat)
+    assert table["controller_claim"](node="a", epoch=3)["granted"]
+    row = {str(lid): {"algo": "sw", "max_permits": 10, "window_ms": 1000,
+                      "refill_rate": 0.0, "gen": 1}}
+    resp = table["set_policy"](rows=row, epoch=2, node="zombie")
+    assert resp == {"applied": False, "stale_epoch": True, "epoch": 3,
+                    "generation": 0}
+    assert seat.stale_rejected == 1
+    assert st.policy_info()["lids"][lid]["max_permits"] == 40
+    # The current epoch applies; a duplicate is idempotent; an OLDER
+    # generation at a current epoch is refused in-protocol.
+    assert table["set_policy"](rows=row, epoch=3)["applied"]
+    assert st.policy_info()["lids"][lid]["max_permits"] == 10
+    dup = table["set_policy"](rows=row, epoch=3)
+    assert dup["applied"] and dup["generation"] == 1
+    older = {str(lid): {"algo": "sw", "max_permits": 20,
+                        "window_ms": 1000, "refill_rate": 0.0, "gen": 1}}
+    resp = table["set_policy"](rows=older, epoch=3)
+    assert resp["stale_generation"] and not resp["applied"]
+    info = table["policy_info"]()
+    assert info["controller"]["node"] == "a"
+    assert info["controller"]["epoch"] == 3
+    st.close()
+
+
+def test_seat_expiry_is_reported_not_self_cleared():
+    clock = {"t": 0.0}
+    seat = ControllerSeat(clock=lambda: clock["t"])
+    seat.claim("a", 1, ttl_ms=100.0)
+    clock["t"] += 10.0  # seconds: far past the 100ms TTL
+    refused = seat.claim("b", 1)
+    # Same-epoch rivals stay refused even expired — only a HIGHER epoch
+    # (a real election round) takes an expired seat, so a network blip
+    # can never yield two same-epoch holders.
+    assert not refused["granted"] and refused["expired"]
+    assert seat.info()["expired"]
+    assert seat.claim("b", 2)["granted"]
+
+
+# ---------------------------------------------------------------------------
+# FleetControlPlane: election, broadcast, demotion
+# ---------------------------------------------------------------------------
+
+def test_plane_elects_with_majority_and_broadcasts_one_generation():
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    plane = make_plane(members, limiter)
+    assert not plane.is_leader
+    with pytest.raises(NotLeader):
+        plane.set_policy(lid, RateLimitConfig(max_permits=10,
+                                              window_ms=1000))
+    assert plane.elect()
+    assert plane.is_leader and plane.epoch == 1
+    gen = plane.set_policy(lid, RateLimitConfig(max_permits=10,
+                                                window_ms=1000))
+    assert gen == 1 and plane.last_broadcast_generation == 1
+    for st in storages:
+        info = st.policy_info()
+        assert info["generation"] == 1
+        assert info["lids"][lid]["max_permits"] == 10
+    assert plane.node_generations == {"n0": 1, "n1": 1}
+    with pytest.raises(KeyError):
+        plane.set_policy(99, RateLimitConfig(max_permits=5,
+                                             window_ms=1000))
+    for st in storages:
+        st.close()
+
+
+def test_plane_without_majority_does_not_lead():
+    clock = {"t": T0}
+    storages, members, _, limiter = make_cell(clock, n=3)
+    members["n1"].unreachable = True
+    members["n2"].unreachable = True
+    plane = make_plane(members, limiter)
+    assert not plane.elect()  # 1 of 3 seats is no quorum
+    assert not plane.is_leader
+    for st in storages:
+        st.close()
+
+
+def test_plane_superseded_demotes_and_refuses_to_actuate():
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    old = make_plane(members, limiter, node="ctrl-old")
+    new = make_plane(members, limiter, node="ctrl-new")
+    assert old.elect() and old.epoch == 1
+    assert new.elect() and new.epoch == 2  # observed 1, claims 2
+    # The old leader learns it was superseded at its next heartbeat
+    # and self-demotes; its actuations refuse BEFORE touching a seat.
+    assert not old.maintain()
+    assert not old.is_leader and old.demote_reason == "superseded"
+    with pytest.raises(NotLeader):
+        old.set_policy(lid, RateLimitConfig(max_permits=5,
+                                            window_ms=1000))
+    # Its zombie frame (stale epoch, forced past the self-fence) dies
+    # at every seat without moving a row.
+    row = {str(lid): {"algo": "sw", "max_permits": 5, "window_ms": 1000,
+                      "refill_rate": 0.0, "gen": 9}}
+    for name, member in members.items():
+        resp = member.set_policy_rows(row, old.epoch, "ctrl-old")
+        assert resp["stale_epoch"] and not resp["applied"], name
+    for st in storages:
+        assert st.policy_info()["lids"][lid]["max_permits"] == 40
+    # The rightful leader still actuates.
+    assert new.set_policy(lid, RateLimitConfig(max_permits=20,
+                                               window_ms=1000)) >= 1
+    for st in storages:
+        st.close()
+
+
+def test_plane_own_clock_lease_expiry_self_demotes():
+    clock = {"t": T0}
+    mono = {"t": 0.0}
+    storages, members, lid, limiter = make_cell(clock)
+    plane = make_plane(members, limiter, mono=mono, ttl_ms=500.0)
+    assert plane.elect()
+    mono["t"] += 499.0
+    assert plane.self_check()
+    # Sever BOTH seats: renewals stop landing a majority, and once the
+    # plane's OWN clock passes the TTL it must assume a rival won.
+    for member in members.values():
+        member.unreachable = True
+    mono["t"] += 2.0
+    assert not plane.renew()
+    assert plane.is_leader  # not yet expired on its own clock... barely
+    mono["t"] += 500.0
+    assert not plane.self_check()
+    assert not plane.is_leader
+    assert plane.demote_reason == "lease_expired"
+    with pytest.raises(NotLeader):
+        plane.set_policy(lid, RateLimitConfig(max_permits=5,
+                                              window_ms=1000))
+    for st in storages:
+        st.close()
+
+
+def test_plane_converge_anti_entropies_a_stale_member():
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    plane = make_plane(members, limiter)
+    assert plane.elect()
+    plane.set_policy(lid, RateLimitConfig(max_permits=10,
+                                          window_ms=1000))
+    # A re-seeded member joins at generation 0 with the same
+    # registrations: converge pushes the leader's newest rows to it.
+    fresh = make_storage(clock)
+    assert fresh.register_limiter("sw", limiter) == lid
+    plane.add_member("n2", TableBackend(controller_handlers(fresh)))
+    # The new seat has never granted the leader's epoch: a broadcast
+    # would be refused (stale epoch 0 < ... no: seat epoch is 0, the
+    # leader's 1 wins) — converge claims nothing, so re-elect first.
+    assert plane.elect()  # re-claims every seat (epoch 2), converges
+    assert fresh.policy_info()["generation"] == 1
+    assert fresh.policy_info()["lids"][lid]["max_permits"] == 10
+    assert plane.converged()
+    for st in storages:
+        st.close()
+    fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# ControllerElection: the repair loop
+# ---------------------------------------------------------------------------
+
+def test_election_fails_over_to_the_standby_candidate():
+    clock = {"t": T0}
+    mono = {"t": 0.0}
+    storages, members, lid, limiter = make_cell(clock)
+    registry = MeterRegistry()
+    a = make_plane(members, limiter, node="ctrl-a", mono=mono,
+                   ttl_ms=500.0)
+    # ctrl-b gets its OWN links to the same seats — the partition cuts
+    # one controller's world, not the seats themselves.
+    members_b = {name: TableBackend(m.table)
+                 for name, m in members.items()}
+    b = make_plane(members_b, limiter, node="ctrl-b")
+    election = ControllerElection([a, b], registry=registry)
+    election.tick()
+    assert election.leader() is a and a.epoch == 1
+    # Healthy ticks keep the lease renewed.
+    mono["t"] += 400.0
+    election.tick()
+    mono["t"] += 400.0
+    election.tick()
+    assert election.leader() is a
+    # Kill ctrl-a's links: the tick demotes it (own-clock lease) and
+    # seats ctrl-b at the NEXT epoch in the same repair pass.
+    for member in members.values():
+        member.unreachable = True
+    mono["t"] += 600.0
+    election.tick()
+    assert not a.is_leader and a.demote_reason == "lease_expired"
+    assert election.leader() is b and b.epoch == 2
+    assert election.elections == 2
+    meters = registry.scrape()
+    assert meters["ratelimiter.control.leader"] == 1.0
+    assert meters["ratelimiter.control.elections"] == 2
+    assert meters["ratelimiter.control.converge_ms"] >= 0.0
+    # The healed zombie's writes die at the seats and are EXPORTED:
+    # its next broadcast attempt self-fences, and a forced frame bumps
+    # stale_rejected on every seat (scraped via the election tick).
+    for member in members.values():
+        member.unreachable = False
+    row = {str(lid): {"algo": "sw", "max_permits": 5, "window_ms": 1000,
+                      "refill_rate": 0.0, "gen": 9}}
+    for member in members.values():
+        assert member.set_policy_rows(row, a.epoch, "ctrl-a")["stale_epoch"]
+    election.tick()
+    assert registry.scrape()["ratelimiter.control.stale_rejected"] == 0
+    # (stale_rejected meters the CANDIDATES' own refusals-at-claim;
+    # node-side seat counts surface via /actuator/controller instead.)
+    assert all(st.policy_info()["lids"][lid]["max_permits"] == 40
+               for st in storages)
+    election.close()
+    for st in storages:
+        st.close()
+
+
+def test_election_note_join_converges_the_newcomer():
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    plane = make_plane(members, limiter)
+    election = ControllerElection([plane])
+    election.tick()
+    plane.set_policy(lid, RateLimitConfig(max_permits=10,
+                                          window_ms=1000))
+    fresh = make_storage(clock)
+    assert fresh.register_limiter("sw", limiter) == lid
+    seat = ControllerSeat()
+    backend = TableBackend(controller_handlers(fresh, seat))
+    # A promoted/re-seeded standby joins: it must not serve gen 0
+    # while its peers serve gen 1.
+    seat.claim(plane.node, plane.epoch)  # promotion handshake grants
+    election.note_join("n2", backend)
+    assert fresh.policy_info()["generation"] == 1
+    assert fresh.policy_info()["lids"][lid]["max_permits"] == 10
+    assert plane.node_generations["n2"] == 1
+    election.close()
+    for st in storages:
+        st.close()
+    fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# The AIMD controller over the fleet plane
+# ---------------------------------------------------------------------------
+
+def _storm(st, lid, demand, now):
+    st.acquire_many("sw", [lid] * demand, ["hot"] * demand, [1] * demand)
+
+
+def test_controller_over_plane_cuts_fleet_wide():
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    plane = make_plane(members, limiter)
+    assert plane.elect()
+    ctl = AdaptivePolicyController(
+        plane, ControlConfig(interval_ms=1000.0, window_ms=2000,
+                             target_excess=0.5, decrease_factor=0.5,
+                             min_load_per_s=1.0),
+        clock_ms=lambda: clock["t"])
+    for _ in range(2):
+        clock["t"] += 1000
+        for st in storages:
+            _storm(st, lid, 300, clock["t"])  # 40 admitted, 260 denied
+        ctl.tick()
+    assert ctl.adjustments_total >= 1
+    # The cut is ONE broadcast landing on EVERY node at one generation.
+    gens = {st.policy_info()["generation"] for st in storages}
+    assert len(gens) == 1 and gens.pop() >= 1
+    cuts = [st.policy_info()["lids"][lid]["max_permits"]
+            for st in storages]
+    assert all(c < limiter.max_permits for c in cuts)
+    assert len(set(cuts)) == 1
+    ctl.close()
+    for st in storages:
+        st.close()
+
+
+def test_stale_fleet_signals_freeze_raises_allow_cuts():
+    """An unreachable member makes the plane's staleness infinite:
+    raises freeze (a partitioned reporter's silence must not justify
+    relaxing), cuts stay allowed, and the episode is one coalesced
+    control.signals_stale flight event."""
+    clock = {"t": T0}
+    storages, members, lid, limiter = make_cell(clock)
+    recorder = FlightRecorder(64)
+    plane = make_plane(members, limiter)
+    assert plane.elect()
+    ctl = AdaptivePolicyController(
+        plane, ControlConfig(interval_ms=1000.0, window_ms=2000,
+                             target_excess=0.5, decrease_factor=0.5,
+                             staleness_bound_ms=10_000.0,
+                             event_coalesce_ms=10_000.0,
+                             min_load_per_s=1.0),
+        clock_ms=lambda: clock["t"], recorder=recorder)
+    # Storm -> cut while healthy.
+    clock["t"] += 1000
+    for st in storages:
+        _storm(st, lid, 300, clock["t"])
+    ctl.tick()
+    cut = storages[0].policy_info()["lids"][lid]["max_permits"]
+    assert cut < limiter.max_permits
+    clock["t"] += 5000  # the storm ages out of the signals window
+    # Partition one member: staleness goes to the unreachable sentinel.
+    members["n1"].unreachable = True
+    assert plane.telemetry.all_signals(2000) is not None
+    assert plane.telemetry.staleness_ms() == STALE_UNREACHABLE_MS
+    # Healthy-looking signals from the remaining member would RAISE —
+    # stale signals must hold the cut instead.
+    for _ in range(3):
+        clock["t"] += 1000
+        _storm(storages[0], lid, 5, clock["t"])  # light, healthy load
+        ctl.tick()
+    assert ctl.signals_stale_ticks >= 3
+    held = storages[0].policy_info()["lids"][lid]["max_permits"]
+    assert held == cut, "a stale plane RAISED a limit"
+    # A storm during the partition still cuts (observed evidence of
+    # overload is safe to act on even if old).
+    clock["t"] += 1000
+    _storm(storages[0], lid, 300, clock["t"])
+    ctl.tick()
+    assert storages[0].policy_info()["lids"][lid]["max_permits"] < cut
+    kinds = [e["kind"] for e in recorder.snapshot(last=64)["events"]]
+    assert kinds.count("control.signals_stale") == 1  # coalesced
+    assert ctl.status()["signals_stale_ticks"] == ctl.signals_stale_ticks
+    ctl.close()
+    for st in storages:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# The drill (fast shape)
+# ---------------------------------------------------------------------------
+
+def test_partitioned_controller_drill_fast():
+    from ratelimiter_tpu.storage.chaos import partitioned_controller_drill
+
+    report = partitioned_controller_drill(pre_waves=2, storm_waves=2)
+    assert report["mismatches"] == 0 and report["decisions"] > 0
+    assert report["epochs"]["ctrl-b"] == report["epochs"]["ctrl-a"] + 1
+    assert report["demote_reason"] == "lease_expired"
+    assert report["stale_refused"] == 2
+    assert report["goodput_ratio"] >= 0.8
+    assert report["elections"] == 2

@@ -101,5 +101,9 @@ def test_catalog_regex_expands_families():
                      "ratelimiter.fleet.nodes",
                      "ratelimiter.fleet.respawns",
                      "ratelimiter.fleet.reseeds",
-                     "ratelimiter.fleet.upgrade_steps"):
+                     "ratelimiter.fleet.upgrade_steps",
+                     "ratelimiter.control.leader",
+                     "ratelimiter.control.elections",
+                     "ratelimiter.control.stale_rejected",
+                     "ratelimiter.control.converge_ms"):
         assert expected in names, expected

@@ -165,3 +165,97 @@ def test_fail_open_allows_on_storage_outage():
     finally:
         srv.shutdown()
         thread.join(timeout=5)
+
+
+def test_controller_actuator_disabled_by_default(server):
+    status, data, _ = req(server, "GET", "/actuator/controller")
+    assert status == 200 and data == {"enabled": False}
+
+
+def test_fleet_control_needs_a_control_port():
+    """fleet.enabled with no peers and no own control port cannot form
+    a member set: wiring warns and leaves fleet control off."""
+    props = AppProperties({"storage.backend": "memory",
+                           "ratelimiter.control.fleet.enabled": "true"})
+    ctx = build_app(props)
+    try:
+        assert ctx.fleet_control is None
+    finally:
+        ctx.close()
+
+
+def test_controller_actuator_and_health_fold_fleet_mode():
+    """/actuator/controller in fleet mode: leader identity, fence
+    epoch, last broadcast generation, per-node applied generation —
+    and a node serving BEHIND the leader's generation folds health to
+    DEGRADED (the generation-convergence invariant, operator-visible)."""
+    from ratelimiter_tpu.control import ControllerElection, FleetControlPlane
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.replication.control import controller_handlers
+    from ratelimiter_tpu.service.wiring import FleetControlHandle
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    class TableBackend:
+        def __init__(self, table):
+            self.table = table
+
+        def controller_claim(self, node, epoch, ttl_ms=3000.0):
+            return self.table["controller_claim"](node=node, epoch=epoch,
+                                                  ttl_ms=ttl_ms)
+
+        def set_policy_rows(self, rows, epoch, node=""):
+            return self.table["set_policy"](rows=rows, epoch=epoch,
+                                            node=node)
+
+        def policy_info(self):
+            return self.table["policy_info"]()
+
+        def signals(self, window_ms=2000):
+            return self.table["signals"](window_ms=window_ms)
+
+    props = AppProperties({"storage.backend": "memory", "server.port": "0"})
+    ctx = build_app(props, storage=InMemoryStorage())
+    member = TpuBatchedStorage(num_slots=64, max_delay_ms=0.2)
+    cfg = RateLimitConfig(max_permits=40, window_ms=1000)
+    lid = member.register_limiter("sw", cfg)
+    plane = FleetControlPlane(
+        "ctrl-a", {"n0": TableBackend(controller_handlers(member))},
+        limiters={lid: ("sw", cfg)})
+    election = ControllerElection([plane])
+    election.tick()
+    ctx.fleet_control = FleetControlHandle(plane, election)
+    srv = make_server(ctx, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        plane.set_policy(lid, RateLimitConfig(max_permits=10,
+                                              window_ms=1000))
+        status, data, _ = req(srv, "GET", "/actuator/controller")
+        assert status == 200
+        assert data["enabled"] and data["fleet"]
+        assert data["node"] == "ctrl-a" and data["is_leader"]
+        assert data["epoch"] == 1
+        assert data["last_broadcast_generation"] == 1
+        assert data["nodes"]["n0"]["generation"] == 1
+        assert data["election"]["leader"] == "ctrl-a"
+        assert data["lagging_nodes"] == []
+        status, health, _ = req(srv, "GET", "/actuator/health")
+        assert status == 200 and health["status"] == "UP"
+        assert health["controller"]["is_leader"]
+        # A node left behind the broadcast generation = DEGRADED.
+        # (Simulate a broadcast the node never applied: the leader's
+        # generation advances, the seat's stays — exactly what the
+        # actuator's per-node refresh would find after a lost frame.)
+        plane.generation = plane.last_broadcast_generation = 2
+        plane.node_generations["n0"] = 1
+        status, health, _ = req(srv, "GET", "/actuator/health")
+        assert status == 200 and health["status"] == "DEGRADED"
+        assert health["controller"]["lagging_nodes"] == ["n0"]
+        status, data, _ = req(srv, "GET", "/actuator/controller")
+        assert data["lagging_nodes"] == ["n0"]
+        assert data["nodes"]["n0"]["generation"] == 1
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+        ctx.close()
+        member.close()

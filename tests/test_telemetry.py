@@ -127,6 +127,28 @@ def test_client_telemetry_roundtrip_and_classes():
     assert sum(c for _i, c in report.hist) == 4
 
 
+def test_client_telemetry_sampled_latency_stamping():
+    """stamp_pending: the caller pays the perf_counter pair only for
+    the FIRST record of each flush interval — counts always land,
+    the histogram gets one sample per interval, and encode_and_reset
+    re-arms the stamp."""
+    telem = ClientTelemetry(client_id=7)
+    assert telem.stamp_pending
+    telem.record_burn(1, "t:a", 1, 4.0)
+    assert not telem.stamp_pending          # first sample taken
+    telem.record_burn(1, "t:a", 1)          # latency-free fast path
+    telem.record_deny(1, "t:b")
+    report = decode_report(telem.encode_and_reset())
+    assert (report.allowed, report.denied) == (2, 1)  # counts complete
+    assert sum(c for _i, c in report.hist) == 1       # one sample
+    assert telem.stamp_pending               # re-armed by the flush
+    # A latency passed while unarmed still lands (the caller decides).
+    telem.record_deny(1, "t:b", 9.0)
+    assert not telem.stamp_pending
+    report = decode_report(telem.encode_and_reset())
+    assert sum(c for _i, c in report.hist) == 1
+
+
 def test_default_key_class_bounds_cardinality():
     assert default_key_class("tenant:user123") == "tenant"
     assert default_key_class("plainkey") == "*"

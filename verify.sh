@@ -99,12 +99,15 @@ fi
 echo "running observability overhead gate (full layer incl. telemetry plane + usage ring <= 2% of hot path)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python \
     bench/observability_overhead.py --n 2097152 --rounds 5 \
-    --assert-budget 0.02 > /dev/null; then
-  echo "  ok  observability overhead budget"
+    --assert-budget 0.02 --assert-leased-ratio 0.4 > /dev/null; then
+  echo "  ok  observability overhead budget + leased telemetry ratio"
 else
   echo "  FAILED  observability overhead budget (stage timers + trace +"
   echo "          flight recorder + fleet telemetry/usage ring cost more"
-  echo "          than 2% of the headline stream)"
+  echo "          than 2% of the headline stream, the leased client's"
+  echo "          telemetry-on throughput fell below 0.4x the off"
+  echo "          baseline, or sampled latency stamping stopped beating"
+  echo "          the per-burn perf_counter pair)"
   fail=1
 fi
 
@@ -203,6 +206,33 @@ else
   echo "  FAILED  control-plane overhead budget (a converged controller's"
   echo "          tick sweep + per-grant generation checks cost more than"
   echo "          2% of steady-state CPU at the configured cadence)"
+  fail=1
+fi
+
+echo "running fleet control-plane overhead gate (elected leader over control RPC <= 2%)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/control_overhead.py \
+    --fleet --assert-budget 0.02 > /dev/null; then
+  echo "  ok  fleet control-plane overhead budget"
+else
+  echo "  FAILED  fleet control-plane overhead budget (the fleet cadence —"
+  echo "          majority seat renewal + fleet-summed signals sweep +"
+  echo "          the AIMD pass over real control-RPC members — costs"
+  echo "          more than 2% of steady-state CPU)"
+  fail=1
+fi
+
+echo "running partitioned-controller drill (epoch-fenced leadership, zero zombie writes)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet_control.py::test_partitioned_controller_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  partitioned-controller drill"
+else
+  echo "  FAILED  partitioned-controller drill (a partitioned leader's"
+  echo "          policy write landed after its epoch was superseded, the"
+  echo "          standby failed to take over inside the detection budget,"
+  echo "          the fleet did not converge to one policy generation, a"
+  echo "          decision diverged from the generation-aware oracle, or"
+  echo "          storm goodput fell below 0.8x pre-storm)"
   fail=1
 fi
 
