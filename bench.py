@@ -495,6 +495,29 @@ def main() -> None:
         detail["sidecar_loopback"] = {"error": str(exc)}
         log(f"  sidecar loopback failed: {exc}")
 
+    # -- coalesce smoke: Zipf key coalescing A/B (v5 ingest digest) ----------
+    # The wire-speed ingestion claim: repeat-heavy Zipf traffic coalesces
+    # to one weighted decision per unique key, bit-identical to the
+    # sequential oracle.  Subprocess (CPU in-process device).
+    log("coalesce smoke: Zipf digest vs rank-major scan (subprocess)...")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench",
+                                          "coalesce_smoke.py")],
+            capture_output=True, timeout=600, text=True, cwd=_REPO)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"rc={proc.returncode} stderr={proc.stderr[-500:]!r}")
+        detail["coalesce_smoke"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+        r = detail["coalesce_smoke"]
+        log(f"  coalesce: {r['coalesce_ratio']}x vs uncoalesced scan "
+            f"({r['coalesced_decisions_per_sec']:,.0f}/s; "
+            f"{r['oracle_mismatches']} oracle mismatches)")
+    except Exception as exc:  # noqa: BLE001 — aux section must not kill bench
+        detail["coalesce_smoke"] = {"error": str(exc)}
+        log(f"  coalesce smoke failed: {exc}")
+
     # -- scenario 3: 10M-key sliding window, uniform (streaming) -------------
     num_keys3 = 50_000 if small else 10_000_000
     n3 = super_n * (2 if small else 4)

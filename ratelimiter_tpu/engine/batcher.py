@@ -378,6 +378,52 @@ class MicroBatcher:
             self._cv.notify()
         return futs
 
+    def submit_block(self, algo: str, slots, lids, permits,
+                     deadline_ms: float | None = None,
+                     trace_id: int = 0) -> Future:
+        """One future for a whole columnar burst (the sidecar's v5 batch
+        frame): the n requests stage exactly like :meth:`submit_many` —
+        contiguous lanes, all-or-nothing admission, one shared deadline —
+        but resolve through a SINGLE future whose result maps each output
+        key to its lanes' array slice ({"allowed": bool[n], ...}), so a
+        thousand-row frame costs one Future and one set_result instead of
+        a thousand.  The future object rides every one of its lanes in
+        the parallel staging lists (tagged ``_lanes = n``), which keeps
+        compaction, forget(), deadline expiry, and close() positional:
+        the shared deadline makes expiry all-or-nothing, forget() drops
+        every lane at once, and repeated _fail/cancel calls are no-ops
+        after the first."""
+        n = len(slots)
+        fut = Future()
+        fut._lanes = n
+        if n == 0:
+            fut.set_result({})
+            return fut
+        with self._cv:
+            if self._closed:
+                raise ShutdownError("batcher closed")
+            if self._flusher_dead:
+                raise OverloadedError(
+                    "flusher thread died; nothing will dispatch this queue",
+                    reason="flusher_dead", retry_after_ms=1000.0)
+            pend = self._pending[algo]
+            self._check_admission(pend, n)
+            if pend.born is None:
+                pend.born = time.monotonic()
+            budget = self.deadline_ms if deadline_ms is None else deadline_ms
+            deadline = (time.monotonic() + budget / 1000.0
+                        if budget and budget > 0 else math.inf)
+            pend.extend(slots, lids, permits)
+            pend.futures.extend([fut] * n)
+            pend.deadlines.extend([deadline] * n)
+            pend.t_sub.extend([time.perf_counter()] * n)
+            pend.traces.extend([int(trace_id)] * n)
+            if pend.n > self.max_depth_seen:
+                self.max_depth_seen = pend.n
+            self._waiters.add(fut)
+            self._cv.notify()
+        return fut
+
     def queue_depth(self) -> int:
         """Largest per-algo pending queue (the admission-control bound's
         operand), for health reporting."""
@@ -500,9 +546,20 @@ class MicroBatcher:
                 # Adaptive flush feedback: the measured device stage
                 # (dispatch enqueued -> results fetched) for this batch.
                 self._controller.observe(t_dev - stamps[2], len(futures))
-            for i, fut in enumerate(futures):
+            i, nf = 0, len(futures)
+            while i < nf:
+                fut = futures[i]
+                # submit_block rides one future across its lanes; such a
+                # future resolves ONCE, to the lanes' array slices.
+                lanes = getattr(fut, "_lanes", 1)
+                j = min(i + lanes, nf)
                 if not fut.done():  # close() may have failed it already
-                    fut.set_result({k: v[i] for k, v in out.items()})
+                    if lanes == 1:
+                        fut.set_result({k: v[i] for k, v in out.items()})
+                    else:
+                        fut.set_result({k: np.asarray(v[i:j])
+                                        for k, v in out.items()})
+                i = j
         except Exception as exc:  # noqa: BLE001 — fail every waiter
             for fut in futures:
                 if not fut.done():

@@ -496,6 +496,58 @@ class DeviceEngine:
                     perms_rank, roff, lid, now)
         return bits
 
+    def sw_weighted_counts_dispatch(self, uwords, wlane, lid, now_ms,
+                                    out_dtype):
+        return self._weighted_counts_dispatch("sw", uwords, wlane, lid,
+                                              now_ms, out_dtype)
+
+    def tb_weighted_counts_dispatch(self, uwords, wlane, lid, now_ms,
+                                    out_dtype):
+        return self._weighted_counts_dispatch("tb", uwords, wlane, lid,
+                                              now_ms, out_dtype)
+
+    def _weighted_counts_dispatch(self, algo, uwords, wlane, lid, now_ms,
+                                  out_dtype):
+        """Coalesced weighted digest dispatch
+        (ops/relay.py:*_relay_weighted_counts): uwords uint32[U] (slot |
+        clamped count; padding 0xFFFFFFFF), wlane uint8[U] the segment's
+        uniform per-request weight; returns the lazy out_dtype[U]
+        per-unique allowed-count handle (the host reconstructs
+        ``rank < counts[uidx]``).  Only valid when every repeat of a key
+        inside the chunk carries the same weight — the stream loop
+        elects this per chunk and falls back to the scan otherwise."""
+        from ratelimiter_tpu.ops.relay import (
+            sw_relay_weighted_counts,
+            tb_relay_weighted_counts,
+        )
+
+        uwords_host = uwords
+        uwords = jnp.asarray(np.ascontiguousarray(uwords, dtype=np.uint32))
+        self._mark_words(algo, uwords_host, dev=uwords)
+        jdt = jnp.uint8 if out_dtype == np.uint8 else jnp.uint16
+        key = (algo, out_dtype().dtype.name, "wcounts")
+        fn = self._relay_counts.get(key)
+        if fn is None:
+            base = (sw_relay_weighted_counts if algo == "sw"
+                    else tb_relay_weighted_counts)
+            fn = jax.jit(functools.partial(
+                base, rank_bits=self.rank_bits, out_dtype=jdt),
+                donate_argnums=0)
+            self._relay_counts[key] = fn
+        wlane = jnp.asarray(np.ascontiguousarray(wlane, dtype=np.uint8))
+        lid = jnp.asarray(np.int32(lid))
+        now = jnp.int64(now_ms)
+        with self._lock:
+            if algo == "sw":
+                self.sw_packed, counts = fn(
+                    self.sw_packed, self.table.device_arrays, uwords,
+                    wlane, lid, now)
+            else:
+                self.tb_packed, counts = fn(
+                    self.tb_packed, self.table.device_arrays, uwords,
+                    wlane, lid, now)
+        return counts
+
     def sw_relay_counts_dispatch(self, uwords, lids, now_ms, out_dtype,
                                  slots_sorted=False):
         return self._relay_counts_dispatch("sw", uwords, lids, now_ms,

@@ -212,6 +212,69 @@ class LeaseClient:
             self._maybe_flush(now)
         return False
 
+    def try_acquire_many(self, keys, permits=None) -> list:
+        """Batched decision surface: burn locally where live leases
+        cover, then coalesce EVERY fallback decision of the flush into
+        columnar batch frames (transport ``acquire_block``, wire v5 —
+        one frame per chunk instead of one frame per request).
+        Decisions are positionally identical to calling
+        :meth:`try_acquire` per key; only the wire framing changes.
+        Transports without ``acquire_block`` fall back per-request."""
+        n = len(keys)
+        perms = ([1] * n if permits is None
+                 else [max(int(p), 1) for p in permits])
+        out = [False] * n
+        fb_i: list = []
+        fb_k: list = []
+        fb_p: list = []
+        telem = self._telem
+        now = int(self._clock_ms())
+        for i, key in enumerate(keys):
+            p = perms[i]
+            lease = self._leases.get(key)
+            hit = lease is not None and now < lease.deadline \
+                and lease.remaining >= p
+            if not hit:
+                lease = self._refresh(key, lease, now)
+            if lease is not None and now < lease.deadline \
+                    and lease.remaining >= p:
+                lease.remaining -= p
+                lease.used += p
+                if hit:
+                    self.local_decisions += 1
+                self.allowed_by_key[key] += p
+                if telem is not None:
+                    telem.record_burn(self.lid, key, p, None)
+                out[i] = True
+                continue
+            if self.direct_fallback:
+                fb_i.append(i)
+                fb_k.append(key)
+                fb_p.append(p)
+            else:
+                self.local_denies += 1
+                if telem is not None:
+                    telem.record_deny(self.lid, key, None)
+        if telem is not None:
+            self._maybe_flush(now)
+        if fb_i:
+            block = getattr(self._t, "acquire_block", None)
+            if block is not None:
+                # One columnar frame per 16-row chunk (the server's
+                # default pipeline cap bounds declared rows per frame).
+                self.wire_ops += -(-len(fb_k) // 16)
+                allowed = block(self.lid, fb_k, permits=fb_p)
+            else:
+                allowed = []
+                for k, p in zip(fb_k, fb_p):
+                    self.wire_ops += 1
+                    allowed.append(bool(self._t.try_acquire(self.lid, k, p)))
+            for i, k, p, a in zip(fb_i, fb_k, fb_p, allowed):
+                if a:
+                    out[i] = True
+                    self.allowed_by_key[k] += p
+        return out
+
     # -- telemetry flushing ----------------------------------------------------
     def _maybe_flush(self, now: int) -> None:
         if self._telem is not None and self._telem.pending() \

@@ -510,6 +510,96 @@ def sw_relay_weighted(packed, table, uwords, perms_rank, roff, lid, now, *,
     return packed_new, jnp.packbits(buf)
 
 
+def tb_relay_weighted_counts(packed, table, uwords, wlane, lid, now, *,
+                             rank_bits: int, out_dtype=jnp.uint8):
+    """Coalesced weighted token-bucket step: one lane per unique, no scan.
+
+    When every repeat of a key inside a chunk carries the SAME permit
+    weight w (the overwhelmingly common shape — clients rarely vary a
+    key's weight within one flush), the weighted scan recurrence of
+    :func:`tb_relay_weighted` has a closed form per segment: denied
+    requests consume nothing, so the allowed requests are a PREFIX of
+    the segment and ``n_allowed = min(count, v1 // (w * FP_ONE))``
+    (0 unless 1 <= w <= max_permits), consuming exactly
+    ``n_allowed * w * FP_ONE``.  The host reconstructs per-request
+    booleans as ``rank < n_allowed[uidx]`` — bit-identical to the scan
+    and to sequential per-request replay (tests/test_coalesce.py drives
+    all three).  uwords carries (slot | clamped count) exactly as the
+    digest path; the clamp stays exact because n_allowed <= max_permits
+    < clamp.  wlane uint8[U] is the per-unique weight (padding lanes
+    don't care — they decode invalid).  Device work and wire traffic
+    scale with UNIQUES (4B word + 1B weight up, 1-2B count down), not
+    requests: the Zipf-coalescing win.
+    """
+    num_slots = packed.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    cap = table.cap_fp[lid]
+    rate = table.rate_fp[lid]
+    maxp = table.max_permits[lid]
+    ttl2 = table.ttl2_ms[lid]
+
+    rows = _tb_decode(packed[sc])
+    v1 = _refilled(rows, cap, rate, ttl2, now)
+    w = wlane.astype(jnp.int64)
+    ok = valid & (w >= 1) & (w <= maxp)
+    w_fp = jnp.where(ok, w, 1) * TOKEN_FP_ONE
+    n_alw = jnp.where(ok, jnp.clip(v1 // w_fp, 0, count), jnp.int64(0))
+    consumed = n_alw * w_fp
+    any_inc = n_alw > 0
+    tokens_new = jnp.where(any_inc, v1 - consumed, rows[0])
+    last_new = jnp.where(any_inc, jnp.maximum(now, 1), rows[1])
+    widx = jnp.where(valid & any_inc, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(
+        _tb_encode(tokens_new, last_new), mode="drop")
+    lim = jnp.int64(jnp.iinfo(out_dtype).max)
+    return packed_new, jnp.clip(n_alw, 0, lim).astype(out_dtype)
+
+
+def sw_relay_weighted_counts(packed, table, uwords, wlane, lid, now, *,
+                             rank_bits: int, out_dtype=jnp.uint8):
+    """Coalesced weighted sliding-window step (see
+    tb_relay_weighted_counts).
+
+    Closed form of the :func:`sw_relay_weighted` scan under a uniform
+    segment weight: the increment test ``m <= maxp - base - curr_e - w``
+    admits a prefix of ``n_inc = clip(maxp - base - curr_e - w + 1, 0,
+    count)`` requests (0 unless w >= 1; quirk Q1 — weighted requests
+    check count+permits but increment by 1), and the emitted decision
+    re-checks the post-increment count (quirk Q2): request r is allowed
+    iff ``r < min(n_inc, maxp - curr_e)``.  STATE advances by n_inc —
+    the Q2-denied prefix tail still increments, exactly as the scan —
+    while the returned count is the Q2-checked n_allowed the host
+    reconstructs with.
+    """
+    num_slots = packed.shape[0]
+    slot, count, _, valid = decode_words(uwords, rank_bits, num_slots)
+    sc = jnp.where(valid, slot, 0)
+    maxp = table.max_permits[lid]
+    win = table.window_ms[lid]
+    rem = now % win
+
+    rows = _sw_decode(packed[sc])
+    curr_ws, curr_e, prev_e, prev_dl_e = _rolled(rows, win, now)
+    base = (prev_e * (win - rem)) // win
+    w = wlane.astype(jnp.int64)
+    ok = valid & (w >= 1)
+    t = maxp - base - curr_e - w
+    n_inc = jnp.where(ok, jnp.clip(t + 1, 0, count), jnp.int64(0))
+    n_alw = jnp.minimum(n_inc, jnp.maximum(maxp - curr_e, 0))
+    any_inc = n_inc > 0
+    curr_new = curr_e + n_inc
+    samew = rows[0] == curr_ws
+    cdl_new = jnp.where(any_inc, now + win, jnp.where(samew, rows[2], 0))
+    curr_ws_b = jnp.broadcast_to(curr_ws, sc.shape).astype(jnp.int64)
+    widx = jnp.where(valid, slot, jnp.int32(num_slots))
+    packed_new = packed.at[widx].set(
+        _sw_encode(curr_ws_b, curr_new, cdl_new, prev_e, prev_dl_e),
+        mode="drop")
+    lim = jnp.int64(jnp.iinfo(out_dtype).max)
+    return packed_new, jnp.clip(n_alw, 0, lim).astype(out_dtype)
+
+
 def sw_relay_bits(packed, table, words, lids, now, *, rank_bits: int):
     """Relay sliding-window counterpart of :func:`tb_relay_bits` (same
     contract; decision math mirrors ops/flat.py:sw_flat_bits with

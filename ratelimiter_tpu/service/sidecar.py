@@ -76,6 +76,35 @@ The server folds reports into the fleet telemetry plane
 (``storage.telemetry``); a report during drain or on a plane-less
 server is silently dropped (still no response — op 9 never answers).
 
+**Wire v5: columnar batch frames (op 10).**  One BATCH frame carries a
+whole burst as packed columns instead of N per-request frames::
+
+  v5 batch  := u32 len | u8 op=10 | u32 lid | u32 rows | u64 trace_id
+             | u32 klen | key bytes[klen]          (interned UTF-8 buffer)
+             | u32 offsets[rows + 1]               (key i = bytes[offsets[i]
+                                                    : offsets[i+1]])
+             | u8 flags                            (bit 0: permits column)
+             | u32 permits[rows]                   (iff flags & 1; else all 1)
+  response  := u32 len=10+ceil(rows/8) | u8 status=OK | u8 1 | i64 rows
+             | allow bits (np.packbits order: row r = bit 7-r%8 of byte r//8)
+
+The key column is EXACTLY the native index's input
+(``rl_index_assign_bytes``: packed UTF-8 + offsets), so the server
+assigns slots straight off the wire buffer and submits ONE
+batcher block (``submit_block``) — zero per-request Python objects
+between socket and device.  Column validation is answered in-protocol:
+truncated columns are ``BAD_FRAME``/``ERR_SHORT_FRAME``, trailing-length
+or offset violations (offsets[0] != 0, decreasing, offsets[rows] !=
+klen) are ``BAD_FRAME``/``ERR_BAD_COLUMN``, ``rows`` above the pipeline
+cap is ``BAD_FRAME``/``ERR_FRAME_TOO_LONG``, and a per-key length over
+``max_key_bytes`` is ``BAD_FRAME``/``ERR_KEY_TOO_LONG`` — the length
+prefix keeps the stream in sync through all of them.  Error statuses
+keep the plain 14-byte response shape (the length field disambiguates).
+The op exists only on connections negotiated at v5 (HELLO, exactly like
+v2->v4): a v<=4 connection sending op 10 gets the same unknown-op
+``BAD_FRAME`` a v4 server would give, and v<=4 ingress is served
+byte-identically to a v4 server.
+
 **Ingress hardening.**  Every byte on the wire is untrusted:
 
 - frames are validated (max frame length, max key length, UTF-8 key,
@@ -127,6 +156,8 @@ from concurrent.futures import CancelledError
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ratelimiter_tpu.core.config import RateLimitConfig
 from ratelimiter_tpu.engine.errors import OverloadedError, ShutdownError
 from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
@@ -143,8 +174,9 @@ OP_LEASE = 6
 OP_RENEW = 7
 OP_RELEASE = 8
 OP_TELEMETRY = 9
+OP_BATCH = 10
 
-PROTOCOL_VERSION = 4
+PROTOCOL_VERSION = 5
 
 ST_OK = 0
 ST_ERROR = 1
@@ -164,6 +196,7 @@ ERR_SHUTTING_DOWN = 8
 ERR_BAD_KEY = 9
 ERR_LEASE_DISABLED = 10
 ERR_LEASE_REVOKED = 11
+ERR_BAD_COLUMN = 12
 
 # Lease-response field packing (remaining i64):
 #   granted | ttl_ms << 16 | fence_epoch << 40
@@ -213,7 +246,25 @@ class _ConnState:
         self.version = 1       # until a HELLO negotiates up
         self.buf = b""         # unparsed wire bytes
         self.skip = 0          # bytes of an oversized frame left to discard
-        self.pending: List = []  # current burst: response bytes | futures
+        self.pending: List = []  # burst: response bytes | futures | batches
+
+
+class _BatchPending:
+    """One submitted v5 BATCH frame awaiting resolution: either a single
+    block future (columnar storage path — resolves to array slices) or a
+    per-key future list (decoded-string fallback)."""
+
+    __slots__ = ("fut", "futs", "rows")
+
+    def __init__(self, fut_or_futs, rows: int):
+        if isinstance(fut_or_futs, list):
+            self.fut, self.futs = None, fut_or_futs
+        else:
+            self.fut, self.futs = fut_or_futs, None
+        self.rows = int(rows)
+
+    def futures(self) -> list:
+        return self.futs if self.fut is None else [self.fut]
 
 
 class SidecarServer:
@@ -558,7 +609,12 @@ class SidecarServer:
             else:
                 op, a, b = _REQ_BODY.unpack_from(frame)
                 key_bytes = frame[_REQ_BODY.size:]
-            if op != OP_TELEMETRY and self.max_key_bytes \
+            # BATCH payloads are columns, not one key — their per-key
+            # lengths are checked in the column validation.  The v5 gate
+            # is inside the condition so a v<=4 connection sending op 10
+            # stays byte-identical to a v4 server (key check first).
+            batch_op = op == OP_BATCH and st.version >= 5
+            if op != OP_TELEMETRY and not batch_op and self.max_key_bytes \
                     and len(key_bytes) > self.max_key_bytes:
                 self._count_malformed()
                 return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
@@ -581,6 +637,16 @@ class SidecarServer:
                 # nothing — a report must never cost a round trip.
                 self._fold_telemetry(key_bytes)
                 return b""
+            if op == OP_BATCH:
+                if st.version < 5:
+                    # The batch op does not exist below v5: same
+                    # unknown-op answer a v4 server would give.
+                    self._count_malformed()
+                    return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
+                if self._draining:
+                    self._count_drained()
+                    return resp(st, ST_SHUTTING_DOWN, 0, 0)
+                return self._begin_batch(st, a, b, tid, key_bytes)
             lease_op = op in (OP_LEASE, OP_RENEW, OP_RELEASE)
             if lease_op and st.version < 3:
                 # The lease ops do not exist below v3: a v2 (or v1)
@@ -673,7 +739,7 @@ class SidecarServer:
                        permits: int, trace_id: int = 0):
         """Submit one decision frame, enforcing the pipeline cap and
         relaying the batcher's own admission control in-protocol."""
-        n_inflight = sum(1 for p in st.pending if not isinstance(p, bytes))
+        n_inflight = self._pending_rows(st)
         if self.max_pipeline and n_inflight >= self.max_pipeline:
             self._count_pipeline_shed()
             plane = getattr(self.storage, "telemetry", None)
@@ -703,10 +769,171 @@ class SidecarServer:
         except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
             return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
 
+    @staticmethod
+    def _pending_rows(st: _ConnState) -> int:
+        """In-flight decision ROWS of the current burst (the pipeline
+        cap's operand): a batch frame counts as its row count."""
+        n = 0
+        for p in st.pending:
+            if isinstance(p, bytes):
+                continue
+            n += p.rows if isinstance(p, _BatchPending) else 1
+        return n
+
+    def _begin_batch(self, st: _ConnState, lid: int, rows: int,
+                     trace_id: int, payload: bytes):
+        """Validate one v5 columnar BATCH frame and submit it.
+
+        Returns a _BatchPending (phase 2 packs the allow bitmask) or
+        immediate response bytes for validation failures / shed.  The
+        happy path touches no per-request Python objects: the key column
+        feeds the native index verbatim and the whole frame rides ONE
+        batcher block future."""
+        resp = self._resp
+        rows = int(rows)
+        if rows < 1 or (self.max_pipeline and rows > self.max_pipeline):
+            # Declared rows above the pipeline cap: reject before any
+            # column math sized by the attacker's number.
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_FRAME_TOO_LONG)
+        if self.max_pipeline and \
+                self._pending_rows(st) + rows > self.max_pipeline:
+            self._count_pipeline_shed()
+            plane = getattr(self.storage, "telemetry", None)
+            if plane is not None:
+                plane.note_shed(lid, rows)
+            batcher = getattr(self.storage, "_batcher", None)
+            hint = max(getattr(batcher, "max_delay_s", 0.001) * 1000.0, 1.0)
+            return resp(st, ST_SHED, 0, int(hint))
+        if len(payload) < 4:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
+        (klen,) = struct.unpack_from("<I", payload)
+        off_pos = 4 + klen
+        flag_pos = off_pos + 4 * (rows + 1)
+        if len(payload) < flag_pos + 1:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
+        flags = payload[flag_pos]
+        expect = flag_pos + 1 + (4 * rows if flags & 1 else 0)
+        if len(payload) != expect:
+            # Column length mismatch: declared columns and frame length
+            # disagree (short permits column, trailing garbage, ...).
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0,
+                        ERR_SHORT_FRAME if len(payload) < expect
+                        else ERR_BAD_COLUMN)
+        offsets = np.frombuffer(payload, np.uint32, rows + 1,
+                                offset=off_pos).astype(np.int64)
+        if (offsets[0] != 0 or offsets[-1] != klen
+                or bool(np.any(np.diff(offsets) < 0))):
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_COLUMN)
+        if self.max_key_bytes and rows and \
+                int(np.diff(offsets).max()) > self.max_key_bytes:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
+        try:
+            payload[4:off_pos].decode()  # one pass; no per-key objects
+        except UnicodeDecodeError:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_KEY)
+        entry = self._limiters.get(lid)
+        if entry is None:
+            return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
+        algo, _cfg = entry
+        permits = None
+        if flags & 1:
+            # Mirror the per-frame contract: permits floor at 1.
+            permits = np.maximum(
+                np.frombuffer(payload, np.uint32, rows,
+                              offset=flag_pos + 1).astype(np.int64), 1)
+        if trace_id:
+            lineage = getattr(self.storage, "lineage", None)
+            if lineage is not None:
+                lineage.force(trace_id)
+                lineage.record(trace_id, "sidecar", op=OP_BATCH,
+                               lid=int(lid), version=st.version,
+                               rows=rows)
+        data = np.frombuffer(payload, np.uint8, klen, offset=4)
+        try:
+            block = getattr(self.storage, "acquire_async_block", None)
+            fut = None
+            if block is not None:
+                fut = block(algo, lid, data, offsets, permits,
+                            trace_id=trace_id)
+            if fut is not None:
+                self._track_submit(1)
+                return _BatchPending(fut, rows)
+            # Fallback (Python index / fenced shards): decode the keys
+            # and ride the per-key async path — identical decisions.
+            keys = [payload[4 + offsets[i]:4 + offsets[i + 1]].decode()
+                    for i in range(rows)]
+            many = getattr(self.storage, "acquire_async_many", None)
+            if many is not None:
+                futs = many(algo, lid, keys, permits)
+                self._track_submit(len(futs))
+                return _BatchPending(futs, rows)
+            allowed = np.empty(rows, dtype=bool)
+            perms = permits if permits is not None else np.ones(
+                rows, dtype=np.int64)
+            for i, k in enumerate(keys):
+                allowed[i] = bool(
+                    self.storage.acquire(algo, lid, k,
+                                         int(perms[i]))["allowed"])
+            return self._batch_resp(rows, allowed)
+        except UnicodeDecodeError:
+            # A multi-byte char split across key boundaries survives the
+            # whole-buffer check but no per-key slice decodes.
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_KEY)
+        except OverloadedError as exc:
+            return resp(st, ST_SHED, 0, max(int(exc.retry_after_ms), 1))
+        except ShutdownError:
+            return resp(st, ST_SHUTTING_DOWN, 0, 0)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return resp(st, ST_ERROR, 0, ERR_INTERNAL)
+
+    @staticmethod
+    def _batch_resp(rows: int, allowed: np.ndarray) -> bytes:
+        """OK batch response: standard header (remaining = rows) plus
+        the packed allow bits; the length field disambiguates."""
+        bits = np.packbits(np.asarray(allowed, dtype=bool)).tobytes()
+        return _RESP.pack(_RESP.size - 4 + len(bits), ST_OK, 1,
+                          rows) + bits
+
+    def _finish_batch(self, item: _BatchPending, st: _ConnState) -> bytes:
+        """Phase 2 for a BATCH frame: one bitmask response."""
+        try:
+            timeout = self.resolve_timeout_s or None
+            if item.fut is not None:
+                out = item.fut.result(timeout=timeout)
+                allowed = np.asarray(out["allowed"], dtype=bool)
+            else:
+                allowed = np.empty(item.rows, dtype=bool)
+                for i, f in enumerate(item.futs):
+                    allowed[i] = bool(f.result(timeout=timeout)["allowed"])
+            return self._batch_resp(item.rows, allowed)
+        except OverloadedError as exc:
+            return self._resp(st, ST_SHED, 0,
+                              max(int(exc.retry_after_ms), 1))
+        except ShutdownError:
+            return self._resp(st, ST_SHUTTING_DOWN, 0, 0)
+        except _FutureTimeout:
+            for f in item.futures():
+                f.add_done_callback(_consume_future)
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
+        finally:
+            self._track_submit(-len(item.futures()))
+
     def _finish_frame(self, item, st: _ConnState) -> bytes:
         """Phase 2: resolve a submitted future (or pass bytes through)."""
         if isinstance(item, bytes):
             return item
+        if isinstance(item, _BatchPending):
+            return self._finish_batch(item, st)
         try:
             out = item.result(
                 timeout=self.resolve_timeout_s or None)
@@ -736,7 +963,11 @@ class SidecarServer:
         consuming device capacity and their slots stop pinning eviction);
         frames already dispatched resolve normally and are consumed via a
         done-callback."""
-        futs = [p for p in st.pending if not isinstance(p, bytes)]
+        futs = []
+        for p in st.pending:
+            if isinstance(p, bytes):
+                continue
+            futs.extend(p.futures() if isinstance(p, _BatchPending) else [p])
         st.pending = []
         if not futs:
             return
@@ -931,6 +1162,90 @@ class SidecarClient:
             self._frame(OP_TRY_ACQUIRE, lid, p, k) for k, p in zip(keys, permits))
         self._send(payload)
         return self._read_responses(len(keys))
+
+    # -- columnar batch (protocol v5) -----------------------------------------
+    def _batch_frame(self, lid: int, keys: Sequence[str],
+                     permits: Optional[Sequence[int]] = None,
+                     trace_id: int = 0) -> bytes:
+        """One v5 BATCH frame: interned key column + offsets (+ optional
+        permits column).  One frame carries the whole chunk — the server
+        answers with ONE packed allow bitmask."""
+        kbufs = [k.encode() for k in keys]
+        rows = len(kbufs)
+        offs = np.zeros(rows + 1, dtype=np.uint32)
+        np.cumsum(np.fromiter((len(b) for b in kbufs), dtype=np.uint32,
+                              count=rows), out=offs[1:])
+        key_col = b"".join(kbufs)
+        parts = [struct.pack("<I", len(key_col)), key_col, offs.tobytes()]
+        if permits is not None:
+            parts.append(b"\x01")
+            parts.append(np.asarray(permits, dtype=np.uint32).tobytes())
+        else:
+            parts.append(b"\x00")
+        body = _REQ_BODY4.pack(OP_BATCH, lid, rows,
+                               int(trace_id) & ((1 << 64) - 1)) + b"".join(parts)
+        return struct.pack("<I", len(body)) + body
+
+    def _read_block_response(self, rows: int) -> list:
+        """One BATCH response: the standard 14-byte header plus
+        ``length - 10`` bitmask bytes (error responses carry none and
+        raise via :meth:`_check`, leaving the stream in sync)."""
+        while len(self._rbuf) < _RESP.size:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        length, status, _, remaining = _RESP.unpack_from(self._rbuf)
+        self._rbuf = self._rbuf[_RESP.size:]
+        if status != ST_OK:
+            self._check(status, remaining)
+        nbits = length - (_RESP.size - 4)
+        while len(self._rbuf) < nbits:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        bits = np.frombuffer(self._rbuf[:nbits], np.uint8)
+        self._rbuf = self._rbuf[nbits:]
+        return [bool(b) for b in np.unpackbits(bits)[:rows]]
+
+    def acquire_block(self, lid: int, keys: Sequence[str],
+                      permits: Optional[Sequence[int]] = None,
+                      trace_id: int = 0, max_rows: int = 16) -> list:
+        """Columnar batch acquire: ONE v5 frame per ``max_rows`` chunk
+        (and one bitmask back), zero per-request frames on the wire.
+        Falls back to :meth:`acquire_batch` below v5 with identical
+        decisions.  Returns a list of per-row allow booleans; shed /
+        shutdown / malformed answers raise like :meth:`_check`.
+
+        ``max_rows`` defaults to the server's default pipeline cap — a
+        frame declaring more rows than the cap is rejected whole."""
+        rows_total = len(keys)
+        if rows_total == 0:
+            return []
+        if self.server_version < 5:
+            allowed = []
+            for status, alw, remaining in self.acquire_batch(
+                    lid, keys, permits):
+                self._check(status, remaining)
+                allowed.append(alw)
+            return allowed
+        allowed = []
+        start = 0
+        while start < rows_total:
+            n = min(max_rows or rows_total, rows_total - start)
+            while True:
+                p = permits[start:start + n] if permits is not None else None
+                frame = self._batch_frame(lid, keys[start:start + n], p,
+                                          trace_id)
+                if n == 1 or not self.server_max_frame or \
+                        len(frame) - 4 <= self.server_max_frame:
+                    break
+                n = max(n // 2, 1)
+            self._send(frame)
+            allowed.extend(self._read_block_response(n))
+            start += n
+        return allowed
 
     # -- token leases (protocol v3) -------------------------------------------
     def _lease_roundtrip(self, op: int, lid: int, b: int, key: str,

@@ -487,6 +487,50 @@ def ingress_drill(
         report["faults"].append("malformed")
         healthy_wave()
 
+        # -- fault 1b: malformed v5 columnar frames ----------------------
+        # A v5 attacker hand-builds BATCH frames whose columns lie about
+        # themselves.  Every one must be answered in-protocol with
+        # BAD_FRAME + the right errno, the stream must stay in sync, and
+        # a well-formed batch directly after must still decide.
+        import numpy as _np
+        atk5 = sc.SidecarClient("127.0.0.1", server.port)
+        assert atk5.server_version >= 5
+
+        def batch_frame(rows, klen, key_col, offs, flags, permits=b""):
+            payload = (struct.pack("<I", klen) + key_col
+                       + _np.asarray(offs, dtype=_np.uint32).tobytes()
+                       + bytes([flags]) + permits)
+            body = struct.pack("<BIIQ", sc.OP_BATCH, lid_atk, rows,
+                               0) + payload
+            return struct.pack("<I", len(body)) + body
+
+        bad5 = [
+            # column length mismatch: flags declare a permits column the
+            # frame does not carry.
+            batch_frame(2, 4, b"abcd", [0, 2, 4], 1),
+            # offsets out of bounds: offs[-1] walks past the key column.
+            batch_frame(2, 4, b"abcd", [0, 2, 9], 0),
+            # offsets not monotonic.
+            batch_frame(2, 4, b"abcd", [0, 3, 2][:3], 0),
+            # declared rows over the frame cap (max_pipeline).
+            batch_frame(max_pipeline + 1, 4, b"abcd",
+                        [0] * (max_pipeline + 2), 0),
+        ]
+        atk5._send(b"".join(bad5))
+        got5 = atk5._read_responses(len(bad5))
+        for status, _, errno in got5:
+            assert status == sc.ST_BAD_FRAME, got5
+            report["malformed_answered"] += 1
+        assert [g[2] for g in got5] == [
+            sc.ERR_SHORT_FRAME, sc.ERR_BAD_COLUMN, sc.ERR_BAD_COLUMN,
+            sc.ERR_FRAME_TOO_LONG], got5
+        # Stream in sync: a valid columnar batch right behind the attack
+        # still decides (and the bitmask has exactly its rows).
+        assert atk5.acquire_block(lid_atk, ["b5-a", "b5-b"]) == [True, True]
+        atk5.close()
+        report["faults"].append("malformed_v5_columns")
+        healthy_wave()
+
         # -- fault 2: slowloris / truncated frame ------------------------
         idle_before = server.idle_closed_total
         slow = socket_mod.create_connection(("127.0.0.1", server.port),

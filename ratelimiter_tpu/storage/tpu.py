@@ -174,6 +174,13 @@ _HOST_PARALLEL_AUTO_MAX = 8
 # weighted traffic (the burst batch-acquire scenario) stays on the relay.
 _WREL_MAX_R = 64
 
+# Zipf key coalescing: chunks whose repeated keys carry segment-uniform
+# permits dispatch ONE weighted decision per unique key
+# (ops/relay.py:*_relay_weighted_counts) and reconstruct per-request
+# booleans host-side, so device work and wire bytes scale with uniques
+# instead of requests.  Opt-out knob for A/B runs (bench/coalesce_smoke.py).
+_COALESCE = os.environ.get("RATELIMITER_COALESCE", "1") != "0"
+
 
 def _bucket_pow2(n: int) -> int:
     from ratelimiter_tpu.parallel.sharded import _bucket
@@ -1236,6 +1243,49 @@ class TpuBatchedStorage(RateLimitStorage):
                 self.telemetry.note_shed(lid, n)
             raise
 
+    def acquire_async_block(self, algo: str, lid: int, data, offsets,
+                            permits=None,
+                            deadline_ms: float | None = None,
+                            trace_id: int = 0):
+        """Columnar :meth:`acquire_async_many`: the caller hands the v5
+        batch frame's key column verbatim (data uint8[klen] packed UTF-8
+        + offsets i64[n+1]) and gets ONE future resolving to
+        ``{"allowed": bool[n], ...}`` lane slices — zero per-request
+        Python objects end to end (native assign_batch_bytes ->
+        batcher.submit_block).  Returns None when this storage can't take
+        the columnar shortcut (Python index, or shard fences that need
+        the key strings) — the caller falls back to the decoded-string
+        path with identical decisions."""
+        self._check_not_promoting()
+        if self._fenced_shards:
+            return None  # fence checks need the decoded keys
+        index = self._index[algo]
+        if not hasattr(index, "assign_batch_bytes"):
+            return None
+        n = len(offsets) - 1
+        if permits is None:
+            permits = np.ones(n, dtype=np.int64)
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
+        with self._evictions_cleared(algo):
+            slots, clears = index.assign_batch_bytes(
+                data, offsets, lid,
+                pinned=self._batcher.pending_slots(algo),
+                hold_pins=True)
+        if self._tracer is not None:
+            self._tracer.record_sub(
+                "index", (time.perf_counter() - t0) * 1e6)
+        for evicted in clears:
+            self._batcher.add_clear(algo, int(evicted))
+        try:
+            with self._pins_released(index, slots):
+                return self._batcher.submit_block(
+                    algo, slots, np.full(n, lid, dtype=np.int64), permits,
+                    deadline_ms=deadline_ms, trace_id=trace_id)
+        except OverloadedError:
+            if self.telemetry is not None:
+                self.telemetry.note_shed(lid, n)
+            raise
+
     def acquire_many(
         self, algo: str, lid_per_req: Sequence[int], keys: Sequence[str],
         permits: Sequence[int],
@@ -1940,8 +1990,11 @@ class TpuBatchedStorage(RateLimitStorage):
         ``_stream_flat`` on the same chunking (tests/test_relay.py)."""
         eng = self.engine
         rb = eng.rank_bits
+        cdt = eng.counts_dtype()
         dispatch = (eng.sw_weighted_dispatch if algo == "sw"
                     else eng.tb_weighted_dispatch)
+        wc_dispatch = (eng.sw_weighted_counts_dispatch if algo == "sw"
+                       else eng.tb_weighted_counts_dispatch)
         flat_dispatch = (eng.sw_flat_dispatch if algo == "sw"
                          else eng.tb_flat_dispatch)
         # The CSR mask needs true counts; the word count field clamps at
@@ -1952,7 +2005,18 @@ class TpuBatchedStorage(RateLimitStorage):
 
         def drain(kind, handle, start, count, extra, t0, rec):
             tf0 = time.perf_counter()
-            if kind == "weighted_native":
+            if kind == "weighted_coal":
+                # Coalesced digest: per-unique allowed counts; the
+                # prefix-allow closed form makes ``rank < counts[uidx]``
+                # the exact arrival-order reconstruction (same C helper
+                # as the unit-permit digest drain).
+                arr = np.ascontiguousarray(np.asarray(handle))
+                tf1 = time.perf_counter()
+                from ratelimiter_tpu.engine.native_index import relay_decide
+
+                uidx, rank, u = extra
+                got = relay_decide(arr[:u], uidx, rank)
+            elif kind == "weighted_native":
                 arr = np.ascontiguousarray(np.asarray(handle))
                 tf1 = time.perf_counter()
                 from ratelimiter_tpu.engine.native_index import (
@@ -2017,7 +2081,36 @@ class TpuBatchedStorage(RateLimitStorage):
                     r_max = int(rank.max()) + 1 if cn else 1
                     now = self._monotonic_now()
                     t0 = time.perf_counter()
-                    if r_max <= r_cap:
+                    wlane = None
+                    if _COALESCE and cdt is not None and cn:
+                        # Segment-uniform weight probe: coalescing needs
+                        # every repeat of a key to carry the same permits
+                        # within the chunk (the closed form consumes
+                        # n_allowed * w at once).  One scatter + one
+                        # compare over the chunk — cheap next to the scan
+                        # it deletes.  Mixed-weight chunks keep the exact
+                        # rank-major scan path below, bit-identical either
+                        # way.
+                        wfirst = np.zeros(max(u, 1), dtype=np.uint8)
+                        firsts = rank == 0
+                        wfirst[uidx[firsts]] = p_chunk[firsts]
+                        if not np.any(wfirst[uidx] != p_chunk):
+                            wlane = wfirst
+                    if wlane is not None:
+                        u_b = _bucket_fine(max(u, 1))
+                        uw_pad = _pad_tail(uwords, u_b, 0xFFFFFFFF,
+                                           np.uint32)
+                        w_pad = _pad_tail(wlane, u_b, 0, np.uint8)
+                        handle = wc_dispatch(uw_pad, w_pad, lid, now, cdt)
+                        drains.submit(drain, "weighted_coal", handle,
+                                      start, cn, (uidx, rank, u), t0, rec)
+                        csize = np.dtype(cdt).itemsize
+                        wire_b = (5 + csize) * u_b
+                        dev_s = u_b * rates["s_per_unique_unsorted"]
+                        if rec is not None:
+                            rec["mode"] = "weighted_coal"
+                            rec["wire_bytes"] = int(wire_b)
+                    elif r_max <= r_cap:
                         # Count-descending rank-major layout: segments sorted
                         # by occurrence count so each rank step's active set
                         # is a prefix — permits ship compacted (1 B/request,
@@ -2074,6 +2167,7 @@ class TpuBatchedStorage(RateLimitStorage):
                                           cn, pos, t0, rec)
                         wire_b = (4 * u_b + len(perms_rank)
                                   + len(perms_rank) // 8)
+                        dev_s = cn * rates["s_per_lane"]  # scan ~ lanes
                         if rec is not None:
                             rec["mode"] = "weighted"
                             rec["wire_bytes"] = int(wire_b)
@@ -2093,6 +2187,7 @@ class TpuBatchedStorage(RateLimitStorage):
                             drains.submit(drain, "flat", bits, start + off,
                                           sl, None, t0, rec)
                         wire_b = 5.0 * cn
+                        dev_s = cn * rates["s_per_lane"]
                         if rec is not None:
                             rec["mode"] = "flat_fb"
                             rec["wire_bytes"] = int(wire_b)
@@ -2103,7 +2198,7 @@ class TpuBatchedStorage(RateLimitStorage):
                     tot["host_s"] += host_span
                     tot["cu"].append((int(cn), int(u)))
                     tot["bpr"] = wire_b / max(cn, 1)
-                    tot["device_s"] += cn * rates["s_per_lane"]  # scan~lanes
+                    tot["device_s"] += dev_s
                 if rec is not None:
                     rec["walk_s"] = round(tot["walk_s"], 6)  # cumulative
                     rec["host_s"] = round(host_span, 6)

@@ -1,0 +1,221 @@
+"""Zipf key coalescing (ops/relay.py:*_relay_weighted_counts).
+
+Within a chunk whose repeats carry segment-uniform weights, the stream
+path folds every repeat of a key into ONE weighted decision per unique
+(device work scales with uniques, not requests) and reconstructs the
+per-request allow/deny bits host-side via the prefix-allow rule
+``rank < n_allowed[uidx]``.  These tests pin the bit-identity contract:
+coalesced decisions must equal the sequential per-request semantics of
+``semantics/oracle.py`` — and of the uncoalesced device path — exactly,
+including deny/allow interleavings and eviction pressure.
+"""
+
+import numpy as np
+import pytest
+
+import ratelimiter_tpu.storage.tpu as tpu_mod
+from ratelimiter_tpu.core.config import RateLimitConfig
+from ratelimiter_tpu.semantics import SlidingWindowOracle, TokenBucketOracle
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+
+def _cfg_oracle(algo):
+    if algo == "sw":
+        cfg = RateLimitConfig(max_permits=6, window_ms=1000,
+                              enable_local_cache=False)
+        return cfg, SlidingWindowOracle(cfg)
+    cfg = RateLimitConfig(max_permits=9, window_ms=1200, refill_rate=4.0)
+    return cfg, TokenBucketOracle(cfg)
+
+
+def _spy_coalesce(monkeypatch, st, algo):
+    """Count engagements of the coalesced dispatch on this storage."""
+    name = f"{algo}_weighted_counts_dispatch"
+    orig = getattr(st.engine, name)
+    calls = {"n": 0}
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(st.engine, name, spy)
+    return calls
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_coalesced_zipf_stream_vs_oracle(monkeypatch, algo):
+    """Zipf traffic with per-key-uniform weights: the coalesced digest
+    must ENGAGE and every request decision must match the sequential
+    oracle replay exactly — allows, denies, and their interleavings."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    now = [4_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg, oracle = _cfg_oracle(algo)
+    lid = st.register_limiter(algo, cfg)
+    calls = _spy_coalesce(monkeypatch, st, algo)
+    rng = np.random.default_rng(7)
+    for step in range(6):
+        now[0] += int(rng.integers(0, 900))
+        ids = (rng.zipf(1.2, 600) % 40).astype(np.int64)
+        # Per-key-deterministic weight: every repeat of a key carries
+        # the same permits, so every chunk coalesces.
+        perms = (ids % 4 + 1).astype(np.int64)
+        got = st.acquire_stream_ids(algo, lid, ids, perms)
+        for j, k in enumerate(ids):
+            want = oracle.try_acquire(f"id:{k}", int(perms[j]),
+                                      now[0]).allowed
+            assert got[j] == want, (algo, step, j)
+    assert calls["n"] > 0, "coalesced dispatch never engaged"
+    st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_coalesced_matches_uncoalesced_device_path(monkeypatch, algo):
+    """RATELIMITER_COALESCE on/off must be bit-identical on the same
+    stream — the digest is an encoding of the scan, not a new policy."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    cfg, _ = _cfg_oracle(algo)
+    rng = np.random.default_rng(13)
+    ids = (rng.zipf(1.3, 2000) % 64).astype(np.int64)
+    perms = (ids % 5 + 1).astype(np.int64)
+    outs = []
+    for coalesce in (True, False):
+        monkeypatch.setattr(tpu_mod, "_COALESCE", coalesce)
+        now = [8_000_000]
+        st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+        lid = st.register_limiter(algo, cfg)
+        rows = []
+        for start in range(0, len(ids), 500):
+            rows.append(st.acquire_stream_ids(
+                algo, lid, ids[start:start + 500],
+                perms[start:start + 500]))
+            now[0] += 377
+        outs.append(np.concatenate(rows))
+        st.close()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_coalesce_deny_allow_interleave_across_keys(monkeypatch):
+    """Interleaved hot keys with different budgets produce alternating
+    allow/deny in ARRIVAL order; the host-side ``rank < n_allowed``
+    reconstruction must reproduce that ordering exactly."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 64)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 64)
+    now = [2_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 10, clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=10, window_ms=60_000, refill_rate=0.0)
+    lid = st.register_limiter("tb", cfg)
+    calls = _spy_coalesce(monkeypatch, st, "tb")
+    # Key 1 @ weight 4 -> allows 2 of 6; key 2 @ weight 3 -> allows 3 of 6.
+    ids = np.asarray([1, 2] * 6, dtype=np.int64)
+    perms = np.where(ids == 1, 4, 3).astype(np.int64)
+    got = st.acquire_stream_ids("tb", lid, ids, perms)
+    want = [True, True, True, True, False, True,   # k1:4,8 k2:3,6,9
+            False, False, False, False, False, False]
+    np.testing.assert_array_equal(got, want)
+    assert calls["n"] == 1
+    st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_mixed_weights_fall_back_exactly(monkeypatch, algo):
+    """A chunk whose repeats carry DIFFERENT weights for one key cannot
+    coalesce — the path must fall back (no digest dispatch) and still
+    match the oracle, skip recurrence included."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 128)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 128)
+    now = [6_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg, oracle = _cfg_oracle(algo)
+    lid = st.register_limiter(algo, cfg)
+    calls = _spy_coalesce(monkeypatch, st, algo)
+    rng = np.random.default_rng(29)
+    ids = rng.integers(0, 20, 384).astype(np.int64)
+    perms = rng.integers(1, 7, 384).astype(np.int64)  # mixed per key
+    got = st.acquire_stream_ids(algo, lid, ids, perms)
+    for j, k in enumerate(ids):
+        want = oracle.try_acquire(f"id:{k}", int(perms[j]),
+                                  now[0]).allowed
+        assert got[j] == want, (algo, j)
+    assert calls["n"] == 0, "mixed-weight chunk must not coalesce"
+    st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_coalesce_eviction_pressure_matches_uncoalesced(monkeypatch, algo):
+    """Keys evicted between chunks (slot churn far above capacity) must
+    not change a single decision coalesced-vs-uncoalesced: both paths
+    see the same assigns, the same clears, the same state."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 64)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 64)
+    cfg, _ = _cfg_oracle(algo)
+    rng = np.random.default_rng(43)
+    # 300 distinct keys through 128 slots: heavy eviction churn.
+    ids = (rng.zipf(1.1, 1500) % 300).astype(np.int64)
+    perms = (ids % 3 + 1).astype(np.int64)
+    outs = []
+    for coalesce in (True, False):
+        monkeypatch.setattr(tpu_mod, "_COALESCE", coalesce)
+        now = [1_000_000]
+        st = TpuBatchedStorage(num_slots=128, clock_ms=lambda: now[0])
+        lid = st.register_limiter(algo, cfg)
+        rows = []
+        for start in range(0, len(ids), 250):
+            rows.append(st.acquire_stream_ids(
+                algo, lid, ids[start:start + 250],
+                perms[start:start + 250]))
+            now[0] += 211
+        outs.append(np.concatenate(rows))
+        st.close()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_sharded_weighted_stream_vs_oracle():
+    """The sharded weighted stream (flat sharded dispatch — coalescing
+    is a single-device digest) stays bit-identical to the oracle on the
+    same Zipf traffic, so the v5 ingest contract holds on the mesh."""
+    from ratelimiter_tpu.engine.engine import LimiterTable
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine
+
+    now = [3_000_000]
+    eng = ShardedDeviceEngine(slots_per_shard=256, table=LimiterTable())
+    st = TpuBatchedStorage(engine=eng, clock_ms=lambda: now[0])
+    cfg = RateLimitConfig(max_permits=8, window_ms=1500, refill_rate=5.0)
+    oracle = TokenBucketOracle(cfg)
+    lid = st.register_limiter("tb", cfg)
+    rng = np.random.default_rng(59)
+    for step in range(4):
+        now[0] += int(rng.integers(0, 1200))
+        ids = (rng.zipf(1.2, 500) % 60).astype(np.int64)
+        perms = (ids % 4 + 1).astype(np.int64)
+        got = st.acquire_stream_ids("tb", lid, ids, perms)
+        for j, k in enumerate(ids):
+            want = oracle.try_acquire(f"id:{k}", int(perms[j]),
+                                      now[0]).allowed
+            assert got[j] == want, (step, j)
+    st.close()
+
+
+@pytest.mark.parametrize("algo", ["sw", "tb"])
+def test_coalesced_string_stream_vs_oracle(monkeypatch, algo):
+    """String keys ride the same weighted loop (hash once -> assign ->
+    coalesce): the v5 sidecar feeds this path straight off the wire."""
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK", 256)
+    monkeypatch.setattr(tpu_mod, "_RELAY_CHUNK_MAX", 256)
+    now = [7_000_000]
+    st = TpuBatchedStorage(num_slots=1 << 12, clock_ms=lambda: now[0])
+    cfg, oracle = _cfg_oracle(algo)
+    lid = st.register_limiter(algo, cfg)
+    calls = _spy_coalesce(monkeypatch, st, algo)
+    rng = np.random.default_rng(71)
+    ids = (rng.zipf(1.2, 600) % 50).astype(np.int64)
+    keys = [f"user-{k}" for k in ids]
+    perms = (ids % 4 + 1).astype(np.int64)
+    got = st.acquire_stream_strs(algo, lid, keys, perms)
+    for j, k in enumerate(keys):
+        want = oracle.try_acquire(k, int(perms[j]), now[0]).allowed
+        assert got[j] == want, (algo, j)
+    assert calls["n"] > 0, "string stream never coalesced"
+    st.close()

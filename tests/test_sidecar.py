@@ -319,3 +319,131 @@ def test_global_connection_limit():
     finally:
         server.stop()
         storage.close()
+
+
+# ---------------------------------------------------------------------------
+# Protocol v5: columnar batch frames (op 10)
+# ---------------------------------------------------------------------------
+
+def test_v5_negotiation_and_v4_batch_rejected(sidecar):
+    """The ceiling is v5; a v4-pinned connection negotiates v4 and the
+    batch op does not exist there — same unknown-op answer a v4 server
+    gives, with the per-request path untouched afterwards."""
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    cli = SidecarClient("127.0.0.1", server.port)
+    assert cli.server_version == 5
+    pinned = SidecarClient("127.0.0.1", server.port, protocol=4)
+    assert pinned.server_version == 4
+    pinned._send(pinned._frame(sc.OP_BATCH, lid, 2, "xx"))
+    status, _, errno = pinned._read_raw()
+    assert (status, errno) == (sc.ST_BAD_FRAME, sc.ERR_UNKNOWN_OP)
+    assert pinned.try_acquire(lid, "v4-after-batch") is True
+    pinned.close()
+    cli.close()
+
+
+def test_acquire_block_matches_per_request_decisions(sidecar):
+    """One columnar frame must decide exactly like N per-request frames
+    on the same traffic (mirrored limiter = mirrored keyspace), permits
+    column included, across deny/allow interleavings."""
+    server, _ = sidecar
+    cfg = RateLimitConfig(max_permits=7, window_ms=60_000, refill_rate=0.0)
+    lid_blk = server.register("tb", cfg)
+    lid_ref = server.register("tb", cfg)
+    cli = SidecarClient("127.0.0.1", server.port)
+    keys = [f"k{i % 5}" for i in range(40)]
+    perms = [(i % 3) + 1 for i in range(40)]
+    got = cli.acquire_block(lid_blk, keys, permits=perms)
+    ref = [a for _, a, _ in cli.acquire_batch(lid_ref, keys, permits=perms)]
+    assert got == ref
+    assert True in got and False in got  # both outcomes exercised
+    # Unweighted, chunked (>16 rows forces multiple columnar frames).
+    got = cli.acquire_block(lid_blk, keys)
+    ref = [a for _, a, _ in cli.acquire_batch(lid_ref, keys)]
+    assert got == ref
+    cli.close()
+
+
+def test_v5_malformed_columns_answered_in_protocol(sidecar):
+    """Column lies (length mismatch, offsets out of bounds, rows over
+    the cap) answer BAD_FRAME with typed errnos; the stream stays in
+    sync and a valid batch directly behind still decides."""
+    import struct
+
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    cli = SidecarClient("127.0.0.1", server.port)
+
+    def raw(rows, klen, key_col, offs, flags, permits=b""):
+        payload = (struct.pack("<I", klen) + key_col
+                   + np.asarray(offs, dtype=np.uint32).tobytes()
+                   + bytes([flags]) + permits)
+        body = struct.pack("<BIIQ", sc.OP_BATCH, lid, rows, 0) + payload
+        return struct.pack("<I", len(body)) + body
+
+    cap = server.max_pipeline
+    bad = [
+        raw(2, 4, b"abcd", [0, 2, 4], 1),        # permits col missing
+        raw(2, 4, b"abcd", [0, 2, 9], 0),        # offsets past the column
+        raw(2, 4, b"abcd", [4, 2, 4], 0),        # offs[0] != 0
+        raw(cap + 1, 4, b"abcd", [0] * (cap + 2), 0),  # rows over cap
+        raw(2, 2, b"\xff\xfe", [0, 1, 2], 0),    # invalid UTF-8 column
+    ]
+    cli._send(b"".join(bad))
+    got = cli._read_responses(len(bad))
+    assert [s for s, _, _ in got] == [sc.ST_BAD_FRAME] * 5
+    assert [e for _, _, e in got] == [
+        sc.ERR_SHORT_FRAME, sc.ERR_BAD_COLUMN, sc.ERR_BAD_COLUMN,
+        sc.ERR_FRAME_TOO_LONG, sc.ERR_BAD_KEY]
+    assert cli.acquire_block(lid, ["ok-a", "ok-b"]) == [True, True]
+    cli.close()
+
+
+def test_v5_block_unknown_limiter_and_shed_raise(sidecar):
+    from ratelimiter_tpu.service import sidecar as sc
+
+    server, _ = sidecar
+    cli = SidecarClient("127.0.0.1", server.port)
+    with pytest.raises(RuntimeError):
+        cli.acquire_block(9999, ["a", "b"])
+    lid = server.register("tb", RateLimitConfig(
+        max_permits=100, window_ms=60_000, refill_rate=50.0))
+    assert cli.acquire_block(lid, ["after-error"]) == [True]
+    del sc
+    cli.close()
+
+
+def test_lease_client_batched_submit(sidecar):
+    """LeaseClient.try_acquire_many burns locally where leases cover and
+    coalesces fallback decisions into columnar frames — decisions equal
+    the per-key surface, with strictly fewer wire frames."""
+    from ratelimiter_tpu.leases import LeaseClient, LeaseManager
+
+    server, _ = sidecar
+    cfg = RateLimitConfig(max_permits=1 << 16, window_ms=60_000,
+                          refill_rate=1e5)
+    lid_a = server.register("tb", cfg)
+    lid_b = server.register("tb", cfg)
+    server.attach_leases(LeaseManager(server.storage, default_budget=32,
+                                      max_budget=32, ttl_ms=60_000.0))
+    wire_a = SidecarClient("127.0.0.1", server.port)
+    wire_b = SidecarClient("127.0.0.1", server.port)
+    batched = LeaseClient(wire_a, lid_a, budget=32, telemetry=False)
+    serial = LeaseClient(wire_b, lid_b, budget=32, telemetry=False)
+    keys = [f"u{i % 6}" for i in range(192)]
+    got = batched.try_acquire_many(keys)
+    ref = [serial.try_acquire(k) for k in keys]
+    assert got == ref
+    assert batched.local_decisions > 0
+    assert batched.wire_ops <= serial.wire_ops
+    batched.release_all()
+    serial.release_all()
+    wire_a.close()
+    wire_b.close()

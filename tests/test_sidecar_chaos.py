@@ -28,11 +28,14 @@ def test_ingress_drill_fast():
     report = ingress_drill(registry=registry)
     assert report["mismatches"] == 0
     assert set(report["faults"]) == {
-        "malformed", "slowloris", "garbage", "kill_mid_pipeline"}
+        "malformed", "malformed_v5_columns", "slowloris", "garbage",
+        "kill_mid_pipeline"}
     assert report["shed"] >= 1
-    assert report["malformed_answered"] == 5
+    # 5 classic malformed frames + 4 malformed v5 columnar frames, every
+    # one answered in-protocol with the stream staying in sync.
+    assert report["malformed_answered"] == 9
     scrape = registry.scrape()
-    assert scrape["ratelimiter.sidecar.malformed"] >= 5
+    assert scrape["ratelimiter.sidecar.malformed"] >= 9
     assert scrape["ratelimiter.sidecar.idle_closed"] >= 1
     assert scrape["ratelimiter.sidecar.pipeline_shed"] >= 1
     assert scrape["ratelimiter.sidecar.connections"] == 0

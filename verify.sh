@@ -280,13 +280,27 @@ else
   fail=1
 fi
 
-echo "running hardened sidecar loopback ratio (>= 0.9x unhardened)..."
+echo "running hardened sidecar loopback ratio (>= 0.9x unhardened; v5 columnar >= 0.9x v4)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/sidecar_loopback.py \
     --assert-ratio > /dev/null; then
-  echo "  ok  hardened loopback throughput"
+  echo "  ok  hardened loopback throughput + v5 columnar floor"
 else
   echo "  FAILED  hardened loopback throughput (ingress hardening costs"
-  echo "          more than 10% of the unhardened baseline)"
+  echo "          more than 10% of the unhardened baseline, or the v5"
+  echo "          columnar batch path fell below 0.9x of the v4"
+  echo "          per-request frame path on the same server shape)"
+  fail=1
+fi
+
+echo "running coalesce smoke gate (coalesced >= 1.0x uncoalesced on Zipf, 0 oracle mismatches)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/coalesce_smoke.py \
+    --assert-ratio > /dev/null; then
+  echo "  ok  Zipf key coalescing (faster than the scan it replaces, bit-identical)"
+else
+  echo "  FAILED  coalesce smoke (the coalesced digest lost to the"
+  echo "          rank-major scan on repeat-heavy Zipf traffic, or a"
+  echo "          coalesced decision diverged from the sequential"
+  echo "          oracle replay)"
   fail=1
 fi
 
