@@ -154,12 +154,128 @@ def lease_pass(server, lid, reps: int) -> dict:
     }
 
 
+def direct_shared_pass(server, lid, reps: int) -> dict:
+    """Direct leases on SHARED hot keys: the lease table grants one
+    lease per (lid, key), so with every client hammering the same key
+    set only one client burns locally per key — the rest pay a wire
+    frame per decision through the fallback.  This is the ingress shape
+    the aggregator tier (ARCHITECTURE §14b) exists to collapse."""
+    from ratelimiter_tpu.leases import LeaseClient
+    from ratelimiter_tpu.service.sidecar import SidecarClient
+
+    barrier = threading.Barrier(N_CLIENTS + 1)
+    stats = [None] * N_CLIENTS
+    per_client = reps * PIPELINE
+    keys = [f"agg-k{i}" for i in range(KEYS_PER_CLIENT)]  # SHARED
+
+    def client_loop(t: int) -> None:
+        wire = SidecarClient("127.0.0.1", server.port)
+        cli = LeaseClient(wire, lid, budget=BUDGET, telemetry=False,
+                          direct_fallback=True)
+        try:
+            assert cli.try_acquire(keys[t % KEYS_PER_CLIENT])  # warm
+            barrier.wait()
+            got = 0
+            for i in range(per_client):
+                if cli.try_acquire(keys[(t + i) % KEYS_PER_CLIENT]):
+                    got += 1
+            cli.release_all()
+            stats[t] = {"allowed": got, "wire": cli.wire_ops,
+                        "local": cli.local_decisions}
+        finally:
+            wire.close()
+
+    threads = [threading.Thread(target=client_loop, args=(t,), daemon=True)
+               for t in range(N_CLIENTS)]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    n = N_CLIENTS * per_client
+    wire = sum(s["wire"] for s in stats)
+    return {
+        "decisions": n,
+        "allowed": sum(s["allowed"] for s in stats),
+        "local_decisions": sum(s["local"] for s in stats),
+        "wall_s": round(wall, 4),
+        "decisions_per_sec": round(n / wall, 1),
+        "wire_frames": wire,
+        "frames_per_decision": round(wire / n, 5),
+    }
+
+
+def aggregator_pass(server, lid, reps: int) -> dict:
+    """The same shared hot keys through ONE EdgeAggregator: each client
+    burns a sublease locally, the aggregator holds one bulk lease per
+    key and renews its whole portfolio in one v6 OP_BULK_RENEW frame
+    per flush — every upstream frame rides ONE TCP connection, counted
+    at the aggregator (the only place wire traffic exists)."""
+    from ratelimiter_tpu.edge import EdgeAggregator
+    from ratelimiter_tpu.leases import LeaseClient
+    from ratelimiter_tpu.service.sidecar import SidecarClient
+
+    barrier = threading.Barrier(N_CLIENTS + 1)
+    stats = [None] * N_CLIENTS
+    per_client = reps * PIPELINE
+    keys = [f"agg-k{i}" for i in range(KEYS_PER_CLIENT)]  # SHARED
+    wire = SidecarClient("127.0.0.1", server.port)
+    agg = EdgeAggregator(wire, bulk_budget=N_CLIENTS * BUDGET * 2,
+                         slice_budget=BUDGET, flush_ms=50.0)
+
+    def client_loop(t: int) -> None:
+        cli = LeaseClient(agg.session(), lid, budget=BUDGET,
+                          telemetry=False, direct_fallback=False)
+        assert cli.try_acquire(keys[t % KEYS_PER_CLIENT])  # warm
+        barrier.wait()
+        got = 0
+        for i in range(per_client):
+            if cli.try_acquire(keys[(t + i) % KEYS_PER_CLIENT]):
+                got += 1
+        cli.release_all()
+        stats[t] = {"allowed": got, "local": cli.local_decisions}
+
+    try:
+        threads = [threading.Thread(target=client_loop, args=(t,),
+                                    daemon=True)
+                   for t in range(N_CLIENTS)]
+        for th in threads:
+            th.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        agg.release_all()
+        n = N_CLIENTS * per_client
+        return {
+            "decisions": n,
+            "allowed": sum(s["allowed"] for s in stats),
+            "local_decisions": sum(s["local"] for s in stats),
+            "wall_s": round(wall, 4),
+            "decisions_per_sec": round(n / wall, 1),
+            "wire_frames": agg.upstream_frames,
+            "bulk_renewals": agg.bulk_renewals_total,
+            "subleases_granted": agg.slices_granted_total,
+            "frames_per_decision": round(agg.upstream_frames / n, 5),
+        }
+    finally:
+        wire.close()
+
+
 def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     parser = argparse.ArgumentParser()
     parser.add_argument("--assert-ratio", action="store_true",
                         help="gate >=10x wire-frame reduction at equal or "
                              "better decision throughput vs the v2 pass")
+    parser.add_argument("--aggregator", action="store_true",
+                        help="also run the shared-hot-key arms: direct "
+                             "leases (fallback-heavy) vs one edge "
+                             "aggregator subleasing bulk budgets; with "
+                             "--assert-ratio, gate the >=5x collapse")
     args = parser.parse_args()
 
     from ratelimiter_tpu.core.config import RateLimitConfig
@@ -180,7 +296,11 @@ def main() -> None:
             max_permits=1 << 20, window_ms=60_000, refill_rate=1e6))
         server.attach_leases(LeaseManager(
             storage, default_budget=BUDGET, max_budget=BUDGET,
-            ttl_ms=60_000.0))
+            ttl_ms=60_000.0,
+            # Only bulk (aggregator-tier) grants see this cap; the
+            # default arms never issue one, so their wire traffic is
+            # byte-identical with or without it.
+            max_bulk_budget=N_CLIENTS * BUDGET * 4))
         storage.warm_micro_shapes()
 
         # Best-of-2 each (scheduler noise must not read as a regression).
@@ -243,6 +363,30 @@ def main() -> None:
             "wire_frame_reduction_with_telemetry": round(reduction_all, 1),
             "throughput_ratio": round(speedup, 2),
         }
+        if args.aggregator:
+            # Shared-hot-key arms (ARCHITECTURE §14b): direct leases
+            # degenerate to per-decision fallback when every client
+            # hammers the same keys; one aggregator collapses that
+            # ingress multiplicatively.  Same admitted traffic: the
+            # generous config admits every burn, so any allowed !=
+            # decisions gap is an admission mismatch, not throttling.
+            direct = max((direct_shared_pass(server, lid, reps)
+                          for _ in range(2)),
+                         key=lambda r: r["decisions_per_sec"])
+            agg = max((aggregator_pass(server, lid, reps)
+                       for _ in range(2)),
+                      key=lambda r: r["decisions_per_sec"])
+            assert direct["allowed"] == direct["decisions"], (
+                f"direct-shared arm admission mismatch: "
+                f"{direct['allowed']} != {direct['decisions']}")
+            assert agg["allowed"] == agg["decisions"], (
+                f"aggregator arm admission mismatch: "
+                f"{agg['allowed']} != {agg['decisions']}")
+            collapse = (direct["frames_per_decision"]
+                        / max(agg["frames_per_decision"], 1e-9))
+            out["direct_shared"] = direct
+            out["aggregator"] = agg
+            out["aggregator_frame_collapse"] = round(collapse, 1)
         print(json.dumps(out))
         if args.assert_ratio:
             assert reduction >= 10.0, (
@@ -253,6 +397,13 @@ def main() -> None:
                 f"leased decision throughput fell to {speedup:.2f}x of "
                 f"the per-decision v2 path ({ls['decisions_per_sec']:.0f}"
                 f"/s vs {v2['decisions_per_sec']:.0f}/s)")
+            if args.aggregator:
+                assert collapse >= 5.0, (
+                    f"aggregator frame collapse {collapse:.1f}x < 5x vs "
+                    f"the direct-lease arm on the same shared hot keys "
+                    f"(agg {agg['frames_per_decision']:.5f} "
+                    f"frames/decision vs direct "
+                    f"{direct['frames_per_decision']:.5f})")
     finally:
         server.stop()
         storage.close()

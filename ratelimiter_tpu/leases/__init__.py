@@ -16,13 +16,16 @@ burner), wire protocol v3 (``service/sidecar.py``), and the chaos drill
 
 from ratelimiter_tpu.leases.client import DirectTransport, LeaseClient
 from ratelimiter_tpu.leases.manager import LeaseGrant, LeaseManager
+from ratelimiter_tpu.leases.sublease import BulkPool, Sublease
 from ratelimiter_tpu.leases.table import Lease, LeaseTable
 
 __all__ = [
+    "BulkPool",
     "DirectTransport",
     "Lease",
     "LeaseClient",
     "LeaseGrant",
     "LeaseManager",
     "LeaseTable",
+    "Sublease",
 ]

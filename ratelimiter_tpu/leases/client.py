@@ -87,13 +87,36 @@ class DirectTransport:
         self.manager = manager
 
     def lease_grant(self, lid: int, key: str, requested: int,
-                    trace_id: int = 0):
-        return self.manager.grant(lid, key, requested, trace_id=trace_id)
+                    trace_id: int = 0, bulk: bool = False):
+        return self.manager.grant(lid, key, requested, trace_id=trace_id,
+                                  bulk=bulk)
 
     def lease_renew(self, lid: int, key: str, used: int,
                     requested: int = 0, trace_id: int = 0):
         return self.manager.renew(lid, key, used, requested,
                                   trace_id=trace_id)
+
+    def lease_bulk_renew(self, lid: int, keys, used, requested,
+                         epochs=None, trace_id: int = 0):
+        """Portfolio renewal (edge aggregators): one row per key, each
+        the exact equivalent of :meth:`lease_renew`.  ``epochs`` (one
+        per row, optional) names the lease instance each report belongs
+        to, so burns flushed for a revoked bulk lease can never fold
+        into a successor grant's accounting.  Returns one ``(granted,
+        ttl_ms, epoch, revoked)`` tuple per row — the in-process mirror
+        of wire v6 ``OP_BULK_RENEW``."""
+        out = []
+        eps = epochs if epochs is not None else [None] * len(keys)
+        for key, u, req, ep in zip(keys, used, requested, eps):
+            resp = self.manager.renew(lid, key, int(u), int(req),
+                                      trace_id=trace_id,
+                                      epoch=None if ep is None else int(ep))
+            if resp is None:
+                out.append((0, 0, 0, True))
+            else:
+                out.append((int(resp.granted), int(resp.ttl_ms),
+                            int(resp.epoch), False))
+        return out
 
     def lease_release(self, lid: int, key: str, used: int,
                       trace_id: int = 0) -> None:

@@ -85,10 +85,17 @@ class LeaseManager:
                  record_ops: bool = False,
                  storm_threshold: int = 8,
                  storm_window_ms: float = 2000.0,
-                 max_concurrent: int = 0):
+                 max_concurrent: int = 0,
+                 max_bulk_budget: int = 0):
         self.storage = storage
         self.default_budget = max(int(default_budget), 1)
         self.max_budget = max(int(max_budget), 1)
+        # Aggregate cap for BULK leases (edge aggregators, ARCHITECTURE
+        # §14b) — bulk budgets cover many subleased clients, so they may
+        # legitimately exceed the per-client max_budget (and the old
+        # 65535 wire cap; wire v6 carries them full-width).  0 means
+        # "no separate cap": bulk grants clamp like ordinary ones.
+        self.max_bulk_budget = max(int(max_bulk_budget), 0)
         self.ttl_ms = float(ttl_ms)
         self.deny_ttl_ms = max(float(deny_ttl_ms), 1.0)
         self.table = LeaseTable(max_leases=max_leases)
@@ -184,6 +191,25 @@ class LeaseManager:
             return int(fn()["epoch"])
         except Exception:  # noqa: BLE001 — epoch is best-effort metadata
             return 0
+
+    def _scope_epoch(self, lid: int, key: str) -> int:
+        """The revocation epoch for THIS key (ARCHITECTURE §14b): a
+        storage exposing ``lease_scope_epoch`` scopes fence bumps to the
+        shard the key routes to, so a single-shard promotion revokes
+        only that shard's leases.  Storages without the surface keep the
+        old global-epoch semantics."""
+        fn = getattr(self.storage, "lease_scope_epoch", None)
+        if fn is None:
+            return self._epoch()
+        try:
+            return int(fn(int(lid), key))
+        except Exception:  # noqa: BLE001 — epoch is best-effort metadata
+            return self._epoch()
+
+    def _budget_cap(self, bulk: bool) -> int:
+        if bulk and self.max_bulk_budget:
+            return max(self.max_bulk_budget, self.max_budget)
+        return self.max_budget
 
     def _policy_gen(self, lid: int) -> int:
         """The lid's current policy-row generation (0 when the storage
@@ -284,17 +310,20 @@ class LeaseManager:
 
     # -- the lease protocol ----------------------------------------------------
     def grant(self, lid: int, key: str, requested: int = 0,
-              trace_id: int = 0) -> LeaseGrant:
+              trace_id: int = 0, bulk: bool = False) -> LeaseGrant:
         """Grant a fresh per-key budget.  ``granted == 0`` (with a retry
         hint in ``ttl_ms``) when the key is already leased, the budget
         is exhausted, the table is full, or the storage is fenced.
-        ``trace_id`` threads the grant into the lineage ring."""
+        ``trace_id`` threads the grant into the lineage ring.  ``bulk``
+        marks an edge-aggregator portfolio lease: the budget is an
+        aggregate and clamps against ``max_bulk_budget``."""
         with self._lock:
             algo, cfg = self._algo_cfg(lid)
             now = int(self._clock_ms())
             self._maybe_sweep(now)
             self._trace(trace_id, "lease.grant", key=key,
                         requested=int(requested))
+            scope_epoch = self._scope_epoch(lid, key)
             existing = self.table.get(algo, lid, key)
             if existing is not None:
                 if existing.expired(now):
@@ -302,6 +331,21 @@ class LeaseManager:
                     self._bump(self._m_expired, "expired_total")
                     self._recorder.record("lease.expired",
                                           coalesce_ms=1000.0, key=key)
+                elif scope_epoch > existing.epoch:
+                    # The holder's lease predates a fence bump on this
+                    # key's shard: its charge lives (at best) on the
+                    # replaced backend.  Revoke it NOW so a re-granted
+                    # aggregator takes the key over immediately instead
+                    # of waiting out the dead holder's TTL; the dead
+                    # holder's eventual renewal lands "unknown_lease"
+                    # and its burns count into over_admission as usual.
+                    self.table.pop(algo, lid, key)
+                    self._bump(self._m_revoked, "revoked_total")
+                    self._recorder.record("lease.revoked", key=key,
+                                          reason="fence_epoch_grant",
+                                          coalesce_ms=200.0)
+                    self._note_fence_revocation(now, key,
+                                                "fence_epoch_grant")
                 else:
                     # One burner per key: the second client stays on the
                     # per-decision path (the device arbitrates contended
@@ -309,7 +353,8 @@ class LeaseManager:
                     return LeaseGrant(0, int(self.deny_ttl_ms),
                                       existing.epoch)
             req = int(requested) or self.default_budget
-            req = max(1, min(req, self.max_budget, cfg.max_permits))
+            req = max(1, min(req, self._budget_cap(bulk),
+                             cfg.max_permits))
             req = self._slot_clamp(algo, lid, req)
             if req <= 0:
                 # Concurrency slots exhausted: the tenant's outstanding
@@ -331,14 +376,14 @@ class LeaseManager:
             granted = int(out["granted"])
             self._trace(trace_id, "shard", path="lease_reserve",
                         granted=granted, stamp=int(out.get("stamp", 0)))
-            epoch = self._epoch()
+            epoch = self._scope_epoch(lid, key)
             if granted <= 0:
                 return LeaseGrant(0, int(self.deny_ttl_ms), epoch)
             ttl = self._ttl_for(algo, cfg, out["stamp"])
             lease = Lease(algo=algo, lid=int(lid), key=key, budget=granted,
                           ws=int(out["ws"]), epoch=epoch,
                           deadline_ms=now + ttl, granted_total=granted,
-                          policy_gen=self._policy_gen(lid))
+                          policy_gen=self._policy_gen(lid), bulk=bulk)
             if not self.table.put(lease):
                 # Table full: undo the charge and refuse — bounded state.
                 self._credit(lease, granted)
@@ -353,11 +398,24 @@ class LeaseManager:
 
     def renew(self, lid: int, key: str, used: int,
               requested: int = 0,
-              trace_id: int = 0) -> Optional[LeaseGrant]:
+              trace_id: int = 0,
+              epoch: Optional[int] = None) -> Optional[LeaseGrant]:
         """Renew: report ``used`` burns, credit the unused remainder,
         charge a fresh budget.  Returns ``None`` when the lease was
         REVOKED (fence epoch advanced, storage fenced, or unknown
-        lease) — the client must re-grant before burning again."""
+        lease) — the client must re-grant before burning again.
+
+        ``epoch`` (when given) names the lease INSTANCE the report
+        belongs to: an edge aggregator flushing burns for a revoked
+        bulk lease may race a successor grant on the same key, and
+        without the check those burns would fold into the successor's
+        accounting.  A report whose epoch predates the live lease's is
+        counted straight into ``over_admission`` — the dead instance's
+        burns — and the live lease is left untouched.  The check is
+        exact for fence-driven revocations (the epoch always advanced);
+        a TTL-expired instance whose successor carries the SAME epoch
+        folds into the successor — conservative (the successor's next
+        renewal credits less, never more)."""
         with self._lock:
             algo, cfg = self._algo_cfg(lid)
             now = int(self._clock_ms())
@@ -378,8 +436,19 @@ class LeaseManager:
                                       reason="unknown_lease",
                                       coalesce_ms=200.0)
                 return None
+            if epoch is not None and int(epoch) != lease.epoch:
+                # Stale lease-instance report (ARCHITECTURE §14b): the
+                # reporter's lease died and the key was already
+                # re-granted.  The burns ran against the DEAD
+                # instance's (unreclaimed) reservation, so they are
+                # over-admission — never the successor's usage.
+                self._bump(self._m_over, "over_admission_total", used)
+                self._recorder.record("lease.revoked", key=key,
+                                      reason="stale_epoch_report",
+                                      coalesce_ms=200.0)
+                return None
             lease.used_total += used
-            cur_epoch = self._epoch()
+            cur_epoch = self._scope_epoch(lid, key)
             if cur_epoch > lease.epoch:
                 # Failover promoted a replacement since the grant: the
                 # charge lives (at best) on the old backend, so neither
@@ -409,7 +478,8 @@ class LeaseManager:
                 self._gauge()
                 return None
             req = int(requested) or lease.budget
-            req = max(1, min(req, self.max_budget, cfg.max_permits))
+            req = max(1, min(req, self._budget_cap(lease.bulk),
+                             cfg.max_permits))
             cur_gen = self._policy_gen(lid)
             if cur_gen > lease.policy_gen:
                 # A live policy update landed since the last charge: the
@@ -460,7 +530,7 @@ class LeaseManager:
             lease.budget = granted
             lease.ws = int(out["ws"])
             lease.policy_gen = cur_gen
-            lease.epoch = self._epoch()
+            lease.epoch = self._scope_epoch(lid, key)
             lease.deadline_ms = now + ttl
             lease.granted_total += granted
             lease.renewals += 1
@@ -484,7 +554,7 @@ class LeaseManager:
             lease.used_total += used
             self._recorder.record("lease.released", coalesce_ms=1000.0,
                                   key=key)
-            if self._epoch() > lease.epoch:
+            if self._scope_epoch(lid, key) > lease.epoch:
                 self._bump(self._m_over, "over_admission_total", used)
                 self._gauge()
                 return

@@ -38,6 +38,12 @@ class Lease:
     # charged under: a renewal at an older generation re-reserves under
     # the NEW rate (credit + fresh clamp against the updated config).
     policy_gen: int = 0
+    # Bulk lease (edge/, ARCHITECTURE §14b): the holder is an edge
+    # aggregator subleasing slices to its own clients, so the budget is
+    # an AGGREGATE and clamps against ``max_bulk_budget`` instead of the
+    # per-client ``max_budget``.  Over-admission nests: aggregator
+    # outstanding <= this budget <= the core's outstanding bound.
+    bulk: bool = False
 
     def expired(self, now_ms: int) -> bool:
         return now_ms >= self.deadline_ms

@@ -387,6 +387,11 @@ class RateLimiterHandler(BaseHTTPRequestHandler):
             return self._json(200, {"enabled": True, **fleet.status()})
         if self.path == "/actuator/controller":
             return self._controller_actuator()
+        if self.path == "/actuator/edge":
+            edge = getattr(self.ctx, "edge", None)
+            if edge is None:
+                return self._json(200, {"enabled": False})
+            return self._json(200, {"enabled": True, **edge.status()})
         if self.path.startswith("/actuator/trace"):
             trace = getattr(self.ctx.storage, "trace", None)
             if trace is None:

@@ -110,6 +110,17 @@ DEFAULTS = {
     "ratelimiter.lease.ttl_ms": "2000",
     "ratelimiter.lease.deny_ttl_ms": "25",
     "ratelimiter.lease.max_leases": "65536",
+    # Bulk (aggregator-tier, §14b) grants may exceed max_budget up to
+    # this cap; 0 keeps them clamped like ordinary grants.
+    "ratelimiter.lease.max_bulk_budget": "0",
+    # Edge aggregator tier (edge/, ARCHITECTURE §14b): one bulk lease
+    # per hot (lid, key) subleased to in-process clients, the whole
+    # portfolio renewed in ONE columnar frame per flush interval.
+    # Requires ratelimiter.lease.enabled.  OFF by default.
+    "ratelimiter.edge.enabled": "false",
+    "ratelimiter.edge.bulk_budget": "4096",
+    "ratelimiter.edge.slice_budget": "64",
+    "ratelimiter.edge.flush_ms": "50",
     # Observability (observability/, ARCHITECTURE §13).  trace_sample:
     # record one full per-request lifecycle trace per ~N requests into
     # the enriched /actuator/trace ring (0 = off).  slo_ms: any dispatch
@@ -301,6 +312,9 @@ _INT_KEYS = (
     "ratelimiter.lease.default_budget",
     "ratelimiter.lease.max_budget",
     "ratelimiter.lease.max_leases",
+    "ratelimiter.lease.max_bulk_budget",
+    "ratelimiter.edge.bulk_budget",
+    "ratelimiter.edge.slice_budget",
     "ratelimiter.control.window_ms",
     "ratelimiter.control.max_concurrent",
     "ratelimiter.table.capacity",
@@ -327,6 +341,7 @@ _FLOAT_KEYS = (
     "ratelimiter.cache.hybrid.guard_ms",
     "ratelimiter.lease.ttl_ms",
     "ratelimiter.lease.deny_ttl_ms",
+    "ratelimiter.edge.flush_ms",
     "ratelimiter.control.interval_ms",
     "ratelimiter.control.target_excess",
     "ratelimiter.control.increase_fraction",
@@ -348,6 +363,7 @@ _BOOL_KEYS = (
     "ratelimiter.microbatch.adaptive_flush",
     "ratelimiter.cache.hybrid.enabled",
     "ratelimiter.lease.enabled",
+    "ratelimiter.edge.enabled",
     "ratelimiter.control.enabled",
     "ratelimiter.control.fleet.enabled",
     "ratelimiter.fleet.enabled",

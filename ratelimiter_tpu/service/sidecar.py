@@ -25,6 +25,8 @@ can speak it in ~30 lines:
       8 = RELEASE       (v3: a=limiter id, b=used)
       9 = TELEMETRY     (v4: key bytes carry a client burn report;
                          RESPONSE-LESS — see below)
+     10 = BATCH         (v5: columnar decision batch — see below)
+     11 = BULK_RENEW    (v6: columnar lease-portfolio renewal — see below)
   status: 0 = OK
           1 = ERROR          (generic; remaining carries an errno — the only
                               error status v1 clients ever see)
@@ -105,6 +107,42 @@ v2->v4): a v<=4 connection sending op 10 gets the same unknown-op
 ``BAD_FRAME`` a v4 server would give, and v<=4 ingress is served
 byte-identically to a v4 server.
 
+**Wire v6: wide lease budgets + bulk portfolio renewal (edge/).**
+Bulk leases (one aggregate budget subleased to many clients by an edge
+aggregator) routinely exceed the v3 packing's 65535 cap, so a v6
+connection widens every lease budget field:
+
+- v6 lease REQUESTS carry a u32 ``ext`` field between the (v4) trace
+  id and the key bytes — LEASE: ``b`` = requested (full u32), ``ext``
+  bit 0 = bulk flag; RENEW: ``b`` = used (u32), ``ext`` = requested
+  (u32); RELEASE: ``b`` = used (u32), ``ext`` reserved;
+- v6 OK lease RESPONSES append a trailing u64 full-width grant after
+  the standard 14 bytes (the packed ``remaining`` keeps the clamped v3
+  fields; the length field disambiguates, exactly like BATCH).
+
+The BULK_RENEW op (11, v6 only) renews an aggregator's whole portfolio
+for one lid in ONE columnar frame::
+
+  v6 bulk  := u32 len | u8 op=11 | u32 lid | u32 rows | u64 trace_id
+            | u32 klen | key bytes[klen] | u32 offsets[rows + 1]
+            | u64 used[rows] | u64 requested[rows] | u32 epochs[rows]
+  response := u32 len | u8 status=OK | u8 1 | i64 rows
+            | u64 granted[rows] | u32 ttl_ms[rows] | u32 epoch[rows]
+            | u8 flags[rows]            (bit 0: REVOKED — re-grant)
+
+Each row is the exact equivalent of one RENEW frame (same manager
+call, same revocation and over-admission accounting).  ``epochs[i]``
+names the lease instance row i reports for (0xFFFFFFFF = no check):
+burns flushed for a revoked bulk lease that raced a successor grant on
+the same key are counted into ``over_admission`` instead of folding
+into the successor's accounting.  v<=5
+connections never see any of this and are served byte-identically to a
+v5 server (op 11 below v6 is the same unknown-op ``BAD_FRAME`` a v5
+server would give).  When the attached lease backend is
+session-capable (an ``edge.EdgeAggregator`` fronting subleases), each
+connection gets its own session — one client's subleases never alias
+another's.
+
 **Ingress hardening.**  Every byte on the wire is untrusted:
 
 - frames are validated (max frame length, max key length, UTF-8 key,
@@ -175,8 +213,9 @@ OP_RENEW = 7
 OP_RELEASE = 8
 OP_TELEMETRY = 9
 OP_BATCH = 10
+OP_BULK_RENEW = 11
 
-PROTOCOL_VERSION = 5
+PROTOCOL_VERSION = 6
 
 ST_OK = 0
 ST_ERROR = 1
@@ -203,6 +242,15 @@ ERR_BAD_COLUMN = 12
 _LEASE_GRANT_MAX = 0xFFFF
 _LEASE_TTL_MAX = 0xFFFFFF
 _LEASE_EPOCH_MAX = 0x7FFFFF
+# v6: budgets ride the wire full-width (bulk budgets are aggregate and
+# routinely exceed the old 65535 cap).
+_LEASE_GRANT_MAX_V6 = 0xFFFFFFFF
+# v6 bulk-renew response columns, per row: u64 granted + u32 ttl_ms
+# + u32 epoch + u8 flags (bit 0: REVOKED — re-grant at the new epoch).
+_BULK_ROW_BYTES = 8 + 4 + 4 + 1
+# Bulk-renew request epoch column sentinel: "no lease-instance check"
+# (a plain client that does not track instance epochs).
+_EPOCH_ANY = 0xFFFFFFFF
 
 
 def _pack_lease(granted: int, ttl_ms: int, epoch: int) -> int:
@@ -240,13 +288,18 @@ def _consume_future(fut) -> None:
 class _ConnState:
     """Per-connection protocol state (owned by one handler thread)."""
 
-    __slots__ = ("version", "buf", "skip", "pending")
+    __slots__ = ("version", "buf", "skip", "pending", "leases")
 
     def __init__(self):
         self.version = 1       # until a HELLO negotiates up
         self.buf = b""         # unparsed wire bytes
         self.skip = 0          # bytes of an oversized frame left to discard
         self.pending: List = []  # burst: response bytes | futures | batches
+        # Per-connection lease identity: when the attached lease backend
+        # is session-capable (an EdgeAggregator), each connection gets
+        # its own sublease bookkeeping (lazily created on first lease
+        # op).  A plain LeaseManager is shared across connections.
+        self.leases = None
 
 
 class _BatchPending:
@@ -609,11 +662,27 @@ class SidecarServer:
             else:
                 op, a, b = _REQ_BODY.unpack_from(frame)
                 key_bytes = frame[_REQ_BODY.size:]
+            ext = 0
+            if st.version >= 6 and op in (OP_LEASE, OP_RENEW, OP_RELEASE):
+                # v6 lease-frame extension: a u32 ``ext`` field rides
+                # between the (v4) trace id and the key bytes, widening
+                # lease budgets past the old 16-bit packing — LEASE:
+                # b = requested (u32), ext bit 0 = bulk flag; RENEW:
+                # b = used (u32), ext = requested (u32); RELEASE:
+                # b = used (u32), ext reserved.  v<=5 connections never
+                # send it and are served byte-identically to a v5
+                # server.
+                if len(key_bytes) < 4:
+                    self._count_malformed()
+                    return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
+                (ext,) = struct.unpack_from("<I", key_bytes)
+                key_bytes = key_bytes[4:]
             # BATCH payloads are columns, not one key — their per-key
             # lengths are checked in the column validation.  The v5 gate
             # is inside the condition so a v<=4 connection sending op 10
             # stays byte-identical to a v4 server (key check first).
-            batch_op = op == OP_BATCH and st.version >= 5
+            batch_op = (op == OP_BATCH and st.version >= 5) or (
+                op == OP_BULK_RENEW and st.version >= 6)
             if op != OP_TELEMETRY and not batch_op and self.max_key_bytes \
                     and len(key_bytes) > self.max_key_bytes:
                 self._count_malformed()
@@ -647,6 +716,16 @@ class SidecarServer:
                     self._count_drained()
                     return resp(st, ST_SHUTTING_DOWN, 0, 0)
                 return self._begin_batch(st, a, b, tid, key_bytes)
+            if op == OP_BULK_RENEW:
+                if st.version < 6:
+                    # The bulk-renew op does not exist below v6: same
+                    # unknown-op answer a v5 server would give.
+                    self._count_malformed()
+                    return resp(st, ST_BAD_FRAME, 0, ERR_UNKNOWN_OP)
+                if self._draining:
+                    self._count_drained()
+                    return resp(st, ST_SHUTTING_DOWN, 0, 0)
+                return self._bulk_renew_frame(st, a, b, tid, key_bytes)
             lease_op = op in (OP_LEASE, OP_RENEW, OP_RELEASE)
             if lease_op and st.version < 3:
                 # The lease ops do not exist below v3: a v2 (or v1)
@@ -680,7 +759,7 @@ class SidecarServer:
                     lineage.record(tid, "sidecar", op=op, lid=int(a),
                                    version=st.version)
             if lease_op:
-                return self._lease_frame(st, op, a, b, key, tid)
+                return self._lease_frame(st, op, a, b, key, tid, ext)
             if op == OP_TRY_ACQUIRE:
                 return self._begin_acquire(st, algo, a, key,
                                            max(int(b), 1), tid)
@@ -705,35 +784,182 @@ class SidecarServer:
         if plane.fold(blob) < 0:
             self.telemetry_dropped_total += 1
 
+    def _conn_leases(self, st: _ConnState):
+        """The lease backend for THIS connection: a session-capable
+        backend (an ``edge.EdgeAggregator``) gets one session per
+        connection — each client's subleases are its own — while a
+        plain ``LeaseManager`` is shared.  Lazily resolved so
+        ``attach_leases`` may run after connections are open."""
+        if st.leases is not None:
+            return st.leases
+        backend = self._leases
+        if backend is None:
+            return None
+        sess = getattr(backend, "session", None)
+        st.leases = sess() if callable(sess) else backend
+        return st.leases
+
+    @staticmethod
+    def _lease_ok_resp(st: _ConnState, allowed: int, granted: int,
+                       ttl_ms: int, epoch: int) -> bytes:
+        """OK lease response.  v6 appends the full-width u64 grant
+        after the standard 14 bytes (the packed ``remaining`` keeps the
+        old clamped fields, so the layout degrades readably); the
+        length field disambiguates, exactly like BATCH responses.
+        v<=5 stays the plain 14-byte shape, clamps intact."""
+        packed = _pack_lease(granted, ttl_ms, epoch)
+        if st.version >= 6:
+            return _RESP.pack(_RESP.size - 4 + 8, ST_OK, allowed,
+                              packed) + struct.pack("<Q", max(int(granted),
+                                                              0))
+        return _mk_resp(ST_OK, allowed, packed)
+
     def _lease_frame(self, st: _ConnState, op: int, lid: int, b: int,
-                     key: str, trace_id: int = 0) -> bytes:
-        """One v3 lease op against the attached LeaseManager.  Resolves
-        synchronously (a lease frame amortizes over a whole budget, so
-        it does not ride the pipelined decision path)."""
-        if self._leases is None:
+                     key: str, trace_id: int = 0, ext: int = 0) -> bytes:
+        """One v3+ lease op against the attached lease backend.
+        Resolves synchronously (a lease frame amortizes over a whole
+        budget, so it does not ride the pipelined decision path).  On a
+        v6 connection the budget fields are full u32s (``ext`` carries
+        RENEW's requested budget and LEASE's bulk flag); below v6 the
+        v3 16-bit packing applies unchanged."""
+        mgr = self._conn_leases(st)
+        if mgr is None:
             return self._resp(st, ST_ERROR, 0, ERR_LEASE_DISABLED)
+        v6 = st.version >= 6
         try:
             if op == OP_LEASE:
-                g = self._leases.grant(lid, key,
-                                       requested=int(b) & 0xFFFF,
-                                       trace_id=trace_id)
+                if v6:
+                    g = mgr.grant(lid, key, requested=int(b),
+                                  trace_id=trace_id,
+                                  bulk=bool(ext & 1))
+                else:
+                    g = mgr.grant(lid, key, requested=int(b) & 0xFFFF,
+                                  trace_id=trace_id)
             elif op == OP_RENEW:
-                g = self._leases.renew(lid, key, used=int(b) & 0xFFFF,
-                                       requested=(int(b) >> 16) & 0xFFFF,
-                                       trace_id=trace_id)
+                if v6:
+                    g = mgr.renew(lid, key, used=int(b),
+                                  requested=int(ext), trace_id=trace_id)
+                else:
+                    g = mgr.renew(lid, key, used=int(b) & 0xFFFF,
+                                  requested=(int(b) >> 16) & 0xFFFF,
+                                  trace_id=trace_id)
                 if g is None:
                     return self._resp(st, ST_LEASE_REVOKED, 0,
                                       _pack_lease(0, 0, 0))
             else:  # OP_RELEASE
-                self._leases.release(lid, key, used=int(b) & 0xFFFF,
-                                     trace_id=trace_id)
+                used = int(b) if v6 else int(b) & 0xFFFF
+                mgr.release(lid, key, used=used, trace_id=trace_id)
                 return self._resp(st, ST_OK, 1, 0)
-            return self._resp(st, ST_OK, 1 if g.granted > 0 else 0,
-                              _pack_lease(g.granted, g.ttl_ms, g.epoch))
+            return self._lease_ok_resp(st, 1 if g.granted > 0 else 0,
+                                       g.granted, g.ttl_ms, g.epoch)
         except KeyError:
             return self._resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
         except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
             return self._resp(st, ST_ERROR, 0, ERR_INTERNAL)
+
+    def _bulk_renew_frame(self, st: _ConnState, lid: int, rows: int,
+                          trace_id: int, payload: bytes) -> bytes:
+        """One v6 OP_BULK_RENEW frame: an edge aggregator renews its
+        whole bulk portfolio for one lid in ONE columnar round trip.
+
+        request payload (after the v4/v6 header fields)::
+
+          u32 klen | key bytes[klen] | u32 offsets[rows + 1]
+          | u64 used[rows] | u64 requested[rows] | u32 epochs[rows]
+
+        ``epochs[i]`` names the lease instance row ``i`` reports for
+        (0xFFFFFFFF = no instance check): a burn report for a revoked
+        bulk lease must never fold into a successor grant on the same
+        key, so the manager counts an epoch-mismatched row straight
+        into ``over_admission`` and leaves the live lease untouched.
+
+        response::
+
+          u32 len | u8 status=OK | u8 1 | i64 rows
+          | u64 granted[rows] | u32 ttl_ms[rows] | u32 epoch[rows]
+          | u8 flags[rows]                  (bit 0: REVOKED — re-grant)
+
+        Each row is the exact equivalent of one RENEW frame (same
+        manager call, same revocation/over-admission accounting);
+        column validation mirrors OP_BATCH and every violation is
+        answered in-protocol with the stream left in sync."""
+        resp = self._resp
+        mgr = self._conn_leases(st)
+        if mgr is None:
+            return resp(st, ST_ERROR, 0, ERR_LEASE_DISABLED)
+        rows = int(rows)
+        if rows < 1 or (self.max_pipeline and rows > self.max_pipeline):
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_FRAME_TOO_LONG)
+        if len(payload) < 4:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_SHORT_FRAME)
+        (klen,) = struct.unpack_from("<I", payload)
+        off_pos = 4 + klen
+        used_pos = off_pos + 4 * (rows + 1)
+        req_pos = used_pos + 8 * rows
+        ep_pos = req_pos + 8 * rows
+        expect = ep_pos + 4 * rows
+        if len(payload) != expect:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0,
+                        ERR_SHORT_FRAME if len(payload) < expect
+                        else ERR_BAD_COLUMN)
+        offsets = np.frombuffer(payload, np.uint32, rows + 1,
+                                offset=off_pos).astype(np.int64)
+        if (offsets[0] != 0 or offsets[-1] != klen
+                or bool(np.any(np.diff(offsets) < 0))):
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_COLUMN)
+        if self.max_key_bytes and rows and \
+                int(np.diff(offsets).max()) > self.max_key_bytes:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_KEY_TOO_LONG)
+        try:
+            payload[4:off_pos].decode()
+        except UnicodeDecodeError:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_KEY)
+        if self._limiters.get(lid) is None:
+            return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
+        used = np.frombuffer(payload, np.uint64, rows, offset=used_pos)
+        req = np.frombuffer(payload, np.uint64, rows, offset=req_pos)
+        eps = np.frombuffer(payload, np.uint32, rows, offset=ep_pos)
+        if trace_id:
+            lineage = getattr(self.storage, "lineage", None)
+            if lineage is not None:
+                lineage.force(trace_id)
+                lineage.record(trace_id, "sidecar", op=OP_BULK_RENEW,
+                               lid=int(lid), version=st.version,
+                               rows=rows)
+        granted = np.zeros(rows, dtype=np.uint64)
+        ttls = np.zeros(rows, dtype=np.uint32)
+        epochs = np.zeros(rows, dtype=np.uint32)
+        flags = np.zeros(rows, dtype=np.uint8)
+        try:
+            for i in range(rows):
+                key = payload[4 + offsets[i]:4 + offsets[i + 1]].decode()
+                ep = int(eps[i])
+                g = mgr.renew(lid, key, used=int(used[i]),
+                              requested=int(req[i]), trace_id=trace_id,
+                              epoch=None if ep == _EPOCH_ANY else ep)
+                if g is None:
+                    flags[i] = 1
+                else:
+                    granted[i] = max(int(g.granted), 0)
+                    ttls[i] = min(max(int(g.ttl_ms), 0), 0xFFFFFFFF)
+                    epochs[i] = min(max(int(g.epoch), 0), 0xFFFFFFFF)
+        except UnicodeDecodeError:
+            self._count_malformed()
+            return resp(st, ST_BAD_FRAME, 0, ERR_BAD_KEY)
+        except KeyError:
+            return resp(st, ST_ERROR, 0, ERR_UNKNOWN_LIMITER)
+        except Exception:  # noqa: BLE001 — per-frame errors stay per-frame
+            return resp(st, ST_ERROR, 0, ERR_INTERNAL)
+        cols = (granted.tobytes() + ttls.tobytes() + epochs.tobytes()
+                + flags.tobytes())
+        return _RESP.pack(_RESP.size - 4 + len(cols), ST_OK, 1,
+                          rows) + cols
 
     def _begin_acquire(self, st: _ConnState, algo: str, lid: int, key: str,
                        permits: int, trace_id: int = 0):
@@ -1095,11 +1321,16 @@ class SidecarClient:
     # -- framing --------------------------------------------------------------
     def _frame(self, op: int, lid: int, permits: int, key: str,
                trace_id: int = 0,
-               key_bytes: Optional[bytes] = None) -> bytes:
+               key_bytes: Optional[bytes] = None,
+               ext: Optional[int] = None) -> bytes:
         """One request frame in the connection's negotiated format: the
         v4 shape carries a u64 trace id after the header (HELLO always
-        keeps the v1 shape — it predates negotiation)."""
+        keeps the v1 shape — it predates negotiation); ``ext`` is the
+        v6 lease-frame u32 extension field (budget widening), inserted
+        between the trace id and the key bytes on v6 connections."""
         raw = key.encode() if key_bytes is None else key_bytes
+        if ext is not None and self.server_version >= 6:
+            raw = struct.pack("<I", int(ext)) + raw
         if self.server_version >= 4 and op != OP_HELLO:
             body = _REQ_BODY4.pack(op, lid, permits,
                                    int(trace_id) & ((1 << 64) - 1)) + raw
@@ -1247,25 +1478,58 @@ class SidecarClient:
             start += n
         return allowed
 
-    # -- token leases (protocol v3) -------------------------------------------
+    # -- token leases (protocol v3; widened at v6) ----------------------------
+    def _read_lease_response(self) -> Optional[LeaseWire]:
+        """One lease response, honoring the length field: a v6 OK
+        answer carries a trailing u64 full-width grant after the
+        standard 14 bytes (authoritative — the packed ``remaining``
+        clamps at the old 65535); revoked/error answers carry none."""
+        while len(self._rbuf) < _RESP.size:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        length, status, _, remaining = _RESP.unpack_from(self._rbuf)
+        self._rbuf = self._rbuf[_RESP.size:]
+        extra = max(length - (_RESP.size - 4), 0)
+        while len(self._rbuf) < extra:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        tail = self._rbuf[:extra]
+        self._rbuf = self._rbuf[extra:]
+        if status == ST_LEASE_REVOKED:
+            return None
+        self._check(status, remaining)
+        granted, ttl_ms, epoch = _unpack_lease(remaining)
+        if extra >= 8:
+            (granted,) = struct.unpack_from("<Q", tail)
+        return LeaseWire(int(granted), ttl_ms, epoch)
+
     def _lease_roundtrip(self, op: int, lid: int, b: int, key: str,
-                         trace_id: int = 0) -> Optional[LeaseWire]:
+                         trace_id: int = 0,
+                         ext: Optional[int] = None) -> Optional[LeaseWire]:
         if self.server_version < 3:
             raise RuntimeError(
                 f"server negotiated protocol v{self.server_version}; "
                 "lease ops need v3")
-        self._send(self._frame(op, lid, b, key, trace_id=trace_id))
-        status, allowed, remaining = self._read_raw()
-        if status == ST_LEASE_REVOKED:
-            return None
-        self._check(status, remaining)
-        del allowed
-        return LeaseWire(*_unpack_lease(remaining))
+        self._send(self._frame(op, lid, b, key, trace_id=trace_id,
+                               ext=ext))
+        return self._read_lease_response()
 
     def lease_grant(self, lid: int, key: str, requested: int = 0,
-                    trace_id: int = 0) -> Optional[LeaseWire]:
+                    trace_id: int = 0,
+                    bulk: bool = False) -> Optional[LeaseWire]:
         """Charge a per-key budget; ``granted == 0`` means the key stays
-        on the per-decision path for ``ttl_ms`` (retry hint)."""
+        on the per-decision path for ``ttl_ms`` (retry hint).  ``bulk``
+        (v6) marks an edge-aggregator portfolio lease — the budget is
+        aggregate and may exceed the old 65535 wire cap."""
+        if self.server_version >= 6:
+            return self._lease_roundtrip(
+                OP_LEASE, lid,
+                min(int(requested), _LEASE_GRANT_MAX_V6), key,
+                trace_id=trace_id, ext=1 if bulk else 0)
         return self._lease_roundtrip(OP_LEASE, lid,
                                      min(int(requested), 0xFFFF), key,
                                      trace_id=trace_id)
@@ -1275,6 +1539,11 @@ class SidecarClient:
                     trace_id: int = 0) -> Optional[LeaseWire]:
         """Report ``used`` burns + re-charge; None when REVOKED (the
         fence epoch advanced — re-grant via :meth:`lease_grant`)."""
+        if self.server_version >= 6:
+            return self._lease_roundtrip(
+                OP_RENEW, lid, min(int(used), _LEASE_GRANT_MAX_V6), key,
+                trace_id=trace_id,
+                ext=min(int(requested), _LEASE_GRANT_MAX_V6))
         b = (min(int(used), 0xFFFF)
              | min(int(requested), 0xFFFF) << 16)
         return self._lease_roundtrip(OP_RENEW, lid, b, key,
@@ -1285,10 +1554,83 @@ class SidecarClient:
         """Close a lease: final burn report, unused budget credited."""
         if self.server_version < 3:
             return
-        self._send(self._frame(OP_RELEASE, lid,
-                               min(int(used), 0xFFFF), key,
-                               trace_id=trace_id))
-        self._read_raw()
+        if self.server_version >= 6:
+            self._send(self._frame(OP_RELEASE, lid,
+                                   min(int(used), _LEASE_GRANT_MAX_V6),
+                                   key, trace_id=trace_id, ext=0))
+        else:
+            self._send(self._frame(OP_RELEASE, lid,
+                                   min(int(used), 0xFFFF), key,
+                                   trace_id=trace_id))
+        try:
+            self._read_lease_response()
+        except (SidecarShedError, RuntimeError):
+            pass  # release is best-effort, exactly as before
+
+    def lease_bulk_renew(self, lid: int, keys: Sequence[str],
+                         used: Sequence[int], requested: Sequence[int],
+                         epochs: Optional[Sequence[int]] = None,
+                         trace_id: int = 0) -> list:
+        """Portfolio renewal (v6 OP_BULK_RENEW): one columnar frame
+        renews every ``(key, used, requested)`` row — each row the
+        exact equivalent of one :meth:`lease_renew` — and one columnar
+        response comes back.  ``epochs`` (one per row, optional) names
+        the lease instance each report belongs to; rows without one are
+        sent with the ANY sentinel (no instance check).  Returns
+        ``[(granted, ttl_ms, epoch, revoked), ...]`` in row order."""
+        if self.server_version < 6:
+            return [
+                ((0, 0, 0, True) if r is None
+                 else (int(r.granted), int(r.ttl_ms), int(r.epoch),
+                       False))
+                for r in (self.lease_renew(lid, k, int(u), int(q),
+                                           trace_id=trace_id)
+                          for k, u, q in zip(keys, used, requested))]
+        rows = len(keys)
+        if rows == 0:
+            return []
+        kbufs = [k.encode() for k in keys]
+        offs = np.zeros(rows + 1, dtype=np.uint32)
+        np.cumsum(np.fromiter((len(b) for b in kbufs), dtype=np.uint32,
+                              count=rows), out=offs[1:])
+        key_col = b"".join(kbufs)
+        ep_col = (np.full(rows, _EPOCH_ANY, dtype=np.uint32)
+                  if epochs is None
+                  else np.asarray(epochs, dtype=np.uint32))
+        payload = (struct.pack("<I", len(key_col)) + key_col
+                   + offs.tobytes()
+                   + np.asarray(used, dtype=np.uint64).tobytes()
+                   + np.asarray(requested, dtype=np.uint64).tobytes()
+                   + ep_col.tobytes())
+        body = _REQ_BODY4.pack(OP_BULK_RENEW, lid, rows,
+                               int(trace_id) & ((1 << 64) - 1)) + payload
+        self._send(struct.pack("<I", len(body)) + body)
+        while len(self._rbuf) < _RESP.size:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        length, status, _, remaining = _RESP.unpack_from(self._rbuf)
+        self._rbuf = self._rbuf[_RESP.size:]
+        extra = max(length - (_RESP.size - 4), 0)
+        while len(self._rbuf) < extra:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            self._rbuf += chunk
+        cols = self._rbuf[:extra]
+        self._rbuf = self._rbuf[extra:]
+        self._check(status, remaining)
+        if extra != rows * _BULK_ROW_BYTES:
+            raise RuntimeError(
+                f"bulk-renew response carries {extra} column bytes; "
+                f"expected {rows * _BULK_ROW_BYTES}")
+        granted = np.frombuffer(cols, np.uint64, rows)
+        ttls = np.frombuffer(cols, np.uint32, rows, offset=8 * rows)
+        epochs = np.frombuffer(cols, np.uint32, rows, offset=12 * rows)
+        flags = np.frombuffer(cols, np.uint8, rows, offset=16 * rows)
+        return [(int(granted[i]), int(ttls[i]), int(epochs[i]),
+                 bool(flags[i] & 1)) for i in range(rows)]
 
     # -- telemetry (protocol v4, response-less) -------------------------------
     def telemetry_supported(self) -> bool:

@@ -170,8 +170,19 @@ class AppContext:
     # epoch-fenced controller leadership + cross-host policy broadcast
     # behind GET /actuator/controller (ARCHITECTURE §15).
     fleet_control: FleetControlHandle | None = None
+    # In-process edge aggregator (ratelimiter.edge.enabled) — bulk
+    # leases subleased to in-process clients behind GET /actuator/edge
+    # (ARCHITECTURE §14b).
+    edge: object = None
 
     def close(self) -> None:
+        if self.edge is not None:
+            # Return every outstanding bulk budget before the lease
+            # manager (and its storage) goes away.
+            try:
+                self.edge.release_all()
+            except Exception:  # noqa: BLE001 — best-effort drain
+                pass
         if self.fleet is not None:
             self.fleet.close()
         if self.controller is not None:
@@ -406,11 +417,47 @@ def _maybe_leases(storage: RateLimitStorage, sidecar, props: AppProperties,
         # aggregate outstanding lease budget (0 = unbounded).
         max_concurrent=props.get_int("ratelimiter.control.max_concurrent",
                                      0),
+        # Aggregator-tier bulk leases (ARCHITECTURE §14b) may exceed
+        # the per-client cap; 0 keeps bulk clamped like ordinary grants.
+        max_bulk_budget=props.get_int("ratelimiter.lease.max_bulk_budget",
+                                      0),
         registry=registry,
     )
     if sidecar is not None:
         sidecar.attach_leases(manager)
     return manager
+
+
+def _maybe_edge(leases, props: AppProperties, registry: MeterRegistry):
+    """Config-gated in-process edge aggregator (OFF by default;
+    ARCHITECTURE §14b).
+
+    Fronts the lease manager with an ``EdgeAggregator`` over a
+    ``DirectTransport``: in-process ``LeaseClient``s built on
+    ``ctx.edge.session()`` burn memory-speed subleases carved from one
+    bulk lease per hot (lid, key), and the aggregator renews its whole
+    portfolio in one batch per flush interval.  The standalone-process
+    shape of the same tier is ``python -m ratelimiter_tpu.edge.edgeproc``
+    pointed at this node's sidecar."""
+    if not props.get_bool("ratelimiter.edge.enabled", False):
+        return None
+    if leases is None:
+        import logging
+
+        logging.getLogger("ratelimiter").warning(
+            "ratelimiter.edge.enabled requires ratelimiter.lease.enabled; "
+            "edge aggregator disabled")
+        return None
+    from ratelimiter_tpu.edge import EdgeAggregator
+    from ratelimiter_tpu.leases import DirectTransport
+
+    return EdgeAggregator(
+        DirectTransport(leases),
+        bulk_budget=props.get_int("ratelimiter.edge.bulk_budget", 4096),
+        slice_budget=props.get_int("ratelimiter.edge.slice_budget", 64),
+        flush_ms=props.get_float("ratelimiter.edge.flush_ms", 50.0),
+        registry=registry,
+    )
 
 
 def _maybe_controller(serving: RateLimitStorage, props: AppProperties,
@@ -812,6 +859,7 @@ def build_app(props: AppProperties | None = None,
     sidecar = None
     orchestrator = None
     leases = None
+    edge = None
     control = None
     controller = None
     fleet = None
@@ -876,6 +924,7 @@ def build_app(props: AppProperties | None = None,
         # present) so a promoted replacement receives the charges for
         # its keys exactly like decisions.
         leases = _maybe_leases(serving, sidecar, props, registry)
+        edge = _maybe_edge(leases, props, registry)
         wrapped, breaker = _maybe_breaker(_maybe_chaos(storage, props),
                                           props, registry)
         storage = _maybe_retry(wrapped, props)
@@ -945,4 +994,5 @@ def build_app(props: AppProperties | None = None,
         controller=controller,
         fleet=fleet,
         fleet_control=fleet_control,
+        edge=edge,
     )

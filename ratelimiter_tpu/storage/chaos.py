@@ -1644,7 +1644,38 @@ def lease_failover_drill(
             if k in cli_tb._leases:
                 assert cli_tb._leases[k].epoch == orch.fence_epoch, (
                     "re-grant does not carry the bumped fence epoch")
-        report["decisions"] += burned_after_fence + post_burns
+        # SCOPED revocation (ARCHITECTURE §14b): the fence above named
+        # only the victim shard, so survivor-shard leases renew WITHOUT
+        # a revocation or an epoch bounce — failover cost is O(leases
+        # routing to the promoted shard), not O(clients).
+        survivor_keys = [k for k in tb_keys if shard_of[k] != victim]
+        assert survivor_keys, "degenerate key split; raise n_keys"
+        revoked_settled = mgr.revoked_total
+        survivor_epochs = {k: cli_tb._leases[k].epoch
+                           for k in survivor_keys if k in cli_tb._leases}
+        assert survivor_epochs, "no survivor lease left to renew"
+        survivor_burns = 0
+        for k in survivor_keys:
+            lease = cli_tb._leases.get(k)
+            # Drain the slice, then one more burn to force a wire RENEW
+            # through the fence-epoch check.
+            while lease is not None and lease.remaining > 0:
+                clock["t"] += 1
+                assert cli_tb.try_acquire(k), "survivor burn denied"
+                survivor_burns += 1
+            clock["t"] += 1
+            assert cli_tb.try_acquire(k), "survivor renewal denied"
+            survivor_burns += 1
+        assert mgr.revoked_total == revoked_settled, (
+            "a survivor-shard lease was revoked by the scoped fence")
+        for k, ep in survivor_epochs.items():
+            if k in cli_tb._leases:
+                assert cli_tb._leases[k].epoch == ep, (
+                    f"survivor {k!r} epoch bounced across the scoped "
+                    f"promotion: {ep} -> {cli_tb._leases[k].epoch}")
+        report["survivor_renewals"] = len(survivor_epochs)
+        report["decisions"] += (burned_after_fence + post_burns
+                                + survivor_burns)
         report["burned_after_fence"] = burned_after_fence
         report["revoked"] = mgr.revoked_total
         report["over_admission"] = mgr.over_admission_total
@@ -1683,6 +1714,301 @@ def lease_failover_drill(
                 f"oracle {want}")
         report["local_denies"] = cli_tb.local_denies + cli_sw.local_denies
         report["status"] = mgr.status()
+        report["promotions"] = orch.promotions
+        report["fence_epoch"] = orch.fence_epoch
+        return report
+    finally:
+        orch.close()
+        repl.stop()
+        router.close()
+        mesh_set.close()
+
+
+def aggregator_failover_drill(
+    n_shards: int = 4,
+    slots_per_shard: int = 256,
+    n_keys: int = 12,
+    burns: int = 500,
+    bulk_budget: int = 192,
+    slice_budget: int = 12,
+    n_clients: int = 4,
+    seed: int = 0,
+    registry=None,
+    probe_interval_ms: float = 50.0,
+    suspect_threshold: int = 3,
+    hysteresis_ms: float = 200.0,
+) -> dict:
+    """The edge aggregator tier under failure (ARCHITECTURE §14b): an
+    aggregator killed mid-Zipf, its replacement resuming, and a scoped
+    shard promotion revoking only the bulk leases it names.  Proves:
+
+    - **multiplicative wire collapse**: ``n_clients`` clients burning a
+      Zipf-skewed key set through one aggregator spend <= decisions/5
+      upstream frames (the loopback bench gates the TCP version);
+    - **death is bounded by the bulk budgets**: killing the aggregator
+      WITHOUT a final flush strands only the subleased permits already
+      in clients' hands — every burn after the death is served from
+      those slices, and their sum is <= the dropped bulk budgets (the
+      nesting invariant's fleet-level bound);
+    - **TTL reclaims the carcass**: the dead aggregator's bulk leases
+      expire at the core like any dead client's, and a re-granted
+      aggregator takes the keys over cleanly;
+    - **scoped revocation**: a victim-shard promotion revokes exactly
+      the bulk pools whose keys route to that shard — survivor pools
+      renew without revocation or epoch bounce (failover is
+      O(affected aggregator pools), not O(clients)) — and the burns
+      clients fold onto the revoked pools land in the core's
+      ``lease.over_admission``, equal tier-to-tier;
+    - **bit-identical reconciliation**: replaying the core manager's
+      reserve/credit stream into ``semantics/oracle.py`` reproduces the
+      device counters bit-for-bit for every key.
+
+    Deterministic: controlled decision clock, simulated orchestrator
+    clock, in-process transports.  Raises AssertionError on any
+    violated claim; returns a report dict.
+    """
+    import numpy as np
+
+    from ratelimiter_tpu.core.config import RateLimitConfig
+    from ratelimiter_tpu.edge import EdgeAggregator
+    from ratelimiter_tpu.engine.state import LimiterTable
+    from ratelimiter_tpu.leases import DirectTransport, LeaseClient, LeaseManager
+    from ratelimiter_tpu.parallel import ShardedDeviceEngine, make_mesh
+    from ratelimiter_tpu.parallel.sharded import shard_of_key
+    from ratelimiter_tpu.replication import (
+        FailoverOrchestrator,
+        OrchestratorConfig,
+        ShardedReplicationLog,
+        ShardedReplicator,
+        ShardFailoverRouter,
+        ShardStandbySet,
+    )
+    from ratelimiter_tpu.semantics.oracle import TokenBucketOracle
+    from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+
+    clock = {"t": 1_753_000_000_000}
+    engine = ShardedDeviceEngine(
+        slots_per_shard=slots_per_shard, table=LimiterTable(),
+        mesh=make_mesh(n_devices=n_shards))
+    primary = TpuBatchedStorage(engine=engine, clock_ms=lambda: clock["t"])
+    router = ShardFailoverRouter(primary)
+    cfg_tb = RateLimitConfig(max_permits=1 << 14, window_ms=60_000,
+                             refill_rate=1000.0)
+    lid = primary.register_limiter("tb", cfg_tb)
+
+    def standby_factory():
+        return TpuBatchedStorage(num_slots=slots_per_shard,
+                                 clock_ms=lambda: clock["t"])
+
+    mesh_set = ShardStandbySet(n_shards, standby_factory, registry=registry)
+    repl = ShardedReplicator(ShardedReplicationLog(primary),
+                             mesh_set.in_process_sinks(), registry=registry)
+    sim = {"s": 0.0}
+    dead = {"flag": False}
+    victim_box = [None]
+    cfg = OrchestratorConfig(probe_interval_ms=probe_interval_ms,
+                             suspect_threshold=suspect_threshold,
+                             hysteresis_ms=hysteresis_ms,
+                             promote_backoff_ms=1.0)
+
+    def probe(q):
+        return not (dead["flag"] and q == victim_box[0])
+
+    orch = FailoverOrchestrator(
+        router, mesh_set, repl, standby_factory=standby_factory,
+        config=cfg, probe=probe, registry=registry,
+        clock=lambda: sim["s"], sleep=lambda s: None)
+
+    def tick(n=1):
+        for _ in range(n):
+            sim["s"] += cfg.probe_interval_ms / 1000.0
+            orch.tick()
+
+    mgr = LeaseManager(router, default_budget=slice_budget,
+                       max_budget=slice_budget, max_bulk_budget=bulk_budget,
+                       ttl_ms=5_000.0, registry=registry, record_ops=True,
+                       clock_ms=lambda: clock["t"])
+
+    def make_aggregator():
+        return EdgeAggregator(DirectTransport(mgr),
+                              bulk_budget=bulk_budget,
+                              slice_budget=slice_budget,
+                              flush_ms=20.0, registry=registry,
+                              clock_ms=lambda: clock["t"])
+
+    agg = make_aggregator()
+    clients = [LeaseClient(agg.session(), lid, budget=slice_budget,
+                           clock_ms=lambda: clock["t"],
+                           direct_fallback=False, telemetry=False)
+               for _ in range(n_clients)]
+    keys = [f"edge-{i}" for i in range(n_keys)]
+    shard_of = {k: int(shard_of_key((lid, k), n_shards)) for k in keys}
+    # Zipf-skewed draws: the hot keys every client hammers are exactly
+    # where bulk leases multiply the collapse.
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, n_keys + 1) ** 1.1
+    draws = rng.choice(n_keys, size=burns + 200, p=p / p.sum())
+    report = {"decisions": 0}
+
+    try:
+        # -- Phase A: healthy Zipf burn through one aggregator ------------
+        for i in range(burns):
+            clock["t"] += 1
+            assert clients[i % n_clients].try_acquire(keys[draws[i]]), (
+                "healthy edge burn denied")
+            report["decisions"] += 1
+            if i % 100 == 0:
+                repl.ship_now()
+                tick()
+        agg.flush()  # settle burn reports before the kill window
+        assert agg.upstream_frames * 5 <= report["decisions"], (
+            f"{agg.upstream_frames} upstream frames for "
+            f"{report['decisions']} decisions — the aggregator collapse "
+            "failed in-process")
+        report["wire_frames_healthy"] = agg.upstream_frames
+
+        # -- Phase B: kill mid-Zipf — burns bounded by bulk budgets -------
+        repl.ship_now()
+        exposure = agg.drop()
+        assert exposure["pools"] > 0 and exposure["subleases"] > 0, (
+            "the kill caught no live subleases; raise burns")
+        burned_after_death = 0
+        for lc in clients:
+            for k in list(lc._leases):
+                lease = lc._leases[k]
+                while lease.remaining > 0:
+                    clock["t"] += 1
+                    assert lc.try_acquire(k), "sliced burn denied"
+                    burned_after_death += 1
+        assert burned_after_death <= exposure["sliced_out"] \
+            <= exposure["bulk_budget"] <= bulk_budget * n_keys, (
+            f"burns after death ({burned_after_death}) escaped the "
+            f"dropped bulk budgets ({exposure})")
+        report["burned_after_death"] = burned_after_death
+        report["exposure"] = exposure
+
+        # -- Phase C: TTL reclaim + re-granted aggregator -----------------
+        expired_before = mgr.expired_total
+        clock["t"] += int(mgr.ttl_ms) + 1  # past the bulk-lease TTL
+        agg2 = make_aggregator()
+        for lc in clients:
+            # The fleet re-points at the replacement aggregator; stale
+            # client-side leases renew into it, fold conservatively, and
+            # re-grant from fresh bulk pools.
+            lc._t = agg2.session()
+        for i in range(200):
+            clock["t"] += 1
+            assert clients[i % n_clients].try_acquire(
+                keys[draws[burns + i]]), "post-reclaim burn denied"
+            report["decisions"] += 1
+        assert mgr.expired_total > expired_before, (
+            "the dead aggregator's bulk leases never expired")
+        assert agg2._pools, "replacement aggregator took no pools"
+
+        # -- Phase D: scoped promotion revokes only victim pools ----------
+        agg2.flush()  # settle pending reports; pools now current
+        pool_epochs = {key: p_.epoch
+                       for (_l, key), p_ in agg2._pools.items()}
+        counts = [0] * n_shards
+        for key in pool_epochs:
+            counts[shard_of[key]] += 1
+        victim = victim_box[0] = int(np.argmax(counts))
+        victim_pools = [k for k in pool_epochs if shard_of[k] == victim]
+        survivor_pools = [k for k in pool_epochs if shard_of[k] != victim]
+        assert victim_pools and survivor_pools, (
+            "degenerate pool split; raise n_keys")
+        victim_budget = sum(p_.budget for (_l, key), p_ in
+                            agg2._pools.items() if key in victim_pools)
+        repl.ship_now()
+        epoch_before = orch.fence_epoch
+        dead["flag"] = True
+        ticks = 0
+        while orch.fence_epoch == epoch_before and ticks < 64:
+            tick()
+            ticks += 1
+        assert orch.fence_epoch > epoch_before, "never fenced"
+        settle = 0
+        while (orch.status()["shards"][victim]["state"] != "MONITORING"
+               and settle < 32):
+            tick()
+            settle += 1
+        assert orch.promotions == 1
+        dead["flag"] = False
+        rev_before = agg2.scoped_revocations_total
+        over_core_before = mgr.over_admission_total
+        over_agg_before = agg2.over_admission_total
+        agg2.flush()
+        assert agg2.scoped_revocations_total - rev_before \
+            == len(victim_pools), (
+            f"scoped fence revoked {agg2.scoped_revocations_total - rev_before} "
+            f"pools; expected exactly the {len(victim_pools)} victim pools")
+        for (_l, key), p_ in agg2._pools.items():
+            assert shard_of[key] != victim, (
+                f"victim-shard pool {key!r} survived the fence")
+            assert p_.epoch == pool_epochs[key], (
+                f"survivor pool {key!r} epoch bounced: "
+                f"{pool_epochs[key]} -> {p_.epoch}")
+        # Clients still hold slices cut from the revoked pools: burning
+        # them is the bounded over-admission window, and the fold-and-
+        # flush lands those burns in the core's lease.over_admission.
+        post_burns = 0
+        for lc in clients:
+            for k in list(lc._leases):
+                if shard_of[k] != victim:
+                    continue
+                lease = lc._leases[k]
+                while lease.remaining > 0:
+                    clock["t"] += 1
+                    assert lc.try_acquire(k), "revoked-slice burn denied"
+                    post_burns += 1
+                clock["t"] += 1
+                # Renew folds the burns onto the dead pool, the client
+                # re-grants from a fresh pool at the NEW epoch.
+                assert lc.try_acquire(k), "post-promotion re-grant failed"
+                post_burns += 1
+        agg2.flush()  # dead pools' final burn reports land upstream
+        report["decisions"] += post_burns
+        assert agg2.over_admission_total - over_agg_before <= victim_budget, (
+            "aggregator-tier over-admission escaped the revoked budgets")
+        assert mgr.over_admission_total - over_core_before \
+            == agg2.over_admission_total - over_agg_before, (
+            f"core over_admission delta "
+            f"{mgr.over_admission_total - over_core_before} != aggregator "
+            f"fold delta {agg2.over_admission_total - over_agg_before}")
+        for (_l, key), p_ in agg2._pools.items():
+            if key in victim_pools:
+                assert p_.epoch == orch.fence_epoch, (
+                    f"re-granted pool {key!r} does not carry the bumped "
+                    f"fence epoch")
+        report["scoped_revocations"] = agg2.scoped_revocations_total
+        report["over_admission"] = mgr.over_admission_total
+        report["burned_after_fence"] = post_burns
+
+        # -- Phase E: drain + bit-identical reconciliation ----------------
+        for lc in clients:
+            lc.release_all()
+        agg2.release_all()
+        router.flush()
+        oracle = TokenBucketOracle(cfg_tb)
+        for op in mgr.ops:
+            if op[0] == "reserve":
+                _, _algo, _lid, key, req, granted, ws, stamp = op
+                g, w = oracle.reserve(key, req, stamp)
+                assert (g, w) == (granted, ws), (
+                    f"replayed reserve diverged for {key!r}: oracle "
+                    f"({g}, {w}) vs device ({granted}, {ws})")
+            else:
+                _, _algo, _lid, key, unused, ws, stamp = op
+                oracle.credit(key, unused, ws, stamp)
+        now = clock["t"]
+        for k in keys:
+            got = int(router.available_many("tb", lid, [k])[0])
+            want = oracle.get_available_permits(k, now)
+            assert got == want, (
+                f"availability diverged for {k!r}: device {got} vs "
+                f"oracle {want}")
+        report["status"] = mgr.status()
+        report["edge_status"] = agg2.status()
         report["promotions"] = orch.promotions
         report["fence_epoch"] = orch.fence_epoch
         return report

@@ -706,6 +706,15 @@ class TpuBatchedStorage(RateLimitStorage):
         self._fence_all = False
         self._fenced_shards: frozenset = frozenset()
         self.fence_rejected = 0
+        # Scoped fence epochs (ARCHITECTURE §14b): token-lease revocation
+        # is keyed off lease_scope_epoch(lid, key), not the global fence
+        # epoch, so a single-shard promotion revokes only the leases whose
+        # keys route to the promoted shard.  _shard_fence_epochs is a
+        # per-shard ratchet (never cleared by lift_fence — revoking a
+        # lease is always safe; resurrecting one never is);
+        # _full_fence_epoch moves only on whole-storage fences.
+        self._shard_fence_epochs: Dict[int, int] = {}
+        self._full_fence_epoch = 0
         # Distributed fence lease (cross-host failover, ARCHITECTURE
         # §10c): the orchestrator grants this storage the right to serve
         # at a fence epoch for a bounded TTL and renews it while probes
@@ -3625,12 +3634,15 @@ class TpuBatchedStorage(RateLimitStorage):
         self._fence_epoch = epoch
         if shards is None:
             self._fence_all = True
+            self._full_fence_epoch = epoch
             # An explicit fence supersedes the serving lease: the lease
             # expiry check is moot once every decision is refused.
             self._lease_deadline_ms = 0
         else:
             self._fenced_shards = self._fenced_shards | frozenset(
                 int(q) for q in shards)
+            for q in shards:
+                self._shard_fence_epochs[int(q)] = epoch
         if self._recorder is not None:
             self._recorder.record(
                 "fence.installed", epoch=epoch,
@@ -3668,7 +3680,27 @@ class TpuBatchedStorage(RateLimitStorage):
         return {"epoch": max(self._fence_epoch, self._lease_epoch),
                 "all": self._fence_all,
                 "shards": sorted(self._fenced_shards),
+                "shard_epochs": dict(self._shard_fence_epochs),
                 "rejected": self.fence_rejected}
+
+    def lease_scope_epoch(self, lid: int, key) -> int:
+        """The revocation epoch a token lease on ``(lid, key)`` must be
+        checked against (leases/manager.py).  For an unsharded engine
+        this is the global ``fence_info()`` epoch — identical semantics
+        to before scoping existed.  For a sharded engine, a scoped fence
+        (single-shard promotion) only advances the epoch of keys that
+        ROUTE to the fenced shard, so survivors renew without a bounce
+        and failover cost is O(affected aggregators), not O(clients)."""
+        n_sh = getattr(self.engine, "n_shards", None)
+        if n_sh is None:
+            return max(self._fence_epoch, self._lease_epoch)
+        base = max(self._full_fence_epoch, self._lease_epoch)
+        if not self._shard_fence_epochs:
+            return base
+        from ratelimiter_tpu.parallel.sharded import shard_of_key
+
+        q = shard_of_key((int(lid), key), int(n_sh))
+        return max(base, self._shard_fence_epochs.get(int(q), 0))
 
     # ------------------------------------------------------------------------
     # Serving lease: the distributed fence (replication/control.py)
@@ -3720,6 +3752,8 @@ class TpuBatchedStorage(RateLimitStorage):
         (the storage/degraded.py bound)."""
         self._fence_all = True
         self._fence_epoch = max(self._fence_epoch, self._lease_epoch)
+        self._full_fence_epoch = max(self._full_fence_epoch,
+                                     self._fence_epoch)
         self._lease_deadline_ms = 0
         self.lease_self_fenced = True
         if self._recorder is not None:

@@ -45,6 +45,7 @@ def test_all_registered_meters_are_documented():
         "ratelimiter.sidecar.enabled": "true",
         "ratelimiter.sidecar.port": "0",
         "ratelimiter.lease.enabled": "true",
+        "ratelimiter.edge.enabled": "true",
         "ratelimiter.control.enabled": "true",
         "ratelimiter.control.interval_ms": "60000",
         "ratelimiter.fleet.enabled": "true",

@@ -163,7 +163,7 @@ def test_lease_ops_without_manager_answer_disabled(sidecar):
     lid = server.register("tb", RateLimitConfig(
         max_permits=100, window_ms=60_000, refill_rate=50.0))
     client = SidecarClient("127.0.0.1", server.port)
-    client._send(client._frame(sc.OP_LEASE, lid, 8, "k"))
+    client._send(client._frame(sc.OP_LEASE, lid, 8, "k", ext=0))
     status, _, errno = client._read_raw()
     assert (status, errno) == (sc.ST_ERROR, sc.ERR_LEASE_DISABLED)
     client.close()
@@ -335,7 +335,7 @@ def test_v5_negotiation_and_v4_batch_rejected(sidecar):
     lid = server.register("tb", RateLimitConfig(
         max_permits=100, window_ms=60_000, refill_rate=50.0))
     cli = SidecarClient("127.0.0.1", server.port)
-    assert cli.server_version == 5
+    assert cli.server_version == sc.PROTOCOL_VERSION
     pinned = SidecarClient("127.0.0.1", server.port, protocol=4)
     assert pinned.server_version == 4
     pinned._send(pinned._frame(sc.OP_BATCH, lid, 2, "xx"))

@@ -185,6 +185,30 @@ else
   fail=1
 fi
 
+echo "running fast aggregator failover drill (bulk leases, scoped revocation, nested bound)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_edge.py::test_aggregator_failover_drill_fast \
+    -q -p no:cacheprovider; then
+  echo "  ok  aggregator failover drill"
+else
+  echo "  FAILED  aggregator failover drill (burns after an aggregator death"
+  echo "          escaped the dropped bulk budgets, a shard promotion revoked"
+  echo "          a survivor-shard pool, the aggregator/core over-admission"
+  echo "          folds diverged, or the replay diverged from the oracle)"
+  fail=1
+fi
+
+echo "running aggregator loopback gate (>= 5x frame collapse vs direct leases on shared keys)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/lease_loopback.py \
+    --assert-ratio --aggregator > /dev/null; then
+  echo "  ok  aggregator frame collapse (zero admission mismatches both arms)"
+else
+  echo "  FAILED  aggregator loopback (fewer than 5x frames saved per decision"
+  echo "          vs the direct-lease arm on the same shared hot keys, or an"
+  echo "          admission mismatch in either arm)"
+  fail=1
+fi
+
 echo "running tenant-storm gate (adaptive limits hold goodput where static collapse)..."
 if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/tenant_storm.py \
     --assert-adaptive > /dev/null; then
@@ -340,6 +364,7 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
       tests/test_overload.py::test_overload_soak_slow \
       tests/test_breaker.py::test_outage_soak_slow \
       tests/test_sidecar_chaos.py::test_ingress_soak_slow \
+      tests/test_edge.py::test_edgeproc_subprocess_ready_and_eof_shutdown \
       -q -m slow -p no:cacheprovider; then
     echo "  ok  slow soaks"
   else
