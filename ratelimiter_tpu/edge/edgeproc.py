@@ -16,15 +16,20 @@ device stays the only arbiter for traffic the aggregator holds no
 budget for.
 
 Like ``replication/hostproc.py``, the process prints ONE JSON line on
-stdout when ready (front port, upstream address, lids) and exits when
-stdin closes — the launcher (a drill, an init system wrapper) owns its
-lifetime through the pipe.
+stdout when ready (front port, upstream address, lids) and exits
+cleanly on stdin EOF **or SIGTERM** — the launcher (a drill, an init
+system wrapper) owns its lifetime through the pipe, and a TERM gets
+the same graceful teardown (final portfolio flush, bulk releases, exit
+0), so a chaos conductor can tell a crash-kill (signal death, budget
+abandoned upstream) from a graceful stop (exit 0, accounting settled).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 import threading
 import time
@@ -117,14 +122,44 @@ def build_edge(upstream_host: str, upstream_port: int, lids,
     return server, agg, upstream
 
 
+# Graceful-shutdown latch (mirrors replication/hostproc.py): stdin EOF
+# or SIGTERM, one teardown path, exit 0 either way.
+_SHUTDOWN = threading.Event()
+
+
+def _install_sigterm() -> None:
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: _SHUTDOWN.set())
+    except ValueError:  # not the main thread (in-process harnesses)
+        pass
+
+
 def _wait_for_eof() -> None:
     """Block until the launcher closes our stdin (its handle on our
-    lifetime); also returns if stdin was never a pipe."""
+    lifetime); also returns if stdin was never a pipe.  Raw-fd read:
+    a buffered ``sys.stdin`` read holds the reader's lock, and a
+    SIGTERM exit racing a daemon thread parked in it is a fatal
+    ``_enter_buffered_busy`` abort at interpreter shutdown."""
     try:
-        while sys.stdin.buffer.read(4096):
+        fd = sys.stdin.fileno()
+        while os.read(fd, 4096):
             pass
     except (OSError, ValueError):
         time.sleep(3600.0)
+
+
+def _wait_for_shutdown() -> None:
+    """Block until stdin EOF or SIGTERM, whichever first (the EOF
+    watch rides a daemon thread so TERM can interrupt a blocked pipe
+    read)."""
+
+    def eof_watch() -> None:
+        _wait_for_eof()
+        _SHUTDOWN.set()
+
+    threading.Thread(target=eof_watch, name="eof-watch",
+                     daemon=True).start()
+    _SHUTDOWN.wait()
 
 
 def main(argv=None) -> int:
@@ -140,6 +175,7 @@ def main(argv=None) -> int:
     parser.add_argument("--slice-budget", type=int, default=64)
     parser.add_argument("--flush-ms", type=float, default=50.0)
     args = parser.parse_args(argv)
+    _install_sigterm()
 
     lids = [int(x) for x in args.lids.split(",") if x.strip()]
     server, agg, upstream = build_edge(
@@ -152,7 +188,7 @@ def main(argv=None) -> int:
         "upstream": f"{args.upstream_host}:{args.upstream_port}",
         "lids": lids, "version": upstream.server_version,
     }), flush=True)
-    _wait_for_eof()
+    _wait_for_shutdown()
     # Graceful: final portfolio flush + bulk releases BEFORE the front
     # door closes, so the core's accounting is settled.
     agg.release_all()

@@ -98,7 +98,14 @@ class LeaseManager:
         self.max_bulk_budget = max(int(max_bulk_budget), 0)
         self.ttl_ms = float(ttl_ms)
         self.deny_ttl_ms = max(float(deny_ttl_ms), 1.0)
-        self.table = LeaseTable(max_leases=max_leases)
+        # TTL accounting rides the table's forward-clamped expiry clock:
+        # one observed wall step advances expiry time by at most a few
+        # TTLs, so an injected forward clock jump (chaos ``clock_jump``,
+        # a bad NTP slew) degrades into a handful of clamped ticks
+        # instead of mass-expiring every live lease at once.
+        self.table = LeaseTable(
+            max_leases=max_leases,
+            max_forward_jump_ms=max(10_000, 4 * int(self.ttl_ms)))
         self._clock_ms = (clock_ms
                           or getattr(storage, "_clock_ms", None)
                           or _wall_ms)
@@ -319,7 +326,7 @@ class LeaseManager:
         aggregate and clamps against ``max_bulk_budget``."""
         with self._lock:
             algo, cfg = self._algo_cfg(lid)
-            now = int(self._clock_ms())
+            now = self.table.clamp_forward(int(self._clock_ms()))
             self._maybe_sweep(now)
             self._trace(trace_id, "lease.grant", key=key,
                         requested=int(requested))
@@ -418,7 +425,7 @@ class LeaseManager:
         renewal credits less, never more)."""
         with self._lock:
             algo, cfg = self._algo_cfg(lid)
-            now = int(self._clock_ms())
+            now = self.table.clamp_forward(int(self._clock_ms()))
             used = max(int(used), 0)
             self._bump(self._m_local, "local_decisions_total", used)
             # The client leg of the lineage: burns since the last wire

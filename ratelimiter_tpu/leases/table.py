@@ -52,10 +52,62 @@ class Lease:
 class LeaseTable:
     """Thread-safe bounded registry of outstanding leases."""
 
-    def __init__(self, max_leases: int = 65536):
+    def __init__(self, max_leases: int = 65536,
+                 max_forward_jump_ms: int = 0,
+                 forward_step_ms: int = 0):
         self._lock = threading.Lock()
         self._leases: Dict[Tuple[str, int, str], Lease] = {}
         self.max_leases = int(max_leases)
+        # Forward clock-jump clamp (the TTL-side mirror of the storage
+        # stamp's ``backward_clamps``): a wall-clock step LARGER than
+        # ``max_forward_jump_ms`` is implausible (an injected jump, a
+        # bad NTP slew), so :meth:`clamp_forward` refuses to replay it
+        # into TTL accounting — the jump is ABSORBED into a standing
+        # offset (counted once in ``forward_clamps``) and the expiry
+        # clock resumes ``forward_step_ms`` past the last observation,
+        # then keeps tracking subsequent wall progress at 1x.  Live
+        # clients renewing at their normal cadence sail through
+        # (nothing mass-expires in the poisoned tick, no matter how
+        # many keys one sweep visits), while abandoned leases still
+        # expire after their ordinary remaining TTL of rebased time.
+        # Jumps at or under the threshold pass through untouched
+        # (normal TTL expiry is exactly a legit forward step).
+        # ``max_forward_jump_ms=0`` disables the clamp.
+        self.max_forward_jump_ms = int(max_forward_jump_ms)
+        self.forward_step_ms = int(forward_step_ms) or max(
+            1, self.max_forward_jump_ms // 8)
+        self.forward_clamps = 0
+        self._expiry_clock: Optional[int] = None
+        self._forward_offset = 0
+
+    def clamp_forward(self, now_ms: int) -> int:
+        """The table's view of ``now`` for TTL accounting: wall time
+        minus the absorbed-jump offset.  A step beyond
+        ``max_forward_jump_ms`` since the last observation grows the
+        offset so TTL time lands ``forward_step_ms`` past that
+        observation and continues at wall rate from there — every
+        caller in the same sweep sees the SAME rebased now, so a
+        poisoned jump can never expire more than a normal tick's worth
+        of leases.  Backward steps pass through untouched (an earlier
+        ``now`` only ever keeps a lease alive longer, which is the
+        safe direction; the storage stamp clamp owns backward
+        monotonicity)."""
+        now = int(now_ms)
+        if self.max_forward_jump_ms <= 0:
+            return now
+        with self._lock:
+            eff = now - self._forward_offset
+            if self._expiry_clock is None:
+                self._expiry_clock = eff
+                return eff
+            if eff - self._expiry_clock > self.max_forward_jump_ms:
+                target = self._expiry_clock + self.forward_step_ms
+                self._forward_offset += eff - target
+                eff = target
+                self.forward_clamps += 1
+            if eff > self._expiry_clock:
+                self._expiry_clock = eff
+            return eff
 
     @staticmethod
     def _k(algo: str, lid: int, key: str) -> Tuple[str, int, str]:

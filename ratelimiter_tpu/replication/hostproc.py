@@ -28,9 +28,13 @@ unpromoted standby refuses (re-seeding from a shadow would fork the
 authority chain).
 
 The process prints ONE JSON line on stdout when ready (ports, explicit
-``lid_base``, ``version``, and shard count included) and exits when
-stdin closes — the launcher (fleet/NodeManager, a drill, an init
-system wrapper) owns its lifetime through the pipe.
+``lid_base``, ``version``, and shard count included) and exits cleanly
+on stdin EOF **or SIGTERM** — the launcher (fleet/NodeManager, a drill,
+an init system wrapper) owns its lifetime through the pipe, and an init
+system's TERM gets the same graceful teardown (drain sidecars, release
+the serving lease, exit 0).  Exit code therefore distinguishes a
+graceful stop (0) from a crash-kill (signal death) — the chaos
+conductor's ``kill`` vs ``stop`` actions assert on exactly that.
 
 ``storage/chaos.py`` spawns these as real OS subprocesses with
 ``FaultInjectingProxy`` links between them.
@@ -40,6 +44,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 import threading
 import time
@@ -216,6 +222,22 @@ def _shard_extras(storage, box: dict, args,
     return {"ship": ship, "retarget": retarget}
 
 
+def _node_extras() -> Dict[str, Callable]:
+    """Process-global control ops (both roles): ``skew`` sets the
+    injected clock offset every default now-source in this process
+    reads (storage/tpu.py), so the chaos conductor can step one NODE's
+    clock mid-drill without touching the others."""
+    from ratelimiter_tpu.storage.tpu import clock_skew_ms, set_clock_skew_ms
+
+    def skew(skew_ms: Optional[int] = None) -> dict:
+        if skew_ms is None:
+            return {"skew_ms": clock_skew_ms()}
+        prev = set_clock_skew_ms(int(skew_ms))
+        return {"skew_ms": int(skew_ms), "prev_ms": prev}
+
+    return {"skew": skew}
+
+
 def run_primary(args) -> int:
     from ratelimiter_tpu.core.config import RateLimitConfig
     from ratelimiter_tpu.replication.control import (
@@ -276,13 +298,13 @@ def run_primary(args) -> int:
         boxes.append(box)
         lids_per_shard.append(lids)
 
-    control = ControlServer(mux_handlers(per_shard),
+    control = ControlServer(mux_handlers(per_shard, extra=_node_extras()),
                             host=args.host).start()
     print(json.dumps(_ready_line(
         "primary", control, args,
         sidecar_ports=[s.port for s in sidecars],
         lids=lids_per_shard)), flush=True)
-    _wait_for_eof()
+    _wait_for_shutdown()
     for keeper in keepers:
         keeper.stop()
     for box in boxes:
@@ -290,8 +312,14 @@ def run_primary(args) -> int:
             box["replicator"].close()
     control.stop()
     for sidecar in sidecars:
-        sidecar.stop()
+        sidecar.stop()  # drains in-flight frames (drain_timeout_ms)
     for storage in storages:
+        # Graceful hand-back: drop the serving lease BEFORE close so
+        # the orchestrator reads "stopped on purpose", not a TTL runout.
+        try:
+            storage.release_serving_lease()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
         storage.close()
     if standby_ctl is not None:
         standby_ctl.close()
@@ -349,12 +377,12 @@ def run_standby(args) -> int:
         boxes.append(box)
         promoted_sidecars.append(promoted_sidecar)
 
-    control = ControlServer(mux_handlers(per_shard),
+    control = ControlServer(mux_handlers(per_shard, extra=_node_extras()),
                             host=args.host).start()
     print(json.dumps(_ready_line(
         "standby", control, args,
         repl_ports=[s.port for s in repl_servers])), flush=True)
-    _wait_for_eof()
+    _wait_for_shutdown()
     for box in boxes:
         if box["replicator"] is not None:
             box["replicator"].close()
@@ -402,14 +430,50 @@ def _ready_line(role: str, control, args,
     return info
 
 
+# Graceful-shutdown latch: set by stdin EOF (the launcher dropped its
+# pipe) or SIGTERM (an init system / the chaos conductor's graceful
+# stop).  Either way the caller runs the SAME ordered teardown and
+# exits 0 — only an actual kill signal dies nonzero.
+_SHUTDOWN = threading.Event()
+
+
+def _install_sigterm() -> None:
+    """Route SIGTERM into the shutdown latch.  Best-effort: signal
+    handlers only install from the main thread (in-process tests that
+    drive ``run_primary`` from a worker thread just skip this)."""
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: _SHUTDOWN.set())
+    except ValueError:
+        pass
+
+
 def _wait_for_eof() -> None:
     """Block until the launcher closes our stdin (its handle on our
-    lifetime); also returns if stdin was never a pipe."""
+    lifetime); also returns if stdin was never a pipe.  Reads the raw
+    fd — a buffered ``sys.stdin`` read would hold the reader's lock
+    across the block, and interpreter finalization aborts (fatal
+    ``_enter_buffered_busy``) if a SIGTERM exit races a daemon thread
+    parked inside it."""
     try:
-        while sys.stdin.buffer.read(4096):
+        fd = sys.stdin.fileno()
+        while os.read(fd, 4096):
             pass
     except (OSError, ValueError):
         time.sleep(3600.0)
+
+
+def _wait_for_shutdown() -> None:
+    """Block until stdin EOF or SIGTERM, whichever first.  The EOF
+    watch runs on a daemon thread so a TERM can interrupt a blocked
+    pipe read (PEP 475 would otherwise retry it forever)."""
+
+    def eof_watch() -> None:
+        _wait_for_eof()
+        _SHUTDOWN.set()
+
+    threading.Thread(target=eof_watch, name="eof-watch",
+                     daemon=True).start()
+    _SHUTDOWN.wait()
 
 
 def main(argv=None) -> int:
@@ -457,6 +521,7 @@ def main(argv=None) -> int:
         enable_compile_cache(None)
     except Exception:  # noqa: BLE001 — cold compiles still work
         pass
+    _install_sigterm()
     if args.role == "primary":
         return run_primary(args)
     return run_standby(args)

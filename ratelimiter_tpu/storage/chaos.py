@@ -145,13 +145,16 @@ class FaultInjectingProxy:
       ``period_s`` (a flaky link that heals before any single probe
       window closes — what the orchestrator's hysteresis must damp).
 
-    The original fault modes are snapshotted per connection at accept
-    time, so a drill can flip modes between waves without racing live
-    pumps.  ``partition``/``flap`` are evaluated LIVE per chunk instead:
-    a long-lived connection (a replication link) must be cuttable and
-    healable mid-stream without reconnecting.  Server->client bytes
-    pass through untouched except under partition/flap — those attack
-    the LINK, not just the ingress.
+    ALL fault modes are evaluated LIVE, per chunk: a ``set_fault``/
+    ``heal`` takes effect on in-flight connections at their next chunk
+    boundary, not just on new accepts.  A long-lived connection (a
+    replication link, a pinned sidecar session) must be degradable and
+    healable mid-stream without reconnecting — the chaos conductor
+    flips faults on links whose connections outlive every schedule
+    step.  Per-connection byte counters (``after`` bookkeeping, the
+    one-shot garbage injection) still start at accept time.
+    Server->client bytes pass through untouched except under
+    partition/flap — those attack the LINK, not just the ingress.
     """
 
     def __init__(self, target_port: int, target_host: str = "127.0.0.1",
@@ -171,11 +174,7 @@ class FaultInjectingProxy:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 with outer._lock:
-                    mode, params = outer._fault
                     outer.connections += 1
-                    garbage = (bytes(outer._rng.randrange(256)
-                                     for _ in range(params.get("n", 64)))
-                               if mode == "garbage" else b"")
                 try:
                     up = socket.create_connection(outer.target, timeout=10.0)
                 except OSError:
@@ -185,7 +184,7 @@ class FaultInjectingProxy:
                     daemon=True)
                 down.start()
                 try:
-                    outer._pump_up(self.request, up, mode, params, garbage)
+                    outer._pump_up(self.request, up)
                 finally:
                     for s in (up, self.request):
                         try:
@@ -210,8 +209,9 @@ class FaultInjectingProxy:
 
     # -- control surface ------------------------------------------------------
     def set_fault(self, mode: str | None, **params) -> None:
-        """Set the fault class applied to NEW connections (and, for
-        ``partition``/``flap``, to LIVE ones).
+        """Set the fault class, applied LIVE: in-flight connections see
+        the new mode at their next chunk boundary, new connections from
+        their first byte.
 
         ``after``: client bytes forwarded before the fault engages
         (default 0); ``n``: garbage byte count; ``delay_ms``: per-byte
@@ -252,8 +252,8 @@ class FaultInjectingProxy:
     def _link_cut(self, direction: str = "both") -> bool:
         """Live verdict: are bytes currently being dropped in
         ``direction`` ("up" = client->server, "down" = server->client)?
-        (Only the partition/flap modes — the snapshotted ingress faults
-        keep their per-connection semantics.)"""
+        (Only the partition/flap modes cut the link wholesale; the
+        ingress faults shape bytes in :meth:`_pump_up` instead.)"""
         with self._lock:
             mode, params = self._fault
             if mode == "partition":
@@ -297,10 +297,13 @@ class FaultInjectingProxy:
             except OSError:
                 return
 
-    def _pump_up(self, client, up, mode, params, garbage: bytes) -> None:
-        """Client->server with the configured fault applied."""
-        after = int(params.get("after", 0))
-        delay_s = float(params.get("delay_ms", 20.0)) / 1000.0
+    def _pump_up(self, client, up) -> None:
+        """Client->server with the CURRENT fault applied — the mode is
+        re-read per chunk, so a mid-connection ``set_fault``/``heal``
+        takes effect without a reconnect.  The ``forwarded`` byte
+        counter and the garbage one-shot are per-connection state; the
+        one-shot re-arms whenever the mode leaves ``"garbage"``, so a
+        heal-then-reinject cycle corrupts the stream again."""
         forwarded = 0
         injected = False
         while True:
@@ -314,6 +317,15 @@ class FaultInjectingProxy:
                 with self._lock:
                     self.faults_injected += 1
                 continue  # partition/flap: dropped — silence, no close
+            with self._lock:
+                mode, params = self._fault
+                garbage = b""
+                if mode == "garbage" and not injected:
+                    garbage = bytes(self._rng.randrange(256)
+                                    for _ in range(params.get("n", 64)))
+            after = int(params.get("after", 0))
+            if mode != "garbage":
+                injected = False
             if mode == "kill" and forwarded + len(chunk) >= after:
                 cut = max(after - forwarded, 0)
                 try:
@@ -340,6 +352,7 @@ class FaultInjectingProxy:
                     self.faults_injected += 1
             try:
                 if mode == "delay":
+                    delay_s = float(params.get("delay_ms", 20.0)) / 1000.0
                     for i in range(len(chunk)):
                         up.sendall(chunk[i:i + 1])
                         time.sleep(delay_s)

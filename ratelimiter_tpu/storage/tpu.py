@@ -200,8 +200,34 @@ def _bucket_fine(n: int, floor: int = 4096) -> int:
     return -(-n // step) * step
 
 
+# Injectable per-process clock offset (chaos conductor, ARCHITECTURE
+# §17): every default now-source in this process reads wall time PLUS
+# this skew, so cross-cell clock skew and step jumps are testable
+# against a real clock instead of dodged with order-only policies.
+# Seeded from RATELIMITER_CLOCK_SKEW_MS so a spawned hostproc/edgeproc
+# can boot skewed; mutable at runtime via set_clock_skew_ms (a control
+# op or an in-process actor).  Storages built with an explicit
+# ``clock_ms=`` are unaffected — their clock is the caller's problem.
+_CLOCK_SKEW_MS: int = int(os.environ.get("RATELIMITER_CLOCK_SKEW_MS",
+                                         "0") or "0")
+
+
+def set_clock_skew_ms(skew_ms: int) -> int:
+    """Set this process's injected clock offset (ms, may be negative);
+    returns the previous value.  Takes effect on the next clock read —
+    a forward step is a "jump", a standing offset is "skew"."""
+    global _CLOCK_SKEW_MS
+    prev = _CLOCK_SKEW_MS
+    _CLOCK_SKEW_MS = int(skew_ms)
+    return prev
+
+
+def clock_skew_ms() -> int:
+    return _CLOCK_SKEW_MS
+
+
 def _wall_clock_ms() -> int:
-    return time.time_ns() // 1_000_000
+    return time.time_ns() // 1_000_000 + _CLOCK_SKEW_MS
 
 
 def _elect_digest_mode(link_profile, u: int, cn: int, n_delta: int,
@@ -3727,6 +3753,21 @@ class TpuBatchedStorage(RateLimitStorage):
                 f"epoch {self._lease_epoch}; grants are monotonic")
         self._lease_epoch = epoch
         self._lease_deadline_ms = int(self._clock_ms()) + int(ttl_ms)
+        return self.serving_lease_info()
+
+    def release_serving_lease(self) -> Dict:
+        """Voluntarily drop the serving lease (graceful stop — the
+        SIGTERM/drain path in ``replication/hostproc.py``).  NOT a
+        fence: the storage simply stops claiming the keyspace, so the
+        orchestrator reads a clean hand-back (``installed: False``)
+        instead of a TTL runout, and a later ``grant_serving_lease`` at
+        the same-or-newer epoch re-arms serving without an operator
+        ``lift_fence``.  Distinguishes "stopped on purpose" from the
+        self-fenced zombie the expiry path produces."""
+        self._lease_deadline_ms = 0
+        if self._recorder is not None:
+            self._recorder.record("lease.released",
+                                  epoch=self._lease_epoch)
         return self.serving_lease_info()
 
     def serving_lease_info(self) -> Dict:

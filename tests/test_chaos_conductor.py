@@ -1,0 +1,383 @@
+"""Chaos conductor (ARCHITECTURE §17): seeded schedules, the fleet
+harness + invariant monitor, failure minimization, and the satellites
+that ride along — the forward clock-jump clamp and the seeded-random
+BulkPool conservation property.
+
+Layout mirrors the package:
+
+- plan: determinism (same inputs → byte-identical schedule), JSON
+  roundtrip, generator hygiene (heals scheduled, defects gated);
+- clamp: LeaseTable.clamp_forward unit behavior plus the manager-level
+  claim that a poisoned forward jump does NOT mass-expire live leases
+  while normal TTL expiry still works;
+- sublease: conservation holds under a seeded-random interleaving of
+  every BulkPool verb (the property the invariant monitor checks
+  fleet-wide every step);
+- fleet: a fault-free plan and a faulted plan both run to completion
+  with ZERO violations; a known-bad schedule (deliberate defect)
+  produces a violation the minimizer shrinks to the planted action and
+  the artifact replays deterministically;
+- procs (slow): a real hostproc under ProcActor honors SIGTERM (drain,
+  exit 0) and survives SIGSTOP/SIGCONT.
+"""
+
+import json
+import random
+
+import pytest
+
+from ratelimiter_tpu.chaos.plan import (
+    DEFAULT_TOPOLOGY,
+    DEFECT_OPS,
+    FAULT_OPS,
+    FaultAction,
+    FaultPlan,
+)
+from ratelimiter_tpu.leases.manager import LeaseManager
+from ratelimiter_tpu.leases.sublease import BulkPool
+from ratelimiter_tpu.leases.table import LeaseTable
+from ratelimiter_tpu.storage.tpu import TpuBatchedStorage
+from ratelimiter_tpu.core.config import RateLimitConfig
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: determinism, serialization, generator hygiene
+# ---------------------------------------------------------------------------
+
+def test_plan_generation_is_deterministic():
+    a = FaultPlan.generate(42, steps=32, fault_rate=0.6)
+    b = FaultPlan.generate(42, steps=32, fault_rate=0.6)
+    assert a.dumps() == b.dumps()
+    c = FaultPlan.generate(43, steps=32, fault_rate=0.6)
+    assert a.dumps() != c.dumps()
+
+
+def test_plan_json_roundtrip():
+    plan = FaultPlan.generate(7, steps=24, include_defects=True)
+    back = FaultPlan.from_json(json.loads(json.dumps(plan.to_json())))
+    assert back.dumps() == plan.dumps()
+    assert back.topology == plan.topology
+    assert all(isinstance(a, FaultAction) for a in back.actions)
+
+
+def test_generator_emits_only_known_ops_and_gates_defects():
+    clean = FaultPlan.generate(3, steps=64, fault_rate=0.9)
+    assert clean.actions, "a 0.9 fault rate over 64 steps emits faults"
+    assert all(a.op in FAULT_OPS for a in clean.actions)
+    dirty = FaultPlan.generate(3, steps=64, fault_rate=0.9,
+                               include_defects=True)
+    planted = [a for a in dirty.actions if a.op in DEFECT_OPS]
+    assert len(planted) == 1
+
+
+def test_generator_schedules_heals_and_resumes():
+    plan = FaultPlan.generate(11, steps=64, fault_rate=0.9)
+    ops = [a.op for a in plan.actions]
+    if any(o.startswith("edge_") and o != "edge_heal" for o in ops):
+        assert "edge_heal" in ops
+    pauses = [a for a in plan.actions if a.op == "pause_shard"]
+    resumes = {(a.params["cell"], a.params["shard"]): a.step
+               for a in plan.actions if a.op == "resume_shard"}
+    for p in pauses:
+        key = (p.params["cell"], p.params["shard"])
+        assert key in resumes and resumes[key] > p.step, (
+            "every pause must schedule its resume (the zombie probe "
+            "runs at resume time)")
+
+
+def test_with_actions_preserves_the_traffic_frame():
+    plan = FaultPlan.generate(5, steps=16)
+    cut = plan.with_actions(plan.actions[:1])
+    assert (cut.seed, cut.steps, cut.fault_rate) == (
+        plan.seed, plan.steps, plan.fault_rate)
+    assert cut.topology == plan.topology
+    assert len(cut.actions) == min(1, len(plan.actions))
+
+
+def test_lazy_package_exports_resolve_to_callables():
+    # `minimize` and `replay` collide with submodule names: importing
+    # the submodule sets the MODULE as a package attribute, which must
+    # not shadow the exported callable on a from-import.
+    from ratelimiter_tpu.chaos import (
+        dump_artifact, load_artifact, minimize, replay, run_plan)
+    for fn in (dump_artifact, load_artifact, minimize, replay, run_plan):
+        assert callable(fn), fn
+
+
+# ---------------------------------------------------------------------------
+# Forward clock-jump clamp (leases/table.py) — satellite
+# ---------------------------------------------------------------------------
+
+def test_clamp_forward_passes_normal_steps():
+    t = LeaseTable(max_forward_jump_ms=10_000)
+    assert t.clamp_forward(1_000) == 1_000
+    assert t.clamp_forward(5_000) == 5_000       # within threshold
+    assert t.clamp_forward(15_000) == 15_000     # exactly 10_000 ahead
+    assert t.forward_clamps == 0
+
+
+def test_clamp_forward_absorbs_a_jump():
+    t = LeaseTable(max_forward_jump_ms=10_000, forward_step_ms=1_000)
+    assert t.clamp_forward(0) == 0
+    got = t.clamp_forward(1_000_000)             # a poisoned jump
+    assert got == 1_000                          # one bounded step
+    assert t.forward_clamps == 1
+    # The jump is absorbed as an offset: the same wall reading maps to
+    # the SAME rebased now (a sweep over many keys can't creep the
+    # expiry clock), and wall progress resumes at 1x from there.
+    assert t.clamp_forward(1_000_000) == 1_000
+    assert t.clamp_forward(1_000_500) == 1_500
+    assert t.forward_clamps == 1
+
+
+def test_clamp_forward_backward_and_disabled():
+    t = LeaseTable(max_forward_jump_ms=10_000)
+    t.clamp_forward(50_000)
+    assert t.clamp_forward(40_000) == 40_000     # backward: untouched
+    off = LeaseTable()                           # clamp disabled
+    off.clamp_forward(0)
+    assert off.clamp_forward(10 ** 12) == 10 ** 12
+    assert off.forward_clamps == 0
+
+
+def test_forward_jump_does_not_mass_expire_leases():
+    """The manager-level claim: a huge injected forward jump degrades
+    into clamped ticks — live clients keep renewing through it instead
+    of every lease expiring in one poisoned sweep."""
+    clock = {"t": 1_000_000}
+    storage = TpuBatchedStorage(num_slots=256,
+                                clock_ms=lambda: clock["t"])
+    cfg = RateLimitConfig(max_permits=1 << 12, window_ms=60_000,
+                          refill_rate=500.0)
+    lid = storage.register_limiter("tb", cfg)
+    mgr = LeaseManager(storage, default_budget=8, ttl_ms=2_000.0,
+                       clock_ms=lambda: clock["t"])
+    try:
+        keys = [f"k{i}" for i in range(8)]
+        for k in keys:
+            assert mgr.grant(lid, k).granted > 0
+        clock["t"] += 10 ** 9                    # the poisoned jump
+        for k in keys:
+            resp = mgr.renew(lid, k, used=1)
+            assert resp is not None and resp.granted > 0, (
+                f"lease {k} mass-expired through a clamped forward "
+                f"jump")
+        assert mgr.table.forward_clamps >= 1
+        assert mgr.expired_total == 0
+    finally:
+        storage.close()
+
+
+def test_normal_ttl_expiry_still_works_with_the_clamp():
+    clock = {"t": 1_000_000}
+    storage = TpuBatchedStorage(num_slots=256,
+                                clock_ms=lambda: clock["t"])
+    cfg = RateLimitConfig(max_permits=1 << 12, window_ms=60_000,
+                          refill_rate=500.0)
+    lid = storage.register_limiter("tb", cfg)
+    mgr = LeaseManager(storage, default_budget=8, ttl_ms=2_000.0,
+                       clock_ms=lambda: clock["t"])
+    try:
+        assert mgr.grant(lid, "k").granted > 0
+        clock["t"] += 2_001                      # one ordinary TTL lapse
+        assert mgr.renew(lid, "k", used=0) is None
+        assert mgr.expired_total == 1
+        assert mgr.table.forward_clamps == 0, (
+            "an ordinary TTL-sized step must pass the clamp untouched")
+    finally:
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# BulkPool conservation under seeded-random interleaving — satellite
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_bulk_pool_conserves_under_random_interleaving(seed):
+    """Every interleaving of slice/fold/return/top_up/renewal/lost/
+    over-report conserves ``remaining + sliced_out + used_pending ==
+    budget + deficit`` — checked after EVERY verb, exactly what the
+    fleet monitor asserts per step."""
+    rng = random.Random(seed)
+    pool = BulkPool(lid=1, key="k", budget=256, remaining=256,
+                    epoch=0, deadline_ms=10_000)
+    sessions = list(range(6))
+    for step in range(400):
+        sid = rng.choice(sessions)
+        sub = pool.subs.get(sid)
+        op = rng.randrange(7)
+        if op == 0:
+            pool.slice(sid, rng.randint(1, 64))
+        elif op == 1 and sub is not None:
+            pool.fold_used(sub, rng.randint(0, sub.amount + 8))
+        elif op == 2 and sub is not None:
+            pool.return_unused(sub)
+        elif op == 3 and sub is not None:
+            pool.top_up(sub, rng.randint(1, 64))
+        elif op == 4 and sub is not None:
+            pool.fold_lost(pool.drop_sub(sid))
+        elif op == 5:
+            pool.fold_over_report(rng.randint(0, 16))
+        elif op == 6:
+            # Renewal, sometimes shrinking below what's sliced out —
+            # the deficit path.
+            pool.apply_renewal(rng.randint(32, 256), 5_000, 0, 0,
+                               rng.randint(0, pool.used_pending))
+        pool.check_conservation()
+        assert pool.remaining >= 0 and pool.sliced_out >= 0
+        assert pool.used_pending >= 0 and pool.deficit >= 0
+
+
+# ---------------------------------------------------------------------------
+# FleetHarness: clean runs, known-bad fixtures, minimize + replay
+# ---------------------------------------------------------------------------
+
+def _small_topology(**over):
+    topo = {"n_direct_keys": 12, "n_lease_keys": 4, "n_edge_keys": 3}
+    topo.update(over)
+    return topo
+
+
+def test_fault_free_plan_runs_clean():
+    from ratelimiter_tpu.chaos.harness import run_plan
+
+    plan = FaultPlan.generate(0, steps=6, fault_rate=0.0,
+                              topology=_small_topology())
+    report = run_plan(plan)
+    assert report["violation"] is None
+    assert report["steps_completed"] == 6
+    assert report["decisions"] > 0
+    assert report["invariant_checks"] == 6
+
+
+def test_faulted_plan_runs_clean():
+    """The acceptance shape: a seeded multi-fault schedule (kills,
+    pauses, clock jumps, edge faults, storage faults) completes with
+    zero violations AND the final reserve/credit replay reconciles
+    against the oracle bit-for-bit."""
+    from ratelimiter_tpu.chaos.harness import run_plan
+
+    plan = FaultPlan.generate(0, steps=14, fault_rate=0.5,
+                              topology=_small_topology())
+    assert plan.actions, "seed 0 must exercise real faults"
+    report = run_plan(plan)
+    assert report["violation"] is None
+    assert report["steps_completed"] == 14
+
+
+def test_planted_epoch_rollback_is_caught_minimized_and_replayed(tmp_path):
+    """The known-bad fixture end to end: a deliberately corrupted
+    schedule fails, the minimizer strips it to the planted defect, the
+    artifact round-trips, and the replay reproduces the SAME invariant
+    deterministically."""
+    from ratelimiter_tpu.chaos.harness import run_plan
+    from ratelimiter_tpu.chaos.minimize import minimize
+    from ratelimiter_tpu.chaos.replay import (
+        dump_artifact,
+        load_artifact,
+        replay,
+    )
+
+    base = FaultPlan.generate(5, steps=10, fault_rate=0.4,
+                              topology=_small_topology())
+    bad = base.with_actions(
+        list(base.actions)
+        + [FaultAction(5, "epoch_rollback", {"cell": 1})])
+
+    res = minimize(bad, max_runs=16)
+    assert res["reproduced"]
+    v = res["violation"]
+    assert v["invariant"] == "epoch-monotonicity"
+    kept = res["plan"].actions
+    assert [a.op for a in kept] == ["epoch_rollback"], (
+        f"minimizer kept noise: {[a.to_dict() for a in kept]}")
+
+    path = str(tmp_path / "artifact.json")
+    dump_artifact(path, res["plan"], v, minimized=True,
+                  original_actions=len(bad.actions))
+    art = load_artifact(path)
+    assert art["minimized"] and art["original_actions"] == len(bad.actions)
+
+    rep = replay(art)
+    assert rep["reproduced"], (
+        f"replay diverged: expected [{v['invariant']}], "
+        f"got {rep.get('violation')}")
+    assert rep["violation"]["step"] == v["step"]
+    # Determinism: replaying twice is byte-for-byte the same verdict.
+    rep2 = replay(art)
+    assert rep2["violation"] == rep["violation"]
+
+    # And the clean base still passes (the defect WAS the failure).
+    assert run_plan(base)["violation"] is None
+
+
+def test_planted_pool_leak_is_caught():
+    from ratelimiter_tpu.chaos.harness import run_plan
+
+    topo = dict(DEFAULT_TOPOLOGY)
+    topo.update(_small_topology())
+    plan = FaultPlan(seed=3, steps=8, topology=topo,
+                     actions=[FaultAction(4, "pool_leak", {"cell": 0})])
+    report = run_plan(plan)
+    v = report["violation"]
+    assert v is not None and v["invariant"] == "conservation"
+
+
+@pytest.mark.slow
+def test_tcp_edge_topology_runs_clean():
+    """The same schedule shape over a REAL wire: sidecar server behind
+    a FaultInjectingProxy, garbage/partition faults included."""
+    from ratelimiter_tpu.chaos.harness import run_plan
+
+    plan = FaultPlan.generate(1, steps=12, fault_rate=0.5,
+                              topology=_small_topology(edge="tcp"))
+    report = run_plan(plan)
+    assert report["violation"] is None
+    assert report["steps_completed"] == 12
+
+
+# ---------------------------------------------------------------------------
+# ProcActor: real processes under signal control — slow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_procactor_sigterm_is_a_graceful_stop():
+    from ratelimiter_tpu.chaos.actors import ProcActor
+
+    actor = ProcActor(["ratelimiter_tpu.replication.hostproc",
+                       "--role", "standby", "--shards", "1",
+                       "--num-slots", "128"])
+    try:
+        ready = actor.spawn(timeout_s=180.0)
+        assert ready.get("ready") and ready.get("role") == "standby"
+        rc = actor.stop_graceful(timeout_s=30.0)
+        assert rc == 0, (
+            f"SIGTERM must drain and exit 0 (the graceful path), "
+            f"got rc={rc}")
+    finally:
+        actor.close()
+
+
+@pytest.mark.slow
+def test_procactor_sigstop_pause_preserves_the_process():
+    """The zombie shape at the process level: SIGSTOP freezes the node
+    (state intact, sockets open), SIGCONT revives it, and the revived
+    process still honors the graceful stop."""
+    import time
+
+    from ratelimiter_tpu.chaos.actors import ProcActor
+
+    actor = ProcActor(["ratelimiter_tpu.replication.hostproc",
+                       "--role", "standby", "--shards", "1",
+                       "--num-slots", "128"])
+    try:
+        actor.spawn(timeout_s=180.0)
+        actor.pause()
+        time.sleep(0.2)
+        assert actor.proc.poll() is None, "SIGSTOP killed the process"
+        actor.resume()
+        time.sleep(0.2)
+        rc = actor.stop_graceful(timeout_s=30.0)
+        assert rc == 0, f"revived process lost the drain path: rc={rc}"
+    finally:
+        actor.close()

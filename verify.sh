@@ -328,6 +328,20 @@ else
   fail=1
 fi
 
+echo "running chaos conductor gate (seeded multi-fault schedules, zero invariant violations)..."
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench/chaos_soak.py \
+    --seeds 3 --assert-invariants > /tmp/_chaos_soak.log 2>&1; then
+  echo "  ok  chaos conductor (oracle bit-identity, lease/pool conservation,"
+  echo "      admission bound, epoch monotonicity, liveness — all held)"
+else
+  echo "  FAILED  chaos conductor (an invariant broke under a seeded fault"
+  echo "          schedule; the minimized replayable artifact path is below —"
+  echo "          re-run it with: python -m ratelimiter_tpu.chaos.replay"
+  echo "          --artifact <path>)"
+  tail -20 /tmp/_chaos_soak.log | sed 's/^/    /'
+  fail=1
+fi
+
 echo "regenerating CAPABILITIES.md test/LoC counts..."
 if python bench/gen_capabilities.py; then
   echo "  ok  capability counts"
@@ -369,6 +383,15 @@ if [[ "${RUN_SLOW:-0}" == "1" ]]; then
     echo "  ok  slow soaks"
   else
     echo "  FAILED  slow soaks"
+    fail=1
+  fi
+  echo "running long chaos soak (RUN_SLOW=1: 6 seeds x 48 steps, both edge topologies)..."
+  if timeout -k 10 1800 env JAX_PLATFORMS=cpu python bench/chaos_soak.py \
+      --seeds 6 --soak --assert-invariants > /tmp/_chaos_soak_slow.log 2>&1; then
+    echo "  ok  chaos soak"
+  else
+    echo "  FAILED  chaos soak (minimized replayable artifact path below)"
+    tail -20 /tmp/_chaos_soak_slow.log | sed 's/^/    /'
     fail=1
   fi
 else
